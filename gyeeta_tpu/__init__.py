@@ -1,0 +1,26 @@
+"""gyeeta_tpu — TPU-native observability aggregation framework.
+
+A brand-new JAX/XLA implementation of the capabilities of Gyeeta
+(https://github.com/Gyeeta/gyeeta): per-host agents stream flow/service/process
+telemetry over a length-prefixed binary wire format; the aggregation tiers
+(reference: madhava ``server/gy_mconnhdlr.cc`` and shyama
+``server/gy_shconnhdlr.cc`` CPU loops) are replaced by device-resident
+streaming-sketch state — Count-Min, HyperLogLog, log-bucketed histograms,
+t-digest, top-K — updated in jitted microbatches and rolled up across a
+``jax.sharding.Mesh`` with XLA collectives (``psum``/``pmax``/``all_to_all``).
+
+Layout:
+    utils/     hashing, time windows, field maps        (ref: common/ L1)
+    sketch/    device sketch kernels + exact CPU refs   (ref: gy_statistics.h)
+    ingest/    wire format, C++ deframer, columnar decode (ref: gy_comm_proto)
+    sim/       synthetic partha agent simulator          (ref: test_multi_partha)
+    engine/    AggState pytree + jitted update step      (ref: MCONN_HANDLER L2)
+    parallel/  mesh, psum roll-ups, all_to_all routing   (ref: SHCONN_HANDLER)
+    semantic/  service/host health classifiers           (ref: get_curr_state)
+    query/     criteria filters + JSON query API         (ref: gy_query_common)
+    alerts/    alert defs, manager, silences/grouping    (ref: gy_alertmgr)
+"""
+
+from gyeeta_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,31 @@
+"""Device-resident streaming sketches — the tensor replacement for the
+reference's CPU sketch tier (``common/gy_statistics.h``:
+``GY_HISTOGRAM``/``TIME_HISTOGRAM``/``BOUNDED_PRIO_QUEUE`` and
+``thirdparty/TimeseriesSlabHistogram``).
+
+Each sketch is a pure-functional module: ``init() -> state`` (a pytree of
+arrays), ``update(state, batch) -> state``, ``merge(a, b) -> state`` (the
+cross-shard roll-up primitive — always expressible as psum/pmax so it rides
+ICI collectives), and ``query(state) -> stats``. Everything is fixed-shape and
+jittable.
+"""
+
+from gyeeta_tpu.sketch import (
+    countmin,
+    exact,
+    hyperloglog,
+    loghist,
+    tdigest,
+    topk,
+    windows,
+)
+
+__all__ = [
+    "countmin",
+    "exact",
+    "hyperloglog",
+    "loghist",
+    "tdigest",
+    "topk",
+    "windows",
+]

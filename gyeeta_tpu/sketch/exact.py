@@ -1,0 +1,38 @@
+"""Exact CPU (numpy) reference implementations for sketch-accuracy tests.
+
+Mirrors the reference's test strategy (SURVEY §4): each device sketch is
+diffed against an exact host computation with explicit error bounds, the way
+``test_histogram.cc``/``test_quantiles.cc`` assert on
+``GY_HISTOGRAM``/``TIME_HISTOGRAM`` outputs.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+
+def distinct(keys_hi: np.ndarray, keys_lo: np.ndarray) -> int:
+    k = (keys_hi.astype(np.uint64) << np.uint64(32)) | keys_lo.astype(np.uint64)
+    return len(np.unique(k))
+
+
+def quantiles(values: np.ndarray, qs) -> np.ndarray:
+    return np.quantile(np.asarray(values, np.float64), qs)
+
+
+def key_totals(keys_hi, keys_lo, values) -> dict:
+    acc = collections.defaultdict(float)
+    keys = (np.asarray(keys_hi, np.uint64) << np.uint64(32)) | np.asarray(
+        keys_lo, np.uint64
+    )
+    for k, v in zip(keys.tolist(), np.asarray(values).tolist()):
+        acc[k] += v
+    return dict(acc)
+
+
+def topk(keys_hi, keys_lo, values, k: int):
+    acc = key_totals(keys_hi, keys_lo, values)
+    items = sorted(acc.items(), key=lambda kv: -kv[1])[:k]
+    return items

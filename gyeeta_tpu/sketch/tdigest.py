@@ -1,0 +1,154 @@
+"""t-digest as fixed-capacity centroid tensors.
+
+The high-accuracy quantile sketch for readback paths (north-star config #1:
+single-stream RTT p50/p95/p99 vs exact). Complements ``loghist`` (the bulk
+per-entity path): t-digest gives sub-percent tail accuracy independent of the
+value range.
+
+Design is the *merging* t-digest (Dunning), but compression uses k-bin
+clustering instead of the sequential greedy pass: sort centroids+samples by
+mean, compute midpoint quantiles q, assign cluster id = floor(k1(q)) with the
+arcsine scale k1(q) = δ/2π·asin(2q−1), and segment-sum into the fixed C slots.
+Everything is fixed-shape (sort + scatter), so it jits, vmaps over entity
+axes, and runs on the VPU — no data-dependent loop like the CPU original.
+
+State merge is concat+recompress → shard roll-up uses gathered concat
+(all_gather of (C,2) tensors is tiny) rather than psum.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TDigest(NamedTuple):
+    means: jnp.ndarray    # (..., C) float32, sorted ascending among occupied
+    weights: jnp.ndarray  # (..., C) float32, 0 = empty slot
+    vmin: jnp.ndarray     # (...,) float32 observed min (inf if empty)
+    vmax: jnp.ndarray     # (...,) float32 observed max (-inf if empty)
+
+
+def init(capacity: int = 128, entities: tuple = ()) -> TDigest:
+    return TDigest(
+        means=jnp.zeros(entities + (capacity,), jnp.float32),
+        weights=jnp.zeros(entities + (capacity,), jnp.float32),
+        vmin=jnp.full(entities, jnp.inf, jnp.float32),
+        vmax=jnp.full(entities, -jnp.inf, jnp.float32),
+    )
+
+
+def _k1(q, delta):
+    # arcsine scale: dense bins at the tails → tail quantile accuracy
+    return (delta / (2.0 * jnp.pi)) * jnp.arcsin(
+        jnp.clip(2.0 * q - 1.0, -1.0, 1.0)
+    )
+
+
+def _compress(means, weights, capacity: int):
+    """Cluster (means, weights) rows into ≤capacity centroids. 1-D inputs."""
+    delta = 2.0 * (capacity - 1)
+    # empty slots sort to the end
+    sort_key = jnp.where(weights > 0, means, jnp.inf)
+    order = jnp.argsort(sort_key)
+    m = means[order]
+    w = weights[order]
+    tot = jnp.sum(w)
+    cum = jnp.cumsum(w)
+    q_mid = (cum - 0.5 * w) / jnp.maximum(tot, 1e-30)
+    k = _k1(q_mid, delta) - _k1(jnp.float32(0.0), delta)
+    cid = jnp.clip(jnp.floor(k).astype(jnp.int32), 0, capacity - 1)
+    cid = jnp.where(w > 0, cid, capacity - 1)
+    new_w = jax.ops.segment_sum(w, cid, num_segments=capacity)
+    new_s = jax.ops.segment_sum(w * m, cid, num_segments=capacity)
+    new_m = jnp.where(new_w > 0, new_s / jnp.maximum(new_w, 1e-30), 0.0)
+    return new_m, new_w
+
+
+def update(sk: TDigest, values, valid=None) -> TDigest:
+    """Fold a batch of unit-weight samples into a (single-entity) digest."""
+    capacity = sk.means.shape[-1]
+    w_in = jnp.ones_like(values, jnp.float32)
+    if valid is not None:
+        w_in = jnp.where(valid, w_in, 0.0)
+    vals = values.astype(jnp.float32)
+    all_m = jnp.concatenate([sk.means, vals])
+    all_w = jnp.concatenate([sk.weights, w_in])
+    new_m, new_w = _compress(all_m, all_w, capacity)
+    vmasked_min = jnp.where(w_in > 0, vals, jnp.inf)
+    vmasked_max = jnp.where(w_in > 0, vals, -jnp.inf)
+    return TDigest(
+        means=new_m,
+        weights=new_w,
+        vmin=jnp.minimum(sk.vmin, vmasked_min.min()),
+        vmax=jnp.maximum(sk.vmax, vmasked_max.max()),
+    )
+
+
+def merge(a: TDigest, b: TDigest) -> TDigest:
+    capacity = a.means.shape[-1]
+    all_m = jnp.concatenate([a.means, b.means], axis=-1)
+    all_w = jnp.concatenate([a.weights, b.weights], axis=-1)
+    if a.means.ndim == 1:
+        new_m, new_w = _compress(all_m, all_w, capacity)
+    else:
+        flat_m = all_m.reshape(-1, all_m.shape[-1])
+        flat_w = all_w.reshape(-1, all_w.shape[-1])
+        new_m, new_w = jax.vmap(_compress, in_axes=(0, 0, None))(
+            flat_m, flat_w, capacity
+        )
+        new_m = new_m.reshape(a.means.shape)
+        new_w = new_w.reshape(a.weights.shape)
+    return TDigest(
+        means=new_m,
+        weights=new_w,
+        vmin=jnp.minimum(a.vmin, b.vmin),
+        vmax=jnp.maximum(a.vmax, b.vmax),
+    )
+
+
+def quantiles(sk: TDigest, qs):
+    """Quantile estimates for a single-entity digest. qs: (Q,) → (Q,)."""
+    qs = jnp.asarray(qs, jnp.float32)
+    w = sk.weights
+    m = sk.means
+    # occupied centroids are already in ascending-mean order except empty
+    # slots (weight 0) interleaved at the tail of value 0 — resort defensively.
+    sort_key = jnp.where(w > 0, m, jnp.inf)
+    order = jnp.argsort(sort_key)
+    m = m[order]
+    w = w[order]
+    tot = jnp.sum(w)
+    cum = jnp.cumsum(w)
+    left = cum - 0.5 * w                      # midpoint mass of each centroid
+    target = qs * tot                         # (Q,)
+    # find the pair of adjacent centroid midpoints bracketing target
+    ge = left[None, :] >= target[:, None]     # (Q, C)
+    hi_idx = jnp.argmax(ge, axis=-1)
+    any_ge = jnp.any(ge, axis=-1)
+    hi_idx = jnp.where(any_ge, hi_idx, m.shape[-1] - 1)
+    lo_idx = jnp.maximum(hi_idx - 1, 0)
+    x0 = left[lo_idx]
+    x1 = left[hi_idx]
+    y0 = m[lo_idx]
+    y1 = m[hi_idx]
+    t = jnp.where(x1 > x0, (target - x0) / jnp.maximum(x1 - x0, 1e-30), 0.0)
+    est = y0 + t * (y1 - y0)
+    # clamp into observed range; below-first-midpoint → interp from vmin
+    below = target < left[0]
+    est = jnp.where(below, sk.vmin + (m[0] - sk.vmin) *
+                    (target / jnp.maximum(left[0], 1e-30)), est)
+    est = jnp.clip(est, sk.vmin, sk.vmax)
+    return jnp.where(tot > 0, est, 0.0)
+
+
+def count(sk: TDigest):
+    return sk.weights.sum(axis=-1)
+
+
+# ---------------------------------------------------------------- numpy ref
+def np_quantiles_exact(values: np.ndarray, qs) -> np.ndarray:
+    return np.quantile(np.asarray(values, np.float64), qs)

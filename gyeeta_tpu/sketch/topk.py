@@ -1,0 +1,105 @@
+"""Top-K heavy hitters over unbounded 64-bit key spaces, as tensors.
+
+Replaces the reference's ``BOUNDED_PRIO_QUEUE`` top-K rankings
+(``common/gy_statistics.h:29``; used for top-CPU/QPS/net listeners,
+``gy_task_handler.cc:655-756``) in the unbounded-key regime (flow tuples,
+remote endpoints). For *dense* tracked entities (service rows) use
+``dense_topk`` — a plain ``lax.top_k`` over the stat column.
+
+Algorithm (Misra-Gries-style truncation, fully vectorized):
+  1. concat candidate table with the microbatch's (key, value) lanes,
+  2. lexicographic sort by (hi, lo) via ``lax.sort`` with num_keys=2,
+  3. segment-sum duplicate keys (boundary detection + segment ids),
+  4. keep the top `capacity` segment totals via ``lax.top_k``.
+Evicted keys lose their history (undercount bound = mass evicted); pair with
+a CMS estimate at query time when exact-ish counts matter.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TopK(NamedTuple):
+    key_hi: jnp.ndarray  # (cap,) uint32
+    key_lo: jnp.ndarray  # (cap,) uint32
+    counts: jnp.ndarray  # (cap,) float32 (0 = empty slot)
+
+
+def init(capacity: int = 256) -> TopK:
+    return TopK(
+        key_hi=jnp.zeros((capacity,), jnp.uint32),
+        key_lo=jnp.zeros((capacity,), jnp.uint32),
+        counts=jnp.zeros((capacity,), jnp.float32),
+    )
+
+
+def _combine(hi, lo, vals, capacity: int) -> TopK:
+    """Sort by key, merge duplicates, keep heaviest ``capacity`` entries."""
+    hi_s, lo_s, v_s = jax.lax.sort((hi, lo, vals), num_keys=2)
+    first = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1]),
+    ])
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    n = hi_s.shape[0]
+    seg_tot = jax.ops.segment_sum(v_s, seg, num_segments=n)
+    # route each segment's total onto its first lane; non-first lanes → 0
+    lane_tot = jnp.where(first, seg_tot[seg], 0.0)
+    top_v, top_i = jax.lax.top_k(lane_tot, capacity)
+    return TopK(key_hi=hi_s[top_i], key_lo=lo_s[top_i], counts=top_v)
+
+
+def update(sk: TopK, key_hi, key_lo, values, valid=None) -> TopK:
+    capacity = sk.counts.shape[0]
+    vals = values.astype(jnp.float32)
+    if valid is not None:
+        vals = jnp.where(valid, vals, 0.0)
+        # invalid lanes get key 0 so they merge into one dead segment
+        key_hi = jnp.where(valid, key_hi, 0)
+        key_lo = jnp.where(valid, key_lo, 0)
+    hi = jnp.concatenate([sk.key_hi, key_hi.astype(jnp.uint32)])
+    lo = jnp.concatenate([sk.key_lo, key_lo.astype(jnp.uint32)])
+    v = jnp.concatenate([sk.counts, vals])
+    return _combine(hi, lo, v, capacity)
+
+
+def merge(a: TopK, b: TopK) -> TopK:
+    capacity = a.counts.shape[0]
+    return _combine(
+        jnp.concatenate([a.key_hi, b.key_hi]),
+        jnp.concatenate([a.key_lo, b.key_lo]),
+        jnp.concatenate([a.counts, b.counts]),
+        capacity,
+    )
+
+
+def query(sk: TopK, k: int):
+    """Return (key_hi, key_lo, counts) of the top k entries (count desc)."""
+    v, i = jax.lax.top_k(sk.counts, k)
+    return sk.key_hi[i], sk.key_lo[i], v
+
+
+def dense_topk(stats, k: int):
+    """Top-k rows of a dense per-entity stat column: (values, row_indices).
+
+    The tensor form of the reference's per-subsystem BOUNDED_PRIO_QUEUE walks
+    (top issue/QPS/net listeners, server/gy_mconnhdlr.cc partha_listener_state).
+    """
+    return jax.lax.top_k(stats, k)
+
+
+# ---------------------------------------------------------------- numpy ref
+def np_exact_topk(keys: np.ndarray, values: np.ndarray, k: int):
+    """Exact top-k: keys int64 array, values float; returns (keys, totals)."""
+    import collections
+    acc = collections.defaultdict(float)
+    for key, v in zip(keys.tolist(), values.tolist()):
+        acc[key] += v
+    items = sorted(acc.items(), key=lambda kv: -kv[1])[:k]
+    return (np.array([key for key, _ in items], dtype=np.int64),
+            np.array([v for _, v in items], dtype=np.float64))

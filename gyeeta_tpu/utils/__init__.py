@@ -1,0 +1,3 @@
+from gyeeta_tpu.utils import hashing
+
+__all__ = ["hashing"]

@@ -1,0 +1,167 @@
+"""Vectorized 32/64-bit hashing for device-side sketch indexing.
+
+The reference hashes flow 5-tuples and entity ids with cityhash/fnv1
+(``common/gy_common_inc.h`` — cityhash; ``common/jhash.h``) on the CPU, one
+key at a time. Here every hash is a vectorized uint32 mix evaluated on-device
+over whole microbatches, because TPUs have no native 64-bit integer ALU path
+worth using: 64-bit keys travel as ``(hi, lo)`` uint32 pairs and all mixing is
+modular uint32 arithmetic (murmur3-style finalizers), which XLA maps directly
+onto the VPU.
+
+Every function has identical semantics in JAX (device) and numpy (host), so
+host-side decoders and tests can reproduce device indices bit-exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Murmur3 / splitmix constants.
+_C1 = 0x85EBCA6B
+_C2 = 0xC2B2AE35
+_GOLDEN = 0x9E3779B9  # 2^32 / phi — per-salt stream separator
+
+
+def _is_np(x) -> bool:
+    return isinstance(x, (np.ndarray, np.generic, int))
+
+
+def fmix32(h):
+    """Murmur3 32-bit finalizer: bijective avalanche mix of a uint32 array.
+
+    Works on either jnp or np uint32 arrays (wrapping multiply).
+    """
+    if _is_np(h):
+        h = np.asarray(h, dtype=np.uint32)
+        with np.errstate(over="ignore"):
+            h = h ^ (h >> np.uint32(16))
+            h = h * np.uint32(_C1)
+            h = h ^ (h >> np.uint32(13))
+            h = h * np.uint32(_C2)
+            h = h ^ (h >> np.uint32(16))
+        return h
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(_C1)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(_C2)
+    h = h ^ (h >> 16)
+    return h
+
+
+def mix64(hi, lo, salt: int = 0):
+    """Mix a 64-bit key given as (hi, lo) uint32 halves into one uint32.
+
+    ``salt`` selects an independent hash stream (e.g. one per Count-Min row).
+    """
+    if _is_np(hi):
+        hi = np.asarray(hi, dtype=np.uint32)
+        lo = np.asarray(lo, dtype=np.uint32)
+        with np.errstate(over="ignore"):
+            s = np.uint32((salt + 1) & 0xFFFFFFFF) * np.uint32(_GOLDEN)
+            h = fmix32(lo ^ s)
+            h = fmix32(hi ^ h ^ np.uint32(salt & 0xFFFFFFFF))
+        return h
+    hi = hi.astype(jnp.uint32)
+    lo = lo.astype(jnp.uint32)
+    s = jnp.uint32(((salt + 1) & 0xFFFFFFFF)) * jnp.uint32(_GOLDEN)
+    h = fmix32(lo ^ s)
+    h = fmix32(hi ^ h ^ jnp.uint32(salt & 0xFFFFFFFF))
+    return h
+
+
+def bucket_index(hi, lo, salt: int, nbuckets: int):
+    """Map a 64-bit key to a bucket in [0, nbuckets) for hash stream ``salt``.
+
+    nbuckets need not be a power of two; uses the high-multiply range trick
+    (Lemire) to avoid modulo bias and the slow integer divide on TPU.
+    """
+    h = mix64(hi, lo, salt)
+    if _is_np(h):
+        return ((h.astype(np.uint64) * np.uint64(nbuckets)) >> np.uint64(32)).astype(
+            np.int32
+        )
+    # TPU path: mulhi32(h, n) via four 16x16 partial products (no 64-bit mul).
+    n = jnp.uint32(nbuckets)
+    a_hi, a_lo = h >> 16, h & jnp.uint32(0xFFFF)
+    b_hi, b_lo = n >> 16, n & jnp.uint32(0xFFFF)
+    lo_lo = a_lo * b_lo
+    t = a_hi * b_lo + (lo_lo >> 16)
+    w1 = (t & jnp.uint32(0xFFFF)) + a_lo * b_hi
+    res = a_hi * b_hi + (t >> 16) + (w1 >> 16)
+    return res.astype(jnp.int32)
+
+
+def leading_zeros32(x):
+    """Count leading zeros of each uint32 (for HyperLogLog rank).
+
+    Returns int32 in [0, 32]. Branch-free binary search, identical on both
+    backends.
+    """
+    if _is_np(x):
+        x = np.asarray(x, dtype=np.uint32)
+        n = np.zeros(x.shape, dtype=np.int32)
+        y = x
+        for shift in (16, 8, 4, 2, 1):
+            mask = y > np.uint32((1 << shift) - 1)
+            n = np.where(mask, n, n + shift)
+            y = np.where(mask, y >> np.uint32(shift), y)
+        return np.where(x == 0, np.int32(32), n).astype(np.int32)
+    x = x.astype(jnp.uint32)
+    n = jnp.zeros(x.shape, dtype=jnp.int32)
+    y = x
+    for shift in (16, 8, 4, 2, 1):
+        mask = y > jnp.uint32((1 << shift) - 1)
+        n = jnp.where(mask, n, n + shift)
+        y = jnp.where(mask, y >> shift, y)
+    return jnp.where(x == 0, jnp.int32(32), n)
+
+
+def flow_key(saddr_hi, saddr_lo, daddr_hi, daddr_lo, sport, dport, proto):
+    """Collapse a flow 5-tuple into a 64-bit (hi, lo) key.
+
+    Reference analogue: ``PAIR_IP_PORT`` hashing in ``common/gy_inet_inc.h``
+    (the 5-tuple flow key of the sketch tier, SURVEY §2.1). All inputs uint32
+    arrays (IPv6 addresses pre-folded to two uint32 words by the decoder).
+    """
+    ports = (sport.astype(jnp.uint32) << 16) | (dport.astype(jnp.uint32) & 0xFFFF) \
+        if not _is_np(sport) else (
+            (np.asarray(sport, np.uint32) << np.uint32(16))
+            | (np.asarray(dport, np.uint32) & np.uint32(0xFFFF)))
+    a = mix64(saddr_hi, saddr_lo, 1)
+    b = mix64(daddr_hi, daddr_lo, 2)
+    if _is_np(a):
+        with np.errstate(over="ignore"):
+            lo = fmix32(a ^ (ports * np.uint32(_C1)))
+            hi = fmix32(b ^ (np.asarray(proto, np.uint32) * np.uint32(_C2)) ^ lo)
+        return hi, lo
+    lo = fmix32(a ^ (ports * jnp.uint32(_C1)))
+    hi = fmix32(b ^ (proto.astype(jnp.uint32) * jnp.uint32(_C2)) ^ lo)
+    return hi, lo
+
+
+def hash_bytes_np(data: bytes, salt: int = 0) -> int:
+    """Host-only: hash arbitrary bytes to a 64-bit int (string interning ids,
+    machine ids — ref: SHA256-derived host id, partha/gypartha.cc:64; we use a
+    fast non-crypto mix since ids are internal)."""
+    h = np.uint32(0x811C9DC5 ^ (salt & 0xFFFFFFFF))
+    g = np.uint32(0x01000193)
+    with np.errstate(over="ignore"):
+        # FNV over 4-byte words, tail handled by padding.
+        pad = (-len(data)) % 4
+        w = np.frombuffer(data + b"\x00" * pad, dtype=np.uint32)
+        h1 = h
+        h2 = h ^ np.uint32(_GOLDEN)
+        for word in w:
+            h1 = (h1 ^ word) * g
+            h2 = fmix32(h2 + word)
+        h1 = fmix32(h1 ^ np.uint32(len(data)))
+        h2 = fmix32(h2 ^ h1)
+    return (int(h2) << 32) | int(h1)
+
+
+def split64(x: int):
+    """Split a python/np 64-bit int into (hi, lo) uint32."""
+    x = int(x) & 0xFFFFFFFFFFFFFFFF
+    return np.uint32(x >> 32), np.uint32(x & 0xFFFFFFFF)

@@ -1,0 +1,8 @@
+"""Version gate (ref: partha/sversion.cc, server/mversion.cc — registration
+version gating per common/gy_comm_proto.h:55-56)."""
+
+__version__ = "0.1.0"
+
+# Minimum wire-format version this build accepts from agents/simulators.
+MIN_WIRE_VERSION = 1
+CURR_WIRE_VERSION = 1

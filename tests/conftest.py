@@ -1,0 +1,23 @@
+"""Test harness config: force an 8-device virtual CPU platform BEFORE jax
+import so sharded tests (shard_map/pjit over a Mesh) run hermetically without
+TPU hardware. Mirrors the reference's strategy of scale-testing the server
+tier on one box (partha/test_multi_partha.sh — N agents, one machine)."""
+
+import os
+
+# Force-override: the driver environment pins JAX_PLATFORMS to the TPU
+# backend; tests must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,107 @@
+"""Hashing parity + quality tests (device path == host path bit-exactly).
+
+Mirrors the reference's container/infra unit binaries (test/Makefile:15-23,
+e.g. test_rcu_hashtable.cc) in pytest form.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gyeeta_tpu.utils import hashing as H
+
+
+def _rand_u32(rng, n):
+    return rng.integers(0, 2**32, size=n, dtype=np.uint32)
+
+
+def test_fmix32_parity(rng):
+    x = _rand_u32(rng, 4096)
+    got_np = H.fmix32(x)
+    got_jax = np.asarray(H.fmix32(jnp.asarray(x)))
+    np.testing.assert_array_equal(got_np, got_jax)
+
+
+def test_fmix32_bijective_sample(rng):
+    # finalizer must not collide on a decent sample (it is bijective)
+    x = rng.choice(2**32, size=100_000, replace=False).astype(np.uint32)
+    y = H.fmix32(x)
+    assert len(np.unique(y)) == len(x)
+
+
+def test_mix64_parity_and_salt_independence(rng):
+    hi, lo = _rand_u32(rng, 4096), _rand_u32(rng, 4096)
+    for salt in (0, 1, 7, 255):
+        got_np = H.mix64(hi, lo, salt)
+        got_jax = np.asarray(H.mix64(jnp.asarray(hi), jnp.asarray(lo), salt))
+        np.testing.assert_array_equal(got_np, got_jax)
+    # different salts must decorrelate
+    a = H.mix64(hi, lo, 0)
+    b = H.mix64(hi, lo, 1)
+    assert (a == b).mean() < 0.01
+
+
+def test_bucket_index_parity_and_range(rng):
+    hi, lo = _rand_u32(rng, 8192), _rand_u32(rng, 8192)
+    for nb in (7, 1024, 65536, 100_003):
+        got_np = H.bucket_index(hi, lo, 3, nb)
+        got_jax = np.asarray(
+            jax.jit(lambda a, b: H.bucket_index(a, b, 3, nb))(
+                jnp.asarray(hi), jnp.asarray(lo)
+            )
+        )
+        np.testing.assert_array_equal(got_np, got_jax)
+        assert got_np.min() >= 0 and got_np.max() < nb
+
+
+def test_bucket_index_uniformity(rng):
+    hi, lo = _rand_u32(rng, 200_000), _rand_u32(rng, 200_000)
+    nb = 256
+    idx = H.bucket_index(hi, lo, 0, nb)
+    counts = np.bincount(idx, minlength=nb)
+    # chi-square-ish sanity: all buckets within 20% of the mean
+    mean = counts.mean()
+    assert counts.min() > 0.8 * mean and counts.max() < 1.2 * mean
+
+
+def test_leading_zeros32_parity_exact(rng):
+    cases = np.array(
+        [0, 1, 2, 3, 0x80000000, 0xFFFFFFFF, 0x00010000, 0x7FFFFFFF],
+        dtype=np.uint32,
+    )
+    expect = np.array([32, 31, 30, 30, 0, 0, 15, 1], dtype=np.int32)
+    np.testing.assert_array_equal(H.leading_zeros32(cases), expect)
+    x = _rand_u32(rng, 4096)
+    np.testing.assert_array_equal(
+        H.leading_zeros32(x), np.asarray(H.leading_zeros32(jnp.asarray(x)))
+    )
+
+
+def test_flow_key_parity(rng):
+    n = 2048
+    cols = {k: _rand_u32(rng, n) for k in
+            ("shi", "slo", "dhi", "dlo")}
+    sport = rng.integers(0, 65536, n).astype(np.uint32)
+    dport = rng.integers(0, 65536, n).astype(np.uint32)
+    proto = rng.integers(0, 2, n).astype(np.uint32) * 11 + 6
+    hi_np, lo_np = H.flow_key(cols["shi"], cols["slo"], cols["dhi"],
+                              cols["dlo"], sport, dport, proto)
+    hi_j, lo_j = H.flow_key(*(jnp.asarray(v) for v in
+                              (cols["shi"], cols["slo"], cols["dhi"],
+                               cols["dlo"], sport, dport, proto)))
+    np.testing.assert_array_equal(hi_np, np.asarray(hi_j))
+    np.testing.assert_array_equal(lo_np, np.asarray(lo_j))
+    # keys must be distinct for distinct tuples (sample check)
+    keys = (hi_np.astype(np.uint64) << np.uint64(32)) | lo_np.astype(np.uint64)
+    assert len(np.unique(keys)) == n
+
+
+def test_hash_bytes_and_split(rng):
+    seen = set()
+    for i in range(1000):
+        h = H.hash_bytes_np(f"service-{i}".encode())
+        seen.add(h)
+    assert len(seen) == 1000
+    hi, lo = H.split64(H.hash_bytes_np(b"abc"))
+    assert (int(hi) << 32) | int(lo) == H.hash_bytes_np(b"abc")
