@@ -1,0 +1,11 @@
+"""Aggregation engine: device-resident entity tables + jitted sketch update.
+
+TPU-native replacement for the madhava in-memory aggregation core
+(``server/gy_mconnhdlr.cc`` L1/L2 loops + RCU entity tables): instead of
+per-event pointer-chasing threads, the engine folds whole columnar
+microbatches into per-entity sketch tensors with one jitted step.
+"""
+
+from gyeeta_tpu.engine import table  # noqa: F401
+from gyeeta_tpu.engine import aggstate  # noqa: F401
+from gyeeta_tpu.engine import step  # noqa: F401

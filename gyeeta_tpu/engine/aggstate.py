@@ -1,0 +1,93 @@
+"""AggState: the full device-resident aggregation state as one pytree.
+
+This is the TPU replacement for a madhava's in-memory model
+(``server/gy_msocket.h`` MTCP_LISTENER/MAGGR_TASK rows + per-listener
+histograms): one keyed entity slab for services, struct-of-arrays sketch
+columns per service, global flow sketches, and a dense per-host stat panel.
+A single jitted step (see ``engine/step.py``) folds whole columnar
+microbatches into this state; queries are pure readbacks (``query/``).
+
+Memory (defaults, f32): per-service loghist windows dominate —
+(S=1024 rows × 256 buckets) × (1 cur + 12 + 24 ring slabs + 2 totals + 1
+alltime) ≈ 40 MB. Scale S/buckets per deployment; HBM is the budget.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from gyeeta_tpu.engine import table
+from gyeeta_tpu.ingest import decode
+from gyeeta_tpu.sketch import countmin, hyperloglog as hll, loghist, \
+    tdigest, topk, windows
+
+# conn-counter columns (windowed, per service)
+CTR_BYTES_SENT = 0
+CTR_BYTES_RCVD = 1
+CTR_NCONN_CLOSED = 2
+CTR_DUR_SUM_US = 3
+NCTR = 4
+
+# host panel columns (canonical order defined by the decode layer)
+from gyeeta_tpu.ingest.decode import (  # noqa: E402,F401
+    HOST_NTASKS, HOST_NTASKS_ISSUE, HOST_NTASKS_SEVERE, HOST_NLISTEN,
+    HOST_NLISTEN_ISSUE, HOST_NLISTEN_SEVERE, HOST_CPU_ISSUE, HOST_MEM_ISSUE,
+    HOST_SEVERE_CPU, HOST_SEVERE_MEM, HOST_STATE, NHOSTCOL,
+)
+
+
+class EngineCfg(NamedTuple):
+    """Static engine geometry (all sizes are compile-time constants)."""
+    svc_capacity: int = 1024          # service slab rows (power of two)
+    n_hosts: int = 64                 # dense host panel rows
+    resp_spec: loghist.LogHistSpec = loghist.LogHistSpec(
+        vmin=1.0, vmax=1e8, nbuckets=256)   # usec: 1us..100s, <2% error
+    levels: tuple = windows.LEVELS_DEFAULT
+    hll_p_svc: int = 10               # per-svc distinct clients (±3.2%)
+    hll_p_global: int = 14            # global distinct endpoints (±0.8%)
+    cms_depth: int = 4
+    cms_width: int = 1 << 16
+    topk_capacity: int = 512
+    td_capacity: int = 64             # per-svc t-digest centroids
+    td_route_cap: int = 64            # per-svc samples folded per step
+    conn_batch: int = 2048            # static microbatch lanes
+    resp_batch: int = 4096
+    listener_batch: int = 512
+
+
+class AggState(NamedTuple):
+    tbl: table.Table                  # service key slab (glob_id → row)
+    resp_win: windows.MultiWindow     # (S, B) resp-time loghist, windowed
+    ctr_win: windows.MultiWindow      # (S, NCTR) conn counters, windowed
+    svc_hll: hll.HLL                  # (S, m) distinct client endpoints
+    svc_td: tdigest.TDigest           # (S, C) per-svc resp digest
+    svc_stats: jnp.ndarray            # (S, NSTAT) last listener-state gauges
+    host_panel: jnp.ndarray           # (H, NHOSTCOL) last host state
+    glob_hll: hll.HLL                 # distinct flow endpoints global
+    cms: countmin.CMS                 # flow-key → bytes
+    flow_topk: topk.TopK              # heavy-hitter flows by bytes
+    n_conn: jnp.ndarray               # () f32 counters
+    n_resp: jnp.ndarray
+    n_td_overflow: jnp.ndarray        # samples that missed the digest path
+
+
+def init(cfg: EngineCfg) -> AggState:
+    S = cfg.svc_capacity
+    B = cfg.resp_spec.nbuckets
+    return AggState(
+        tbl=table.init(S),
+        resp_win=windows.init((S, B), cfg.levels),
+        ctr_win=windows.init((S, NCTR), cfg.levels),
+        svc_hll=hll.init(p=cfg.hll_p_svc, entities=(S,)),
+        svc_td=tdigest.init(capacity=cfg.td_capacity, entities=(S,)),
+        svc_stats=jnp.zeros((S, decode.NSTAT), jnp.float32),
+        host_panel=jnp.zeros((cfg.n_hosts, NHOSTCOL), jnp.float32),
+        glob_hll=hll.init(p=cfg.hll_p_global),
+        cms=countmin.init(cfg.cms_depth, cfg.cms_width),
+        flow_topk=topk.init(cfg.topk_capacity),
+        n_conn=jnp.zeros((), jnp.float32),
+        n_resp=jnp.zeros((), jnp.float32),
+        n_td_overflow=jnp.zeros((), jnp.float32),
+    )

@@ -1,0 +1,125 @@
+"""Jitted microbatch update steps — the hot loop of the framework.
+
+Replaces the reference's per-event handler chain (madhava L1 dispatch →
+L2 ``partha_*`` RCU walks, ``server/gy_mconnhdlr.cc:2521-3490,4700``) with
+four batched tensor folds, each one traced once and fused by XLA:
+
+- ``ingest_conn``   — TCP_CONN flow records → per-svc counters, per-svc
+  distinct-client HLL, global HLL, CMS bytes, heavy-hitter top-K
+  (the ``partha_tcp_conn_info``/``add_tcp_conn_cli`` analogue)
+- ``ingest_resp``   — raw response samples → per-svc windowed loghist +
+  per-svc t-digest (replacing agent-side ``resp_hist_`` updates,
+  ``common/gy_socket_stat.cc:1554``)
+- ``ingest_listener`` / ``ingest_host`` — 5s state sweeps → gauge panels
+  (the ``partha_listener_state`` hot loop, ``gy_mconnhdlr.cc:10993``)
+- ``tick_5s``       — closes the 5s window slab (scheduler cadence,
+  ``common/gy_scheduler.h`` 5s domain)
+
+All functions are pure ``state, batch → state`` and donate-friendly. Batches
+are the columnar pytrees from ``ingest/decode.py`` (device arrays inside
+jit). `fold_step` is the fused flagship step used by bench + __graft_entry__.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gyeeta_tpu.engine import table
+from gyeeta_tpu.engine.aggstate import (
+    AggState, EngineCfg, CTR_BYTES_SENT, CTR_BYTES_RCVD, CTR_NCONN_CLOSED,
+    CTR_DUR_SUM_US,
+)
+from gyeeta_tpu.sketch import countmin, hyperloglog as hll, loghist, \
+    tdigest, topk, windows
+
+
+def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
+    """Fold a ConnBatch. cb fields are (B,) device arrays."""
+    valid = cb.valid
+    tbl, rows = table.upsert(st.tbl, cb.svc_hi, cb.svc_lo, valid)
+    ok = valid & (rows >= 0)
+    rowz = jnp.where(ok, rows, 0)
+    S = cfg.svc_capacity
+
+    # per-svc windowed counters: one scatter-add over (row, ctr) pairs
+    cur = st.ctr_win.cur
+    lanes = jnp.where(ok, rowz, S)  # S = dropped (mode=drop)
+    cur = cur.at[lanes, CTR_BYTES_SENT].add(cb.bytes_sent, mode="drop")
+    cur = cur.at[lanes, CTR_BYTES_RCVD].add(cb.bytes_rcvd, mode="drop")
+    cur = cur.at[lanes, CTR_NCONN_CLOSED].add(
+        cb.is_close.astype(jnp.float32), mode="drop")
+    cur = cur.at[lanes, CTR_DUR_SUM_US].add(cb.duration_us, mode="drop")
+    ctr_win = st.ctr_win._replace(cur=cur)
+
+    svc_hll = hll.update_entities(st.svc_hll, rowz, cb.cli_hi, cb.cli_lo,
+                                  valid=ok)
+    glob_hll = hll.update(st.glob_hll, cb.flow_hi, cb.flow_lo, valid=valid)
+    tot_bytes = cb.bytes_sent + cb.bytes_rcvd
+    cms = countmin.update(st.cms, cb.flow_hi, cb.flow_lo, tot_bytes,
+                          valid=valid)
+    flow_topk = topk.update(st.flow_topk, cb.flow_hi, cb.flow_lo, tot_bytes,
+                            valid=valid)
+    return st._replace(
+        tbl=tbl, ctr_win=ctr_win, svc_hll=svc_hll, glob_hll=glob_hll,
+        cms=cms, flow_topk=flow_topk,
+        n_conn=st.n_conn + jnp.sum(valid).astype(jnp.float32),
+    )
+
+
+def ingest_resp(cfg: EngineCfg, st: AggState, rb) -> AggState:
+    """Fold a RespBatch of raw (glob_id, resp_us) samples."""
+    valid = rb.valid
+    tbl, rows = table.upsert(st.tbl, rb.svc_hi, rb.svc_lo, valid)
+    ok = valid & (rows >= 0)
+    rowz = jnp.where(ok, rows, 0)
+    cur = loghist.update_entities(
+        st.resp_win.cur, cfg.resp_spec, rowz, rb.resp_us, valid=ok)
+    resp_win = st.resp_win._replace(cur=cur)
+    svc_td, n_over = tdigest.update_routed(
+        st.svc_td, jnp.where(ok, rows, -1), rb.resp_us,
+        route_cap=cfg.td_route_cap)
+    return st._replace(
+        tbl=tbl, resp_win=resp_win, svc_td=svc_td,
+        n_resp=st.n_resp + jnp.sum(valid).astype(jnp.float32),
+        n_td_overflow=st.n_td_overflow + n_over.astype(jnp.float32),
+    )
+
+
+def ingest_listener(cfg: EngineCfg, st: AggState, lb) -> AggState:
+    """Fold a ListenerBatch: store last-reported gauges per service row."""
+    valid = lb.valid
+    tbl, rows = table.upsert(st.tbl, lb.svc_hi, lb.svc_lo, valid)
+    ok = valid & (rows >= 0)
+    lanes = jnp.where(ok, rows, cfg.svc_capacity)
+    svc_stats = st.svc_stats.at[lanes].set(lb.stats, mode="drop")
+    return st._replace(tbl=tbl, svc_stats=svc_stats)
+
+
+def ingest_host(cfg: EngineCfg, st: AggState, hb) -> AggState:
+    """Fold a HostBatch (decode.host_batch): dense panel write by host_id."""
+    hid = jnp.where(hb.valid, hb.host_id, cfg.n_hosts)
+    panel = st.host_panel.at[hid].set(
+        hb.panel.astype(jnp.float32), mode="drop")
+    return st._replace(host_panel=panel)
+
+
+def tick_5s(cfg: EngineCfg, st: AggState) -> AggState:
+    """Close the 5s base slab on all windowed state."""
+    return st._replace(
+        resp_win=windows.tick(st.resp_win, cfg.levels),
+        ctr_win=windows.tick(st.ctr_win, cfg.levels),
+    )
+
+
+def fold_step(cfg: EngineCfg, st: AggState, cb, rb) -> AggState:
+    """The flagship fused step: one conn batch + one resp batch."""
+    st = ingest_conn(cfg, st, cb)
+    st = ingest_resp(cfg, st, rb)
+    return st
+
+
+def jit_fold_step(cfg: EngineCfg):
+    """Compiled fold_step with state donation (in-place HBM update)."""
+    return jax.jit(
+        lambda st, cb, rb: fold_step(cfg, st, cb, rb), donate_argnums=(0,))

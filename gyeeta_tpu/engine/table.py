@@ -1,0 +1,211 @@
+"""Device-resident keyed entity table (the RCU-hash-table replacement).
+
+The reference keeps every keyed entity (listener by ``glob_id_``, task by
+``aggr_task_id_``, conn by tuple hash) in liburcu lock-free hash tables
+(``common/gy_rcu_inc.h:1664`` ``RCU_HASH_TABLE``), mutated one pointer at a
+time by many threads. On TPU the equivalent is a fixed-capacity open-addressing
+hash slab living in HBM:
+
+- keys are 64-bit ids carried as ``(hi, lo)`` uint32 pairs (TPUs have no
+  useful 64-bit integer path),
+- lookup/insert is a *batched* vectorized probe: every lane of a microbatch
+  resolves its row in ``PROBES`` unrolled gather/scatter rounds,
+- per-entity state lives in separate ``(capacity, ...)`` column tensors
+  indexed by the returned row ids (struct-of-arrays),
+- delete writes a tombstone key; ``compact`` rebuilds the slab and permutes
+  the state columns (the analogue of RCU grace-period reclamation
+  (``gy_rcu_inc.h:487``) without any host round-trip).
+
+Intra-batch insert races (two lanes claiming the same empty slot) are resolved
+deterministically with a scatter-min "winner lane" pass, so the same batch
+always produces the same table — a property the threaded original cannot give.
+
+Everything is fixed-shape and branch-free → jits, shards (each mesh shard owns
+an independent slab), and runs entirely on the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gyeeta_tpu.utils import hashing as H
+
+# Key sentinels. Real ids of ~0 are astronomically unlikely (ids are hashes);
+# colliding with one merely loses that id, never corrupts others.
+EMPTY = np.uint32(0xFFFFFFFF)
+TOMB = np.uint32(0xFFFFFFFE)
+
+PROBES = 8  # unrolled double-hash probe rounds
+
+
+class Table(NamedTuple):
+    key_hi: jnp.ndarray   # (S,) uint32
+    key_lo: jnp.ndarray   # (S,) uint32
+    n_live: jnp.ndarray   # () int32 — live keys
+    n_tomb: jnp.ndarray   # () int32 — tombstones awaiting compaction
+    n_drop: jnp.ndarray   # () int32 — inserts dropped (probe exhaustion)
+
+
+def init(capacity: int) -> Table:
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+    return Table(
+        key_hi=jnp.full((capacity,), EMPTY, jnp.uint32),
+        key_lo=jnp.full((capacity,), EMPTY, jnp.uint32),
+        n_live=jnp.zeros((), jnp.int32),
+        n_tomb=jnp.zeros((), jnp.int32),
+        n_drop=jnp.zeros((), jnp.int32),
+    )
+
+
+def _probe_slots(khi, klo, capacity: int):
+    """(B, PROBES) candidate slots via double hashing (odd step)."""
+    h1 = H.mix64(khi, klo, 0x7AB1E5)
+    h2 = H.mix64(khi, klo, 0x57E9) | jnp.uint32(1)
+    p = jnp.arange(PROBES, dtype=jnp.uint32)
+    slots = (h1[:, None] + p[None, :] * h2[:, None]) & jnp.uint32(capacity - 1)
+    return slots.astype(jnp.int32)
+
+
+def _is_empty(hi, lo):
+    return (hi == EMPTY) & (lo == EMPTY)
+
+
+def _is_tomb(hi, lo):
+    return (hi == TOMB) & (lo == TOMB)
+
+
+def upsert(tbl: Table, khi, klo, valid=None):
+    """Resolve (or insert) a batch of keys → (new_table, rows).
+
+    rows: (B,) int32 — slab row per lane, or -1 for invalid lanes and for
+    inserts dropped after probe exhaustion (counted in ``n_drop``).
+    """
+    capacity = tbl.key_hi.shape[0]
+    khi = khi.astype(jnp.uint32)
+    klo = klo.astype(jnp.uint32)
+    B = khi.shape[0]
+    if valid is None:
+        valid = jnp.ones((B,), bool)
+    # never insert sentinel-valued keys
+    valid = valid & ~_is_empty(khi, klo) & ~_is_tomb(khi, klo)
+    lane = jnp.arange(B, dtype=jnp.int32)
+    slots = _probe_slots(khi, klo, capacity)            # (B, P)
+    rows = jnp.full((B,), -1, jnp.int32)
+    key_hi, key_lo = tbl.key_hi, tbl.key_lo
+    inserted = jnp.zeros((), jnp.int32)
+
+    def match_rows(key_hi, key_lo, rows):
+        cur_hi = key_hi[slots]
+        cur_lo = key_lo[slots]
+        m = (cur_hi == khi[:, None]) & (cur_lo == klo[:, None])   # (B, P)
+        pos = jnp.argmax(m, axis=1)
+        found = jnp.any(m, axis=1) & valid
+        mrow = slots[lane, pos]
+        return jnp.where((rows < 0) & found, mrow, rows)
+
+    for _ in range(PROBES):
+        rows = match_rows(key_hi, key_lo, rows)
+        unresolved = valid & (rows < 0)
+        cur_hi = key_hi[slots]
+        cur_lo = key_lo[slots]
+        claimable = _is_empty(cur_hi, cur_lo) | _is_tomb(cur_hi, cur_lo)
+        has_claim = jnp.any(claimable, axis=1)
+        pos = jnp.argmax(claimable, axis=1)
+        target = slots[lane, pos]
+        want = unresolved & has_claim
+        # deterministic winner per contested slot: lowest lane index
+        winner = jnp.full((capacity,), B, jnp.int32)
+        winner = winner.at[jnp.where(want, target, capacity)].min(
+            lane, mode="drop")
+        win = want & (winner[target] == lane)
+        wtarget = jnp.where(win, target, capacity)
+        was_tomb = _is_tomb(key_hi[target], key_lo[target])
+        key_hi = key_hi.at[wtarget].set(khi, mode="drop")
+        key_lo = key_lo.at[wtarget].set(klo, mode="drop")
+        rows = jnp.where(win, target, rows)
+        inserted = inserted + jnp.sum(win).astype(jnp.int32)
+        tomb_reclaimed = jnp.sum(win & was_tomb).astype(jnp.int32)
+        tbl = tbl._replace(n_tomb=tbl.n_tomb - tomb_reclaimed)
+    # duplicates of a round-(P-1) winner resolve in this final pass
+    rows = match_rows(key_hi, key_lo, rows)
+    dropped = jnp.sum(valid & (rows < 0)).astype(jnp.int32)
+    new_tbl = Table(
+        key_hi=key_hi,
+        key_lo=key_lo,
+        n_live=tbl.n_live + inserted,
+        n_tomb=tbl.n_tomb,
+        n_drop=tbl.n_drop + dropped,
+    )
+    return new_tbl, rows
+
+
+def lookup(tbl: Table, khi, klo, valid=None):
+    """Find rows for a batch of keys without inserting. -1 = absent."""
+    capacity = tbl.key_hi.shape[0]
+    khi = khi.astype(jnp.uint32)
+    klo = klo.astype(jnp.uint32)
+    B = khi.shape[0]
+    if valid is None:
+        valid = jnp.ones((B,), bool)
+    slots = _probe_slots(khi, klo, capacity)
+    cur_hi = tbl.key_hi[slots]
+    cur_lo = tbl.key_lo[slots]
+    m = (cur_hi == khi[:, None]) & (cur_lo == klo[:, None])
+    pos = jnp.argmax(m, axis=1)
+    found = jnp.any(m, axis=1) & valid
+    rows = slots[jnp.arange(B), pos]
+    return jnp.where(found, rows, -1)
+
+
+def delete(tbl: Table, khi, klo, valid=None):
+    """Tombstone a batch of keys → (new_table, rows_deleted).
+
+    Callers must clear state columns at the returned rows (>=0). The row
+    stays unusable until ``compact`` or until an insert reclaims the
+    tombstone.
+    """
+    rows = lookup(tbl, khi, klo, valid)
+    tgt = jnp.where(rows >= 0, rows, tbl.key_hi.shape[0])
+    key_hi = tbl.key_hi.at[tgt].set(TOMB, mode="drop")
+    key_lo = tbl.key_lo.at[tgt].set(TOMB, mode="drop")
+    ndel = jnp.sum(rows >= 0).astype(jnp.int32)
+    return Table(
+        key_hi=key_hi,
+        key_lo=key_lo,
+        n_live=tbl.n_live - ndel,
+        n_tomb=tbl.n_tomb + ndel,
+        n_drop=tbl.n_drop,
+    ), rows
+
+
+def live_mask(tbl: Table):
+    return ~_is_empty(tbl.key_hi, tbl.key_lo) & \
+        ~_is_tomb(tbl.key_hi, tbl.key_lo)
+
+
+def compact(tbl: Table, state_cols):
+    """Rebuild the slab without tombstones; permute state columns to match.
+
+    state_cols: pytree of ``(S, ...)`` arrays indexed by row. Returns
+    (new_table, new_state_cols). Deleted rows' state is zeroed. Runs fully
+    on device (jit-able): the analogue of an RCU grace-period sweep.
+    """
+    capacity = tbl.key_hi.shape[0]
+    live = live_mask(tbl)
+    fresh = init(capacity)
+    new_tbl, new_rows = upsert(fresh, tbl.key_hi, tbl.key_lo, valid=live)
+
+    def permute(col):
+        out = jnp.zeros_like(col)
+        tgt = jnp.where(new_rows >= 0, new_rows, capacity)
+        return out.at[tgt].set(
+            jnp.where(
+                live.reshape((-1,) + (1,) * (col.ndim - 1)), col,
+                jnp.zeros_like(col)),
+            mode="drop")
+
+    return new_tbl, jax.tree_util.tree_map(permute, state_cols)

@@ -1,0 +1,11 @@
+"""Ingest tier: wire framing, record decode, columnar microbatch packing.
+
+The serialization boundary of the framework (SURVEY §2.4): agents stream
+length-prefixed little-endian binary event batches; the ingest tier deframes,
+decodes to structured arrays, and packs fixed-shape columnar microbatches for
+the jitted device engine. A C++ fast path lives in ``ingest/native``; the
+numpy path here is the reference implementation and test oracle.
+"""
+
+from gyeeta_tpu.ingest import wire  # noqa: F401
+from gyeeta_tpu.ingest import decode  # noqa: F401

@@ -1,0 +1,228 @@
+"""Structured wire records → fixed-shape columnar microbatches.
+
+The device engine consumes only fixed-width numeric columns with a static
+batch size (XLA: one traced shape). This module converts decoded record
+arrays (``wire.decode_frames``) into padded column dicts:
+
+- 64-bit ids are split into ``(hi, lo)`` uint32 pairs (TPU int path),
+- IPs are folded to two uint32 words (xor-fold of the 16 bytes — enough for
+  hashing/HLL identity, the only device use of addresses),
+- flow 5-tuple → 64-bit flow key via ``hashing.flow_key`` (host-side numpy,
+  bit-identical to the device version),
+- a ``valid`` lane mask marks padding.
+
+This mirrors what the reference's L1 threads do (validate + batch into
+DB_WRITE_ARR, ``server/gy_mconnhdlr.cc:2430-2520``) — but produces tensors,
+not pointer arrays. The C++ fast path (ingest/native) emits the identical
+layout.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.utils import hashing as H
+
+
+def split_u64(a) -> tuple[np.ndarray, np.ndarray]:
+    a = np.asarray(a, np.uint64)
+    return ((a >> np.uint64(32)).astype(np.uint32),
+            (a & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def fold_ip(ip_bytes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(N,16) uint8 → two uint32 words (xor-fold halves)."""
+    w = ip_bytes.reshape(-1, 4, 4).copy().view("<u4").reshape(-1, 4)
+    return (w[:, 0] ^ w[:, 2]).astype(np.uint32), \
+        (w[:, 1] ^ w[:, 3]).astype(np.uint32)
+
+
+class ConnBatch(NamedTuple):
+    """Columnar TCP_CONN microbatch (all shape (B,))."""
+    svc_hi: np.ndarray        # ser_glob_id split — per-service routing key
+    svc_lo: np.ndarray
+    flow_hi: np.ndarray       # 5-tuple flow key
+    flow_lo: np.ndarray
+    cli_hi: np.ndarray        # client endpoint identity (HLL distinct-cli)
+    cli_lo: np.ndarray
+    cli_task_hi: np.ndarray   # client process-group id
+    cli_task_lo: np.ndarray
+    bytes_sent: np.ndarray    # float32
+    bytes_rcvd: np.ndarray    # float32
+    duration_us: np.ndarray   # float32 (0 if still open)
+    host_id: np.ndarray       # int32 source agent
+    is_close: np.ndarray      # bool — close-notification record
+    valid: np.ndarray         # bool lane mask
+
+
+class RespBatch(NamedTuple):
+    svc_hi: np.ndarray
+    svc_lo: np.ndarray
+    resp_us: np.ndarray       # float32 response/service time in usec
+    host_id: np.ndarray
+    valid: np.ndarray
+
+
+class ListenerBatch(NamedTuple):
+    """Columnar LISTENER_STATE microbatch: key + packed stat columns."""
+    svc_hi: np.ndarray
+    svc_lo: np.ndarray
+    stats: np.ndarray         # (B, NSTAT) float32, see STAT_* indices
+    host_id: np.ndarray
+    valid: np.ndarray
+
+
+class HostBatch(NamedTuple):
+    """Columnar HOST_STATE microbatch (dense panel write by host_id)."""
+    host_id: np.ndarray       # int32
+    panel: np.ndarray         # (B, NHOSTCOL) float32, aggstate.HOST_* order
+    valid: np.ndarray
+
+
+# stat column indices of ListenerBatch.stats
+STAT_NQRYS = 0
+STAT_TOTAL_RESP_MS = 1
+STAT_NCONNS = 2
+STAT_NCONNS_ACTIVE = 3
+STAT_NTASKS = 4
+STAT_KB_IN = 5
+STAT_KB_OUT = 6
+STAT_SER_ERRORS = 7
+STAT_CLI_ERRORS = 8
+STAT_TASKS_DELAY_US = 9
+STAT_TASKS_CPUDELAY_US = 10
+STAT_TASKS_BLKIODELAY_US = 11
+STAT_USER_CPU = 12
+STAT_SYS_CPU = 13
+STAT_RSS_MB = 14
+STAT_NTASKS_ISSUE = 15
+NSTAT = 16
+
+# host panel column indices of HostBatch.panel (and AggState.host_panel)
+HOST_NTASKS = 0
+HOST_NTASKS_ISSUE = 1
+HOST_NTASKS_SEVERE = 2
+HOST_NLISTEN = 3
+HOST_NLISTEN_ISSUE = 4
+HOST_NLISTEN_SEVERE = 5
+HOST_CPU_ISSUE = 6
+HOST_MEM_ISSUE = 7
+HOST_SEVERE_CPU = 8
+HOST_SEVERE_MEM = 9
+HOST_STATE = 10
+NHOSTCOL = 11
+
+_HOST_PANEL_FIELDS = (
+    "ntasks", "ntasks_issue", "ntasks_severe", "nlisten", "nlisten_issue",
+    "nlisten_severe", "cpu_issue", "mem_issue", "severe_cpu_issue",
+    "severe_mem_issue", "curr_state",
+)
+
+_LISTENER_STAT_FIELDS = (
+    "nqrys_5s", "total_resp_5sec", "nconns", "nconns_active", "ntasks",
+    "curr_kbytes_inbound", "curr_kbytes_outbound", "ser_errors",
+    "cli_errors", "tasks_delay_usec", "tasks_cpudelay_usec",
+    "tasks_blkiodelay_usec", "tasks_user_cpu", "tasks_sys_cpu",
+    "tasks_rss_mb", "ntasks_issue",
+)
+
+
+def _pad(a: np.ndarray, size: int, fill=0):
+    out = np.full((size,) + a.shape[1:], fill, a.dtype)
+    out[: len(a)] = a[:size]
+    return out
+
+
+def _check_fit(recs, size):
+    """Batch builders never truncate silently: oversize input is a caller
+    bug (wire.decode_frames already enforces per-type caps on the wire)."""
+    if len(recs) > size:
+        raise ValueError(
+            f"{len(recs)} records exceed batch size {size}; split upstream")
+    return len(recs)
+
+
+def conn_batch(recs: np.ndarray, size: int = wire.MAX_CONNS_PER_BATCH
+               ) -> ConnBatch:
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    svc_hi, svc_lo = split_u64(r["ser_glob_id"])
+    cip_hi, cip_lo = fold_ip(r["cli"]["ip"])
+    sip_hi, sip_lo = fold_ip(r["ser"]["ip"])
+    proto = np.full(n, 6, np.uint32)  # TCP
+    f_hi, f_lo = H.flow_key(cip_hi, cip_lo, sip_hi, sip_lo,
+                            r["cli"]["port"].astype(np.uint32),
+                            r["ser"]["port"].astype(np.uint32), proto)
+    # client endpoint identity = address hash only (distinct clients)
+    c_hi = H.fmix32(cip_hi ^ np.uint32(0xC11E57))
+    c_lo = H.fmix32(cip_lo ^ c_hi)
+    t_hi, t_lo = split_u64(r["cli_task_aggr_id"])
+    closed = r["tusec_close"] > 0
+    dur = np.where(closed, r["tusec_close"] - r["tusec_start"],
+                   0).astype(np.float32)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return ConnBatch(
+        svc_hi=_pad(svc_hi, size), svc_lo=_pad(svc_lo, size),
+        flow_hi=_pad(f_hi, size), flow_lo=_pad(f_lo, size),
+        cli_hi=_pad(c_hi, size), cli_lo=_pad(c_lo, size),
+        cli_task_hi=_pad(t_hi, size), cli_task_lo=_pad(t_lo, size),
+        bytes_sent=_pad(r["bytes_sent"].astype(np.float32), size),
+        bytes_rcvd=_pad(r["bytes_rcvd"].astype(np.float32), size),
+        duration_us=_pad(dur, size),
+        host_id=_pad(r["host_id"].astype(np.int32), size),
+        is_close=_pad(closed, size),
+        valid=valid,
+    )
+
+
+def resp_batch(recs: np.ndarray, size: int = wire.MAX_RESP_PER_BATCH
+               ) -> RespBatch:
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    svc_hi, svc_lo = split_u64(r["glob_id"])
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return RespBatch(
+        svc_hi=_pad(svc_hi, size), svc_lo=_pad(svc_lo, size),
+        resp_us=_pad(r["resp_usec"].astype(np.float32), size),
+        host_id=_pad(r["host_id"].astype(np.int32), size),
+        valid=valid,
+    )
+
+
+def listener_batch(recs: np.ndarray,
+                   size: int = wire.MAX_LISTENERS_PER_BATCH
+                   ) -> ListenerBatch:
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    svc_hi, svc_lo = split_u64(r["glob_id"])
+    stats = np.zeros((n, NSTAT), np.float32)
+    for i, f in enumerate(_LISTENER_STAT_FIELDS):
+        stats[:, i] = r[f].astype(np.float32)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return ListenerBatch(
+        svc_hi=_pad(svc_hi, size), svc_lo=_pad(svc_lo, size),
+        stats=_pad(stats, size),
+        host_id=_pad(r["host_id"].astype(np.int32), size),
+        valid=valid,
+    )
+
+
+def host_batch(recs: np.ndarray, size: int = 4096) -> HostBatch:
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    panel = np.zeros((n, NHOSTCOL), np.float32)
+    for i, f in enumerate(_HOST_PANEL_FIELDS):
+        panel[:, i] = r[f].astype(np.float32)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return HostBatch(
+        host_id=_pad(r["host_id"].astype(np.int32), size),
+        panel=_pad(panel, size),
+        valid=valid,
+    )

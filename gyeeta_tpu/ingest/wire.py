@@ -1,0 +1,247 @@
+"""GYT wire format: COMM_HEADER-compatible framing + typed event records.
+
+Field-for-field equivalent of the reference protocol's framing and hot event
+structs (``common/gy_comm_proto.h``): ``COMM_HEADER`` (:336 — magic/total_sz/
+data_type/padding, 8-byte aligned, 16MB cap), ``EVENT_NOTIFY`` (:486 —
+subtype + nevents), ``TCP_CONN_NOTIFY`` (:1665, ≤2048/batch),
+``LISTENER_STATE_NOTIFY`` (:2183, ≤512/batch), ``HOST_STATE_NOTIFY`` (:2289).
+
+Differences from the reference (deliberate, TPU-first):
+- records are **fixed width** (no trailing cmdline/issue strings — strings are
+  interned host-side to 64-bit ids before serialization), so a whole batch
+  decodes with one ``np.frombuffer`` and converts to device columns with zero
+  per-record Python;
+- ``RESP_SAMPLE`` is new: the reference aggregates response times into
+  CPU histograms *inside the agent* (``common/gy_socket_stat.h`` resp_hist_);
+  our agents forward raw duty-cycle-sampled (glob_id, resp_usec) pairs and the
+  device does all sketching — that is the point of the TPU tier;
+- IP addresses travel as 16 raw bytes (IPv4-mapped for v4) + port, the
+  field content of ``IP_PORT`` (``common/gy_inet_inc.h``).
+
+Layouts are explicit little-endian numpy structured dtypes; every struct is
+8-byte aligned by construction (itemsize % 8 == 0), mirroring the reference's
+``alignas(8)`` + explicit padding discipline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# ---------------------------------------------------------------- constants
+MAGIC_PM = 0x47590001   # partha-equivalent agent -> aggregation tier
+MAGIC_MS = 0x47590002   # aggregation tier -> global tier
+MAGIC_NQ = 0x47590003   # query client (node webserver analogue)
+
+MAX_COMM_DATA_SZ = 16 * 1024 * 1024   # 16MB frame cap (gy_comm_proto.h:31)
+
+# COMM_TYPE (header data_type_)
+COMM_EVENT_NOTIFY = 1
+COMM_QUERY_CMD = 2
+COMM_QUERY_RESP = 3
+
+# NOTIFY_TYPE (EVENT_NOTIFY subtype_)
+NOTIFY_TCP_CONN = 10          # flow close/open records
+NOTIFY_LISTENER_STATE = 11    # 5s per-service state
+NOTIFY_HOST_STATE = 12        # 5s per-host rollup
+NOTIFY_RESP_SAMPLE = 13       # raw response-time samples (TPU-first)
+NOTIFY_AGGR_TASK_STATE = 14   # 5s per-process-group state
+NOTIFY_CPU_MEM_STATE = 15     # 2s host cpu/mem state
+
+MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
+MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
+MAX_RESP_PER_BATCH = 4096
+
+HEADER_DT = np.dtype([
+    ("magic", "<u4"),
+    ("total_sz", "<u4"),      # header + notify + payload, 8-aligned
+    ("data_type", "<u4"),
+    ("padding_sz", "<u4"),
+])
+
+EVENT_NOTIFY_DT = np.dtype([
+    ("subtype", "<u4"),
+    ("nevents", "<u4"),
+])
+
+IP_PORT_DT = np.dtype([
+    ("ip", "u1", (16,)),      # IPv6 bytes; IPv4 mapped ::ffff:a.b.c.d
+    ("port", "<u2"),
+    ("pad", "u1", (6,)),
+])
+
+# TCP_CONN record — field-for-field vs gy_comm_proto.h:1665, strings interned.
+TCP_CONN_DT = np.dtype([
+    ("cli", IP_PORT_DT),
+    ("ser", IP_PORT_DT),
+    ("nat_cli", IP_PORT_DT),
+    ("nat_ser", IP_PORT_DT),
+    ("tusec_start", "<u8"),
+    ("tusec_close", "<u8"),
+    ("cli_task_aggr_id", "<u8"),
+    ("cli_related_listen_id", "<u8"),
+    ("cli_madhava_id", "<u8"),
+    ("peer_machine_id_hi", "<u8"),
+    ("peer_machine_id_lo", "<u8"),
+    ("ser_related_listen_id", "<u8"),
+    ("ser_glob_id", "<u8"),
+    ("ser_madhava_id", "<u8"),
+    ("bytes_sent", "<u8"),     # client perspective
+    ("bytes_rcvd", "<u8"),
+    ("cli_pid", "<i4"),
+    ("ser_pid", "<i4"),
+    ("ser_conn_hash", "<u4"),
+    ("ser_sock_inode", "<u4"),
+    ("cli_comm_id", "<u8"),    # interned comm string (ref: cli_comm_[16])
+    ("ser_comm_id", "<u8"),
+    ("cli_cmdline_id", "<u8"),  # interned cmdline (ref: trailing string)
+    ("host_id", "<u4"),        # source agent index (shard routing key)
+    ("flags", "<u4"),          # bit0 connect, bit1 accept, bit2 loopback,
+                               # bit3 pre-existing, bit4 notified-before
+])
+
+# LISTENER_STATE record — field-for-field vs gy_comm_proto.h:2183.
+LISTENER_STATE_DT = np.dtype([
+    ("glob_id", "<u8"),
+    ("nqrys_5s", "<u4"),
+    ("total_resp_5sec", "<u4"),
+    ("nconns", "<u4"),
+    ("nconns_active", "<u4"),
+    ("ntasks", "<u4"),
+    ("p95_5s_resp_ms", "<u4"),
+    ("p95_5min_resp_ms", "<u4"),
+    ("curr_kbytes_inbound", "<u4"),
+    ("curr_kbytes_outbound", "<u4"),
+    ("ser_errors", "<u4"),
+    ("cli_errors", "<u4"),
+    ("tasks_delay_usec", "<u4"),
+    ("tasks_cpudelay_usec", "<u4"),
+    ("tasks_blkiodelay_usec", "<u4"),
+    ("tasks_user_cpu", "<u4"),
+    ("tasks_sys_cpu", "<u4"),
+    ("tasks_rss_mb", "<u4"),
+    ("ntasks_issue", "<u2"),
+    ("is_http_svc", "u1"),
+    ("curr_state", "u1"),
+    ("curr_issue", "u1"),
+    ("issue_bit_hist", "u1"),
+    ("high_resp_bit_hist", "u1"),
+    ("last_issue_subsrc", "u1"),
+    ("query_flags", "<u4"),
+    ("host_id", "<u4"),
+    ("pad", "u1", (4,)),
+    ("issue_string_id", "<u8"),  # interned (ref: trailing issue_string_)
+])
+
+# HOST_STATE record — field-for-field vs gy_comm_proto.h:2289.
+HOST_STATE_DT = np.dtype([
+    ("curr_time_usec", "<u8"),
+    ("ntasks_issue", "<u4"),
+    ("ntasks_severe", "<u4"),
+    ("ntasks", "<u4"),
+    ("nlisten_issue", "<u4"),
+    ("nlisten_severe", "<u4"),
+    ("nlisten", "<u4"),
+    ("curr_state", "u1"),
+    ("issue_bit_hist", "u1"),
+    ("cpu_issue", "u1"),
+    ("mem_issue", "u1"),
+    ("severe_cpu_issue", "u1"),
+    ("severe_mem_issue", "u1"),
+    ("pad", "u1", (2,)),
+    ("host_id", "<u4"),
+    ("pad2", "u1", (4,)),
+])
+
+# RESP_SAMPLE — TPU-first raw response-time sample (see module docstring).
+RESP_SAMPLE_DT = np.dtype([
+    ("glob_id", "<u8"),
+    ("resp_usec", "<u4"),
+    ("host_id", "<u4"),
+])
+
+DTYPE_OF_SUBTYPE = {
+    NOTIFY_TCP_CONN: TCP_CONN_DT,
+    NOTIFY_LISTENER_STATE: LISTENER_STATE_DT,
+    NOTIFY_HOST_STATE: HOST_STATE_DT,
+    NOTIFY_RESP_SAMPLE: RESP_SAMPLE_DT,
+}
+
+# per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
+# validate() checks, gy_comm_proto.h:1711,2222)
+MAX_OF_SUBTYPE = {
+    NOTIFY_TCP_CONN: MAX_CONNS_PER_BATCH,
+    NOTIFY_LISTENER_STATE: MAX_LISTENERS_PER_BATCH,
+    NOTIFY_HOST_STATE: 4096,
+    NOTIFY_RESP_SAMPLE: MAX_RESP_PER_BATCH,
+}
+
+for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
+                   ("TCP_CONN_DT", TCP_CONN_DT),
+                   ("LISTENER_STATE_DT", LISTENER_STATE_DT),
+                   ("HOST_STATE_DT", HOST_STATE_DT),
+                   ("RESP_SAMPLE_DT", RESP_SAMPLE_DT)]:
+    assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
+
+
+def encode_frame(subtype: int, records: np.ndarray,
+                 magic: int = MAGIC_PM) -> bytes:
+    """Frame a structured record array as COMM_HEADER+EVENT_NOTIFY+payload."""
+    payload = records.tobytes()
+    total = HEADER_DT.itemsize + EVENT_NOTIFY_DT.itemsize + len(payload)
+    assert total < MAX_COMM_DATA_SZ, "frame exceeds 16MB cap"
+    hdr = np.zeros((), HEADER_DT)
+    hdr["magic"] = magic
+    hdr["total_sz"] = total          # records are 8-aligned → no padding
+    hdr["data_type"] = COMM_EVENT_NOTIFY
+    hdr["padding_sz"] = 0
+    ev = np.zeros((), EVENT_NOTIFY_DT)
+    ev["subtype"] = subtype
+    ev["nevents"] = len(records)
+    return hdr.tobytes() + ev.tobytes() + payload
+
+
+class FrameError(ValueError):
+    pass
+
+
+def decode_frames(buf: bytes):
+    """Parse a byte stream of frames → list of (subtype, structured array).
+
+    Returns (frames, bytes_consumed): a trailing partial frame is left for
+    the caller to resume with more bytes — the batched analogue of the
+    partial-read resume in the reference's epoll conntrack
+    (``common/gy_epoll_conntrack.h``).
+    """
+    frames = []
+    off = 0
+    n = len(buf)
+    hsz = HEADER_DT.itemsize
+    esz = EVENT_NOTIFY_DT.itemsize
+    while off + hsz <= n:
+        hdr = np.frombuffer(buf, HEADER_DT, count=1, offset=off)[0]
+        if hdr["magic"] not in (MAGIC_PM, MAGIC_MS, MAGIC_NQ):
+            raise FrameError(f"bad magic {hdr['magic']:#x} at {off}")
+        total = int(hdr["total_sz"])
+        if total < hsz + esz or total >= MAX_COMM_DATA_SZ:
+            raise FrameError(f"bad total_sz {total} at {off}")
+        if off + total > n:
+            break  # partial frame
+        if hdr["data_type"] == COMM_EVENT_NOTIFY:
+            ev = np.frombuffer(buf, EVENT_NOTIFY_DT, 1, off + hsz)[0]
+            subtype = int(ev["subtype"])
+            nev = int(ev["nevents"])
+            dt = DTYPE_OF_SUBTYPE.get(subtype)
+            if dt is not None:
+                if nev > MAX_OF_SUBTYPE[subtype]:
+                    raise FrameError(
+                        f"nevents {nev} > cap {MAX_OF_SUBTYPE[subtype]} "
+                        f"for subtype {subtype} at {off}")
+                need = hsz + esz + nev * dt.itemsize
+                if need > total:
+                    raise FrameError(
+                        f"nevents {nev} overflows frame at {off}")
+                recs = np.frombuffer(buf, dt, nev, off + hsz + esz)
+                frames.append((subtype, recs))
+            # unknown subtypes skipped (forward compat, ref version gates)
+        off += total
+    return frames, off

@@ -1,0 +1,9 @@
+"""Query tier: device readbacks, criteria filters, field maps, JSON.
+
+The analogue of the madhava web-query engine (``server/gy_mnodehandle.cc``
+``web_query_*`` triads + ``common/gy_query_criteria.h`` filters): pointintime
+queries are pure device readbacks of sketch state; filters compile to boolean
+masks over readback columns; output is Gyeeta-shaped JSON.
+"""
+
+from gyeeta_tpu.query import readback  # noqa: F401

@@ -1,0 +1,113 @@
+"""Point-in-time readbacks of live AggState (the ``web_curr_*`` analogue).
+
+Each snapshot function is a single jitted device computation returning a
+dense column dict over service rows (or hosts / flows); the host then
+filters/serializes. This is the freshness-critical path of the north star
+(<1s p99 query freshness): no DB, no RCU walk — a readback of sketch
+tensors (ref: live-path triads ``server/gy_mnodehandle.cc:798``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gyeeta_tpu.engine import table
+from gyeeta_tpu.engine.aggstate import (
+    AggState, EngineCfg, CTR_BYTES_SENT, CTR_BYTES_RCVD, CTR_NCONN_CLOSED,
+    CTR_DUR_SUM_US,
+)
+from gyeeta_tpu.sketch import hyperloglog as hll, loghist, tdigest, topk, \
+    windows
+
+DEFAULT_QS = (0.25, 0.5, 0.95, 0.99)
+
+
+@partial(jax.jit, static_argnums=(0, 2))
+def svc_snapshot(cfg: EngineCfg, st: AggState, level: int = 0):
+    """Per-service live snapshot at a window level (0=5min, 1=5d, 2=all).
+
+    Returns dense (S,) columns; row validity in ``live``. Quantiles from the
+    windowed loghist (the bulk path); all-time digest quantiles alongside
+    (the high-accuracy path).
+    """
+    live = table.live_mask(st.tbl)
+    resp_hist = windows.read(st.resp_win, level)
+    ctr = windows.read(st.ctr_win, level)
+    qs = jnp.asarray(DEFAULT_QS, jnp.float32)
+    resp_q_us = loghist.quantiles(resp_hist, cfg.resp_spec, qs)
+    td_q_us = tdigest.quantiles_entities(st.svc_td, qs)
+    nresp = loghist.counts_total(resp_hist)
+    if level < len(cfg.levels):
+        lv = cfg.levels[level] if level >= 0 else None
+        span_sec = jnp.float32(
+            5.0 if lv is None else lv.stride_ticks * lv.nslots * 5.0)
+    else:
+        # all-time: elapsed base ticks × 5 s (dynamic, min one tick)
+        span_sec = jnp.maximum(st.resp_win.tick.astype(jnp.float32), 1.0) * 5.0
+    return {
+        "glob_id_hi": st.tbl.key_hi,
+        "glob_id_lo": st.tbl.key_lo,
+        "live": live,
+        "nresp": nresp,
+        "qps": nresp / span_sec,
+        "resp_p25_us": resp_q_us[:, 0],
+        "resp_p50_us": resp_q_us[:, 1],
+        "resp_p95_us": resp_q_us[:, 2],
+        "resp_p99_us": resp_q_us[:, 3],
+        "td_p50_us": td_q_us[:, 1],
+        "td_p95_us": td_q_us[:, 2],
+        "td_p99_us": td_q_us[:, 3],
+        "bytes_sent": ctr[:, CTR_BYTES_SENT],
+        "bytes_rcvd": ctr[:, CTR_BYTES_RCVD],
+        "nconn_closed": ctr[:, CTR_NCONN_CLOSED],
+        "mean_conn_dur_us": ctr[:, CTR_DUR_SUM_US]
+        / jnp.maximum(ctr[:, CTR_NCONN_CLOSED], 1.0),
+        "distinct_clients": hll.estimate(st.svc_hll),
+        "stats": st.svc_stats,
+    }
+
+
+@partial(jax.jit, static_argnums=(0, 2))
+def flow_snapshot(cfg: EngineCfg, st: AggState, k: int = 64):
+    """Heavy-hitter flows by bytes + global distinct-endpoint estimate."""
+    f_hi, f_lo, f_bytes = topk.query(st.flow_topk, k)
+    return {
+        "flow_hi": f_hi,
+        "flow_lo": f_lo,
+        "flow_bytes": f_bytes,
+        "evicted_bytes": st.flow_topk.evicted,
+        "distinct_flows": hll.estimate(st.glob_hll),
+        "total_bytes": countmin.total(st.cms),
+    }
+
+
+@partial(jax.jit, static_argnums=(0,))
+def host_snapshot(cfg: EngineCfg, st: AggState):
+    return {"panel": st.host_panel}
+
+
+def svc_rows_to_host(cfg: EngineCfg, snap: dict) -> list[dict]:
+    """Device snapshot → list of per-service dicts (live rows only).
+
+    One device→host transfer per column (hoisted), then pure-python row
+    assembly — this is on the <1s-freshness query path.
+    """
+    host = {k: np.asarray(v) for k, v in snap.items()}
+    live = host["live"]
+    idx = np.nonzero(live)[0]
+    gid = (host["glob_id_hi"].astype(np.uint64) << np.uint64(32)) \
+        | host["glob_id_lo"].astype(np.uint64)
+    scalar_cols = [k for k, v in host.items()
+                   if k not in ("glob_id_hi", "glob_id_lo", "live", "stats")
+                   and v.ndim == 1]
+    out = []
+    for i in idx:
+        row = {"glob_id": int(gid[i])}
+        for k in scalar_cols:
+            row[k] = float(host[k][i])
+        out.append(row)
+    return out

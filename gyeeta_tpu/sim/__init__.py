@@ -1,0 +1,11 @@
+"""Synthetic agent firehose (simulator tier).
+
+Replaces live partha agents with a deterministic, vectorized generator of
+wire-format event batches — the analogue of the reference's multi-agent
+scale harness (``partha/test_multi_partha.sh`` — N synthetic agent ids on one
+box) and pcap replay (``partha/gy_pseudo_pcap_cap.cc``), but generating the
+event-struct stream directly (``partha/gy_ebpf_kernel_struct.h:209-325``
+record vocabulary) so benchmarks and tests are reproducible without kernels.
+"""
+
+from gyeeta_tpu.sim.partha import ParthaSim  # noqa: F401

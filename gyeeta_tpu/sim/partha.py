@@ -1,0 +1,178 @@
+"""Deterministic multi-agent event generator.
+
+Models a fleet of ``n_hosts`` agents, each exposing ``n_svcs`` listening
+services (glob_ids) and a population of client endpoints. Emits the three
+hot record streams of the reference protocol (SURVEY §3.2, §3.3):
+
+- TCP_CONN close notifications (flow records, zipf-heavy flow keys —
+  ref ``TCP_CONN_NOTIFY`` ``common/gy_comm_proto.h:1665``),
+- raw response-time samples (lognormal per-service latency with per-service
+  scale — the duty-cycled eBPF response stream,
+  ref ``partha/gy_ebpf_kernel_struct.h`` tcp_ipv4_resp_event_t),
+- 5s LISTENER_STATE / HOST_STATE summaries (ref :2183, :2289).
+
+All draws are vectorized numpy with a fixed seed: the same (seed, sequence of
+calls) produces bit-identical streams — the replayable fixture style of the
+reference's test strategy (SURVEY §4), minus the kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gyeeta_tpu.ingest import wire
+
+
+class ParthaSim:
+    def __init__(self, n_hosts: int = 64, n_svcs: int = 16,
+                 n_clients: int = 4096, seed: int = 42,
+                 zipf_a: float = 1.3):
+        self.n_hosts = n_hosts
+        self.n_svcs = n_svcs
+        self.n_clients = n_clients
+        self.rng = np.random.default_rng(seed)
+        self.zipf_a = zipf_a
+        # stable 64-bit glob_ids per (host, svc): mixed so ids look like the
+        # reference's hashed listener ids, not small integers
+        hs = np.arange(n_hosts, dtype=np.uint64)[:, None]
+        sv = np.arange(n_svcs, dtype=np.uint64)[None, :]
+        raw = (hs << np.uint64(32)) | (sv + np.uint64(1))
+        self.glob_ids = _splitmix64(raw)                    # (H, S)
+        # per-service latency scale: log-spaced 200us..50ms across services
+        scales = np.geomspace(200.0, 50_000.0, n_svcs)
+        self.svc_latency_us = np.tile(scales, (n_hosts, 1))  # (H, S)
+        # client IPv4 pool per host (10.x.y.z)
+        self.cli_ips = self.rng.integers(
+            0x0A000000, 0x0AFFFFFF, size=(n_clients,), dtype=np.uint32)
+        self.tusec = np.uint64(1_700_000_000_000_000)
+
+    # ------------------------------------------------------------ streams
+    def resp_records(self, n: int) -> np.ndarray:
+        """n response-time samples across all hosts/services."""
+        r = self.rng
+        host = r.integers(0, self.n_hosts, n)
+        svc = r.integers(0, self.n_svcs, n)
+        scale = self.svc_latency_us[host, svc]
+        lat = r.lognormal(mean=0.0, sigma=0.7, size=n) * scale
+        out = np.zeros(n, wire.RESP_SAMPLE_DT)
+        out["glob_id"] = self.glob_ids[host, svc]
+        out["resp_usec"] = np.minimum(lat, 4e9).astype(np.uint32)
+        out["host_id"] = host.astype(np.uint32)
+        return out
+
+    def conn_records(self, n: int) -> np.ndarray:
+        """n TCP_CONN close notifications with zipf-heavy flow keys."""
+        r = self.rng
+        host = r.integers(0, self.n_hosts, n)
+        svc = r.integers(0, self.n_svcs, n)
+        # zipf rank → client index: few clients dominate (heavy hitters)
+        rank = r.zipf(self.zipf_a, n)
+        cli = (rank - 1) % self.n_clients
+        cli_ip = self.cli_ips[cli]
+        sport = (20000 + (rank % 20000)).astype(np.uint16)
+        out = np.zeros(n, wire.TCP_CONN_DT)
+        _put_ipv4(out["cli"], cli_ip, sport)
+        ser_ip = (0xC0A80000 | (host.astype(np.uint32) & 0xFFFF))
+        _put_ipv4(out["ser"], ser_ip.astype(np.uint32),
+                  (8000 + svc).astype(np.uint16))
+        dur = (r.lognormal(1.0, 1.0, n) * 50_000).astype(np.uint64)
+        out["tusec_start"] = self.tusec
+        out["tusec_close"] = self.tusec + dur
+        out["cli_task_aggr_id"] = _splitmix64(
+            cli.astype(np.uint64) + np.uint64(0xABCD))
+        out["ser_glob_id"] = self.glob_ids[host, svc]
+        out["ser_related_listen_id"] = out["ser_glob_id"]
+        nbytes = (r.pareto(1.5, n) + 1.0) * 2000.0
+        out["bytes_sent"] = np.minimum(nbytes, 2**40).astype(np.uint64)
+        out["bytes_rcvd"] = np.minimum(nbytes * 9.0, 2**40).astype(np.uint64)
+        out["cli_pid"] = cli.astype(np.int32) + 1000
+        out["ser_pid"] = svc.astype(np.int32) + 300
+        out["host_id"] = host.astype(np.uint32)
+        out["flags"] = 1  # connect-observed
+        self.tusec += np.uint64(5_000_000)
+        return out
+
+    def listener_state_records(self) -> np.ndarray:
+        """One 5s LISTENER_STATE sweep over every (host, svc)."""
+        r = self.rng
+        n = self.n_hosts * self.n_svcs
+        host = np.repeat(np.arange(self.n_hosts, dtype=np.uint32),
+                         self.n_svcs)
+        out = np.zeros(n, wire.LISTENER_STATE_DT)
+        out["glob_id"] = self.glob_ids.reshape(-1)
+        qps = r.poisson(200, n)
+        out["nqrys_5s"] = qps
+        out["total_resp_5sec"] = (
+            qps * self.svc_latency_us.reshape(-1) / 1000.0).astype(np.uint32)
+        out["nconns"] = r.poisson(50, n)
+        out["nconns_active"] = np.minimum(out["nconns"], r.poisson(20, n))
+        out["ntasks"] = 1 + r.integers(0, 4, n)
+        out["p95_5s_resp_ms"] = (
+            self.svc_latency_us.reshape(-1) * 2.5 / 1000.0).astype(np.uint32)
+        out["curr_kbytes_inbound"] = r.poisson(500, n)
+        out["curr_kbytes_outbound"] = r.poisson(4000, n)
+        out["ser_errors"] = (r.random(n) < 0.02) * r.poisson(3, n)
+        out["tasks_delay_usec"] = r.poisson(100, n)
+        out["host_id"] = host
+        return out
+
+    def host_state_records(self) -> np.ndarray:
+        r = self.rng
+        n = self.n_hosts
+        out = np.zeros(n, wire.HOST_STATE_DT)
+        out["curr_time_usec"] = self.tusec
+        out["ntasks"] = 100 + r.integers(0, 50, n)
+        out["ntasks_issue"] = (r.random(n) < 0.1) * r.integers(1, 5, n)
+        out["nlisten"] = self.n_svcs
+        out["nlisten_issue"] = (r.random(n) < 0.1) * r.integers(1, 3, n)
+        out["cpu_issue"] = r.random(n) < 0.05
+        out["mem_issue"] = r.random(n) < 0.03
+        out["host_id"] = np.arange(n, dtype=np.uint32)
+        return out
+
+    # --------------------------------------------------------------- wire
+    def conn_frames(self, n_events: int) -> bytes:
+        """n_events conn records framed into ≤2048-record messages."""
+        recs = self.conn_records(n_events)
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_TCP_CONN,
+                              recs[i:i + wire.MAX_CONNS_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_CONNS_PER_BATCH))
+
+    def resp_frames(self, n_events: int) -> bytes:
+        recs = self.resp_records(n_events)
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_RESP_SAMPLE,
+                              recs[i:i + wire.MAX_RESP_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_RESP_PER_BATCH))
+
+    def listener_frames(self) -> bytes:
+        recs = self.listener_state_records()
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_LISTENER_STATE,
+                              recs[i:i + wire.MAX_LISTENERS_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_LISTENERS_PER_BATCH))
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    x = np.asarray(x, np.uint64)
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        z = x
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+    return z
+
+
+def _put_ipv4(ip_port_view: np.ndarray, ipv4: np.ndarray,
+              port: np.ndarray) -> None:
+    """Write IPv4-mapped addresses (::ffff:a.b.c.d) + port into IP_PORT."""
+    ip = ip_port_view["ip"]
+    ip[:, 10] = 0xFF
+    ip[:, 11] = 0xFF
+    ip[:, 12] = (ipv4 >> 24).astype(np.uint8)
+    ip[:, 13] = ((ipv4 >> 16) & 0xFF).astype(np.uint8)
+    ip[:, 14] = ((ipv4 >> 8) & 0xFF).astype(np.uint8)
+    ip[:, 15] = (ipv4 & 0xFF).astype(np.uint8)
+    ip_port_view["port"] = port

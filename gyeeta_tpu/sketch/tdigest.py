@@ -88,6 +88,54 @@ def update(sk: TDigest, values, valid=None) -> TDigest:
     )
 
 
+def update_routed(sk: TDigest, rows, values, valid=None, route_cap: int = 128):
+    """Per-entity batched update: fold B samples into S per-entity digests.
+
+    ``sk`` has entity shape (S, C); ``rows``: (B,) int32 target entity row
+    (<0 = drop); ``values``: (B,) float32. Samples are routed into a dense
+    (S, route_cap) staging tensor (sort by row + position-in-segment
+    scatter), then every entity recompresses centroids+samples in one vmapped
+    pass. Fixed-shape → jits; per-entity per-step overflow beyond
+    ``route_cap`` is dropped and returned as a count (callers keep the
+    loghist path as the lossless-count estimator; north-star configs #3/#5
+    need 1k+ per-service digests — this is that path).
+
+    Returns (new_digest, n_overflow).
+    """
+    S, C = sk.means.shape
+    B = rows.shape[0]
+    vals = values.astype(jnp.float32)
+    ok = rows >= 0
+    if valid is not None:
+        ok = ok & valid
+    rows_ok = jnp.where(ok, rows, S)            # S = drop lane
+    order = jnp.argsort(rows_ok)
+    r_s = rows_ok[order]
+    v_s = vals[order]
+    lane = jnp.arange(B, dtype=jnp.int32)
+    first = jnp.concatenate([jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(first, lane, 0))
+    pos = lane - seg_start                      # position within entity
+    keep = (r_s < S) & (pos < route_cap)
+    n_overflow = jnp.sum((r_s < S) & (pos >= route_cap)).astype(jnp.int32)
+    tgt_row = jnp.where(keep, r_s, S)
+    tgt_pos = jnp.where(keep, pos, 0)
+    stage_v = jnp.zeros((S + 1, route_cap), jnp.float32)
+    stage_w = jnp.zeros((S + 1, route_cap), jnp.float32)
+    stage_v = stage_v.at[tgt_row, tgt_pos].set(v_s, mode="drop")
+    stage_w = stage_w.at[tgt_row, tgt_pos].set(
+        jnp.where(keep, 1.0, 0.0), mode="drop")
+    all_m = jnp.concatenate([sk.means, stage_v[:S]], axis=-1)
+    all_w = jnp.concatenate([sk.weights, stage_w[:S]], axis=-1)
+    new_m, new_w = jax.vmap(_compress, in_axes=(0, 0, None))(all_m, all_w, C)
+    vmin = sk.vmin.at[tgt_row].min(
+        jnp.where(keep, v_s, jnp.inf), mode="drop")
+    vmax = sk.vmax.at[tgt_row].max(
+        jnp.where(keep, v_s, -jnp.inf), mode="drop")
+    return TDigest(means=new_m, weights=new_w, vmin=vmin, vmax=vmax), \
+        n_overflow
+
+
 def merge(a: TDigest, b: TDigest) -> TDigest:
     capacity = a.means.shape[-1]
     all_m = jnp.concatenate([a.means, b.means], axis=-1)
@@ -121,6 +169,12 @@ def quantiles(sk: TDigest, qs):
     order = jnp.argsort(sort_key)
     m = m[order]
     w = w[order]
+    # Empty slots sort to the tail with weight 0 and mean 0; their midpoint
+    # mass equals the total, so a tail quantile whose target exceeds the last
+    # occupied centroid's midpoint would otherwise interpolate toward 0.
+    # Substitute vmax so that region interpolates last-midpoint → observed max
+    # (mirror of the `below` branch toward vmin).
+    m = jnp.where(w > 0, m, sk.vmax)
     tot = jnp.sum(w)
     cum = jnp.cumsum(w)
     left = cum - 0.5 * w                      # midpoint mass of each centroid
@@ -143,6 +197,13 @@ def quantiles(sk: TDigest, qs):
                     (target / jnp.maximum(left[0], 1e-30)), est)
     est = jnp.clip(est, sk.vmin, sk.vmax)
     return jnp.where(tot > 0, est, 0.0)
+
+
+def quantiles_entities(sk: TDigest, qs):
+    """Vmapped quantiles over a (S, C) entity-axis digest → (S, Q)."""
+    return jax.vmap(
+        lambda m, w, vn, vx: quantiles(TDigest(m, w, vn, vx), qs),
+        in_axes=(0, 0, 0, 0))(sk.means, sk.weights, sk.vmin, sk.vmax)
 
 
 def count(sk: TDigest):

@@ -24,21 +24,30 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Reserved sentinel marking empty/invalid slots. A real key of all-ones is
+# astronomically unlikely for hashed flow keys (and merely loses one slot if
+# it occurs); a real all-zero key is NOT special, unlike the previous design.
+SENTINEL = jnp.uint32(0xFFFFFFFF)
+
+
 class TopK(NamedTuple):
-    key_hi: jnp.ndarray  # (cap,) uint32
-    key_lo: jnp.ndarray  # (cap,) uint32
-    counts: jnp.ndarray  # (cap,) float32 (0 = empty slot)
+    key_hi: jnp.ndarray   # (cap,) uint32 (SENTINEL = empty slot)
+    key_lo: jnp.ndarray   # (cap,) uint32
+    counts: jnp.ndarray   # (cap,) float32 (<=0 with SENTINEL key = empty)
+    evicted: jnp.ndarray  # () float32 — total mass dropped by truncation;
+    #                        per-key undercount is bounded by this.
 
 
 def init(capacity: int = 256) -> TopK:
     return TopK(
-        key_hi=jnp.zeros((capacity,), jnp.uint32),
-        key_lo=jnp.zeros((capacity,), jnp.uint32),
+        key_hi=jnp.full((capacity,), SENTINEL, jnp.uint32),
+        key_lo=jnp.full((capacity,), SENTINEL, jnp.uint32),
         counts=jnp.zeros((capacity,), jnp.float32),
+        evicted=jnp.zeros((), jnp.float32),
     )
 
 
-def _combine(hi, lo, vals, capacity: int) -> TopK:
+def _combine(hi, lo, vals, capacity: int, evicted) -> TopK:
     """Sort by key, merge duplicates, keep heaviest ``capacity`` entries."""
     hi_s, lo_s, v_s = jax.lax.sort((hi, lo, vals), num_keys=2)
     first = jnp.concatenate([
@@ -48,24 +57,41 @@ def _combine(hi, lo, vals, capacity: int) -> TopK:
     seg = jnp.cumsum(first.astype(jnp.int32)) - 1
     n = hi_s.shape[0]
     seg_tot = jax.ops.segment_sum(v_s, seg, num_segments=n)
-    # route each segment's total onto its first lane; non-first lanes → 0
+    # route each segment's total onto its first lane; non-first lanes get 0
+    # mass AND sentinel keys, so top_k can never surface a duplicate key.
     lane_tot = jnp.where(first, seg_tot[seg], 0.0)
+    sentinel_lane = (hi_s == SENTINEL) & (lo_s == SENTINEL)
+    lane_tot = jnp.where(sentinel_lane, 0.0, lane_tot)
+    keep_key = first & ~sentinel_lane
+    hi_k = jnp.where(keep_key, hi_s, SENTINEL)
+    lo_k = jnp.where(keep_key, lo_s, SENTINEL)
     top_v, top_i = jax.lax.top_k(lane_tot, capacity)
-    return TopK(key_hi=hi_s[top_i], key_lo=lo_s[top_i], counts=top_v)
+    out_hi = hi_k[top_i]
+    out_lo = lo_k[top_i]
+    # slots that got a zero-mass lane are empty → sentinel them explicitly
+    empty = top_v <= 0.0
+    out_hi = jnp.where(empty, SENTINEL, out_hi)
+    out_lo = jnp.where(empty, SENTINEL, out_lo)
+    out_v = jnp.where(empty, 0.0, top_v)
+    new_evicted = evicted + (jnp.sum(lane_tot) - jnp.sum(out_v))
+    return TopK(key_hi=out_hi, key_lo=out_lo, counts=out_v,
+                evicted=new_evicted)
 
 
 def update(sk: TopK, key_hi, key_lo, values, valid=None) -> TopK:
     capacity = sk.counts.shape[0]
     vals = values.astype(jnp.float32)
+    key_hi = key_hi.astype(jnp.uint32)
+    key_lo = key_lo.astype(jnp.uint32)
     if valid is not None:
         vals = jnp.where(valid, vals, 0.0)
-        # invalid lanes get key 0 so they merge into one dead segment
-        key_hi = jnp.where(valid, key_hi, 0)
-        key_lo = jnp.where(valid, key_lo, 0)
-    hi = jnp.concatenate([sk.key_hi, key_hi.astype(jnp.uint32)])
-    lo = jnp.concatenate([sk.key_lo, key_lo.astype(jnp.uint32)])
+        # invalid lanes get the sentinel key → merged into the dead segment
+        key_hi = jnp.where(valid, key_hi, SENTINEL)
+        key_lo = jnp.where(valid, key_lo, SENTINEL)
+    hi = jnp.concatenate([sk.key_hi, key_hi])
+    lo = jnp.concatenate([sk.key_lo, key_lo])
     v = jnp.concatenate([sk.counts, vals])
-    return _combine(hi, lo, v, capacity)
+    return _combine(hi, lo, v, capacity, sk.evicted)
 
 
 def merge(a: TopK, b: TopK) -> TopK:
@@ -75,11 +101,16 @@ def merge(a: TopK, b: TopK) -> TopK:
         jnp.concatenate([a.key_lo, b.key_lo]),
         jnp.concatenate([a.counts, b.counts]),
         capacity,
+        a.evicted + b.evicted,
     )
 
 
 def query(sk: TopK, k: int):
-    """Return (key_hi, key_lo, counts) of the top k entries (count desc)."""
+    """Return (key_hi, key_lo, counts) of the top k entries (count desc).
+
+    Slots with SENTINEL keys / zero counts are empty; callers should filter
+    ``counts > 0``. ``sk.evicted`` bounds the per-key undercount.
+    """
     v, i = jax.lax.top_k(sk.counts, k)
     return sk.key_hi[i], sk.key_lo[i], v
 

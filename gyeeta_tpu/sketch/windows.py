@@ -36,18 +36,14 @@ class WindowSpec(NamedTuple):
         return self.stride_ticks * self.nslots
 
 
-# 5s base tick; 5min = 60 ticks (12 slabs of 25s); 5day = 86400 ticks
-# (24 slabs of 1h). "all" is a plain accumulator, handled separately.
-LEVELS_5S_5MIN_5DAYS: tuple[WindowSpec, ...] = (
-    WindowSpec(stride_ticks=5, nslots=12),      # 5 min, 25 s resolution
-    WindowSpec(stride_ticks=3600, nslots=24),   # 1 day×5 ≈ 5d? no: 24h ring
-)
-# NOTE: 5-day coverage needs stride 18000 (25h) × 24; we pick 1-day ring for
-# HBM economy and document the deviation; the historical path (Postgres tier)
-# serves longer horizons, as in the reference (SURVEY §2.7 Postgres row).
+# 5s base tick; "all" is a plain accumulator, handled separately. Coverage of
+# a level oscillates in [span - stride + 1, span] base ticks: right after a
+# stride boundary the just-expired sub-slab's stride-1 older ticks are gone.
+# 5 min = 60 ticks; 5 days = 86400 ticks (matching Level_5s_5min_5days_all,
+# common/gy_statistics.h:1545).
 LEVELS_DEFAULT: tuple[WindowSpec, ...] = (
-    WindowSpec(stride_ticks=5, nslots=12),      # 5 min
-    WindowSpec(stride_ticks=18000, nslots=24),  # 5 days, 25 h resolution
+    WindowSpec(stride_ticks=5, nslots=12),     # 5 min span, 25 s resolution
+    WindowSpec(stride_ticks=3600, nslots=24),  # 5 day span, 5 h resolution
 )
 
 
@@ -93,11 +89,13 @@ def tick(win: MultiWindow, levels: Sequence[WindowSpec] = LEVELS_DEFAULT
     for lv, ring, total in zip(levels, win.rings, win.totals):
         slot = (t // lv.stride_ticks) % lv.nslots
         boundary = (t % lv.stride_ticks) == 0
-        # at a stride boundary the slab at `slot` expires: subtract + clear
-        expired = jnp.where(boundary, ring[slot], jnp.zeros_like(win.cur))
+        # at a stride boundary the slab at `slot` expires and is replaced
         ring = ring.at[slot].set(
             jnp.where(boundary, win.cur, ring[slot] + win.cur))
-        total = total - expired + win.cur
+        # resync the rolling total from the ring at each boundary: float32
+        # add/subtract drift would otherwise accumulate over the 5-day
+        # level's 86,400 ticks (ADVICE r1). Off-boundary: cheap increment.
+        total = jnp.where(boundary, ring.sum(axis=0), total + win.cur)
         new_rings.append(ring)
         new_totals.append(total)
     return MultiWindow(
@@ -139,17 +137,48 @@ class NpMultiWindow:
             return self.cur
         if level < len(self.levels):
             lv = self.levels[level]
-            # the device ring covers: slabs since the oldest *unexpired*
-            # sub-slab boundary — between span and span+stride slabs.
-            n = len(self.slabs)
-            t = n  # current tick index
-            # replicate device semantics exactly:
+            # the device ring covers the slabs since the oldest *unexpired*
+            # sub-slab boundary — coverage oscillates in
+            # [span - stride + 1, span] base ticks (dips right after a
+            # stride boundary expires a whole sub-slab at once).
+            if not self.slabs:
+                return self.cur.copy()
+            # the ring's content is fixed by the LAST processed tick index:
+            # slab i survives iff its slot wasn't overwritten since, i.e.
+            # (t_last//stride - i//stride) < nslots  (replay reference).
+            t_last = len(self.slabs) - 1
             keep = np.zeros_like(self.cur)
             for i, s in enumerate(self.slabs):
-                slot_of_i = (i // lv.stride_ticks) % lv.nslots
-                # slab i is retained iff its slot hasn't been overwritten:
-                age_strides = (t // lv.stride_ticks) - (i // lv.stride_ticks)
-                if age_strides < lv.nslots:
+                age = (t_last // lv.stride_ticks) - (i // lv.stride_ticks)
+                if age < lv.nslots:
                     keep = keep + s
             return keep + self.cur
         return sum(self.slabs, np.zeros_like(self.cur)) + self.cur
+
+
+class NpTrueSlidingWindow:
+    """Independent oracle: an exact trailing-span sliding window.
+
+    Unlike ``NpMultiWindow`` (which replays device ring semantics), this is
+    the spec-level answer: the sum of exactly the last ``span_ticks`` closed
+    base slabs plus the open one. Device reads must match it within ±stride
+    base ticks of slab mass (tests assert bracketing between the true sums
+    over span-stride and span ticks).
+    """
+
+    def __init__(self, shape, levels=LEVELS_DEFAULT):
+        self.levels = levels
+        self.slabs = []
+        self.cur = np.zeros(shape, np.float64)
+
+    def add(self, delta):
+        self.cur = self.cur + delta
+
+    def tick(self):
+        self.slabs.append(self.cur)
+        self.cur = np.zeros_like(self.cur)
+
+    def read_span(self, n_ticks: int):
+        """Exact sum over the trailing ``n_ticks`` closed slabs + open slab."""
+        tail = self.slabs[-n_ticks:] if n_ticks > 0 else []
+        return sum(tail, np.zeros_like(self.cur)) + self.cur
