@@ -1,0 +1,91 @@
+"""Flagship benchmark: flow-event ingest throughput on one chip.
+
+Measures the jitted ``fold_step`` (one 2048-lane TCP_CONN batch + one
+4096-lane response-sample batch folded into full AggState: entity-table
+upsert, windowed counters, per-svc loghist + HLL + t-digest, global
+HLL/CMS/top-K) with HBM-resident state donation — the device half of the
+north-star path (BASELINE.md: 100M flow-events/sec on v5e-8 ⇒ 12.5M/s/chip).
+
+Prints ONE JSON line:
+  {"metric": "flow_events_per_sec_per_chip", "value": N,
+   "unit": "events/sec", "vs_baseline": N / 12.5e6}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+PER_CHIP_TARGET = 12.5e6  # BASELINE.md north star / 8 chips
+
+
+def main() -> None:
+    import jax
+
+    from gyeeta_tpu.engine import aggstate, step
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import decode
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    cfg = EngineCfg()
+    dev = jax.devices()[0]
+    print(f"bench: device={dev.platform}:{dev.device_kind}", file=sys.stderr)
+
+    import numpy as np
+
+    sim = ParthaSim(n_hosts=64, n_svcs=16, n_clients=4096)
+    K = 16  # microbatches folded per device dispatch (scan'd slab)
+
+    def stage():
+        cbs = [decode.conn_batch(sim.conn_records(cfg.conn_batch))
+               for _ in range(K)]
+        rbs = [decode.resp_batch(sim.resp_records(cfg.resp_batch))
+               for _ in range(K)]
+        stack = lambda bs: jax.tree.map(  # noqa: E731
+            lambda *xs: np.stack(xs), *bs)
+        return (jax.device_put(stack(cbs), dev),
+                jax.device_put(stack(rbs), dev))
+
+    n_distinct = 2  # cycle staged slabs so inputs aren't degenerate
+    slabs = [stage() for _ in range(n_distinct)]
+
+    fold = step.jit_fold_many(cfg)
+    st = jax.device_put(aggstate.init(cfg), dev)
+
+    # warmup / compile
+    t0 = time.perf_counter()
+    for i in range(2):
+        st = fold(st, *slabs[i % n_distinct])
+    jax.block_until_ready(st)
+    print(f"bench: warmup+compile {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    events_per_call = K * (cfg.conn_batch + cfg.resp_batch)
+    # calibrate call count for ~2s of measurement, bounded for slow hosts
+    t0 = time.perf_counter()
+    for i in range(4):
+        st = fold(st, *slabs[i % n_distinct])
+    jax.block_until_ready(st)
+    per_call = (time.perf_counter() - t0) / 4
+    calls = max(4, min(500, int(2.0 / max(per_call, 1e-6))))
+
+    t0 = time.perf_counter()
+    for i in range(calls):
+        st = fold(st, *slabs[i % n_distinct])
+    jax.block_until_ready(st)
+    elapsed = time.perf_counter() - t0
+
+    value = calls * events_per_call / elapsed
+    print(f"bench: {calls} calls x {K} microbatches in {elapsed:.2f}s "
+          f"({per_call * 1e3 / K:.2f}ms/microbatch warm)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "flow_events_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(value / PER_CHIP_TARGET, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

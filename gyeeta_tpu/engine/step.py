@@ -123,3 +123,26 @@ def jit_fold_step(cfg: EngineCfg):
     """Compiled fold_step with state donation (in-place HBM update)."""
     return jax.jit(
         lambda st, cb, rb: fold_step(cfg, st, cb, rb), donate_argnums=(0,))
+
+
+def fold_many(cfg: EngineCfg, st: AggState, cbs, rbs) -> AggState:
+    """Fold K stacked microbatches in one traced ``lax.scan``.
+
+    cbs/rbs leaves have leading axis K. One device dispatch per K batches:
+    this is the shape of the real ingest loop (staged multibatch slabs →
+    scan), amortizing host dispatch the way the reference amortizes
+    syscalls with DB_WRITE_ARR batching (``server/gy_mconnhdlr.h:350``).
+    """
+
+    def body(carry, batch):
+        cb, rb = batch
+        return fold_step(cfg, carry, cb, rb), None
+
+    out, _ = jax.lax.scan(body, st, (cbs, rbs))
+    return out
+
+
+def jit_fold_many(cfg: EngineCfg):
+    return jax.jit(
+        lambda st, cbs, rbs: fold_many(cfg, st, cbs, rbs),
+        donate_argnums=(0,))

@@ -168,11 +168,14 @@ def delete(tbl: Table, khi, klo, valid=None):
     stays unusable until ``compact`` or until an insert reclaims the
     tombstone.
     """
+    capacity = tbl.key_hi.shape[0]
     rows = lookup(tbl, khi, klo, valid)
-    tgt = jnp.where(rows >= 0, rows, tbl.key_hi.shape[0])
+    tgt = jnp.where(rows >= 0, rows, capacity)
     key_hi = tbl.key_hi.at[tgt].set(TOMB, mode="drop")
     key_lo = tbl.key_lo.at[tgt].set(TOMB, mode="drop")
-    ndel = jnp.sum(rows >= 0).astype(jnp.int32)
+    # count distinct rows: duplicate lanes of one key must not double-count
+    hit = jnp.zeros((capacity + 1,), bool).at[tgt].set(True)
+    ndel = jnp.sum(hit[:capacity]).astype(jnp.int32)
     return Table(
         key_hi=key_hi,
         key_lo=key_lo,

@@ -213,7 +213,8 @@ def listener_batch(recs: np.ndarray,
     )
 
 
-def host_batch(recs: np.ndarray, size: int = 4096) -> HostBatch:
+def host_batch(recs: np.ndarray, size: int = wire.MAX_HOSTS_PER_BATCH
+               ) -> HostBatch:
     n = _check_fit(recs, size)
     r = recs[:n]
     panel = np.zeros((n, NHOSTCOL), np.float32)

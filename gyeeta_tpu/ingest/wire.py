@@ -50,6 +50,7 @@ NOTIFY_CPU_MEM_STATE = 15     # 2s host cpu/mem state
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
 MAX_RESP_PER_BATCH = 4096
+MAX_HOSTS_PER_BATCH = 4096
 
 HEADER_DT = np.dtype([
     ("magic", "<u4"),
@@ -171,7 +172,7 @@ DTYPE_OF_SUBTYPE = {
 MAX_OF_SUBTYPE = {
     NOTIFY_TCP_CONN: MAX_CONNS_PER_BATCH,
     NOTIFY_LISTENER_STATE: MAX_LISTENERS_PER_BATCH,
-    NOTIFY_HOST_STATE: 4096,
+    NOTIFY_HOST_STATE: MAX_HOSTS_PER_BATCH,
     NOTIFY_RESP_SAMPLE: MAX_RESP_PER_BATCH,
 }
 
@@ -185,10 +186,20 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
 
 def encode_frame(subtype: int, records: np.ndarray,
                  magic: int = MAGIC_PM) -> bytes:
-    """Frame a structured record array as COMM_HEADER+EVENT_NOTIFY+payload."""
+    """Frame a structured record array as COMM_HEADER+EVENT_NOTIFY+payload.
+
+    Raises FrameError at the producer for frames the decoder would reject
+    (per-subtype batch caps, 16MB frame cap) — a malformed frame in a byte
+    stream poisons every frame behind it.
+    """
+    cap = MAX_OF_SUBTYPE.get(subtype)
+    if cap is not None and len(records) > cap:
+        raise FrameError(
+            f"{len(records)} records > cap {cap} for subtype {subtype}")
     payload = records.tobytes()
     total = HEADER_DT.itemsize + EVENT_NOTIFY_DT.itemsize + len(payload)
-    assert total < MAX_COMM_DATA_SZ, "frame exceeds 16MB cap"
+    if total >= MAX_COMM_DATA_SZ:
+        raise FrameError(f"frame {total} bytes exceeds 16MB cap")
     hdr = np.zeros((), HEADER_DT)
     hdr["magic"] = magic
     hdr["total_sz"] = total          # records are 8-aligned → no padding

@@ -1,0 +1,20 @@
+"""Mesh/collective tier: the madhava→shyama aggregation tree as SPMD.
+
+The reference scales by a server tree (≤512 agents per madhava, ≤1024
+madhavas per shyama) connected by TCP RPCs. Here the same roles map onto a
+``jax.sharding.Mesh``:
+
+- hosts are data-parallel: each mesh shard owns the full engine state for
+  its slice of the host-id space (``mesh.py``, ``sharded.py``),
+- the shyama roll-up (``server/gy_shconnhdlr.cc:4583`` cluster aggregation)
+  is ``psum``/``pmax`` of sketch tensors over the mesh axis (``rollup.py``),
+- global conn pairing (``server/gy_shconnhdlr.h:1136`` glob_tcp_conn_tbl_)
+  is an ``all_to_all`` reshard of conn halves to their flow-key owner shard
+  plus a device pair table (``pairing.py``).
+"""
+
+from gyeeta_tpu.parallel.mesh import HOST_AXIS, make_mesh, shard_of_host
+from gyeeta_tpu.parallel import sharded, rollup, pairing
+
+__all__ = ["HOST_AXIS", "make_mesh", "shard_of_host", "sharded", "rollup",
+           "pairing"]

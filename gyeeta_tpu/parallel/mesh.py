@@ -1,0 +1,42 @@
+"""Device mesh construction + host→shard placement.
+
+The placement rule replaces shyama's ``assign_partha_madhava``
+(``server/gy_shconnhdlr.cc:5876``): instead of a capacity/affinity-aware
+central assignment with DB-backed stickiness, hosts map to mesh shards by a
+stable modulus of host id — deterministic, stateless, and uniform. Region/
+zone affinity returns at the multi-slice level (DCN axis) where it matters
+for TPUs; within a slice every shard is equidistant over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+HOST_AXIS = "hosts"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (HOST_AXIS,))
+
+
+def shard_of_host(host_id, n_shards: int):
+    """Stable host→shard placement (works on np or jnp arrays)."""
+    return host_id % n_shards
+
+
+def leading_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding that splits leaves on their leading (shard) axis."""
+    return NamedSharding(mesh, P(HOST_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,157 @@
+"""Global conn-half pairing: all_to_all reshard by flow key + device table.
+
+The reference pairs the client half and server half of every cross-madhava
+TCP connection in shyama's central ``glob_tcp_conn_tbl_`` hash table
+(``server/gy_shconnhdlr.h:1136``, match loop ``gy_shconnhdlr.cc:3790-3854``):
+each madhava sends unresolved halves upward; shyama joins on ``PAIR_IP_PORT``
+and notifies both sides.
+
+TPU-native version: there is no central table. The flow-key space is
+hash-sharded over the mesh; every shard routes its locally-observed halves
+to the owner shard with one ``lax.all_to_all`` (an EP/MoE-style capacity
+dispatch), and the owner upserts them into its slice of a device pair table.
+A pair completes when both halves have landed on the same row. Exact join —
+this path is deliberately not sketched (SURVEY §7 "exactness boundaries").
+
+Capacity discipline: each shard sends at most ``cap`` lanes to each owner
+per step; overflow lanes are dropped and counted (the analogue of the
+reference's ~100k unresolved-conn cap, ``server/gy_mconnhdlr.h:94``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gyeeta_tpu.engine import table
+from gyeeta_tpu.parallel.mesh import HOST_AXIS
+from gyeeta_tpu.utils import hashing as H
+
+_OWNER_SALT = 0x9A1C
+
+
+class PairTable(NamedTuple):
+    """Per-shard slice of the global pairing table."""
+    tbl: table.Table
+    cli_seen: jnp.ndarray   # (S,) bool — client half landed
+    ser_seen: jnp.ndarray   # (S,) bool — server half landed
+    n_paired: jnp.ndarray   # () f32 — completed pairs (monotonic)
+    n_dropped: jnp.ndarray  # () f32 — dispatch overflow + table drops
+
+
+def pair_init(capacity: int) -> PairTable:
+    return PairTable(
+        tbl=table.init(capacity),
+        cli_seen=jnp.zeros((capacity,), bool),
+        ser_seen=jnp.zeros((capacity,), bool),
+        n_paired=jnp.zeros((), jnp.float32),
+        n_dropped=jnp.zeros((), jnp.float32),
+    )
+
+
+def owner_shard(flow_hi, flow_lo, n_shards: int):
+    """Deterministic flow-key → owner shard (the sharding of the global
+    pair table). Works on np or jnp inputs."""
+    return H.mix64(flow_hi, flow_lo, _OWNER_SALT) % n_shards
+
+
+def _dispatch(flow_hi, flow_lo, is_cli, valid, n: int, cap: int):
+    """Capacity-limited all_to_all dispatch of (B,) lanes → received lanes.
+
+    Returns (r_hi, r_lo, r_cli, r_valid) of shape (n*cap,) on each shard,
+    plus the local count of overflow-dropped lanes.
+    """
+    B = flow_hi.shape[0]
+    dest = owner_shard(flow_hi, flow_lo, n).astype(jnp.int32)
+    dest = jnp.where(valid, dest, n)                   # invalid → trash bin
+    order = jnp.argsort(dest)                          # stable
+    d_s = dest[order]
+    counts = jnp.bincount(d_s, length=n + 1)
+    offsets = jnp.cumsum(counts) - counts              # exclusive prefix
+    pos = jnp.arange(B, dtype=jnp.int32) - offsets[d_s]
+    keep = (d_s < n) & (pos < cap)
+    slot = jnp.where(keep, d_s * cap + pos, n * cap)
+
+    def scatter(x, fill):
+        buf = jnp.full((n * cap,) + x.shape[1:], fill, x.dtype)
+        return buf.at[slot].set(x[order], mode="drop")
+
+    b_hi = scatter(flow_hi.astype(jnp.uint32), 0)
+    b_lo = scatter(flow_lo.astype(jnp.uint32), 0)
+    b_cli = scatter(is_cli, False)
+    b_val = jnp.zeros((n * cap,), bool).at[slot].set(keep, mode="drop")
+
+    def a2a(x):
+        return lax.all_to_all(x.reshape((n, cap) + x.shape[1:]), HOST_AXIS,
+                              split_axis=0, concat_axis=0).reshape(
+                                  (n * cap,) + x.shape[1:])
+
+    dropped = (jnp.sum(valid) - jnp.sum(keep)).astype(jnp.float32)
+    return a2a(b_hi), a2a(b_lo), a2a(b_cli), a2a(b_val), dropped
+
+
+def _pair_local(pt: PairTable, r_hi, r_lo, r_cli, r_valid) -> PairTable:
+    """Upsert received halves into the local pair-table slice."""
+    tbl, rows = table.upsert(pt.tbl, r_hi, r_lo, valid=r_valid)
+    ok = r_valid & (rows >= 0)
+    S = pt.cli_seen.shape[0]
+    lanes = jnp.where(ok, rows, S)
+    cli = pt.cli_seen.at[jnp.where(ok & r_cli, lanes, S)].set(
+        True, mode="drop")
+    ser = pt.ser_seen.at[jnp.where(ok & ~r_cli, lanes, S)].set(
+        True, mode="drop")
+    new_pairs = jnp.sum((cli & ser) & ~(pt.cli_seen & pt.ser_seen))
+    tab_dropped = jnp.sum(r_valid & (rows < 0)).astype(jnp.float32)
+    return pt._replace(
+        tbl=tbl, cli_seen=cli, ser_seen=ser,
+        n_paired=pt.n_paired + new_pairs.astype(jnp.float32),
+        n_dropped=pt.n_dropped + tab_dropped,
+    )
+
+
+def pair_init_sharded(mesh, capacity: int) -> PairTable:
+    """Stacked (n_shards, ...) pair table laid out over the mesh axis."""
+    from jax.sharding import NamedSharding
+    n = mesh.devices.size
+    shd = NamedSharding(mesh, P(HOST_AXIS))
+
+    @partial(jax.jit, out_shardings=shd)
+    def _init():
+        one = pair_init(capacity)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), one)
+
+    return _init()
+
+
+def pairing_fn(mesh, cap_per_dest: int):
+    """Compiled (pair_state, halves) → (pair_state, stats).
+
+    ``halves`` leaves are (n_shards, B) stacked: flow_hi, flow_lo, is_cli,
+    valid. ``stats`` is replicated: total pairs completed, total dropped.
+    """
+    n = mesh.devices.size
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(HOST_AXIS),) * 5, out_specs=(P(HOST_AXIS), P()),
+             check_vma=False)
+    def _step(pt, fhi, flo, is_cli, valid):
+        local = jax.tree.map(lambda x: x[0], pt)
+        r_hi, r_lo, r_cli, r_val, o_drop = _dispatch(
+            fhi[0], flo[0], is_cli[0], valid[0], n, cap_per_dest)
+        local = local._replace(n_dropped=local.n_dropped + o_drop)
+        local = _pair_local(local, r_hi, r_lo, r_cli, r_val)
+        stats = {
+            "n_paired": lax.psum(local.n_paired, HOST_AXIS),
+            "n_dropped": lax.psum(local.n_dropped, HOST_AXIS),
+            "n_table_live": lax.psum(
+                local.tbl.n_live.astype(jnp.float32), HOST_AXIS),
+        }
+        return jax.tree.map(lambda x: x[None], local), stats
+
+    return jax.jit(_step, donate_argnums=(0,))

@@ -1,0 +1,83 @@
+"""Cluster roll-up: shard sketches → one global view, via ICI collectives.
+
+This replaces the madhava→shyama aggregation RPCs — cluster state
+aggregation (``server/gy_shconnhdlr.cc:4583`` aggregate_cluster_state) and
+the per-madhava summary pushes (``MS_CLUSTER_STATE``) — with one jitted
+collective program:
+
+- Count-Min counters and windowed counters are additive → ``psum``,
+- HLL registers merge by elementwise max → ``pmax``,
+- top-K and t-digest need their survivor sets side by side → ``all_gather``
+  (tiled) then one combine/compress on every shard (result replicated —
+  every shard *is* shyama; there is no central server to fail).
+
+Everything rides ICI inside a slice; on a multi-slice mesh the same program
+spans the DCN axis unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gyeeta_tpu.engine import aggstate, table
+from gyeeta_tpu.parallel.mesh import HOST_AXIS
+from gyeeta_tpu.sketch import countmin, hyperloglog as hll, topk
+
+
+class GlobalRollup(NamedTuple):
+    """The shyama-level merged view (replicated on every shard)."""
+    glob_hll: hll.HLL          # distinct flow endpoints, cluster-wide
+    cms: countmin.CMS          # flow-key → bytes, cluster-wide
+    flow_topk: topk.TopK       # heavy hitters across all shards
+    n_conn: jnp.ndarray        # () totals
+    n_resp: jnp.ndarray
+    n_svc_live: jnp.ndarray    # () live service rows cluster-wide
+    host_totals: jnp.ndarray   # (NHOSTCOL,) summed host panel (ntasks,
+    #                             nlisten, issue counts — cluster state)
+    n_hosts_up: jnp.ndarray    # () hosts that have reported
+
+
+def _rollup_local(st: aggstate.AggState) -> GlobalRollup:
+    """Collective merge of one shard's state (runs inside shard_map)."""
+    regs = lax.pmax(st.glob_hll.regs, HOST_AXIS)
+    cms_counts = lax.psum(st.cms.counts, HOST_AXIS)
+
+    hi = lax.all_gather(st.flow_topk.key_hi, HOST_AXIS, tiled=True)
+    lo = lax.all_gather(st.flow_topk.key_lo, HOST_AXIS, tiled=True)
+    cnt = lax.all_gather(st.flow_topk.counts, HOST_AXIS, tiled=True)
+    evicted = lax.psum(st.flow_topk.evicted, HOST_AXIS)
+    cap = st.flow_topk.counts.shape[0]
+    merged_topk = topk._combine(hi, lo, cnt, cap, evicted)
+
+    live = jnp.sum(table.live_mask(st.tbl)).astype(jnp.float32)
+    reported = st.host_panel[:, aggstate.HOST_NTASKS] > 0
+    return GlobalRollup(
+        glob_hll=hll.HLL(regs=regs),
+        cms=countmin.CMS(counts=cms_counts),
+        flow_topk=merged_topk,
+        n_conn=lax.psum(st.n_conn, HOST_AXIS),
+        n_resp=lax.psum(st.n_resp, HOST_AXIS),
+        n_svc_live=lax.psum(live, HOST_AXIS),
+        host_totals=lax.psum(
+            jnp.sum(jnp.where(reported[:, None], st.host_panel, 0.0),
+                    axis=0), HOST_AXIS),
+        n_hosts_up=lax.psum(jnp.sum(reported).astype(jnp.float32),
+                            HOST_AXIS),
+    )
+
+
+def rollup_fn(cfg: aggstate.EngineCfg, mesh):
+    """Compiled sharded-state → replicated GlobalRollup."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
+             out_specs=P(), check_vma=False)
+    def _roll(st):
+        return _rollup_local(jax.tree.map(lambda x: x[0], st))
+
+    return jax.jit(_roll)

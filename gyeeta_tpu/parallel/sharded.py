@@ -1,0 +1,113 @@
+"""Sharded engine: per-shard AggState slabs + shard_map'd fold steps.
+
+Each mesh shard owns an independent ``AggState`` (its own service slab and
+sketches) for its slice of the host-id space — exactly a madhava's role
+(per-host RCU tables, ``server/gy_mconnhdlr.h:1107``), but as one stacked
+pytree with a leading shard axis laid out over the mesh. Ingest batches
+arrive pre-routed ``(n_shards, B, ...)`` (see ``shard_batches``); the fold
+runs embarrassingly parallel under ``shard_map`` with zero collectives —
+collectives appear only in ``rollup.py``/``pairing.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from gyeeta_tpu.engine import aggstate, step
+from gyeeta_tpu.parallel.mesh import HOST_AXIS, leading_sharding, \
+    shard_of_host
+
+
+def _local(tree):
+    """Strip the singleton shard axis inside shard_map."""
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _relocal(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def init_sharded(cfg: aggstate.EngineCfg, mesh):
+    """Stacked (n_shards, ...) AggState laid out over the mesh axis."""
+    n = mesh.devices.size
+    shd = leading_sharding(mesh)
+
+    @partial(jax.jit, out_shardings=shd)
+    def _init():
+        one = aggstate.init(cfg)
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), one)
+
+    return _init()
+
+
+def shard_batches(cfg: aggstate.EngineCfg, mesh, batch_fns, records,
+                  host_ids):
+    """Route host-side records to shards and build stacked batches.
+
+    ``records``: structured record array; ``host_ids``: (N,) source host of
+    each record; ``batch_fns``: (builder, lane_size) — e.g.
+    ``(decode.conn_batch, cfg.conn_batch)``. Returns a batch pytree whose
+    leaves are (n_shards, lane_size, ...) numpy arrays (ready for
+    ``jax.device_put`` with the leading sharding).
+
+    This is the host-side L1 role (validate + batch + route,
+    ``server/gy_mconnhdlr.cc:2430``): pure numpy, no device work.
+    """
+    builder, lanes = batch_fns
+    n = mesh.devices.size
+    dest = shard_of_host(np.asarray(host_ids), n)
+    shards = []
+    for s in range(n):
+        shards.append(builder(records[dest == s], lanes))
+    return jax.tree.map(lambda *xs: np.stack(xs), *shards)
+
+
+def put_sharded(mesh, batch):
+    """Transfer a stacked host batch to devices, split on the shard axis."""
+    shd = leading_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, shd), batch)
+
+
+def fold_step_sharded(cfg: aggstate.EngineCfg, mesh):
+    """Compiled sharded flagship step: (state, conn, resp) → state."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 3,
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _step(st, cb, rb):
+        return _relocal(step.fold_step(cfg, _local(st), _local(cb),
+                                       _local(rb)))
+
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+def tick_5s_sharded(cfg: aggstate.EngineCfg, mesh):
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _tick(st):
+        return _relocal(step.tick_5s(cfg, _local(st)))
+
+    return jax.jit(_tick, donate_argnums=(0,))
+
+
+def ingest_listener_sharded(cfg: aggstate.EngineCfg, mesh):
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _fold(st, lb):
+        return _relocal(step.ingest_listener(cfg, _local(st), _local(lb)))
+
+    return jax.jit(_fold, donate_argnums=(0,))
+
+
+def ingest_host_sharded(cfg: aggstate.EngineCfg, mesh):
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _fold(st, hb):
+        return _relocal(step.ingest_host(cfg, _local(st), _local(hb)))
+
+    return jax.jit(_fold, donate_argnums=(0,))

@@ -20,8 +20,8 @@ from gyeeta_tpu.engine.aggstate import (
     AggState, EngineCfg, CTR_BYTES_SENT, CTR_BYTES_RCVD, CTR_NCONN_CLOSED,
     CTR_DUR_SUM_US,
 )
-from gyeeta_tpu.sketch import hyperloglog as hll, loghist, tdigest, topk, \
-    windows
+from gyeeta_tpu.sketch import countmin, hyperloglog as hll, loghist, \
+    tdigest, topk, windows
 
 DEFAULT_QS = (0.25, 0.5, 0.95, 0.99)
 
@@ -41,13 +41,16 @@ def svc_snapshot(cfg: EngineCfg, st: AggState, level: int = 0):
     resp_q_us = loghist.quantiles(resp_hist, cfg.resp_spec, qs)
     td_q_us = tdigest.quantiles_entities(st.svc_td, qs)
     nresp = loghist.counts_total(resp_hist)
+    elapsed = jnp.maximum(st.resp_win.tick.astype(jnp.float32), 1.0)
     if level < len(cfg.levels):
         lv = cfg.levels[level] if level >= 0 else None
-        span_sec = jnp.float32(
-            5.0 if lv is None else lv.stride_ticks * lv.nslots * 5.0)
+        span_ticks = 1.0 if lv is None else float(lv.stride_ticks * lv.nslots)
+        # before the window fills, the data only covers `elapsed` ticks —
+        # dividing by the full span would underreport rates until then
+        span_sec = jnp.minimum(elapsed, span_ticks) * 5.0
     else:
         # all-time: elapsed base ticks × 5 s (dynamic, min one tick)
-        span_sec = jnp.maximum(st.resp_win.tick.astype(jnp.float32), 1.0) * 5.0
+        span_sec = elapsed * 5.0
     return {
         "glob_id_hi": st.tbl.key_hi,
         "glob_id_lo": st.tbl.key_lo,

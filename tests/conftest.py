@@ -14,8 +14,21 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compilation cache: XLA recompiles are the dominant test cost on
+# small hosts; cache traced executables across pytest runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/gyeeta_tpu_jax"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
+import jax
 import numpy as np
 import pytest
+
+# The axon TPU plugin's sitecustomize calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, which outranks the JAX_PLATFORMS env var —
+# force the virtual CPU platform back explicitly (before any backend init).
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture

@@ -1,0 +1,179 @@
+"""End-to-end engine tests: sim → wire → decode → jitted fold → query,
+diffed against exact numpy references (SURVEY §4 test strategy)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine import aggstate, step, table
+from gyeeta_tpu.engine.aggstate import (
+    EngineCfg, CTR_BYTES_SENT, CTR_BYTES_RCVD, CTR_NCONN_CLOSED,
+)
+from gyeeta_tpu.ingest import decode, wire
+from gyeeta_tpu.query import readback
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.sketch import exact, loghist
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return EngineCfg(
+        svc_capacity=64, n_hosts=8,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
+        hll_p_svc=6, hll_p_global=10, cms_depth=2, cms_width=1 << 10,
+        topk_capacity=64, td_capacity=32, td_route_cap=32,
+        conn_batch=128, resp_batch=256, listener_batch=64)
+
+
+@pytest.fixture(scope="module")
+def folded(cfg):
+    """Run the full pipe once for the module: 3 conn + 3 resp batches."""
+    sim = ParthaSim(n_hosts=8, n_svcs=2, n_clients=128, seed=5)
+    st = aggstate.init(cfg)
+    fold = step.jit_fold_step(cfg)
+    conns, resps = [], []
+    for _ in range(3):
+        craw = sim.conn_records(cfg.conn_batch)
+        rraw = sim.resp_records(cfg.resp_batch)
+        # through the wire: encode + decode (exercises framing in e2e)
+        cdec = wire.decode_frames(
+            wire.encode_frame(wire.NOTIFY_TCP_CONN, craw))[0][0][1]
+        rdec = wire.decode_frames(
+            wire.encode_frame(wire.NOTIFY_RESP_SAMPLE, rraw))[0][0][1]
+        conns.append(cdec)
+        resps.append(rdec)
+        st = fold(st, decode.conn_batch(cdec, cfg.conn_batch),
+                  decode.resp_batch(rdec, cfg.resp_batch))
+    jax.block_until_ready(st)
+    return st, np.concatenate(conns), np.concatenate(resps)
+
+
+def test_counts(cfg, folded):
+    st, conns, resps = folded
+    assert float(st.n_conn) == len(conns)
+    assert float(st.n_resp) == len(resps)
+    assert int(st.tbl.n_live) == len(
+        set(conns["ser_glob_id"]) | set(resps["glob_id"]))
+    assert int(st.tbl.n_drop) == 0
+
+
+def test_per_service_byte_counters(cfg, folded):
+    st, conns, _ = folded
+    rows = np.asarray(table.lookup(
+        st.tbl,
+        (conns["ser_glob_id"] >> np.uint64(32)).astype(np.uint32),
+        (conns["ser_glob_id"] & np.uint64(0xFFFFFFFF)).astype(np.uint32)))
+    assert (rows >= 0).all()
+    cur = np.asarray(st.ctr_win.cur)
+    for gid in np.unique(conns["ser_glob_id"])[:8]:
+        mask = conns["ser_glob_id"] == gid
+        row = rows[mask][0]
+        np.testing.assert_allclose(
+            cur[row, CTR_BYTES_SENT],
+            conns["bytes_sent"][mask].astype(np.float64).sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            cur[row, CTR_NCONN_CLOSED], mask.sum(), rtol=1e-6)
+
+
+def test_resp_quantiles_vs_exact(cfg, folded):
+    st, _, resps = folded
+    snap = readback.svc_snapshot(cfg, st, len(cfg.levels))  # all-time
+    snap = {k: np.asarray(v) for k, v in snap.items()}
+    gids = np.unique(resps["glob_id"])
+    checked = 0
+    for gid in gids:
+        vals = resps["resp_usec"][resps["glob_id"] == gid].astype(np.float64)
+        if len(vals) < 30:
+            continue
+        row = int(np.asarray(table.lookup(
+            st.tbl,
+            np.array([(gid >> np.uint64(32))], np.uint32),
+            np.array([gid & np.uint64(0xFFFFFFFF)], np.uint32)))[0])
+        ex = exact.quantiles(vals, (0.5, 0.95))
+        # loghist path error bound = one geometric bucket width:
+        # (vmax/vmin)^(1/nbuckets) = 1e8^(1/64) ≈ 1.33 → ±~16% half-bucket
+        bucket_w = (cfg.resp_spec.vmax / cfg.resp_spec.vmin) ** (
+            1.0 / cfg.resp_spec.nbuckets) - 1.0
+        assert abs(snap["resp_p50_us"][row] - ex[0]) / ex[0] < bucket_w
+        # p95 at n≈50 samples: order-statistic discretization adds up to
+        # another bucket of error on top of bucket quantization
+        assert abs(snap["resp_p95_us"][row] - ex[1]) / ex[1] < 2 * bucket_w
+        # t-digest path: high accuracy
+        assert abs(snap["td_p50_us"][row] - ex[0]) / ex[0] < 0.05
+        checked += 1
+    assert checked >= 3
+
+
+def test_flow_topk_vs_exact(cfg, folded):
+    st, conns, _ = folded
+    snap = readback.flow_snapshot(cfg, st, k=16)
+    got_bytes = np.asarray(snap["flow_bytes"])
+    tot = (conns["bytes_sent"] + conns["bytes_rcvd"]).astype(np.float64)
+    # compare total mass: top-K + evicted == total inserted
+    np.testing.assert_allclose(
+        float(np.asarray(st.flow_topk.counts).sum())
+        + float(np.asarray(st.flow_topk.evicted)),
+        tot.sum(), rtol=1e-4)
+    assert (got_bytes[:4] > 0).all()
+    # global distinct-flow-key estimate within HLL error of exact
+    all_cb = decode.conn_batch(conns, size=len(conns))
+    n_exact = exact.distinct(all_cb.flow_hi, all_cb.flow_lo)
+    est = float(np.asarray(snap["distinct_flows"]))
+    assert abs(est - n_exact) / n_exact < 0.15
+
+
+def test_host_panel(cfg):
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=11)
+    st = aggstate.init(cfg)
+    hraw = sim.host_state_records()
+    hb = decode.host_batch(hraw, size=16)
+    st = jax.jit(lambda s, b: step.ingest_host(cfg, s, b))(st, hb)
+    panel = np.asarray(st.host_panel)
+    np.testing.assert_allclose(
+        panel[:8, decode.HOST_NTASKS], hraw["ntasks"].astype(np.float32))
+
+
+def test_listener_gauges(cfg):
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=12)
+    st = aggstate.init(cfg)
+    lraw = sim.listener_state_records()[:cfg.listener_batch]
+    lb = decode.listener_batch(lraw, cfg.listener_batch)
+    st = jax.jit(lambda s, b: step.ingest_listener(cfg, s, b))(st, lb)
+    rows = np.asarray(table.lookup(
+        st.tbl,
+        (lraw["glob_id"] >> np.uint64(32)).astype(np.uint32),
+        (lraw["glob_id"] & np.uint64(0xFFFFFFFF)).astype(np.uint32)))
+    assert (rows >= 0).all()
+    stats = np.asarray(st.svc_stats)
+    np.testing.assert_allclose(
+        stats[rows, decode.STAT_NQRYS],
+        lraw["nqrys_5s"].astype(np.float32))
+
+
+def test_tick_and_windowed_read(cfg):
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=13)
+    st = aggstate.init(cfg)
+    fold = step.jit_fold_step(cfg)
+    tick = jax.jit(lambda s: step.tick_5s(cfg, s))
+    for _ in range(3):
+        st = fold(st, decode.conn_batch(sim.conn_records(64),
+                                        cfg.conn_batch),
+                  decode.resp_batch(sim.resp_records(64), cfg.resp_batch))
+        st = tick(st)
+    # after ticks, cur is empty; level-0 window holds all three slabs
+    assert float(np.abs(np.asarray(st.resp_win.cur)).sum()) == 0.0
+    lvl0 = np.asarray(st.resp_win.totals[0]).sum()
+    alltime = np.asarray(st.resp_win.alltime).sum()
+    assert lvl0 == alltime > 0
+    assert float(st.n_resp) == 192
+
+
+def test_svc_rows_to_host(cfg, folded):
+    st, conns, resps = folded
+    snap = readback.svc_snapshot(cfg, st, 0)
+    rows = readback.svc_rows_to_host(cfg, snap)
+    assert len(rows) == int(st.tbl.n_live)
+    gids = {r["glob_id"] for r in rows}
+    assert set(conns["ser_glob_id"].tolist()) <= gids
+    for r in rows[:3]:
+        assert "resp_p95_us" in r and "qps" in r

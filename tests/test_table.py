@@ -1,0 +1,142 @@
+"""Device entity-table tests (ref: RCU_HASH_TABLE ``common/gy_rcu_inc.h:1664``;
+delete flow ``server/gy_mconnhdlr.cc:11195``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine import table
+
+
+def keys_of(rng, n, lo=1, hi=2**31):
+    return (rng.integers(lo, hi, n).astype(np.uint32),
+            rng.integers(lo, hi, n).astype(np.uint32))
+
+
+@pytest.fixture(scope="module")
+def jitted():
+    cap = 64
+    return {
+        "cap": cap,
+        "upsert": jax.jit(table.upsert),
+        "lookup": jax.jit(table.lookup),
+        "delete": jax.jit(table.delete),
+    }
+
+
+def test_upsert_then_lookup(rng, jitted):
+    tbl = table.init(jitted["cap"])
+    khi, klo = keys_of(rng, 40)
+    tbl, rows = jitted["upsert"](tbl, jnp.asarray(khi), jnp.asarray(klo))
+    rows = np.asarray(rows)
+    assert (rows >= 0).all()
+    assert int(tbl.n_live) == 40
+    # same keys resolve to the same rows
+    found = np.asarray(jitted["lookup"](tbl, jnp.asarray(khi),
+                                        jnp.asarray(klo)))
+    assert np.array_equal(found, rows)
+    # unknown keys miss
+    uhi, ulo = keys_of(rng, 8)
+    miss = np.asarray(jitted["lookup"](tbl, jnp.asarray(uhi),
+                                       jnp.asarray(ulo)))
+    assert (miss == -1).all()
+
+
+def test_intra_batch_duplicates_one_row(rng, jitted):
+    tbl = table.init(jitted["cap"])
+    khi = np.full(16, 77, np.uint32)
+    klo = np.full(16, 99, np.uint32)
+    tbl, rows = jitted["upsert"](tbl, jnp.asarray(khi), jnp.asarray(klo))
+    rows = np.asarray(rows)
+    assert (rows == rows[0]).all() and rows[0] >= 0
+    assert int(tbl.n_live) == 1
+
+
+def test_delete_and_reinsert(rng, jitted):
+    tbl = table.init(jitted["cap"])
+    khi, klo = keys_of(rng, 20)
+    tbl, rows = jitted["upsert"](tbl, jnp.asarray(khi), jnp.asarray(klo))
+    tbl, drows = jitted["delete"](tbl, jnp.asarray(khi[:5]),
+                                  jnp.asarray(klo[:5]))
+    assert int(tbl.n_live) == 15
+    assert int(tbl.n_tomb) == 5
+    gone = np.asarray(jitted["lookup"](tbl, jnp.asarray(khi[:5]),
+                                       jnp.asarray(klo[:5])))
+    assert (gone == -1).all()
+    kept = np.asarray(jitted["lookup"](tbl, jnp.asarray(khi[5:]),
+                                       jnp.asarray(klo[5:])))
+    assert (kept >= 0).all()
+    # reinsert reclaims tombstones
+    tbl, rrows = jitted["upsert"](tbl, jnp.asarray(khi[:5]),
+                                  jnp.asarray(klo[:5]))
+    assert int(tbl.n_live) == 20
+    assert (np.asarray(rrows) >= 0).all()
+
+
+def test_delete_duplicate_lanes_count_once(rng, jitted):
+    """Duplicate lanes deleting one key must not drive n_live negative."""
+    tbl = table.init(jitted["cap"])
+    tbl, _ = jitted["upsert"](tbl, jnp.asarray(np.array([7], np.uint32)),
+                              jnp.asarray(np.array([9], np.uint32)))
+    tbl, _ = jitted["delete"](tbl,
+                              jnp.asarray(np.full(3, 7, np.uint32)),
+                              jnp.asarray(np.full(3, 9, np.uint32)))
+    assert int(tbl.n_live) == 0
+    assert int(tbl.n_tomb) == 1
+
+
+def test_compact_permutes_state(rng, jitted):
+    cap = jitted["cap"]
+    tbl = table.init(cap)
+    khi, klo = keys_of(rng, 30)
+    tbl, rows = jitted["upsert"](tbl, jnp.asarray(khi), jnp.asarray(klo))
+    rows = np.asarray(rows)
+    state = jnp.zeros((cap,), jnp.float32).at[rows].set(
+        jnp.arange(30, dtype=jnp.float32))
+    tbl, _ = jitted["delete"](tbl, jnp.asarray(khi[:10]),
+                              jnp.asarray(klo[:10]))
+    new_tbl, (new_state,) = jax.jit(table.compact)(tbl, (state,))
+    assert int(new_tbl.n_tomb) == 0
+    assert int(new_tbl.n_live) == 20
+    new_rows = np.asarray(table.lookup(new_tbl, jnp.asarray(khi[10:]),
+                                       jnp.asarray(klo[10:])))
+    assert (new_rows >= 0).all()
+    # surviving keys carried their state value through the permutation
+    assert np.allclose(np.asarray(new_state)[new_rows],
+                       np.arange(10, 30, dtype=np.float32))
+
+
+def test_churn_storm(rng, jitted):
+    """Create/delete storms: the table never corrupts surviving keys."""
+    cap = jitted["cap"]
+    tbl = table.init(cap)
+    live = {}
+    for step_i in range(6):
+        khi, klo = keys_of(rng, 24)
+        tbl, rows = jitted["upsert"](tbl, jnp.asarray(khi),
+                                     jnp.asarray(klo))
+        rows = np.asarray(rows)
+        for i in range(24):
+            if rows[i] >= 0:
+                live[(int(khi[i]), int(klo[i]))] = rows[i]
+        # delete a random half of live keys
+        keys = list(live)
+        drop = [keys[i] for i in
+                rng.choice(len(keys), len(keys) // 2, replace=False)]
+        dh = np.array([k[0] for k in drop], np.uint32)
+        dl = np.array([k[1] for k in drop], np.uint32)
+        tbl, _ = jitted["delete"](tbl, jnp.asarray(dh), jnp.asarray(dl))
+        for k in drop:
+            del live[k]
+        if int(tbl.n_tomb) > cap // 2:
+            tbl, _ = jax.jit(table.compact)(tbl, (jnp.zeros((cap,)),))
+            live = {k: None for k in live}  # rows changed; re-resolve below
+        # every surviving key still resolves
+        sh = np.array([k[0] for k in live], np.uint32)
+        sl = np.array([k[1] for k in live], np.uint32)
+        if len(sh):
+            got = np.asarray(table.lookup(tbl, jnp.asarray(sh),
+                                          jnp.asarray(sl)))
+            assert (got >= 0).all()
+    assert int(tbl.n_live) == len(live)

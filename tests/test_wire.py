@@ -1,0 +1,108 @@
+"""Wire framing + columnar decode tests (ref: COMM_HEADER framing
+``common/gy_comm_proto.h:336``, batch caps :1711,2222)."""
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.ingest import decode, wire
+from gyeeta_tpu.sim.partha import ParthaSim
+
+
+def test_frame_roundtrip():
+    sim = ParthaSim(n_hosts=4, n_svcs=2, n_clients=64)
+    recs = sim.conn_records(100)
+    buf = wire.encode_frame(wire.NOTIFY_TCP_CONN, recs)
+    frames, consumed = wire.decode_frames(buf)
+    assert consumed == len(buf)
+    assert len(frames) == 1
+    subtype, out = frames[0]
+    assert subtype == wire.NOTIFY_TCP_CONN
+    assert np.array_equal(out, recs)
+
+
+def test_partial_frame_resume():
+    sim = ParthaSim(n_hosts=4, n_svcs=2, n_clients=64)
+    buf = (wire.encode_frame(wire.NOTIFY_RESP_SAMPLE, sim.resp_records(10))
+           + wire.encode_frame(wire.NOTIFY_RESP_SAMPLE,
+                               sim.resp_records(20)))
+    # split mid-second-frame: first decode returns frame 1 only
+    cut = len(buf) - 40
+    frames, consumed = wire.decode_frames(buf[:cut])
+    assert len(frames) == 1 and frames[0][1].shape[0] == 10
+    # resume with the remainder appended to the leftover
+    frames2, consumed2 = wire.decode_frames(buf[consumed:])
+    assert len(frames2) == 1 and frames2[0][1].shape[0] == 20
+    assert consumed + consumed2 == len(buf)
+
+
+def test_bad_magic_rejected():
+    buf = bytearray(wire.encode_frame(wire.NOTIFY_RESP_SAMPLE,
+                                      np.zeros(1, wire.RESP_SAMPLE_DT)))
+    buf[0] = 0xEE
+    with pytest.raises(wire.FrameError):
+        wire.decode_frames(bytes(buf))
+
+
+def test_batch_cap_enforced_at_encoder():
+    recs = np.zeros(wire.MAX_CONNS_PER_BATCH + 1, wire.TCP_CONN_DT)
+    with pytest.raises(wire.FrameError):
+        wire.encode_frame(wire.NOTIFY_TCP_CONN, recs)
+
+
+def test_batch_cap_enforced_at_decoder():
+    # hand-build the oversized frame the encoder refuses to produce
+    recs = np.zeros(wire.MAX_RESP_PER_BATCH + 1, wire.RESP_SAMPLE_DT)
+    payload = recs.tobytes()
+    hdr = np.zeros((), wire.HEADER_DT)
+    hdr["magic"] = wire.MAGIC_PM
+    hdr["total_sz"] = (wire.HEADER_DT.itemsize
+                       + wire.EVENT_NOTIFY_DT.itemsize + len(payload))
+    hdr["data_type"] = wire.COMM_EVENT_NOTIFY
+    ev = np.zeros((), wire.EVENT_NOTIFY_DT)
+    ev["subtype"] = wire.NOTIFY_RESP_SAMPLE
+    ev["nevents"] = len(recs)
+    with pytest.raises(wire.FrameError):
+        wire.decode_frames(hdr.tobytes() + ev.tobytes() + payload)
+
+
+def test_nevents_overflow_rejected():
+    recs = np.zeros(4, wire.RESP_SAMPLE_DT)
+    buf = bytearray(wire.encode_frame(wire.NOTIFY_RESP_SAMPLE, recs))
+    # claim more events than the payload holds
+    ev = np.frombuffer(bytes(buf[16:24]), wire.EVENT_NOTIFY_DT, 1).copy()
+    ev["nevents"] = 100
+    buf[16:24] = ev.tobytes()
+    with pytest.raises(wire.FrameError):
+        wire.decode_frames(bytes(buf))
+
+
+def test_unknown_subtype_skipped():
+    known = wire.encode_frame(wire.NOTIFY_RESP_SAMPLE,
+                              np.zeros(2, wire.RESP_SAMPLE_DT))
+    unknown = wire.encode_frame(999, np.zeros(3, wire.RESP_SAMPLE_DT))
+    frames, consumed = wire.decode_frames(unknown + known)
+    assert len(frames) == 1
+    assert frames[0][0] == wire.NOTIFY_RESP_SAMPLE
+    assert consumed == len(unknown) + len(known)
+
+
+def test_conn_batch_columns():
+    sim = ParthaSim(n_hosts=4, n_svcs=2, n_clients=64, seed=9)
+    recs = sim.conn_records(50)
+    cb = decode.conn_batch(recs, size=64)
+    assert cb.valid.sum() == 50
+    gid = (cb.svc_hi.astype(np.uint64) << np.uint64(32)) | cb.svc_lo
+    assert np.array_equal(gid[:50], recs["ser_glob_id"])
+    assert np.allclose(cb.bytes_sent[:50], recs["bytes_sent"])
+    assert cb.is_close[:50].all()          # sim emits close notifications
+    assert not cb.valid[50:].any()
+    # flow keys: identical 5-tuples hash identically, and the host-side
+    # key matches a direct recompute
+    assert (cb.flow_hi[:50] != 0).any()
+
+
+def test_oversize_batch_raises():
+    sim = ParthaSim(n_hosts=2, n_svcs=2)
+    recs = sim.resp_records(100)
+    with pytest.raises(ValueError):
+        decode.resp_batch(recs, size=64)
