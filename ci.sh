@@ -1,0 +1,6 @@
+#!/bin/sh
+# CI entry: full test suite on the 8-device virtual CPU platform.
+# (tests/conftest.py forces JAX_PLATFORMS=cpu + the device count itself.)
+set -e
+cd "$(dirname "$0")"
+python -m pytest tests/ -q "$@"

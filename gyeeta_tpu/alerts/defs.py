@@ -1,0 +1,85 @@
+"""Alert/silence/inhibit definitions (the CRUD payloads).
+
+Field names follow the reference's alertdef JSON (``common/gy_alerts.cc``
+parse; shyama CRUD ``CRUD_ALERT_JSON`` path): ``alertname``, ``subsys``,
+``filter`` (criteria string), ``severity``, ``numcheckfor`` (consecutive
+5s checks before firing), ``repeataftersec`` (re-notification holdoff),
+``action`` names, ``annotations``/``labels`` templates.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+from gyeeta_tpu.query import criteria, fieldmaps
+
+SEVERITIES = ("info", "warning", "critical")
+
+
+class AlertDef(NamedTuple):
+    name: str
+    subsys: str
+    filter: str
+    severity: str = "warning"
+    numcheckfor: int = 1          # consecutive matching checks to fire
+    repeataftersec: float = 300.0  # holdoff before re-notifying an entity
+    actions: tuple = ("log",)
+    labels: tuple = ()             # ((key, value), ...) — immutable
+    annotations: tuple = ()
+    enabled: bool = True
+
+    @classmethod
+    def from_json(cls, d: dict) -> "AlertDef":
+        if "alertname" not in d or "subsys" not in d or "filter" not in d:
+            raise ValueError("alertdef needs alertname/subsys/filter")
+        if d["subsys"] not in fieldmaps.FIELDS_OF_SUBSYS:
+            raise ValueError(f"unknown subsys {d['subsys']!r}")
+        sev = d.get("severity", "warning")
+        if sev not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}")
+        tree = criteria.parse(d["filter"])     # validate at definition time
+        if tree is None:
+            raise ValueError("alertdef filter must be non-empty")
+        return cls(
+            name=d["alertname"], subsys=d["subsys"], filter=d["filter"],
+            severity=sev,
+            numcheckfor=max(1, int(d.get("numcheckfor", 1))),
+            repeataftersec=float(d.get("repeataftersec", 300.0)),
+            actions=tuple(d.get("action", ("log",)))
+            if not isinstance(d.get("action"), str) else (d["action"],),
+            labels=tuple(sorted(dict(d.get("labels", {})).items())),
+            annotations=tuple(sorted(dict(d.get("annotations", {}))
+                                     .items())),
+            enabled=bool(d.get("enabled", True)),
+        )
+
+
+class Silence(NamedTuple):
+    """Mute alerts matching ``filter`` between tstart and tend
+    (ref silences: ``server/gy_alertmgr.cc:5117`` is_alert_silenced)."""
+    name: str
+    filter: Optional[str] = None       # None = match all
+    alertnames: tuple = ()             # () = any alert
+    tstart: float = 0.0
+    tend: float = float("inf")
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Silence":
+        return cls(name=d["name"], filter=d.get("filter"),
+                   alertnames=tuple(d.get("alertnames", ())),
+                   tstart=float(d.get("tstart", 0.0)),
+                   tend=float(d.get("tend", float("inf"))))
+
+
+class Inhibit(NamedTuple):
+    """While any alert matching ``src_alertnames`` fires, suppress alerts
+    in ``target_alertnames`` (ref: ``gy_alertmgr.cc:5200``)."""
+    name: str
+    src_alertnames: tuple
+    target_alertnames: tuple
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Inhibit":
+        return cls(name=d["name"],
+                   src_alertnames=tuple(d["src_alertnames"]),
+                   target_alertnames=tuple(d["target_alertnames"]))

@@ -1,0 +1,194 @@
+"""Alert manager: columnar realtime evaluation + lifecycle + routing.
+
+One ``check()`` per 5s engine pass evaluates every enabled alertdef as a
+criteria mask over its subsystem snapshot (the whole fleet in a handful of
+vector ops — the tensor form of the reference's per-event RT_ALERT_VECS
+walk, ``server/gy_malerts.cc:1869``), then advances per-entity lifecycle:
+
+    pending (consecutive hits < numcheckfor) → firing → resolved
+
+Silences and inhibits gate *notification*, not detection (matching the
+reference: a silenced alert still tracks state, ``gy_alertmgr.cc:5117``).
+Grouping batches notifications per (alertname, severity) within a check —
+the degenerate group-wait window of the reference's ALERT_GROUP (:574)
+under batch semantics. Actions are pluggable callables; "log" is built in
+(EMAIL/SLACK/PAGERDUTY/WEBHOOK of ``gy_alertmgr.h:50`` register the same
+way; network egress is deployment-specific).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+from gyeeta_tpu.alerts.defs import AlertDef, Inhibit, Silence
+from gyeeta_tpu.query import api, criteria
+
+
+class Alert(NamedTuple):
+    alertname: str
+    severity: str
+    subsys: str
+    entity: str                  # svcid / hostid / flow key
+    tfired: float
+    labels: dict
+    annotations: dict
+    row: dict                    # snapshot row at fire time
+
+
+class _EntityState(NamedTuple):
+    nhits: int = 0
+    firing: bool = False
+    tlast_notify: float = -1e18
+
+
+def _entity_key_of(subsys: str, cols: dict, i: int) -> str:
+    for k in ("svcid", "hostid", "flowid"):
+        if k in cols:
+            return f"{k}={cols[k][i]}"
+    return f"row={i}"
+
+
+class AlertManager:
+    MAX_LOG = 10_000     # bounded notification history (oldest dropped)
+
+    def __init__(self, cfg, clock: Optional[Callable[[], float]] = None):
+        self.cfg = cfg
+        self.defs: dict[str, AlertDef] = {}
+        self.silences: dict[str, Silence] = {}
+        self.inhibits: dict[str, Inhibit] = {}
+        self.alert_log: collections.deque = collections.deque(
+            maxlen=self.MAX_LOG)
+        self.actions: dict[str, Callable[[list], None]] = {
+            "log": self.alert_log.extend,
+        }
+        self._state: dict[tuple, _EntityState] = {}
+        self._trees: dict[str, object] = {}     # parsed filter cache
+        self._clock = clock or time.time
+        self.stats = {"nchecks": 0, "nfired": 0, "nsilenced": 0,
+                      "ninhibited": 0, "nresolved": 0}
+
+    # ------------------------------------------------------------- CRUD
+    def add_def(self, d: dict | AlertDef) -> AlertDef:
+        ad = d if isinstance(d, AlertDef) else AlertDef.from_json(d)
+        self.defs[ad.name] = ad
+        self._trees[f"def:{ad.name}"] = criteria.parse(ad.filter)
+        return ad
+
+    def delete_def(self, name: str) -> bool:
+        self._state = {k: v for k, v in self._state.items()
+                       if k[0] != name}
+        self._trees.pop(f"def:{name}", None)
+        return self.defs.pop(name, None) is not None
+
+    def add_silence(self, d: dict | Silence) -> Silence:
+        s = d if isinstance(d, Silence) else Silence.from_json(d)
+        self.silences[s.name] = s
+        if s.filter:
+            self._trees[f"sil:{s.name}"] = criteria.parse(s.filter)
+        return s
+
+    def add_inhibit(self, d: dict | Inhibit) -> Inhibit:
+        i = d if isinstance(d, Inhibit) else Inhibit.from_json(d)
+        self.inhibits[i.name] = i
+        return i
+
+    def register_action(self, name: str, fn: Callable[[list], None]):
+        self.actions[name] = fn
+
+    # ------------------------------------------------------------ check
+    def firing(self) -> list[tuple]:
+        return [k for k, v in self._state.items() if v.firing]
+
+    def _silenced(self, ad: AlertDef, cols, i, now) -> bool:
+        for s in self.silences.values():
+            if not (s.tstart <= now <= s.tend):
+                continue
+            if s.alertnames and ad.name not in s.alertnames:
+                continue
+            if s.filter:
+                tree = self._trees.get(f"sil:{s.name}") \
+                    or criteria.parse(s.filter)
+                one = {k: np.asarray(v[i:i + 1]) for k, v in cols.items()}
+                if not bool(criteria.evaluate(tree, one, ad.subsys)[0]):
+                    continue
+            return True
+        return False
+
+    def _inhibited(self, ad: AlertDef) -> bool:
+        firing_names = {k[0] for k in self.firing()}
+        for inh in self.inhibits.values():
+            if ad.name in inh.target_alertnames and \
+                    firing_names & set(inh.src_alertnames):
+                return True
+        return False
+
+    def check(self, st) -> list[Alert]:
+        """Evaluate all defs against live engine state → newly-notified
+        alerts (grouped per def, routed to actions)."""
+        now = self._clock()
+        self.stats["nchecks"] += 1
+        notified: list[Alert] = []
+        cols_cache: dict[str, tuple] = {}
+
+        for ad in self.defs.values():
+            if not ad.enabled:
+                continue
+            if ad.subsys not in cols_cache:
+                cols_cache[ad.subsys] = api._COLUMNS_OF[ad.subsys](
+                    self.cfg, st)
+            cols, base = cols_cache[ad.subsys]
+            tree = self._trees.get(f"def:{ad.name}") \
+                or criteria.parse(ad.filter)
+            mask = base & criteria.evaluate(tree, cols, ad.subsys)
+            hits = set(np.nonzero(mask)[0].tolist())
+
+            inhibited = self._inhibited(ad)
+            group: list[Alert] = []
+            seen_keys = set()
+            for i in sorted(hits):
+                ent = _entity_key_of(ad.subsys, cols, i)
+                key = (ad.name, ent)
+                seen_keys.add(key)
+                es = self._state.get(key, _EntityState())
+                nhits = es.nhits + 1
+                firing = nhits >= ad.numcheckfor
+                notify = (firing
+                          and now - es.tlast_notify >= ad.repeataftersec)
+                if notify and self._silenced(ad, cols, i, now):
+                    self.stats["nsilenced"] += 1
+                    notify = False
+                if notify and inhibited:
+                    self.stats["ninhibited"] += 1
+                    notify = False
+                if notify:
+                    row = {k: cols[k][i] for k in cols}
+                    group.append(Alert(
+                        alertname=ad.name, severity=ad.severity,
+                        subsys=ad.subsys, entity=ent, tfired=now,
+                        labels=dict(ad.labels),
+                        annotations=dict(ad.annotations),
+                        row={k: (v.item() if hasattr(v, "item") else v)
+                             for k, v in row.items()}))
+                    es = es._replace(tlast_notify=now)
+                self._state[key] = es._replace(nhits=nhits, firing=firing)
+
+            # entities that stopped matching resolve (and are dropped —
+            # the state dict must not grow with entity churn)
+            for key in [k for k in self._state
+                        if k[0] == ad.name and k not in seen_keys]:
+                if self._state[key].firing:
+                    self.stats["nresolved"] += 1
+                del self._state[key]
+
+            if group:
+                self.stats["nfired"] += len(group)
+                notified.extend(group)
+                for act in ad.actions:
+                    fn = self.actions.get(act)
+                    if fn is not None:
+                        fn(group)
+        return notified

@@ -44,6 +44,12 @@ class EngineCfg(NamedTuple):
     n_hosts: int = 64                 # dense host panel rows
     resp_spec: loghist.LogHistSpec = loghist.LogHistSpec(
         vmin=1.0, vmax=1e8, nbuckets=256)   # usec: 1us..100s, <2% error
+    # learned per-svc baselines (ref: qps_hist_/active_conn_hist_,
+    # common/gy_socket_stat.h:365): QPS 1..1M, active conns 1..100k
+    qps_spec: loghist.LogHistSpec = loghist.LogHistSpec(
+        vmin=1.0, vmax=1e6, nbuckets=64)
+    active_spec: loghist.LogHistSpec = loghist.LogHistSpec(
+        vmin=1.0, vmax=1e5, nbuckets=32)
     levels: tuple = windows.LEVELS_DEFAULT
     hll_p_svc: int = 10               # per-svc distinct clients (±3.2%)
     hll_p_global: int = 14            # global distinct endpoints (±0.8%)
@@ -64,7 +70,17 @@ class AggState(NamedTuple):
     svc_hll: hll.HLL                  # (S, m) distinct client endpoints
     svc_td: tdigest.TDigest           # (S, C) per-svc resp digest
     svc_stats: jnp.ndarray            # (S, NSTAT) last listener-state gauges
+    qps_hist: jnp.ndarray             # (S, Bq) learned QPS baseline hist
+    active_hist: jnp.ndarray          # (S, Ba) learned active-conn baseline
+    svc_host: jnp.ndarray             # (S,) int32 owning host id (-1 unset)
+    svc_state: jnp.ndarray            # (S,) int32 semantic.STATE_*
+    svc_issue: jnp.ndarray            # (S,) int32 semantic.ISSUE_*
+    resp_hi_bits: jnp.ndarray         # (S,) int32 8-tick high-resp history
+    #                                   (ref high_resp_bit_hist_,
+    #                                    gy_comm_proto.h:2212)
     host_panel: jnp.ndarray           # (H, NHOSTCOL) last host state
+    host_last_tick: jnp.ndarray       # (H,) int32 tick of last host report
+    #                                   (-1 = never; staleness → Down)
     glob_hll: hll.HLL                 # distinct flow endpoints global
     cms: countmin.CMS                 # flow-key → bytes
     flow_topk: topk.TopK              # heavy-hitter flows by bytes
@@ -83,7 +99,14 @@ def init(cfg: EngineCfg) -> AggState:
         svc_hll=hll.init(p=cfg.hll_p_svc, entities=(S,)),
         svc_td=tdigest.init(capacity=cfg.td_capacity, entities=(S,)),
         svc_stats=jnp.zeros((S, decode.NSTAT), jnp.float32),
+        qps_hist=jnp.zeros((S, cfg.qps_spec.nbuckets), jnp.float32),
+        active_hist=jnp.zeros((S, cfg.active_spec.nbuckets), jnp.float32),
+        svc_host=jnp.full((S,), -1, jnp.int32),
+        svc_state=jnp.zeros((S,), jnp.int32),
+        svc_issue=jnp.zeros((S,), jnp.int32),
+        resp_hi_bits=jnp.zeros((S,), jnp.int32),
         host_panel=jnp.zeros((cfg.n_hosts, NHOSTCOL), jnp.float32),
+        host_last_tick=jnp.full((cfg.n_hosts,), -1, jnp.int32),
         glob_hll=hll.init(p=cfg.hll_p_global),
         cms=countmin.init(cfg.cms_depth, cfg.cms_width),
         flow_topk=topk.init(cfg.topk_capacity),

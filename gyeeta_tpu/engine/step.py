@@ -52,6 +52,7 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
     cur = cur.at[lanes, CTR_DUR_SUM_US].add(cb.duration_us, mode="drop")
     ctr_win = st.ctr_win._replace(cur=cur)
 
+    svc_host = st.svc_host.at[lanes].set(cb.host_id, mode="drop")
     svc_hll = hll.update_entities(st.svc_hll, rowz, cb.cli_hi, cb.cli_lo,
                                   valid=ok)
     glob_hll = hll.update(st.glob_hll, cb.flow_hi, cb.flow_lo, valid=valid)
@@ -61,8 +62,8 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
     flow_topk = topk.update(st.flow_topk, cb.flow_hi, cb.flow_lo, tot_bytes,
                             valid=valid)
     return st._replace(
-        tbl=tbl, ctr_win=ctr_win, svc_hll=svc_hll, glob_hll=glob_hll,
-        cms=cms, flow_topk=flow_topk,
+        tbl=tbl, ctr_win=ctr_win, svc_host=svc_host, svc_hll=svc_hll,
+        glob_hll=glob_hll, cms=cms, flow_topk=flow_topk,
         n_conn=st.n_conn + jnp.sum(valid).astype(jnp.float32),
     )
 
@@ -87,13 +88,31 @@ def ingest_resp(cfg: EngineCfg, st: AggState, rb) -> AggState:
 
 
 def ingest_listener(cfg: EngineCfg, st: AggState, lb) -> AggState:
-    """Fold a ListenerBatch: store last-reported gauges per service row."""
+    """Fold a ListenerBatch: gauges + learned QPS/active-conn baselines.
+
+    The baseline histograms are the self-learning signal of the reference
+    classifier (qps_hist_/active_conn_hist_, common/gy_socket_stat.h:365):
+    every 5s sweep contributes one QPS and one active-conn sample per
+    service; the classifier later compares current values against the
+    p95/p25 of these histograms.
+    """
+    from gyeeta_tpu.ingest import decode as D
+
     valid = lb.valid
     tbl, rows = table.upsert(st.tbl, lb.svc_hi, lb.svc_lo, valid)
     ok = valid & (rows >= 0)
+    rowz = jnp.where(ok, rows, 0)
     lanes = jnp.where(ok, rows, cfg.svc_capacity)
     svc_stats = st.svc_stats.at[lanes].set(lb.stats, mode="drop")
-    return st._replace(tbl=tbl, svc_stats=svc_stats)
+    svc_host = st.svc_host.at[lanes].set(lb.host_id, mode="drop")
+    qps = lb.stats[:, D.STAT_NQRYS] / 5.0
+    qps_hist = loghist.update_entities(
+        st.qps_hist, cfg.qps_spec, rowz, qps, valid=ok)
+    active_hist = loghist.update_entities(
+        st.active_hist, cfg.active_spec, rowz,
+        lb.stats[:, D.STAT_NCONNS_ACTIVE], valid=ok)
+    return st._replace(tbl=tbl, svc_stats=svc_stats, svc_host=svc_host,
+                       qps_hist=qps_hist, active_hist=active_hist)
 
 
 def ingest_host(cfg: EngineCfg, st: AggState, hb) -> AggState:
@@ -101,7 +120,8 @@ def ingest_host(cfg: EngineCfg, st: AggState, hb) -> AggState:
     hid = jnp.where(hb.valid, hb.host_id, cfg.n_hosts)
     panel = st.host_panel.at[hid].set(
         hb.panel.astype(jnp.float32), mode="drop")
-    return st._replace(host_panel=panel)
+    last = st.host_last_tick.at[hid].set(st.resp_win.tick, mode="drop")
+    return st._replace(host_panel=panel, host_last_tick=last)
 
 
 def tick_5s(cfg: EngineCfg, st: AggState) -> AggState:

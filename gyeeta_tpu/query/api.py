@@ -1,0 +1,202 @@
+"""Query API: QUERY_OPTIONS-style requests over live engine state.
+
+The point-in-time path of the reference's web query engine
+(``common/gy_query_common.h:24`` QUERY_OPTIONS parse →
+``server/gy_mnodehandle.cc:203`` web_query_route_qtype → per-subsystem
+``web_curr_*`` walks): here a request is one device readback + one columnar
+criteria mask + host-side JSON row assembly. Freshness = one snapshot
+latency (<1s north star); the historical path is ``gyeeta_tpu.history``.
+
+Request shape (JSON-compatible dict, matching the Node webserver's query
+envelope semantics)::
+
+    {"subsys": "svcstate", "filter": "{ svcstate.state in 'Bad','Severe' }",
+     "columns": ["svcid", "p95resp5s", "state"],    # optional projection
+     "sortcol": "p95resp5s", "sortdesc": true,      # optional sort
+     "maxrecs": 100}
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from gyeeta_tpu.engine.aggstate import AggState, EngineCfg
+from gyeeta_tpu.ingest import decode as D
+from gyeeta_tpu.query import criteria, fieldmaps, readback
+from gyeeta_tpu.semantic import hoststate
+
+
+class QueryOptions(NamedTuple):
+    subsys: str
+    filter: Optional[str] = None
+    columns: Optional[tuple] = None
+    sortcol: Optional[str] = None
+    sortdesc: bool = True
+    maxrecs: int = 1000
+
+    @classmethod
+    def from_json(cls, req: dict) -> "QueryOptions":
+        known = {"subsys", "filter", "columns", "sortcol", "sortdesc",
+                 "maxrecs"}
+        unknown = set(req) - known
+        if unknown:
+            raise ValueError(f"unknown query options: {sorted(unknown)}")
+        if "subsys" not in req:
+            raise ValueError("query needs 'subsys'")
+        cols = req.get("columns")
+        return cls(
+            subsys=req["subsys"], filter=req.get("filter"),
+            columns=tuple(cols) if cols else None,
+            sortcol=req.get("sortcol"),
+            sortdesc=bool(req.get("sortdesc", True)),
+            maxrecs=int(req.get("maxrecs", 1000)),
+        )
+
+
+def _hex_id(hi, lo):
+    gid = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+    return np.array([format(int(g), "016x") for g in gid], object)
+
+
+def svc_columns(cfg: EngineCfg, st: AggState) -> dict:
+    """svcstate subsystem columns (reference JSON names' units: msec)."""
+    snap = {k: np.asarray(v)
+            for k, v in readback.svcstate_snapshot(cfg, st).items()}
+    g = snap["stats"]
+    cols = {
+        "svcid": _hex_id(snap["glob_id_hi"], snap["glob_id_lo"]),
+        "nqry5s": snap["nqry5s"],
+        "qps5s": snap["qps5s"],
+        "resp5s": snap["resp5s_us"] / 1e3,
+        "p95resp5s": snap["p95resp5s_us"] / 1e3,
+        "p99resp5s": snap["p99resp5s_us"] / 1e3,
+        "p95resp5m": snap["p95resp5m_us"] / 1e3,
+        "p50resp5d": snap["p50resp5d_us"] / 1e3,
+        "p95resp5d": snap["p95resp5d_us"] / 1e3,
+        "nconns": g[:, D.STAT_NCONNS],
+        "nactive": g[:, D.STAT_NCONNS_ACTIVE],
+        "nprocs": g[:, D.STAT_NTASKS],
+        "kbin15s": g[:, D.STAT_KB_IN],
+        "kbout15s": g[:, D.STAT_KB_OUT],
+        "sererr": g[:, D.STAT_SER_ERRORS],
+        "clierr": g[:, D.STAT_CLI_ERRORS],
+        "delayus": g[:, D.STAT_TASKS_DELAY_US],
+        "cpudelus": g[:, D.STAT_TASKS_CPUDELAY_US],
+        "iodelus": g[:, D.STAT_TASKS_BLKIODELAY_US],
+        "usercpu": g[:, D.STAT_USER_CPU],
+        "syscpu": g[:, D.STAT_SYS_CPU],
+        "rssmb": g[:, D.STAT_RSS_MB],
+        "nissue": g[:, D.STAT_NTASKS_ISSUE],
+        "state": snap["state"],
+        "issue": snap["issue"],
+        "hostid": snap["hostid"],
+        "nclients": snap["nclients"],
+    }
+    return cols, snap["live"]
+
+
+# a host is Down after this many base ticks without a report (6 x 5s = 30s;
+# ref: server marks parthas inactive on missed status pings,
+# gy_comm_proto.h:974 PARTHA_STATUS + conn timeouts gy_mconnhdlr.h:79)
+DOWN_AFTER_TICKS = 6
+
+
+def host_columns(cfg: EngineCfg, st: AggState) -> dict:
+    panel = np.asarray(st.host_panel)
+    last = np.asarray(st.host_last_tick)
+    now = int(np.asarray(st.resp_win.tick))
+    reported = last >= 0
+    down = reported & (now - last > DOWN_AFTER_TICKS)
+    states = hoststate.classify_hosts(
+        ntask_issue=panel[:, D.HOST_NTASKS_ISSUE],
+        ntask_severe=panel[:, D.HOST_NTASKS_SEVERE],
+        nlisten_issue=panel[:, D.HOST_NLISTEN_ISSUE],
+        nlisten_severe=panel[:, D.HOST_NLISTEN_SEVERE],
+        cpu_issue=panel[:, D.HOST_CPU_ISSUE] > 0,
+        mem_issue=panel[:, D.HOST_MEM_ISSUE] > 0,
+        severe_cpu=panel[:, D.HOST_SEVERE_CPU] > 0,
+        severe_mem=panel[:, D.HOST_SEVERE_MEM] > 0)
+    from gyeeta_tpu.semantic.states import STATE_DOWN
+    states = np.where(down, STATE_DOWN, states)
+    cols = {
+        "hostid": np.arange(panel.shape[0]),
+        "nprocissue": panel[:, D.HOST_NTASKS_ISSUE],
+        "nprocsevere": panel[:, D.HOST_NTASKS_SEVERE],
+        "nproc": panel[:, D.HOST_NTASKS],
+        "nlistissue": panel[:, D.HOST_NLISTEN_ISSUE],
+        "nlistsevere": panel[:, D.HOST_NLISTEN_SEVERE],
+        "nlisten": panel[:, D.HOST_NLISTEN],
+        "state": states,
+        "cpuissue": panel[:, D.HOST_CPU_ISSUE],
+        "memissue": panel[:, D.HOST_MEM_ISSUE],
+        "severecpu": panel[:, D.HOST_SEVERE_CPU],
+        "severemem": panel[:, D.HOST_SEVERE_MEM],
+    }
+    return cols, reported
+
+
+def flow_columns(cfg: EngineCfg, st: AggState, k: int = 128) -> dict:
+    snap = {kk: np.asarray(v)
+            for kk, v in readback.flow_snapshot(cfg, st, k).items()}
+    valid = snap["flow_bytes"] > 0
+    cols = {
+        "flowid": _hex_id(snap["flow_hi"], snap["flow_lo"]),
+        "bytes": snap["flow_bytes"],
+        "evictedbytes": np.full(len(valid), float(snap["evicted_bytes"])),
+    }
+    return cols, valid
+
+
+def cluster_columns(cfg: EngineCfg, st: AggState) -> dict:
+    hcols, reported = host_columns(cfg, st)
+    c = hoststate.cluster_state(np.asarray(hcols["state"]), valid=reported)
+    cols = {k: np.array([float(v)]) for k, v in c.items()}
+    return cols, np.ones(1, bool)
+
+
+_COLUMNS_OF = {
+    fieldmaps.SUBSYS_SVCSTATE: svc_columns,
+    fieldmaps.SUBSYS_HOSTSTATE: host_columns,
+    fieldmaps.SUBSYS_CLUSTERSTATE: cluster_columns,
+    fieldmaps.SUBSYS_FLOWSTATE: flow_columns,
+}
+
+
+def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions) -> dict:
+    """Run one point-in-time query → {"recs": [...], "nrecs": N}."""
+    if opts.subsys not in _COLUMNS_OF:
+        raise ValueError(f"unknown subsystem {opts.subsys!r}")
+    cols, base_mask = _COLUMNS_OF[opts.subsys](cfg, st)
+    tree = criteria.parse(opts.filter) if opts.filter else None
+    mask = base_mask & criteria.evaluate(tree, cols, opts.subsys)
+    idx = np.nonzero(mask)[0]
+
+    if opts.sortcol:
+        fmap = fieldmaps.field_map(opts.subsys)
+        fd = fmap.get(opts.sortcol)
+        if fd is None:
+            raise ValueError(f"unknown sort column {opts.sortcol!r}")
+        key = np.asarray(cols[fd.col])[idx]
+        order = np.argsort(key, kind="stable")
+        idx = idx[order[::-1] if opts.sortdesc else order]
+    idx = idx[: opts.maxrecs]
+
+    fmap = fieldmaps.field_map(opts.subsys)
+    want = opts.columns or tuple(fmap)
+    unknown = [c for c in want if c not in fmap]
+    if unknown:
+        raise ValueError(f"unknown columns {unknown}")
+    recs = []
+    for i in idx:
+        row = {fmap[c].col: cols[fmap[c].col][i] for c in want
+               if fmap[c].col in cols}
+        recs.append(fieldmaps.row_to_json(opts.subsys, row))
+    return {"recs": recs, "nrecs": len(recs),
+            "ntotal": int(base_mask.sum())}
+
+
+def query_json(cfg: EngineCfg, st: AggState, req: dict) -> dict:
+    """JSON-envelope entry point (the NM-conn QUERY_CMD analogue)."""
+    return execute(cfg, st, QueryOptions.from_json(req))

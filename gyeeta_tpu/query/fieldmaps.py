@@ -1,0 +1,175 @@
+"""Per-subsystem field maps: JSON field ↔ column ↔ type ↔ enum codec.
+
+The tensor-era analogue of ``common/gy_json_field_maps.h`` (~40 subsystems
+of ``JSON_DB_MAPPING`` tables, e.g. hoststate :785, svcstate :1102): every
+queryable subsystem declares its fields once; the criteria engine and the
+JSON writers are generic over these tables. JSON field names match the
+reference's query API so existing Gyeeta queries port unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+from gyeeta_tpu.semantic.states import ISSUE_NAMES, STATE_NAMES
+
+SUBSYS_SVCSTATE = "svcstate"
+SUBSYS_HOSTSTATE = "hoststate"
+SUBSYS_CLUSTERSTATE = "clusterstate"
+SUBSYS_FLOWSTATE = "flowstate"      # heavy-hitter flows (TPU-first)
+SUBSYS_SVCINFO = "svcinfo"
+
+
+class FieldDef(NamedTuple):
+    json: str                       # JSON/query field name (reference name)
+    col: str                        # column key in the readback dict
+    kind: str                       # "num" | "str" | "bool" | "enum"
+    to_json: Optional[Callable] = None     # value → JSON value
+    from_json: Optional[Callable] = None   # query literal → comparable value
+    desc: str = ""
+
+
+def _enum_codec(names):
+    lower = [n.lower() for n in names]
+
+    def enc(v):
+        i = int(v)
+        return names[i] if 0 <= i < len(names) else str(i)
+
+    def dec(s):
+        if isinstance(s, (int, float)):
+            return float(s)
+        try:
+            return float(lower.index(str(s).lower()))
+        except ValueError:
+            raise ValueError(f"unknown enum literal {s!r}; one of {names}")
+
+    return enc, dec
+
+
+_state_enc, _state_dec = _enum_codec(STATE_NAMES)
+_issue_enc, _issue_dec = _enum_codec(ISSUE_NAMES)
+
+
+def num(json, col, desc=""):
+    return FieldDef(json, col, "num", desc=desc)
+
+
+def boolean(json, col, desc=""):
+    return FieldDef(json, col, "bool", desc=desc)
+
+
+def enum(json, col, enc, dec, desc=""):
+    return FieldDef(json, col, "enum", to_json=enc, from_json=dec, desc=desc)
+
+
+def string(json, col, desc=""):
+    return FieldDef(json, col, "str", desc=desc)
+
+
+# --------------------------------------------------------------- svcstate
+# ref json_db_svcstate_arr (gy_json_field_maps.h:1102); column keys are the
+# keys of query.api.svc_columns()
+SVCSTATE_FIELDS = (
+    string("svcid", "svcid", "Service glob id (hex)"),
+    num("qps5s", "qps5s", "Current queries/sec"),
+    num("nqry5s", "nqry5s", "Queries in last 5s window"),
+    num("resp5s", "resp5s", "Mean response last 5s (msec)"),
+    num("p95resp5s", "p95resp5s", "p95 response last 5s (msec)"),
+    num("p95resp5m", "p95resp5m", "p95 response last 5min (msec)"),
+    num("p99resp5s", "p99resp5s", "p99 response last 5s (msec)"),
+    num("nconns", "nconns", "Total connections"),
+    num("nactive", "nactive", "Active connections"),
+    num("nprocs", "nprocs", "Listener processes"),
+    num("kbin15s", "kbin15s", "Inbound KB"),
+    num("kbout15s", "kbout15s", "Outbound KB"),
+    num("sererr", "sererr", "Server errors"),
+    num("clierr", "clierr", "Client errors"),
+    num("delayus", "delayus", "Process delays usec"),
+    num("cpudelus", "cpudelus", "CPU delays usec"),
+    num("iodelus", "iodelus", "Block IO delays usec"),
+    num("usercpu", "usercpu", "User CPU %"),
+    num("syscpu", "syscpu", "System CPU %"),
+    num("rssmb", "rssmb", "Resident memory MB"),
+    num("nissue", "nissue", "Processes with issues"),
+    enum("state", "state", _state_enc, _state_dec,
+         "Service state per analysis"),
+    enum("issue", "issue", _issue_enc, _issue_dec, "Issue source"),
+    num("hostid", "hostid", "Owning host id"),
+    num("nclients", "nclients", "Distinct client endpoints (HLL)"),
+    num("p50resp5d", "p50resp5d", "p50 response 5-day window (msec)"),
+    num("p95resp5d", "p95resp5d", "p95 response 5-day window (msec)"),
+)
+
+# -------------------------------------------------------------- hoststate
+# ref json_db_hoststate_arr (gy_json_field_maps.h:785)
+HOSTSTATE_FIELDS = (
+    num("hostid", "hostid", "Host id"),
+    num("nprocissue", "nprocissue", "Processes with issues"),
+    num("nprocsevere", "nprocsevere", "Processes with severe issues"),
+    num("nproc", "nproc", "Total processes"),
+    num("nlistissue", "nlistissue", "Listeners with issues"),
+    num("nlistsevere", "nlistsevere", "Listeners with severe issues"),
+    num("nlisten", "nlisten", "Total listeners"),
+    enum("state", "state", _state_enc, _state_dec, "Host state"),
+    boolean("cpuissue", "cpuissue", "Host CPU issue"),
+    boolean("memissue", "memissue", "Host memory issue"),
+    boolean("severecpu", "severecpu", "Severe CPU issue"),
+    boolean("severemem", "severemem", "Severe memory issue"),
+)
+
+# ----------------------------------------------------------- clusterstate
+# ref MS_CLUSTER_STATE (gy_comm_proto.h:3181) / shyama aggregate
+CLUSTERSTATE_FIELDS = (
+    num("nhosts", "nhosts", "Hosts reporting"),
+    num("nidle", "nidle", "Hosts Idle"),
+    num("ngood", "ngood", "Hosts Good"),
+    num("nok", "nok", "Hosts OK"),
+    num("nbad", "nbad", "Hosts Bad"),
+    num("nsevere", "nsevere", "Hosts Severe"),
+    num("ndown", "ndown", "Hosts Down"),
+    num("issuefrac", "issue_frac", "Fraction of hosts Bad/Severe"),
+)
+
+# -------------------------------------------------------------- flowstate
+FLOWSTATE_FIELDS = (
+    string("flowid", "flowid", "Flow key (hex)"),
+    num("bytes", "bytes", "Bytes transferred (top-K estimate)"),
+    num("evictedbytes", "evictedbytes", "Undercount bound (evicted mass)"),
+)
+
+FIELDS_OF_SUBSYS = {
+    SUBSYS_SVCSTATE: SVCSTATE_FIELDS,
+    SUBSYS_HOSTSTATE: HOSTSTATE_FIELDS,
+    SUBSYS_CLUSTERSTATE: CLUSTERSTATE_FIELDS,
+    SUBSYS_FLOWSTATE: FLOWSTATE_FIELDS,
+}
+
+
+def field_map(subsys: str) -> dict[str, FieldDef]:
+    try:
+        return {f.json: f for f in FIELDS_OF_SUBSYS[subsys]}
+    except KeyError:
+        raise ValueError(f"unknown subsystem {subsys!r}; "
+                         f"one of {sorted(FIELDS_OF_SUBSYS)}")
+
+
+def row_to_json(subsys: str, row: dict) -> dict:
+    """Apply enum/bool codecs for presentation (statetojson analogues)."""
+    out = {}
+    for f in FIELDS_OF_SUBSYS[subsys]:
+        if f.col not in row:
+            continue
+        v = row[f.col]
+        if f.kind == "enum":
+            out[f.json] = f.to_json(v)
+        elif f.kind == "bool":
+            out[f.json] = bool(v)
+        elif f.kind == "num":
+            fv = float(v)
+            out[f.json] = int(fv) if fv.is_integer() else round(fv, 3)
+        else:
+            out[f.json] = v
+    return out

@@ -74,6 +74,42 @@ def svc_snapshot(cfg: EngineCfg, st: AggState, level: int = 0):
     }
 
 
+@partial(jax.jit, static_argnums=(0,))
+def svcstate_snapshot(cfg: EngineCfg, st: AggState):
+    """The svcstate-subsystem readback: current 5s window + gauges + the
+    semantic classification — the ``web_curr_svcstate`` analogue
+    (``server/gy_mnodehandle.cc``), one device program for the fleet."""
+    spec = cfg.resp_spec
+    qs = jnp.asarray((0.5, 0.95, 0.99), jnp.float32)
+    h5 = st.resp_win.cur
+    h5m = windows.read(st.resp_win, 0)
+    h5d = windows.read(st.resp_win, 1)
+    q5 = loghist.quantiles(h5, spec, qs)
+    q5m = loghist.quantiles(h5m, spec, qs)
+    q5d = loghist.quantiles(h5d, spec, qs)
+    from gyeeta_tpu.ingest.decode import STAT_NQRYS
+    nqrys = jnp.maximum(loghist.counts_total(h5),
+                        st.svc_stats[:, STAT_NQRYS])
+    return {
+        "glob_id_hi": st.tbl.key_hi,
+        "glob_id_lo": st.tbl.key_lo,
+        "live": table.live_mask(st.tbl),
+        "nqry5s": nqrys,
+        "qps5s": nqrys / 5.0,
+        "resp5s_us": loghist.mean(h5, spec),
+        "p95resp5s_us": q5[:, 1],
+        "p99resp5s_us": q5[:, 2],
+        "p95resp5m_us": q5m[:, 1],
+        "p50resp5d_us": q5d[:, 0],
+        "p95resp5d_us": q5d[:, 1],
+        "state": st.svc_state,
+        "issue": st.svc_issue,
+        "hostid": st.svc_host,
+        "nclients": hll.estimate(st.svc_hll),
+        "stats": st.svc_stats,
+    }
+
+
 @partial(jax.jit, static_argnums=(0, 2))
 def flow_snapshot(cfg: EngineCfg, st: AggState, k: int = 64):
     """Heavy-hitter flows by bytes + global distinct-endpoint estimate."""

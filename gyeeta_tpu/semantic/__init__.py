@@ -1,0 +1,26 @@
+"""Semantic layer: self-learning health classification as tensor rules.
+
+The product feature the sketches feed — the reference classifies every
+service (``TCP_LISTENER::get_curr_state``, ``common/gy_socket_stat.cc:2020``)
+and host (``host_status_update``, :4455) into six states
+(Idle/Good/OK/Bad/Severe/Down, ``common/gy_json_field_maps.h:242``) by
+comparing *current* percentiles against the service's own *historical*
+percentile baselines. Here the whole fleet classifies in one jitted
+first-match-wins rule cascade over (S,) columns.
+"""
+
+from gyeeta_tpu.semantic.states import (
+    STATE_IDLE, STATE_GOOD, STATE_OK, STATE_BAD, STATE_SEVERE, STATE_DOWN,
+    ISSUE_NONE, ISSUE_TASKS, ISSUE_QPS_HIGH, ISSUE_ACTIVE_CONN_HIGH,
+    ISSUE_SERVER_ERRORS, ISSUE_OS_CPU, ISSUE_OS_MEMORY, STATE_NAMES,
+    ISSUE_NAMES,
+)
+from gyeeta_tpu.semantic import svcstate, hoststate, derive
+
+__all__ = [
+    "STATE_IDLE", "STATE_GOOD", "STATE_OK", "STATE_BAD", "STATE_SEVERE",
+    "STATE_DOWN", "ISSUE_NONE", "ISSUE_TASKS", "ISSUE_QPS_HIGH",
+    "ISSUE_ACTIVE_CONN_HIGH", "ISSUE_SERVER_ERRORS", "ISSUE_OS_CPU",
+    "ISSUE_OS_MEMORY", "STATE_NAMES", "ISSUE_NAMES", "svcstate", "hoststate",
+    "derive",
+]

@@ -1,0 +1,89 @@
+"""Host and cluster state rollups.
+
+Host: replicates ``TCP_SOCK_HANDLER::host_status_update``
+(``common/gy_socket_stat.cc:4455``): combines host cpu/mem issue flags with
+per-host counts of task/listener issues into one 6-state label — vectorized
+over the whole host panel.
+
+Cluster: the shyama aggregate (``server/gy_shconnhdlr.cc:4583``
+aggregate_cluster_state) — counts of hosts per state plus totals — computed
+from the same panel (optionally the ``psum``-merged panel of a mesh rollup).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from gyeeta_tpu.semantic.states import (
+    STATE_IDLE, STATE_GOOD, STATE_OK, STATE_BAD, STATE_SEVERE,
+)
+
+
+def classify_hosts(ntask_issue, ntask_severe, nlisten_issue, nlisten_severe,
+                   cpu_issue, mem_issue, severe_cpu, severe_mem,
+                   cpu_idle=None):
+    """→ (H,) int32 host states. Rule order mirrors the reference exactly."""
+    xp = jnp if isinstance(ntask_issue, jnp.ndarray) else np
+    H = ntask_issue.shape
+    if cpu_idle is None:
+        cpu_idle = xp.zeros(H, bool)
+    any_cpu_mem = cpu_issue | mem_issue
+    any_entity = (ntask_issue > 0) | (nlisten_issue > 0)
+
+    state = xp.full(H, STATE_OK, np.int32)  # reference fallback (:4529)
+    decided = xp.zeros(H, bool)
+
+    def rule(cond, st):
+        nonlocal state, decided
+        take = cond & ~decided
+        state = xp.where(take, st, state)
+        decided = decided | take
+
+    # severe everywhere (:4462)
+    rule(((ntask_severe > 0) | (nlisten_severe > 0))
+         & (severe_cpu | severe_mem), STATE_SEVERE)
+    # totally clean (:4468)
+    rule(~any_cpu_mem & ~any_entity & cpu_idle, STATE_IDLE)
+    rule(~any_cpu_mem & ~any_entity, STATE_GOOD)
+    # entity issues + host pressure (:4478)
+    rule(any_entity & any_cpu_mem
+         & ((ntask_issue > 5) | (nlisten_issue > 5)), STATE_SEVERE)
+    rule(any_entity & any_cpu_mem, STATE_BAD)
+    # host pressure only (:4488)
+    rule(any_cpu_mem & (severe_cpu | severe_mem), STATE_BAD)
+    rule(any_cpu_mem, STATE_OK)
+    # listener issues only (:4498)
+    rule((nlisten_issue > 0) & ((nlisten_severe > 0) | (ntask_issue > 0))
+         & (nlisten_issue > 5), STATE_SEVERE)
+    rule((nlisten_issue > 0) & ((nlisten_severe > 0) | (ntask_issue > 0)),
+         STATE_BAD)
+    rule(nlisten_issue > 2, STATE_BAD)
+    rule(nlisten_issue > 0, STATE_OK)
+    # task issues only (:4518)
+    rule((ntask_issue > 0) & ((ntask_severe > 0) | (ntask_issue > 5)),
+         STATE_BAD)
+    rule(ntask_issue > 0, STATE_OK)
+    return state
+
+
+def cluster_state(host_states, valid=None):
+    """Counts of hosts per state + issue ratio (the MS_CLUSTER_STATE
+    payload, ``common/gy_comm_proto.h:3181``). → dict of () scalars."""
+    xp = jnp if isinstance(host_states, jnp.ndarray) else np
+    if valid is None:
+        valid = xp.ones(host_states.shape, bool)
+    counts = [xp.sum(valid & (host_states == st)).astype(np.int32)
+              for st in range(6)]
+    n_up = xp.sum(valid).astype(np.int32)
+    n_issue = counts[STATE_BAD] + counts[STATE_SEVERE]
+    return {
+        "nhosts": n_up,
+        "nidle": counts[STATE_IDLE],
+        "ngood": counts[STATE_GOOD],
+        "nok": counts[STATE_OK],
+        "nbad": counts[STATE_BAD],
+        "nsevere": counts[STATE_SEVERE],
+        "ndown": counts[5],
+        "issue_frac": n_issue / xp.maximum(n_up, 1),
+    }

@@ -1,0 +1,22 @@
+"""State and issue-source enums (ref: ``common/gy_json_field_maps.h:242``
+OBJ_STATE_E, :419 LISTENER_ISSUE_SRC)."""
+
+STATE_IDLE = 0
+STATE_GOOD = 1
+STATE_OK = 2
+STATE_BAD = 3
+STATE_SEVERE = 4
+STATE_DOWN = 5
+
+STATE_NAMES = ("Idle", "Good", "OK", "Bad", "Severe", "Down")
+
+ISSUE_NONE = 0
+ISSUE_TASKS = 1           # ISSUE_LISTENER_TASKS
+ISSUE_QPS_HIGH = 2
+ISSUE_ACTIVE_CONN_HIGH = 3
+ISSUE_SERVER_ERRORS = 4
+ISSUE_OS_CPU = 5
+ISSUE_OS_MEMORY = 6
+
+ISSUE_NAMES = ("none", "listener_tasks", "qps_high", "active_conn_high",
+               "server_errors", "os_cpu", "os_memory")

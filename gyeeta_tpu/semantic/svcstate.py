@@ -1,0 +1,213 @@
+"""Vectorized service health classifier.
+
+Replicates the rule structure of ``TCP_LISTENER::get_curr_state``
+(``common/gy_socket_stat.cc:2020-2780``) — the reference's self-learning
+percentile heuristic — as one first-match-wins rule cascade over (S,)
+columns, jitted for the whole service fleet at once.
+
+The learning signal is identical: the service's *own* history is the
+baseline (5s p95 vs 5-day p95 response buckets, current QPS vs p95/p25
+historical QPS, current active conns vs their percentiles). Rules fire in
+the reference's priority order; each rule's condition is the conjunction of
+its branch path in the original tree.
+
+Documented deviations (TPU-first simplifications, same spirit):
+- bucket comparisons use the engine's geometric loghist bucket index
+  (``sketch/loghist.bucket_of``) instead of RESP_TIME_HASH's 15 fixed
+  thresholds — finer resolution, same "within N buckets" semantics;
+- the reference's final per-bucket active-conn scan (nactive_conn_arr_,
+  :2711) and the 8-tick high-resp persistence check (:2750) fold into one
+  ``high_resp_ticks`` input (count of recent high-response ticks) supplied
+  by the engine's issue bit history;
+- one reference fall-through quirk (OK state labeled with the overwritten
+  LISTENER_TASKS issue after a missing return, :2419) is emitted as the
+  evidently-intended OK/SERVER_ERRORS.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from gyeeta_tpu.semantic.states import (
+    STATE_IDLE, STATE_GOOD, STATE_OK, STATE_BAD, STATE_SEVERE,
+    ISSUE_NONE, ISSUE_TASKS, ISSUE_QPS_HIGH, ISSUE_ACTIVE_CONN_HIGH,
+    ISSUE_SERVER_ERRORS,
+)
+
+
+class SvcSignals(NamedTuple):
+    """Per-service classifier inputs, all (S,) float32/bool arrays.
+
+    Response percentiles are loghist *bucket indices* (resolution-free
+    comparisons); qps/active percentiles are plain values.
+    """
+    b5: jnp.ndarray            # bucket of 5s-window p95 response
+    b300: jnp.ndarray          # bucket of 5min-window p95
+    b5day: jnp.ndarray        # bucket of 5day-window p95
+    r5p95: jnp.ndarray         # raw p95 values (usec)
+    r5p99: jnp.ndarray
+    r5dayp95: jnp.ndarray
+    r5dayp99: jnp.ndarray
+    mean5: jnp.ndarray         # 5s-window mean response
+    mean5day: jnp.ndarray
+    nqrys_5s: jnp.ndarray      # queries in current 5s window
+    curr_qps: jnp.ndarray
+    qps_p95: jnp.ndarray       # historical qps percentiles (learned)
+    qps_p25: jnp.ndarray
+    curr_active: jnp.ndarray   # current active conns
+    active_p95: jnp.ndarray
+    active_p25: jnp.ndarray
+    nconn: jnp.ndarray         # total conns
+    ser_errors: jnp.ndarray    # server errors in window
+    task_issue: jnp.ndarray    # bool — process-level issue (delays/cpu)
+    task_severe: jnp.ndarray   # bool
+    task_delay: jnp.ndarray    # bool — delay-type issue
+    ntasks_issue: jnp.ndarray
+    ntasks_noissue: jnp.ndarray
+    tasks_delay_msec: jnp.ndarray
+    total_resp_msec: jnp.ndarray
+    cpu_issue: jnp.ndarray     # bool — host cpu issue
+    mem_issue: jnp.ndarray     # bool
+    high_resp_ticks: jnp.ndarray  # recent high-response tick count (0..8)
+    b_1ms: int = 0             # bucket index of 1 ms (static threshold)
+
+
+def classify(s: SvcSignals):
+    """→ (state, issue): (S,) int32 each. First matching rule wins."""
+    xp = jnp if isinstance(s.b5, jnp.ndarray) else np
+    S = s.b5.shape
+    state = xp.full(S, STATE_BAD, xp.int32 if xp is jnp else np.int32)
+    issue = xp.full(S, ISSUE_NONE, xp.int32 if xp is jnp else np.int32)
+    decided = xp.zeros(S, bool)
+
+    rules = []
+
+    def rule(cond, st, isrc):
+        rules.append((cond, st, isrc))
+
+    err = s.ser_errors
+    nq = s.nqrys_5s
+    many_err = err * 2 > nq
+    some_err = err * 5 > nq
+    has_err = err > 0
+    ti = s.task_issue
+
+    # ---- idle gate (:2125) -------------------------------------------------
+    rule((s.curr_qps == 0) & ~(ti & s.task_severe & has_err),
+         STATE_IDLE, ISSUE_NONE)
+
+    # ---- branch A: low response (:2141) -----------------------------------
+    low = (s.b5 <= s.b_1ms) | (s.r5p95 < s.r5dayp95)
+    qps_low = (s.curr_qps <= s.qps_p25) & (s.qps_p25 < s.qps_p95)
+
+    a1 = low & qps_low
+    rule(a1 & ~ti & ~has_err, STATE_IDLE, ISSUE_NONE)
+    rule(a1 & many_err, STATE_SEVERE, ISSUE_SERVER_ERRORS)
+    rule(a1 & some_err, STATE_BAD, ISSUE_SERVER_ERRORS)
+    rule(a1 & ~ti & has_err & (err < nq * 0.1), STATE_OK,
+         ISSUE_SERVER_ERRORS)
+    rule(a1 & ti & has_err, STATE_BAD, ISSUE_TASKS)
+    rule(a1 & ti & s.task_severe & (s.ntasks_issue > 0)
+         & (s.ntasks_noissue == 0), STATE_BAD, ISSUE_TASKS)
+    rule(a1 & ti & (s.nconn > s.active_p25), STATE_OK, ISSUE_TASKS)
+
+    rule(low & many_err, STATE_SEVERE, ISSUE_SERVER_ERRORS)
+    rule(low & some_err, STATE_BAD, ISSUE_SERVER_ERRORS)
+    rule(low & ti & s.task_severe & (s.ntasks_issue > 0)
+         & (s.ntasks_noissue == 0), STATE_BAD, ISSUE_TASKS)
+    rule(low & ~has_err & ((s.curr_qps <= s.qps_p95)
+                           | (s.b5 + 2 <= s.b5day)), STATE_GOOD, ISSUE_NONE)
+    rule(low & ~has_err, STATE_OK, ISSUE_QPS_HIGH)   # qps > p95
+    rule(low, STATE_OK, ISSUE_SERVER_ERRORS)
+
+    # ---- branch B: response equals the historical baseline (:2309) --------
+    same = s.b5 == s.b5day
+    rule(same & many_err, STATE_SEVERE, ISSUE_SERVER_ERRORS)
+    rule(same & some_err, STATE_BAD, ISSUE_SERVER_ERRORS)
+
+    b2 = same & (s.mean5 <= s.mean5day * 0.8)
+    b2_qlow = b2 & (s.curr_qps <= s.qps_p25)
+    rule(b2_qlow & has_err, STATE_BAD, ISSUE_SERVER_ERRORS)
+    rule(b2_qlow & ~ti, STATE_IDLE, ISSUE_NONE)
+    rule(b2_qlow & (s.ntasks_issue > 0) & (s.ntasks_noissue == 0),
+         STATE_BAD, ISSUE_TASKS)
+    rule(b2_qlow & (s.ntasks_issue > 0) & (s.tasks_delay_msec >= 1000),
+         STATE_BAD, ISSUE_TASKS)
+    rule(b2 & ~ti & ~has_err, STATE_GOOD, ISSUE_NONE)
+    rule(b2 & has_err & ti, STATE_BAD, ISSUE_TASKS)
+    rule(b2 & has_err, STATE_OK, ISSUE_SERVER_ERRORS)
+    rule(b2, STATE_OK, ISSUE_TASKS)
+
+    rule(same & (s.mean5 <= s.mean5day * 1.2), STATE_OK, ISSUE_NONE)
+
+    # ---- high-response section (:2437) ------------------------------------
+    rule(many_err, STATE_SEVERE, ISSUE_SERVER_ERRORS)
+    rule(some_err, STATE_BAD, ISSUE_SERVER_ERRORS)
+
+    much_higher = (s.b5 > s.b5day + 2) & (s.b5 > s.b300)
+    qps_high = ((s.curr_qps > s.qps_p95)
+                & (s.curr_qps - s.qps_p95 > 5)
+                & (s.curr_qps > s.qps_p95 * 1.1))
+    rule(qps_high & much_higher, STATE_SEVERE, ISSUE_QPS_HIGH)
+    rule(qps_high, STATE_BAD, ISSUE_QPS_HIGH)
+
+    task_like = ti | (s.task_delay
+                      & (s.ntasks_issue + s.ntasks_noissue > 2)
+                      & (s.tasks_delay_msec * 4 > s.total_resp_msec))
+    rule(task_like & much_higher, STATE_SEVERE, ISSUE_TASKS)
+    rule(task_like, STATE_BAD, ISSUE_TASKS)
+
+    act_high = ((s.curr_active > s.active_p95)
+                & (s.curr_active - s.active_p95 > 1))
+    rule(act_high & much_higher & (s.curr_active > 10), STATE_SEVERE,
+         ISSUE_ACTIVE_CONN_HIGH)
+    rule(act_high, STATE_BAD, ISSUE_ACTIVE_CONN_HIGH)
+
+    # outliers only: p95 same but p99 worse → a few slow queries (:2556)
+    rule(same & (s.r5p99 > s.r5dayp99), STATE_OK, ISSUE_NONE)
+
+    # low qps + low conns + bounded degradation (:2662)
+    calm = ((s.curr_qps <= s.qps_p25) & (s.curr_active <= s.active_p25)
+            & (s.b5 <= s.b5day + 1))
+    rule(calm & s.task_delay & s.cpu_issue & s.mem_issue, STATE_BAD,
+         ISSUE_TASKS)
+    rule(calm & s.task_delay & (s.cpu_issue | s.mem_issue)
+         & (s.tasks_delay_msec * 4 > s.total_resp_msec), STATE_BAD,
+         ISSUE_TASKS)
+    rule(calm & has_err, STATE_OK, ISSUE_SERVER_ERRORS)
+    rule(calm, STATE_OK, ISSUE_NONE)
+
+    # transient: 5s worse but 5min == 5day (:2685)
+    transient = ((s.b5 <= s.b5day + 1) & (s.b300 == s.b5day)
+                 & (s.mean5 > s.mean5day) & has_err)
+    rule(transient, STATE_OK, ISSUE_SERVER_ERRORS)
+    rule((s.b5 <= s.b5day + 1) & (s.b300 == s.b5day)
+         & (s.mean5 > s.mean5day), STATE_OK, ISSUE_NONE)
+
+    # not persistent: high resp for < 5 of the last 8 ticks (:2750)
+    rule(s.high_resp_ticks < 5, STATE_OK, ISSUE_NONE)
+
+    # final: genuinely degraded (:2774)
+    rule(much_higher & (s.tasks_delay_msec * 4 > s.total_resp_msec),
+         STATE_SEVERE, ISSUE_TASKS)
+    rule(much_higher, STATE_SEVERE, ISSUE_NONE)
+    rule((s.tasks_delay_msec * 4 > s.total_resp_msec), STATE_BAD,
+         ISSUE_TASKS)
+
+    for cond, st, isrc in rules:
+        take = cond & ~decided
+        state = xp.where(take, st, state)
+        issue = xp.where(take, isrc, issue)
+        decided = decided | take
+    # anything undecided: Bad with no attributed source (reference default)
+    return state, issue
+
+
+def np_classify(s: SvcSignals):
+    """Numpy twin of ``classify`` (same cascade, used as the test oracle
+    for scalar-loop cross-checks)."""
+    return classify(SvcSignals(*[np.asarray(x) if not isinstance(x, int)
+                                 else x for x in s]))

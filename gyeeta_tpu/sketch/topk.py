@@ -111,6 +111,7 @@ def query(sk: TopK, k: int):
     Slots with SENTINEL keys / zero counts are empty; callers should filter
     ``counts > 0``. ``sk.evicted`` bounds the per-key undercount.
     """
+    k = min(k, sk.counts.shape[0])
     v, i = jax.lax.top_k(sk.counts, k)
     return sk.key_hi[i], sk.key_lo[i], v
 

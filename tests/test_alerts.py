@@ -1,0 +1,149 @@
+"""Alert manager tests: lifecycle, numcheckfor, repeat holdoff, silences,
+inhibits (ref: ``server/gy_malerts.cc`` realtime defs; ``gy_alertmgr.cc``
+silences :5117, inhibits :5200)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gyeeta_tpu.alerts import AlertManager
+from gyeeta_tpu.engine import aggstate, step
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import decode
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.sketch import loghist
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return EngineCfg(
+        svc_capacity=32, n_hosts=8,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
+        hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
+        topk_capacity=16, td_capacity=16, td_route_cap=16,
+        conn_batch=64, resp_batch=512, listener_batch=32)
+
+
+@pytest.fixture()
+def driven(cfg):
+    """Engine state where exactly the slowest services exceed 10ms p95."""
+    sim = ParthaSim(n_hosts=4, n_svcs=2, n_clients=64, seed=41)
+    st = aggstate.init(cfg)
+    fold = step.jit_fold_step(cfg)
+    for _ in range(2):
+        st = fold(st,
+                  decode.conn_batch(sim.conn_records(64), cfg.conn_batch),
+                  decode.resp_batch(sim.resp_records(512), cfg.resp_batch))
+    return st, sim
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def mgr_with(cfg, clock, **overrides):
+    m = AlertManager(cfg, clock=clock)
+    d = dict(alertname="slow_svc", subsys="svcstate",
+             filter="{ svcstate.p95resp5s > 10 }",
+             severity="critical", numcheckfor=1, repeataftersec=600)
+    d.update(overrides)
+    m.add_def(d)
+    return m
+
+
+def test_def_validation(cfg):
+    m = AlertManager(cfg)
+    with pytest.raises(ValueError):
+        m.add_def({"alertname": "x", "subsys": "nope", "filter": "{a.b=1}"})
+    with pytest.raises(ValueError):
+        m.add_def({"alertname": "x", "subsys": "svcstate",
+                   "filter": "{ svcstate.qps5s >> }"})
+    with pytest.raises(ValueError):
+        m.add_def({"alertname": "x", "subsys": "svcstate",
+                   "filter": "{ svcstate.qps5s > 1 }", "severity": "hair"})
+
+
+def test_fire_and_repeat_holdoff(cfg, driven):
+    st, sim = driven
+    clock = Clock()
+    m = mgr_with(cfg, clock)
+    fired = m.check(st)
+    assert len(fired) > 0
+    assert all(a.row["p95resp5s"] > 10 for a in fired)
+    assert all(a.severity == "critical" for a in fired)
+    assert len(m.alert_log) == len(fired)
+    # immediate re-check: holdoff suppresses
+    assert m.check(st) == []
+    # after holdoff expires, re-notifies
+    clock.t += 700
+    assert len(m.check(st)) == len(fired)
+
+
+def test_numcheckfor(cfg, driven):
+    st, sim = driven
+    clock = Clock()
+    m = mgr_with(cfg, clock, numcheckfor=3)
+    assert m.check(st) == []
+    assert m.check(st) == []
+    fired = m.check(st)          # third consecutive hit
+    assert len(fired) > 0
+    assert len(m.firing()) == len(fired)
+
+
+def test_resolve_on_recovery(cfg, driven):
+    st, sim = driven
+    clock = Clock()
+    m = mgr_with(cfg, clock)
+    fired = m.check(st)
+    assert len(m.firing()) == len(fired)
+    # fresh state: no services over threshold → all resolve
+    st2 = aggstate.init(cfg)
+    m.check(st2)
+    assert m.firing() == []
+    assert m.stats["nresolved"] == len(fired)
+
+
+def test_silence(cfg, driven):
+    st, sim = driven
+    clock = Clock()
+    m = mgr_with(cfg, clock)
+    m.add_silence({"name": "maint", "alertnames": ["slow_svc"],
+                   "tstart": 0, "tend": 2000})
+    assert m.check(st) == []
+    assert m.stats["nsilenced"] > 0
+    # silence expires → fires
+    clock.t = 3000.0
+    assert len(m.check(st)) > 0
+
+
+def test_inhibit(cfg, driven):
+    st, sim = driven
+    clock = Clock()
+    m = mgr_with(cfg, clock)
+    # a cluster-wide alert that also fires inhibits the per-svc one
+    m.add_def({"alertname": "any_traffic", "subsys": "clusterstate",
+               "filter": "{ clusterstate.nhosts >= 0 }"})
+    m.add_inhibit({"name": "i1", "src_alertnames": ["any_traffic"],
+                   "target_alertnames": ["slow_svc"]})
+    first = m.check(st)          # any_traffic fires; slow_svc pending same
+    names = {a.alertname for a in first}
+    assert "any_traffic" in names
+    clock.t += 700
+    second = m.check(st)
+    assert all(a.alertname != "slow_svc" for a in second)
+    assert m.stats["ninhibited"] > 0
+
+
+def test_custom_action(cfg, driven):
+    st, sim = driven
+    got = []
+    m = mgr_with(cfg, Clock())
+    m.defs["slow_svc"] = m.defs["slow_svc"]._replace(
+        actions=("log", "webhook"))
+    m.register_action("webhook", got.extend)
+    fired = m.check(st)
+    assert got == fired

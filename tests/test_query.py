@@ -1,0 +1,191 @@
+"""Query layer tests: criteria parse/eval + end-to-end JSON queries
+(ref: ``common/gy_query_criteria.h:56``, ``gy_query_common.h:24``,
+``server/gy_mnodehandle.cc:203``)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine import aggstate, step
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import decode
+from gyeeta_tpu.query import api, criteria
+from gyeeta_tpu.query.criteria import BoolNode, Criterion, ParseError
+from gyeeta_tpu.semantic import derive
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.sketch import loghist
+
+
+# ---------------------------------------------------------------- parsing
+def test_parse_single():
+    t = criteria.parse("{ svcstate.qps5s > 100 }")
+    assert t == Criterion("svcstate", "qps5s", ">", (100.0,))
+
+
+def test_parse_nested():
+    t = criteria.parse(
+        "( { svcstate.state in 'Bad','Severe' } and "
+        "{ svcstate.qps5s > 100 } ) or { svcstate.sererr >= 1 }")
+    assert isinstance(t, BoolNode) and t.op == "or"
+    left = t.children[0]
+    assert left.op == "and"
+    assert left.children[0] == Criterion(
+        "svcstate", "state", "in", ("Bad", "Severe"))
+
+
+def test_parse_not_and_aliases():
+    t = criteria.parse("not { hoststate.cpuissue = true }")
+    assert t.op == "not"
+    t2 = criteria.parse("{ svcstate.svcid =~ 'abc.*' }")
+    assert t2.op == "like"
+
+
+def test_parse_errors():
+    for bad in ("{ qps5s > 1 }",            # missing subsys
+                "{ svcstate.qps5s >> 3 }",
+                "{ svcstate.qps5s > 1 } and",
+                "( { svcstate.qps5s > 1 }"):
+        with pytest.raises(ParseError):
+            criteria.parse(bad)
+
+
+# ------------------------------------------------------------- evaluation
+def test_eval_numeric_and_enum():
+    cols = {
+        "qps5s": np.array([10.0, 200.0, 500.0]),
+        "state": np.array([1.0, 3.0, 4.0]),     # Good, Bad, Severe
+        "sererr": np.array([0.0, 0.0, 7.0]),
+    }
+    m = criteria.evaluate(criteria.parse(
+        "{ svcstate.state in 'Bad','Severe' } and { svcstate.qps5s > 100 }"),
+        cols, "svcstate")
+    assert m.tolist() == [False, True, True]
+    m2 = criteria.evaluate(criteria.parse(
+        "not { svcstate.sererr > 0 }"), cols, "svcstate")
+    assert m2.tolist() == [True, True, False]
+
+
+def test_eval_string_ops():
+    cols = {"svcid": np.array(["00ab12", "ffcd34", "00ab99"], object)}
+    m = criteria.evaluate(criteria.parse(
+        "{ svcstate.svcid substr '00ab' }"), cols, "svcstate")
+    assert m.tolist() == [True, False, True]
+    m2 = criteria.evaluate(criteria.parse(
+        "{ svcstate.svcid like '^ff' }"), cols, "svcstate")
+    assert m2.tolist() == [False, True, False]
+
+
+def test_other_subsys_criteria_pass():
+    cols = {"qps5s": np.array([1.0, 2.0])}
+    m = criteria.evaluate(criteria.parse(
+        "{ hoststate.state = 'Bad' }"), cols, "svcstate")
+    assert m.tolist() == [True, True]
+
+
+# ---------------------------------------------------------------- queries
+@pytest.fixture(scope="module")
+def driven():
+    cfg = EngineCfg(
+        svc_capacity=32, n_hosts=8,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
+        hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
+        topk_capacity=16, td_capacity=16, td_route_cap=16,
+        conn_batch=128, resp_batch=512, listener_batch=32)
+    sim = ParthaSim(n_hosts=4, n_svcs=2, n_clients=64, seed=31)
+    st = aggstate.init(cfg)
+    fold = step.jit_fold_step(cfg)
+    fold_lst = jax.jit(lambda s, b: step.ingest_listener(cfg, s, b))
+    fold_host = jax.jit(lambda s, b: step.ingest_host(cfg, s, b))
+    for _ in range(3):
+        st = fold(st,
+                  decode.conn_batch(sim.conn_records(128), cfg.conn_batch),
+                  decode.resp_batch(sim.resp_records(512), cfg.resp_batch))
+        st = fold_lst(st, decode.listener_batch(
+            sim.listener_state_records(), cfg.listener_batch))
+        st = fold_host(st, decode.host_batch(sim.host_state_records(), 16))
+    st = derive.jit_classify_pass(cfg)(st)
+    return cfg, st, sim
+
+
+def test_svcstate_query(driven):
+    cfg, st, sim = driven
+    out = api.query_json(cfg, st, {
+        "subsys": "svcstate",
+        "sortcol": "p95resp5s", "maxrecs": 5})
+    assert out["ntotal"] == 8
+    assert 0 < out["nrecs"] <= 5
+    r0 = out["recs"][0]
+    assert set(r0) >= {"svcid", "qps5s", "p95resp5s", "state", "nclients"}
+    assert isinstance(r0["state"], str)
+    # sorted descending by p95
+    p95s = [r["p95resp5s"] for r in out["recs"]]
+    assert p95s == sorted(p95s, reverse=True)
+    # the slowest sim services (50ms scale) should rank first
+    assert p95s[0] > 40.0
+
+
+def test_svcstate_filtered(driven):
+    cfg, st, sim = driven
+    out = api.query_json(cfg, st, {
+        "subsys": "svcstate",
+        "filter": "{ svcstate.p95resp5s > 10 }",
+        "columns": ["svcid", "p95resp5s"]})
+    assert all(r["p95resp5s"] > 10 for r in out["recs"])
+    assert all(set(r) == {"svcid", "p95resp5s"} for r in out["recs"])
+    out2 = api.query_json(cfg, st, {
+        "subsys": "svcstate",
+        "filter": "{ svcstate.p95resp5s > 1e12 }"})
+    assert out2["nrecs"] == 0
+
+
+def test_hoststate_and_cluster(driven):
+    cfg, st, sim = driven
+    out = api.query_json(cfg, st, {"subsys": "hoststate"})
+    assert out["nrecs"] == 4       # sim has 4 hosts in panel of 8
+    assert all(isinstance(r["state"], str) for r in out["recs"])
+    cl = api.query_json(cfg, st, {"subsys": "clusterstate"})
+    assert cl["nrecs"] == 1
+    assert cl["recs"][0]["nhosts"] == 4
+
+
+def test_flow_query(driven):
+    cfg, st, sim = driven
+    out = api.query_json(cfg, st, {
+        "subsys": "flowstate", "sortcol": "bytes", "maxrecs": 10})
+    assert out["nrecs"] > 0
+    byts = [r["bytes"] for r in out["recs"]]
+    assert byts == sorted(byts, reverse=True)
+    assert all(len(r["flowid"]) == 16 for r in out["recs"])
+
+
+def test_down_host_detected(driven):
+    """A host that stops reporting past the staleness window goes Down."""
+    cfg, st, sim = driven
+    tick = jax.jit(lambda s: step.tick_5s(cfg, s))
+    fold_host = jax.jit(lambda s, b: step.ingest_host(cfg, s, b))
+    st2 = st
+    for _ in range(api.DOWN_AFTER_TICKS + 2):
+        st2 = tick(st2)
+        hraw = sim.host_state_records()
+        hraw = hraw[hraw["host_id"] != 2]     # host 2 goes silent
+        st2 = fold_host(st2, decode.host_batch(hraw, 16))
+    out = api.query_json(cfg, st2, {"subsys": "hoststate"})
+    by_host = {r["hostid"]: r["state"] for r in out["recs"]}
+    assert by_host[2] == "Down"
+    assert all(s != "Down" for h, s in by_host.items() if h != 2)
+    cl = api.query_json(cfg, st2, {"subsys": "clusterstate"})
+    assert cl["recs"][0]["ndown"] == 1
+
+
+def test_bad_requests(driven):
+    cfg, st, sim = driven
+    with pytest.raises(ValueError):
+        api.query_json(cfg, st, {"subsys": "nope"})
+    with pytest.raises(ValueError):
+        api.query_json(cfg, st, {"subsys": "svcstate", "bogus": 1})
+    with pytest.raises(ValueError):
+        api.query_json(cfg, st, {"subsys": "svcstate",
+                                 "columns": ["nothere"]})
+    with pytest.raises(ValueError):
+        api.query_json(cfg, st, {"subsys": "svcstate",
+                                 "sortcol": "nothere"})

@@ -1,0 +1,184 @@
+"""Semantic classifier tests: rule-level fixtures + end-to-end degradation
+(ref: ``TCP_LISTENER::get_curr_state`` common/gy_socket_stat.cc:2020,
+``host_status_update`` :4455)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine import aggstate, step
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import decode
+from gyeeta_tpu.semantic import (
+    STATE_IDLE, STATE_GOOD, STATE_OK, STATE_BAD, STATE_SEVERE,
+    ISSUE_SERVER_ERRORS, ISSUE_QPS_HIGH, ISSUE_TASKS, derive, hoststate,
+    svcstate,
+)
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.sketch import loghist
+
+
+def base_signals(n=1, **over):
+    """A healthy service: low resp, moderate qps, no errors/issues."""
+    d = dict(
+        b5=5, b300=5, b5day=8, r5p95=500.0, r5p99=900.0,
+        r5dayp95=800.0, r5dayp99=1500.0, mean5=300.0, mean5day=400.0,
+        nqrys_5s=500.0, curr_qps=100.0, qps_p95=200.0, qps_p25=20.0,
+        curr_active=5.0, active_p95=20.0, active_p25=2.0, nconn=10.0,
+        ser_errors=0.0, task_issue=False, task_severe=False,
+        task_delay=False, ntasks_issue=0.0, ntasks_noissue=2.0,
+        tasks_delay_msec=0.0, total_resp_msec=100.0, cpu_issue=False,
+        mem_issue=False, high_resp_ticks=0.0,
+    )
+    d.update(over)
+    arrs = {k: np.full(n, v) if not isinstance(v, bool)
+            else np.full(n, v, bool) for k, v in d.items()}
+    return svcstate.SvcSignals(**arrs, b_1ms=3)
+
+
+def cls(sig):
+    st, isrc = svcstate.classify(sig)
+    return int(np.asarray(st)[0]), int(np.asarray(isrc)[0])
+
+
+def test_idle_no_traffic():
+    st, _ = cls(base_signals(curr_qps=0.0, nqrys_5s=0.0))
+    assert st == STATE_IDLE
+
+
+def test_good_low_resp():
+    # resp below 5-day baseline, qps below p95, clean
+    st, isrc = cls(base_signals())
+    assert st == STATE_GOOD and isrc == 0
+
+
+def test_error_storm_severe():
+    # errors > half the queries → Severe regardless of latency
+    st, isrc = cls(base_signals(ser_errors=300.0))
+    assert st == STATE_SEVERE and isrc == ISSUE_SERVER_ERRORS
+
+
+def test_some_errors_bad():
+    st, isrc = cls(base_signals(ser_errors=150.0))
+    assert st == STATE_BAD and isrc == ISSUE_SERVER_ERRORS
+
+
+def test_qps_surge_with_high_resp():
+    # resp 3+ buckets above 5-day baseline + qps above learned p95
+    sig = base_signals(b5=14, b300=9, b5day=8, r5p95=9000.0,
+                       r5dayp95=800.0, curr_qps=400.0,
+                       high_resp_ticks=8.0)
+    st, isrc = cls(sig)
+    assert st == STATE_SEVERE and isrc == ISSUE_QPS_HIGH
+
+
+def test_task_issue_high_resp():
+    # one bucket above the "much higher" line → Bad (not Severe)
+    sig = base_signals(b5=10, b300=9, b5day=8, r5p95=2000.0,
+                       r5dayp95=800.0, task_issue=True,
+                       ntasks_issue=2.0, high_resp_ticks=8.0)
+    st, isrc = cls(sig)
+    assert st == STATE_BAD and isrc == ISSUE_TASKS
+    # three buckets above + above 5min → Severe
+    sig = base_signals(b5=12, b300=9, b5day=8, r5p95=5000.0,
+                       r5dayp95=800.0, task_issue=True,
+                       ntasks_issue=2.0, high_resp_ticks=8.0)
+    st, isrc = cls(sig)
+    assert st == STATE_SEVERE and isrc == ISSUE_TASKS
+
+
+def test_transient_spike_ok():
+    # only one bucket above baseline, 5min == 5day, not persistent
+    sig = base_signals(b5=9, b300=8, b5day=8, r5p95=1200.0,
+                       r5dayp95=800.0, mean5=500.0, high_resp_ticks=1.0)
+    st, _ = cls(sig)
+    assert st == STATE_OK
+
+
+def test_host_states():
+    z = np.zeros(6)
+    f = np.zeros(6, bool)
+    states = hoststate.classify_hosts(
+        ntask_issue=np.array([0, 0, 8, 1, 2, 9.0]),
+        ntask_severe=np.array([0, 0, 2, 0, 0, 9.0]),
+        nlisten_issue=np.array([0, 6, 6, 0, 1, 9.0]),
+        nlisten_severe=np.array([0, 1, 1, 0, 0, 9.0]),
+        cpu_issue=np.array([0, 0, 1, 0, 0, 1], bool),
+        mem_issue=f, severe_cpu=np.array([0, 0, 0, 0, 0, 1], bool),
+        severe_mem=f)
+    assert states[0] == STATE_GOOD          # clean
+    assert states[1] == STATE_SEVERE        # >5 listener issues + severe
+    assert states[2] == STATE_SEVERE        # entity issues + cpu pressure
+    assert states[3] == STATE_OK            # one task issue
+    assert states[4] == STATE_BAD           # listener + task issues
+    assert states[5] == STATE_SEVERE        # severe everywhere
+    c = hoststate.cluster_state(states)
+    assert int(c["nhosts"]) == 6 and int(c["nsevere"]) == 3
+    assert float(c["issue_frac"]) == pytest.approx(4 / 6)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return EngineCfg(
+        svc_capacity=32, n_hosts=8,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
+        hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
+        topk_capacity=16, td_capacity=16, td_route_cap=16,
+        conn_batch=64, resp_batch=4096, listener_batch=32)
+
+
+def test_end_to_end_degradation(cfg):
+    """Build a healthy "5-day" baseline, then degrade one service 20x:
+    the classifier must flag exactly the degraded service.
+
+    Baseline mass is deliberately >> degraded mass (20 ticks x 4096 vs
+    8 ticks x 64) so the historical p95 stays clean — the same ratio that
+    makes a real 5-day window a stable baseline against minutes of issue."""
+    sim = ParthaSim(n_hosts=4, n_svcs=2, n_clients=64, seed=23)
+    st = aggstate.init(cfg)
+    fold_resp = jax.jit(lambda s, b: step.ingest_resp(cfg, s, b))
+    fold_lst = jax.jit(lambda s, b: step.ingest_listener(cfg, s, b))
+    tick = jax.jit(lambda s: step.tick_5s(cfg, s))
+    classify = derive.jit_classify_pass(cfg)
+
+    # baseline: 20 ticks of heavy normal traffic + listener sweeps
+    for _ in range(20):
+        st = fold_resp(st, decode.resp_batch(sim.resp_records(4096),
+                                             cfg.resp_batch))
+        lrecs = sim.listener_state_records()
+        lrecs["ser_errors"] = 0
+        st = fold_lst(st, decode.listener_batch(lrecs, cfg.listener_batch))
+        st = tick(st)
+
+    # degrade service 0 of host 0: 20x latency, 64 samples per 5s window
+    bad_gid = sim.glob_ids[0, 0]
+
+    def degraded_window():
+        rr = sim.resp_records(64)
+        rr["glob_id"][:] = bad_gid
+        rr["resp_usec"] = (sim.svc_latency_us[0, 0] * 20 *
+                           (1 + np.arange(64) % 5 / 10)).astype(np.uint32)
+        return decode.resp_batch(rr, cfg.resp_batch)
+
+    st = fold_resp(st, degraded_window())
+    # consecutive bad windows → the 8-tick persistence history fills
+    for _ in range(7):
+        st = classify(st)
+        st = tick(st)
+        st = fold_resp(st, degraded_window())
+    st = classify(st)
+
+    from gyeeta_tpu.engine import table
+    rows = np.asarray(table.lookup(
+        st.tbl,
+        np.array([bad_gid >> np.uint64(32)], np.uint32).astype(np.uint32),
+        np.array([bad_gid & np.uint64(0xFFFFFFFF)], np.uint32)))
+    bad_row = int(rows[0])
+    states = np.asarray(st.svc_state)
+    live = np.asarray(table.live_mask(st.tbl))
+    assert states[bad_row] >= STATE_BAD, (
+        states[bad_row], int(np.asarray(st.svc_issue)[bad_row]))
+    # healthy services must not be flagged Bad/Severe
+    healthy = live.copy()
+    healthy[bad_row] = False
+    assert (states[healthy] < STATE_BAD).all(), states[healthy]
