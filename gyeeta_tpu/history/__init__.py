@@ -1,0 +1,17 @@
+"""Historical tier: day-partitioned relational store for state snapshots.
+
+The reference keeps current state in memory and history in Postgres with
+per-day partitioned tables (``server/gy_mdb_schema.cc:85-940``:
+listenstatetbl, hoststatetbl, ... + partition create/cleanup functions).
+Same design here: the live path is the device sketch readback; the
+historical path is SQL over day-partitioned tables written on a cadence.
+
+Backend: sqlite3 (stdlib) with day partitioning via table suffixes —
+identical schema/semantics to the reference's approach; swapping the
+connection for libpq gives the Postgres deployment (same SQL dialect for
+everything used here).
+"""
+
+from gyeeta_tpu.history.store import HistoryStore, to_sql
+
+__all__ = ["HistoryStore", "to_sql"]

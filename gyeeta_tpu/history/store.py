@@ -1,0 +1,212 @@
+"""Day-partitioned history store + criteria→SQL dual execution.
+
+Mirrors the reference's split (``common/gy_query_criteria.h``: every
+criterion can both evaluate in-memory and emit a SQL WHERE clause): the
+query layer evaluates criteria columnar on live snapshots, while this
+module translates the same expression tree to SQL for the historical
+path. Comparators without a clean SQL form (``like``/``notlike`` regex)
+fall back to a post-filter in Python — flagged by ``to_sql``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import sqlite3
+from typing import Iterable, Optional
+
+from gyeeta_tpu.query import criteria as C
+from gyeeta_tpu.query import fieldmaps
+
+# subsys → persisted columns (json field names; enum codecs applied on
+# write so history stores presentation values like the reference DB does
+# for state strings via statetojson)
+_TABLES = {
+    "svcstate": [f.json for f in fieldmaps.SVCSTATE_FIELDS],
+    "hoststate": [f.json for f in fieldmaps.HOSTSTATE_FIELDS],
+    "clusterstate": [f.json for f in fieldmaps.CLUSTERSTATE_FIELDS],
+}
+
+
+def _day_of(t: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        t, datetime.timezone.utc).strftime("%Y%m%d")
+
+
+def _table(subsys: str, day: str) -> str:
+    return f"{subsys}tbl_{day}"
+
+
+def to_sql(tree, subsys: str):
+    """Expression tree → (where_sql, params, exact) — exact=False when a
+    post-filter pass is still required (regex comparators)."""
+    if tree is None:
+        return "1=1", [], True
+    if isinstance(tree, C.Criterion):
+        if tree.subsys != subsys:
+            return "1=1", [], True     # CRIT_SKIP analogue
+        fd = fieldmaps.field_map(subsys)[tree.field]
+        col = fd.json
+        vals = list(tree.values)
+        if tree.op == "=":
+            return f"{col} = ?", [vals[0]], True
+        if tree.op == "!=":
+            return f"{col} != ?", [vals[0]], True
+        if tree.op in ("<", "<=", ">", ">="):
+            return f"{col} {tree.op} ?", [vals[0]], True
+        if tree.op == "in":
+            q = ",".join("?" * len(vals))
+            return f"{col} IN ({q})", vals, True
+        if tree.op == "notin":
+            q = ",".join("?" * len(vals))
+            return f"{col} NOT IN ({q})", vals, True
+        if tree.op in ("substr", "notsubstr"):
+            esc = (str(vals[0]).replace("\\", "\\\\")
+                   .replace("%", "\\%").replace("_", "\\_"))
+            neg = "NOT " if tree.op == "notsubstr" else ""
+            return (f"{col} {neg}LIKE ? ESCAPE '\\'", [f"%{esc}%"], True)
+        if tree.op in ("like", "notlike", "bit2", "bit3"):
+            # no portable SQL form → select broadly, post-filter in python
+            return "1=1", [], False
+        raise ValueError(f"comparator {tree.op} not translatable")
+    if tree.op == "not":
+        inner, params, exact = to_sql(tree.children[0], subsys)
+        if not exact:
+            # NOT over an approximated clause must not prune in SQL
+            return "1=1", [], False
+        return f"NOT ({inner})", params, True
+    parts, params, exact = [], [], True
+    for ch in tree.children:
+        s, p, e = to_sql(ch, subsys)
+        parts.append(f"({s})")
+        params.extend(p)
+        exact = exact and e
+    joiner = " AND " if tree.op == "and" else " OR "
+    # an OR with an inexact branch must not prune rows in SQL
+    if tree.op == "or" and not exact:
+        return "1=1", [], False
+    return joiner.join(parts), params, exact
+
+
+class HistoryStore:
+    """sqlite-backed day-partitioned snapshot store."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.db = sqlite3.connect(path)
+        self.db.execute("PRAGMA journal_mode=WAL")
+        self._known: set = set()
+
+    def _ensure(self, subsys: str, day: str) -> str:
+        t = _table(subsys, day)
+        if t not in self._known:
+            cols = ", ".join(f"{c}" for c in _TABLES[subsys])
+            self.db.execute(
+                f"CREATE TABLE IF NOT EXISTS {t} (time REAL, {cols})")
+            self.db.execute(
+                f"CREATE INDEX IF NOT EXISTS idx_{t}_time ON {t}(time)")
+            self._known.add(t)
+        return t
+
+    def write(self, subsys: str, t: float, rows: Iterable[dict]) -> int:
+        """Persist one snapshot sweep (rows from query.api.execute)."""
+        if subsys not in _TABLES:
+            raise ValueError(f"no history table for {subsys!r}")
+        tab = self._ensure(subsys, _day_of(t))
+        cols = _TABLES[subsys]
+        q = (f"INSERT INTO {tab} (time, {', '.join(cols)}) VALUES "
+             f"({', '.join('?' * (len(cols) + 1))})")
+        n = 0
+        with self.db:
+            for r in rows:
+                self.db.execute(q, [t] + [r.get(c) for c in cols])
+                n += 1
+        return n
+
+    def _days_between(self, tstart: float, tend: float):
+        d = datetime.datetime.fromtimestamp(tstart, datetime.timezone.utc)
+        end = datetime.datetime.fromtimestamp(tend, datetime.timezone.utc)
+        out = []
+        while d.date() <= end.date():
+            out.append(d.strftime("%Y%m%d"))
+            d += datetime.timedelta(days=1)
+        return out
+
+    def query(self, subsys: str, tstart: float, tend: float,
+              filter: Optional[str] = None, maxrecs: int = 10000):
+        """Historical query: criteria → SQL across day partitions, with
+        python post-filter for regex comparators (dual execution)."""
+        tree = C.parse(filter) if filter else None
+        where, params, exact = to_sql(tree, subsys)
+        cols = ["time"] + _TABLES[subsys]
+        out = []
+        for day in self._days_between(tstart, tend):
+            t = _table(subsys, day)
+            if t not in self._known:
+                row = self.db.execute(
+                    "SELECT name FROM sqlite_master WHERE name=?",
+                    (t,)).fetchone()
+                if row is None:
+                    continue
+                self._known.add(t)
+            # with an inexact WHERE, LIMIT must count post-filtered rows:
+            # stream unlimited and post-filter as we go
+            q = (f"SELECT {', '.join(cols)} FROM {t} "
+                 f"WHERE time >= ? AND time <= ? AND ({where}) "
+                 f"ORDER BY time")
+            for rec in self.db.execute(q, [tstart, tend] + params):
+                row = dict(zip(cols, rec))
+                if not exact and tree is not None \
+                        and not self._match(tree, subsys, row):
+                    continue
+                out.append(row)
+                if len(out) >= maxrecs:
+                    return out
+        return out
+
+    @staticmethod
+    def _match(tree, subsys: str, row: dict) -> bool:
+        """Single-row in-memory eval (the post-filter half of dual
+        execution): rebuild 1-element columns keyed like live snapshots."""
+        import numpy as np
+        fixed = {}
+        fmap = fieldmaps.field_map(subsys)
+        for k, v in row.items():
+            if k == "time" or k not in fmap:
+                continue
+            fd = fmap[k]
+            if v is None:
+                # NULL column: enum -1 / NaN / "" never match a criterion
+                arr = (np.array([""], object) if fd.kind == "str"
+                       else np.array([-1.0 if fd.kind == "enum"
+                                      else np.nan]))
+            elif fd.kind == "enum":
+                arr = np.array([float(fd.from_json(v))])
+            elif isinstance(v, str):
+                arr = np.array([v], object)
+            else:
+                arr = np.array([float(v)])
+            fixed[fd.col] = arr
+        return bool(C.evaluate(tree, fixed, subsys)[0])
+
+    def cleanup(self, keep_days: int, now: float) -> int:
+        """Drop partitions older than keep_days (partition maintenance,
+        ref gy_mdb_schema.cc partition cleanup functions)."""
+        cutoff = _day_of(now - keep_days * 86400.0)
+        dropped = 0
+        rows = self.db.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' "
+            "AND name LIKE '%tbl_%'").fetchall()
+        for (name,) in rows:
+            day = name.rsplit("_", 1)[-1]
+            if day.isdigit() and day < cutoff:
+                self.db.execute(f"DROP TABLE {name}")
+                self._known.discard(name)
+                dropped += 1
+        self.db.commit()
+        return dropped
+
+    def days(self) -> list:
+        rows = self.db.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' "
+            "AND name LIKE '%tbl_%'").fetchall()
+        return sorted({r[0].rsplit("_", 1)[-1] for r in rows})

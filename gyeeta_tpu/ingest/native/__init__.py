@@ -1,0 +1,109 @@
+"""ctypes loader for the native deframer, with pure-Python fallback.
+
+``drain(buf)`` is the L1 ingest entry point: one pass over a byte stream →
+{subtype: contiguous record array} + bytes consumed. Uses the C++ fast
+path when ``libgytdeframe.so`` is built (``python -m
+gyeeta_tpu.ingest.native.build``), else ``wire.decode_frames``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+
+import numpy as np
+
+from gyeeta_tpu.ingest import wire
+
+_SO = pathlib.Path(__file__).resolve().parent / "libgytdeframe.so"
+_lib = None
+
+_ERRNAMES = {1: "bad magic", 2: "bad total_sz", 3: "batch cap exceeded",
+             4: "nevents overflows frame", 5: "output buffer full"}
+
+# order must match kSubtypes in deframe.cpp
+_SCAN_ORDER = (wire.NOTIFY_TCP_CONN, wire.NOTIFY_LISTENER_STATE,
+               wire.NOTIFY_HOST_STATE, wire.NOTIFY_RESP_SAMPLE)
+
+
+def _load():
+    global _lib
+    if _lib is not None or not _SO.exists():
+        return _lib
+    lib = ctypes.CDLL(str(_SO))
+    lib.gyt_extract.restype = ctypes.c_int32
+    lib.gyt_extract.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.gyt_scan.restype = ctypes.c_int32
+    lib.gyt_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.gyt_layout.restype = ctypes.c_int32
+    lib.gyt_layout.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                               ctypes.c_int64]
+    # layout handshake: a stale .so must never silently mis-slice records
+    tri = (ctypes.c_int64 * 12)()
+    n = lib.gyt_layout(tri, 4)
+    native = {int(tri[i * 3]): (int(tri[i * 3 + 1]), int(tri[i * 3 + 2]))
+              for i in range(n)}
+    expect = {st: (wire.DTYPE_OF_SUBTYPE[st].itemsize,
+                   wire.MAX_OF_SUBTYPE[st]) for st in _SCAN_ORDER}
+    if native != expect:
+        raise RuntimeError(
+            f"native deframer layout mismatch: {native} != {expect}; "
+            f"rebuild with python -m gyeeta_tpu.ingest.native.build")
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def drain(buf: bytes) -> tuple[dict, int]:
+    """byte stream → ({subtype: structured record array}, consumed).
+
+    Native path when built; identical semantics to the Python decoder
+    (validation errors raise wire.FrameError either way).
+    """
+    lib = _load()
+    if lib is None:
+        return _drain_py(buf)
+    counts = (ctypes.c_int64 * 4)()
+    consumed = ctypes.c_int64()
+    rc = lib.gyt_scan(buf, len(buf), counts, ctypes.byref(consumed))
+    if rc != 0:
+        raise wire.FrameError(f"native scan: {_ERRNAMES.get(rc, rc)}")
+    out = {}
+    for i, subtype in enumerate(_SCAN_ORDER):
+        n = counts[i]
+        if n == 0:
+            continue
+        dt = wire.DTYPE_OF_SUBTYPE[subtype]
+        rec = np.empty(n, dt)
+        c2 = ctypes.c_int64()
+        nrec = ctypes.c_int64()
+        tot = ctypes.c_int64()
+        rc = lib.gyt_extract(
+            buf, len(buf), subtype,
+            rec.ctypes.data_as(ctypes.c_void_p), rec.nbytes,
+            ctypes.byref(c2), ctypes.byref(nrec), ctypes.byref(tot))
+        if rc != 0:
+            raise wire.FrameError(f"native extract: {_ERRNAMES.get(rc, rc)}")
+        assert nrec.value == n, (nrec.value, n)
+        out[subtype] = rec
+    return out, int(consumed.value)
+
+
+def _drain_py(buf: bytes) -> tuple[dict, int]:
+    frames, consumed = wire.decode_frames(buf)
+    out: dict = {}
+    for subtype, recs in frames:
+        if subtype in out:
+            out[subtype] = np.concatenate([out[subtype], recs])
+        else:
+            out[subtype] = recs.copy()
+    return out, consumed

@@ -1,0 +1,32 @@
+"""Build the native deframer: ``python -m gyeeta_tpu.ingest.native.build``.
+
+One g++ invocation, no external deps (the reference's ingest fast path is
+plain C++ over epoll; ours is plain C++ over byte buffers). The shared
+object lands next to this file; ``ingest.native`` auto-loads it and falls
+back to the pure-Python decoder when absent.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent
+SRC = HERE / "deframe.cpp"
+OUT = HERE / "libgytdeframe.so"
+
+
+def build(verbose: bool = True) -> pathlib.Path:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-Wall", "-Werror", str(SRC), "-o", str(OUT)]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    build()
+    print(f"built {OUT}")
+    sys.exit(0)

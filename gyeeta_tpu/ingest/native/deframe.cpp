@@ -1,0 +1,188 @@
+// Native wire deframer: the hot L1 byte path in C++.
+//
+// The reference's L1 epoll threads validate COMM_HEADER framing and batch
+// payload records into DB_WRITE_ARR before handing to workers
+// (server/gy_mconnhdlr.cc:2430-2520). This is that stage for the TPU
+// ingest tier: scan a byte stream, validate every frame, and compact all
+// records of one subtype into a single contiguous output buffer — so
+// Python does exactly one np.frombuffer per subtype per drain, no
+// per-frame interpreter work.
+//
+// Layouts mirror gyeeta_tpu/ingest/wire.py exactly (little-endian,
+// 8-aligned structured dtypes). Validation rules are identical to
+// wire.decode_frames: magic check, total_sz bounds, per-subtype batch
+// caps, nevents-fits-frame.
+//
+// Build: ingest/native/build.py (g++ -O3 -shared). Loaded via ctypes
+// (ingest/native/__init__.py) with transparent fallback to the Python
+// decoder when the shared object is absent.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t MAGIC_PM = 0x47590001u;
+constexpr uint32_t MAGIC_MS = 0x47590002u;
+constexpr uint32_t MAGIC_NQ = 0x47590003u;
+constexpr uint32_t MAX_COMM_DATA_SZ = 16u * 1024u * 1024u;
+constexpr uint32_t COMM_EVENT_NOTIFY = 1u;
+
+constexpr int64_t HDR_SZ = 16;   // HEADER_DT
+constexpr int64_t EV_SZ = 8;     // EVENT_NOTIFY_DT
+
+struct Header {
+  uint32_t magic;
+  uint32_t total_sz;
+  uint32_t data_type;
+  uint32_t padding_sz;
+};
+
+struct EventNotify {
+  uint32_t subtype;
+  uint32_t nevents;
+};
+
+// per-subtype record sizes + caps, must match wire.py DTYPE_OF_SUBTYPE
+struct SubtypeInfo {
+  uint32_t subtype;
+  int64_t itemsize;
+  uint32_t cap;
+};
+
+constexpr SubtypeInfo kSubtypes[] = {
+    {10, 240, 2048},   // TCP_CONN      (TCP_CONN_DT.itemsize)
+    {11, 104, 512},    // LISTENER_STATE
+    {12, 48, 4096},    // HOST_STATE
+    {13, 16, 4096},    // RESP_SAMPLE
+};
+
+const SubtypeInfo* info_of(uint32_t subtype) {
+  for (const auto& s : kSubtypes)
+    if (s.subtype == subtype) return &s;
+  return nullptr;
+}
+
+enum GytErr : int32_t {
+  GYT_OK = 0,
+  GYT_BAD_MAGIC = 1,
+  GYT_BAD_TOTAL = 2,
+  GYT_CAP_EXCEEDED = 3,
+  GYT_NEV_OVERFLOW = 4,
+  GYT_OUT_FULL = 5,
+};
+
+}  // namespace
+
+extern "C" {
+
+// Scan [buf, buf+len): validate frames; copy records of `subtype` into
+// out (capacity out_cap bytes). A trailing partial frame is left for
+// resume. Returns GYT_OK or first error; *consumed = bytes fully parsed,
+// *out_nrec = records written, *total_nrec = records of this subtype seen
+// (== written unless GYT_OUT_FULL).
+int32_t gyt_extract(const uint8_t* buf, int64_t len, uint32_t subtype,
+                    uint8_t* out, int64_t out_cap, int64_t* consumed,
+                    int64_t* out_nrec, int64_t* total_nrec) {
+  const SubtypeInfo* want = info_of(subtype);
+  int64_t off = 0, written = 0, seen = 0;
+  *consumed = 0;
+  *out_nrec = 0;
+  *total_nrec = 0;
+  if (want == nullptr) return GYT_BAD_TOTAL;
+
+  while (off + HDR_SZ <= len) {
+    Header h;
+    std::memcpy(&h, buf + off, sizeof(h));
+    if (h.magic != MAGIC_PM && h.magic != MAGIC_MS && h.magic != MAGIC_NQ)
+      return GYT_BAD_MAGIC;
+    const int64_t total = static_cast<int64_t>(h.total_sz);
+    if (total < HDR_SZ + EV_SZ || total >= MAX_COMM_DATA_SZ)
+      return GYT_BAD_TOTAL;
+    if (off + total > len) break;  // partial frame: resume later
+
+    if (h.data_type == COMM_EVENT_NOTIFY) {
+      EventNotify ev;
+      std::memcpy(&ev, buf + off + HDR_SZ, sizeof(ev));
+      const SubtypeInfo* si = info_of(ev.subtype);
+      if (si != nullptr) {
+        if (ev.nevents > si->cap) return GYT_CAP_EXCEEDED;
+        const int64_t need =
+            HDR_SZ + EV_SZ + static_cast<int64_t>(ev.nevents) * si->itemsize;
+        if (need > total) return GYT_NEV_OVERFLOW;
+        if (ev.subtype == subtype && ev.nevents > 0) {
+          const int64_t nbytes =
+              static_cast<int64_t>(ev.nevents) * si->itemsize;
+          seen += ev.nevents;
+          if (written + nbytes <= out_cap) {
+            std::memcpy(out + written, buf + off + HDR_SZ + EV_SZ,
+                        static_cast<size_t>(nbytes));
+            written += nbytes;
+          } else {
+            *consumed = off;
+            *out_nrec = written / want->itemsize;
+            *total_nrec = seen;
+            return GYT_OUT_FULL;
+          }
+        }
+      }
+      // unknown subtypes skipped (forward compat)
+    }
+    off += total;
+  }
+  *consumed = off;
+  *out_nrec = written / want->itemsize;
+  *total_nrec = seen;
+  return GYT_OK;
+}
+
+// Count frames + records per subtype without copying (sizing pass).
+// counts: array of 4 int64 (order of kSubtypes). Returns error code.
+int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
+                 int64_t* consumed) {
+  int64_t off = 0;
+  for (int i = 0; i < 4; i++) counts[i] = 0;
+  *consumed = 0;
+  while (off + HDR_SZ <= len) {
+    Header h;
+    std::memcpy(&h, buf + off, sizeof(h));
+    if (h.magic != MAGIC_PM && h.magic != MAGIC_MS && h.magic != MAGIC_NQ)
+      return GYT_BAD_MAGIC;
+    const int64_t total = static_cast<int64_t>(h.total_sz);
+    if (total < HDR_SZ + EV_SZ || total >= MAX_COMM_DATA_SZ)
+      return GYT_BAD_TOTAL;
+    if (off + total > len) break;
+    if (h.data_type == COMM_EVENT_NOTIFY) {
+      EventNotify ev;
+      std::memcpy(&ev, buf + off + HDR_SZ, sizeof(ev));
+      for (int i = 0; i < 4; i++) {
+        if (kSubtypes[i].subtype == ev.subtype) {
+          if (ev.nevents > kSubtypes[i].cap) return GYT_CAP_EXCEEDED;
+          const int64_t need = HDR_SZ + EV_SZ +
+              static_cast<int64_t>(ev.nevents) * kSubtypes[i].itemsize;
+          if (need > total) return GYT_NEV_OVERFLOW;
+          counts[i] += ev.nevents;
+        }
+      }
+    }
+    off += total;
+  }
+  *consumed = off;
+  return GYT_OK;
+}
+
+// Layout handshake: fill (subtype, itemsize, cap) triples so the Python
+// loader can verify the compiled table matches wire.py before first use.
+int32_t gyt_layout(int64_t* out, int64_t max_triples) {
+  int32_t n = 0;
+  for (const auto& s : kSubtypes) {
+    if (n >= max_triples) break;
+    out[n * 3 + 0] = s.subtype;
+    out[n * 3 + 1] = s.itemsize;
+    out[n * 3 + 2] = s.cap;
+    n++;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,174 @@
+"""Runtime: the aggregation-server process loop (the ``madhava`` analogue).
+
+Owns the engine state and composes every tier: byte streams in (native
+deframe), columnar folds onto the device, the 5s cadence (window tick +
+semantic classify + alert check), history snapshots, checkpointing, and
+table compaction — the role of madhava's L1/L2 thread architecture and
+scheduler domains (``server/gy_mconnhdlr.h:53-75``,
+``common/gy_scheduler.h:220``), but single-controller and event-driven:
+``feed()`` ingests bytes; ``run_tick()`` closes a 5s window. No thread
+pool — the device pipeline is the concurrency.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from gyeeta_tpu.alerts import AlertManager
+from gyeeta_tpu.engine import aggstate, compact, step
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.history import HistoryStore
+from gyeeta_tpu.ingest import decode, native, wire
+from gyeeta_tpu.query import api
+from gyeeta_tpu.semantic import derive
+from gyeeta_tpu.utils import checkpoint as ckpt
+from gyeeta_tpu.utils.config import RuntimeOpts
+from gyeeta_tpu.utils.selfstats import Stats
+
+
+class Runtime:
+    def __init__(self, cfg: Optional[EngineCfg] = None,
+                 opts: Optional[RuntimeOpts] = None,
+                 clock=None):
+        self.cfg = cfg or EngineCfg()
+        self.opts = opts or RuntimeOpts()
+        self.state = aggstate.init(self.cfg)
+        self.stats = Stats()
+        self.alerts = AlertManager(self.cfg, clock=clock)
+        self.history = (HistoryStore(self.opts.history_db)
+                        if self.opts.history_db else None)
+        self._clock = clock or time.time
+        self._pending = b""           # partial-frame resume buffer
+        self._fold = step.jit_fold_step(self.cfg)
+        self._fold_lst = jax.jit(
+            lambda s, b: step.ingest_listener(self.cfg, s, b))
+        self._fold_host = jax.jit(
+            lambda s, b: step.ingest_host(self.cfg, s, b))
+        self._tick = jax.jit(lambda s: step.tick_5s(self.cfg, s))
+        self._classify = derive.jit_classify_pass(self.cfg)
+        self._empty_conn = decode.conn_batch(
+            np.empty(0, wire.TCP_CONN_DT), self.cfg.conn_batch)
+        self._empty_resp = decode.resp_batch(
+            np.empty(0, wire.RESP_SAMPLE_DT), self.cfg.resp_batch)
+
+    # ------------------------------------------------------------- ingest
+    def feed(self, buf: bytes) -> int:
+        """Ingest a byte stream (any number of frames, any mix of types).
+
+        Returns records folded. Trailing partial frames are buffered for
+        the next call (epoll partial-read resume semantics)."""
+        data = self._pending + buf
+        try:
+            recs, consumed = native.drain(data)
+        except wire.FrameError:
+            self.stats.bump("frames_bad")
+            self._pending = b""       # poison frame: drop buffer, resync
+            raise
+        self._pending = data[consumed:]
+        n = 0
+        conn = recs.get(wire.NOTIFY_TCP_CONN)
+        resp = recs.get(wire.NOTIFY_RESP_SAMPLE)
+        # pair conn+resp chunks into fused fold steps
+        ci = ri = 0
+        while (conn is not None and ci < len(conn)) or \
+                (resp is not None and ri < len(resp)):
+            cchunk = (conn[ci:ci + self.cfg.conn_batch]
+                      if conn is not None else conn)
+            rchunk = (resp[ri:ri + self.cfg.resp_batch]
+                      if resp is not None else resp)
+            cb = (decode.conn_batch(cchunk, self.cfg.conn_batch)
+                  if cchunk is not None and len(cchunk)
+                  else self._empty_conn)
+            rb = (decode.resp_batch(rchunk, self.cfg.resp_batch)
+                  if rchunk is not None and len(rchunk)
+                  else self._empty_resp)
+            self.state = self._fold(self.state, cb, rb)
+            nc = int(cb.valid.sum())
+            nr = int(rb.valid.sum())
+            ci += nc
+            ri += nr
+            n += nc + nr
+            self.stats.bump("conn_events", nc)
+            self.stats.bump("resp_events", nr)
+        lst = recs.get(wire.NOTIFY_LISTENER_STATE)
+        if lst is not None:
+            for i in range(0, len(lst), self.cfg.listener_batch):
+                lb = decode.listener_batch(
+                    lst[i:i + self.cfg.listener_batch],
+                    self.cfg.listener_batch)
+                self.state = self._fold_lst(self.state, lb)
+                n += int(lb.valid.sum())
+            self.stats.bump("listener_records", len(lst))
+        hst = recs.get(wire.NOTIFY_HOST_STATE)
+        if hst is not None:
+            for i in range(0, len(hst), wire.MAX_HOSTS_PER_BATCH):
+                hb = decode.host_batch(hst[i:i + wire.MAX_HOSTS_PER_BATCH])
+                self.state = self._fold_host(self.state, hb)
+                n += int(hb.valid.sum())
+            self.stats.bump("host_records", len(hst))
+        return n
+
+    # ------------------------------------------------------------ cadence
+    def run_tick(self) -> dict:
+        """Close one 5s window: classify → alerts → windows tick →
+        maintenance cadences. Returns a tick report."""
+        report = {}
+        self.state = self._classify(self.state)
+        fired = self.alerts.check(self.state)
+        report["alerts_fired"] = len(fired)
+        # history snapshots BEFORE the window tick: the closing 5s slab is
+        # still readable (tick zeroes it)
+        tick = int(np.asarray(self.state.resp_win.tick)) + 1
+        report["tick"] = tick
+        self.stats.gauge("tick", tick)
+
+        if self.history and tick % self.opts.history_every_ticks == 0:
+            now = self._clock()
+            out = api.execute(self.cfg, self.state, api.QueryOptions(
+                subsys="svcstate", maxrecs=self.cfg.svc_capacity))
+            self.history.write("svcstate", now, out["recs"])
+            hout = api.execute(self.cfg, self.state, api.QueryOptions(
+                subsys="hoststate", maxrecs=self.cfg.n_hosts))
+            self.history.write("hoststate", now, hout["recs"])
+            cout = api.execute(self.cfg, self.state, api.QueryOptions(
+                subsys="clusterstate"))
+            self.history.write("clusterstate", now, cout["recs"])
+            report["history_rows"] = out["nrecs"] + hout["nrecs"] + 1
+
+        self.state = self._tick(self.state)
+        n_tomb = int(np.asarray(self.state.tbl.n_tomb))
+        if n_tomb > self.cfg.svc_capacity * self.opts.compact_tomb_frac:
+            self.state = compact.compact_state(self.cfg, self.state)
+            self.stats.bump("compactions")
+            report["compacted"] = True
+
+        if (self.opts.checkpoint_dir
+                and tick % self.opts.checkpoint_every_ticks == 0):
+            path = ckpt.save(
+                f"{self.opts.checkpoint_dir}/gyt_ckpt_{tick:08d}.npz",
+                self.cfg, self.state, extra={"tick": tick})
+            report["checkpoint"] = str(path)
+            self.stats.bump("checkpoints")
+        return report
+
+    # -------------------------------------------------------------- query
+    def query(self, req: dict) -> dict:
+        """Point-in-time (live) or historical (time-ranged) JSON query."""
+        if "tstart" in req or "tend" in req:
+            if not self.history:
+                raise ValueError("no history store configured")
+            now = self._clock()
+            return {"recs": self.history.query(
+                req["subsys"], float(req.get("tstart", 0)),
+                float(req.get("tend", now)), req.get("filter"),
+                int(req.get("maxrecs", 10000)))}
+        self.stats.bump("queries")
+        return api.query_json(self.cfg, self.state, req)
+
+    def restore(self, path) -> dict:
+        self.state, extra = ckpt.restore(path, self.cfg, self.state)
+        return extra

@@ -1,0 +1,72 @@
+"""Checkpoint/restore of engine state (sketch snapshots).
+
+The reference has no process checkpointing — durable state is Postgres and
+agents resend inventory on reconnect (SURVEY §5). The TPU tier adds real
+checkpoints: AggState is one pytree of arrays, so a snapshot is an
+``npz`` with the flattened leaves plus a config fingerprint; restore
+refuses a mismatched geometry instead of silently mis-slicing HBM.
+Recovery composes both: restore the sketch snapshot, then replay from
+agents/history for the gap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+
+import jax
+import numpy as np
+
+
+def _cfg_fingerprint(cfg) -> str:
+    # repr-text equality: any geometry field change invalidates restores
+    return hashlib.sha256(repr(cfg).encode()).hexdigest()[:16]
+
+
+def save(path, cfg, state, extra: dict | None = None) -> pathlib.Path:
+    """Write state pytree → ``<path>`` (npz). Atomic via tmp+rename."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    payload = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    payload["__meta__"] = np.frombuffer(json.dumps({
+        "nleaves": len(leaves),
+        "cfg": _cfg_fingerprint(cfg),
+        "extra": extra or {},
+    }).encode(), dtype=np.uint8)
+    tmp = path.with_suffix(".tmp.npz")
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    tmp.rename(path)
+    return path
+
+
+def restore(path, cfg, like):
+    """Read a checkpoint into the structure of ``like`` (same treedef).
+
+    Raises ValueError on config-fingerprint or leaf-shape mismatch.
+    Returns (state, extra_dict).
+    """
+    path = pathlib.Path(path)
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta["cfg"] != _cfg_fingerprint(cfg):
+            raise ValueError(
+                f"checkpoint config fingerprint {meta['cfg']} does not "
+                f"match engine config {_cfg_fingerprint(cfg)}")
+        leaves, treedef = jax.tree_util.tree_flatten(like)
+        if meta["nleaves"] != len(leaves):
+            raise ValueError(
+                f"checkpoint has {meta['nleaves']} leaves, engine state "
+                f"has {len(leaves)} — incompatible versions")
+        new_leaves = []
+        for i, ref in enumerate(leaves):
+            arr = z[f"leaf_{i}"]
+            if arr.shape != ref.shape:
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {arr.shape} != "
+                    f"state shape {ref.shape}")
+            new_leaves.append(arr.astype(ref.dtype))
+        return (jax.tree_util.tree_unflatten(treedef, new_leaves),
+                meta["extra"])

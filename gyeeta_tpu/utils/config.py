@@ -1,0 +1,134 @@
+"""Layered configuration: defaults ≺ JSON file ≺ env ≺ explicit overrides.
+
+The reference's settings system (``PA_SETTINGS_C`` etc.,
+``partha/gypartha.cc:456``) layers cfg JSON files, ``CFG_*`` env vars and
+``--cfg_*`` CLI flags (which just setenv, :1813). Same model here with the
+``GYT_`` prefix, plus the hot-reload runtime file (mtime-polled
+``*_runtime.json``, :1965) for knobs that may change while running.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Any, NamedTuple, Optional
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.sketch import loghist
+
+ENV_PREFIX = "GYT_"
+
+# EngineCfg ints settable via cfg file/env; loghist specs via *_vmin etc.
+_INT_FIELDS = {"svc_capacity", "n_hosts", "hll_p_svc", "hll_p_global",
+               "cms_depth", "cms_width", "topk_capacity", "td_capacity",
+               "td_route_cap", "conn_batch", "resp_batch",
+               "listener_batch"}
+
+
+class RuntimeOpts(NamedTuple):
+    """Process-level knobs outside engine geometry."""
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_ticks: int = 720       # 1 hour of 5s ticks
+    history_db: Optional[str] = None
+    history_every_ticks: int = 12           # 1 min
+    compact_tomb_frac: float = 0.25         # compact when tombs exceed
+    debug_level: int = 0                    # hot-reloadable
+    resp_sample_pct: float = 100.0          # hot-reloadable duty cycle
+
+
+def _coerce(key: str, v: Any):
+    if key in _INT_FIELDS:
+        return int(v)
+    return v
+
+
+def load_engine_cfg(cfg_file: Optional[str] = None,
+                    env: Optional[dict] = None,
+                    **overrides) -> EngineCfg:
+    """defaults ≺ JSON file ≺ GYT_<FIELD> env ≺ kwargs."""
+    env = os.environ if env is None else env
+    spec_keys = {f"{n}_{p}" for n in ("resp", "qps", "active")
+                 for p in ("vmin", "vmax", "nbuckets")}
+    known = set(EngineCfg._fields) | spec_keys
+    vals: dict = {}
+    if cfg_file:
+        with open(cfg_file) as f:
+            data = json.load(f)
+        for k, v in data.get("engine", data).items():
+            if k in known:
+                vals[k] = _coerce(k, v)
+    for k in known:
+        ev = env.get(ENV_PREFIX + k.upper())
+        if ev is not None:
+            vals[k] = _coerce(k, ev)
+    vals.update({k: _coerce(k, v) for k, v in overrides.items()})
+    specs = {}
+    for name in ("resp", "qps", "active"):
+        base = getattr(EngineCfg(), f"{name}_spec")
+        parts = {}
+        for p in ("vmin", "vmax", "nbuckets"):
+            key = f"{name}_{p}"
+            if key in vals:
+                parts[p] = float(vals.pop(key)) if p != "nbuckets" \
+                    else int(vals.pop(key))
+        if parts:
+            specs[f"{name}_spec"] = base._replace(**parts)
+    unknown = set(vals) - set(EngineCfg._fields)
+    if unknown:
+        raise ValueError(f"unknown engine config keys: {sorted(unknown)}")
+    return EngineCfg(**{**vals, **specs})
+
+
+def load_runtime_opts(cfg_file: Optional[str] = None,
+                      env: Optional[dict] = None,
+                      **overrides) -> RuntimeOpts:
+    env = os.environ if env is None else env
+    vals: dict = {}
+    if cfg_file:
+        with open(cfg_file) as f:
+            data = json.load(f)
+        for k, v in data.get("runtime", {}).items():
+            if k in RuntimeOpts._fields:
+                vals[k] = v
+    for k in RuntimeOpts._fields:
+        ev = env.get(ENV_PREFIX + k.upper())
+        if ev is not None:
+            d = getattr(RuntimeOpts(), k)
+            vals[k] = type(d)(ev) if d is not None else ev
+    vals.update(overrides)
+    unknown = set(vals) - set(RuntimeOpts._fields)
+    if unknown:
+        raise ValueError(f"unknown runtime config keys: {sorted(unknown)}")
+    return RuntimeOpts(**vals)
+
+
+class HotReload:
+    """mtime-polled runtime knob file (``tmp/*_runtime.json`` analogue).
+
+    ``poll()`` re-reads the file when its mtime changed and returns the
+    updated RuntimeOpts (only hot-reloadable fields are applied)."""
+
+    HOT_FIELDS = ("debug_level", "resp_sample_pct")
+
+    def __init__(self, path, opts: RuntimeOpts):
+        self.path = pathlib.Path(path)
+        self.opts = opts
+        self._mtime = 0.0
+
+    def poll(self) -> RuntimeOpts:
+        try:
+            mtime = self.path.stat().st_mtime
+        except FileNotFoundError:
+            return self.opts
+        if mtime == self._mtime:
+            return self.opts
+        self._mtime = mtime
+        try:
+            data = json.loads(self.path.read_text())
+        except (json.JSONDecodeError, OSError):
+            return self.opts          # malformed hot file is ignored
+        hot = {k: type(getattr(self.opts, k))(v)
+               for k, v in data.items() if k in self.HOT_FIELDS}
+        self.opts = self.opts._replace(**hot)
+        return self.opts

@@ -1,0 +1,40 @@
+"""Framework self-metrics: named counters/gauges + periodic snapshots.
+
+The reference instruments itself with per-subsystem ``STATS_STR_MAP``
+counters printed on a cadence (``server/gy_mconnhdlr.h:46``,
+``print_stats()`` on pools/captures) and a deferred print-offload thread.
+Here: a process-wide registry with O(1) bumps on the ingest path and a
+``snapshot()``/``delta()`` readback the runtime logs each minute.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+
+class Stats:
+    def __init__(self):
+        self.counters: collections.Counter = collections.Counter()
+        self.gauges: dict = {}
+        self._last: dict = {}
+        self.t_start = time.time()
+
+    def bump(self, name: str, n=1):
+        self.counters[name] += n
+
+    def gauge(self, name: str, v):
+        self.gauges[name] = v
+
+    def snapshot(self) -> dict:
+        out = dict(self.counters)
+        out.update(self.gauges)
+        out["uptime_sec"] = round(time.time() - self.t_start, 1)
+        return out
+
+    def delta(self) -> dict:
+        """Counters since the previous delta() call (rate reporting)."""
+        cur = dict(self.counters)
+        out = {k: v - self._last.get(k, 0) for k, v in cur.items()}
+        self._last = cur
+        return {k: v for k, v in out.items() if v}

@@ -1,0 +1,208 @@
+"""Runtime + checkpoint + history + compaction + config tests."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine import aggstate, compact, step, table
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.history import HistoryStore
+from gyeeta_tpu.ingest import decode
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.sketch import loghist
+from gyeeta_tpu.utils import checkpoint as ckpt
+from gyeeta_tpu.utils.config import (HotReload, RuntimeOpts,
+                                     load_engine_cfg, load_runtime_opts)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return EngineCfg(
+        svc_capacity=32, n_hosts=8,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
+        hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
+        topk_capacity=16, td_capacity=16, td_route_cap=16,
+        conn_batch=64, resp_batch=256, listener_batch=32)
+
+
+class Clock:
+    def __init__(self, t=1_700_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_runtime_end_to_end(cfg, tmp_path):
+    clock = Clock()
+    rt = Runtime(cfg, RuntimeOpts(
+        history_db=str(tmp_path / "hist.db"), history_every_ticks=2,
+        checkpoint_dir=str(tmp_path), checkpoint_every_ticks=4), clock)
+    rt.alerts.add_def({"alertname": "slow", "subsys": "svcstate",
+                       "filter": "{ svcstate.p95resp5s > 10 }"})
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=51)
+    total_alerts = 0
+    for i in range(4):
+        n = rt.feed(sim.conn_frames(200) + sim.resp_frames(600)
+                    + sim.listener_frames())
+        assert n >= 800
+        rep = rt.run_tick()
+        total_alerts += rep["alerts_fired"]
+        clock.t += 5.0
+    assert rt.stats.counters["conn_events"] == 800
+    assert rt.stats.counters["resp_events"] == 2400
+    assert total_alerts > 0
+
+    # live query
+    out = rt.query({"subsys": "svcstate", "maxrecs": 10})
+    assert out["ntotal"] == 8
+    # historical query (history written at ticks 2 and 4)
+    hist = rt.query({"subsys": "svcstate", "tstart": 0,
+                     "tend": clock.t + 1})
+    assert len(hist["recs"]) == 16
+    assert {r["svcid"] for r in hist["recs"]} == \
+        {r["svcid"] for r in out["recs"]}
+    # filtered historical
+    h2 = rt.query({"subsys": "svcstate", "tstart": 0, "tend": clock.t + 1,
+                   "filter": "{ svcstate.p95resp5s > 10 }"})
+    assert 0 < len(h2["recs"]) < 16
+    assert all(r["p95resp5s"] > 10 for r in h2["recs"])
+
+    # checkpoint written at tick 4 → restore into a fresh runtime
+    ck = list(tmp_path.glob("gyt_ckpt_*.npz"))
+    assert len(ck) == 1
+    rt2 = Runtime(cfg, RuntimeOpts(), clock)
+    extra = rt2.restore(ck[0])
+    assert extra["tick"] == 4
+    out2 = rt2.query({"subsys": "svcstate", "maxrecs": 10})
+    assert out2["ntotal"] == 8
+
+
+def test_feed_partial_frames(cfg):
+    rt = Runtime(cfg, RuntimeOpts())
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=52)
+    buf = sim.resp_frames(300)
+    cut = len(buf) - 100
+    n1 = rt.feed(buf[:cut])
+    n2 = rt.feed(buf[cut:])
+    assert n1 + n2 == 300
+
+
+def test_checkpoint_geometry_guard(cfg, tmp_path):
+    st = aggstate.init(cfg)
+    p = ckpt.save(tmp_path / "c.npz", cfg, st)
+    other = cfg._replace(svc_capacity=64)
+    with pytest.raises(ValueError):
+        ckpt.restore(p, other, aggstate.init(other))
+    st2, extra = ckpt.restore(p, cfg, aggstate.init(cfg))
+    assert jax.tree_util.tree_structure(st2) == \
+        jax.tree_util.tree_structure(st)
+
+
+def test_compact_full_state(cfg):
+    """Churn: delete services, compact, surviving sketch state intact."""
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=53)
+    st = aggstate.init(cfg)
+    fold = step.jit_fold_step(cfg)
+    for _ in range(2):
+        st = fold(st, decode.conn_batch(sim.conn_records(64),
+                                        cfg.conn_batch),
+                  decode.resp_batch(sim.resp_records(256), cfg.resp_batch))
+    gids = sim.glob_ids.reshape(-1)
+    khi = (gids >> np.uint64(32)).astype(np.uint32)
+    klo = (gids & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    rows_before = np.asarray(table.lookup(st.tbl, khi, klo))
+    resp_before = np.asarray(st.resp_win.cur)
+    # delete half the services
+    st, _ = compact.delete_services(cfg, st, khi[:4], klo[:4])
+    st = compact.compact_state(cfg, st)
+    assert int(st.tbl.n_tomb) == 0
+    assert int(st.tbl.n_live) == 4
+    # deleted gone, survivors found with their loghist mass intact
+    gone = np.asarray(table.lookup(st.tbl, khi[:4], klo[:4]))
+    assert (gone == -1).all()
+    kept = np.asarray(table.lookup(st.tbl, khi[4:], klo[4:]))
+    assert (kept >= 0).all()
+    resp_after = np.asarray(st.resp_win.cur)
+    for old_row, new_row in zip(rows_before[4:], kept):
+        np.testing.assert_allclose(resp_after[new_row],
+                                   resp_before[old_row])
+    # empty rows reset: vmin back to +inf
+    live = np.asarray(table.live_mask(st.tbl))
+    assert np.isinf(np.asarray(st.svc_td.vmin)[~live]).all()
+
+
+def test_history_cleanup():
+    hs = HistoryStore()
+    day = 86400.0
+    hs.write("clusterstate", 100.0, [{"nhosts": 1}])
+    hs.write("clusterstate", 100.0 + 10 * day, [{"nhosts": 2}])
+    assert len(hs.days()) == 2
+    assert hs.cleanup(keep_days=3, now=100.0 + 10 * day) == 1
+    assert len(hs.days()) == 1
+    rows = hs.query("clusterstate", 0, 100.0 + 11 * day)
+    assert len(rows) == 1 and rows[0]["nhosts"] == 2
+
+
+def test_history_not_over_like_and_substr_escaping():
+    hs = HistoryStore()
+    hs.write("svcstate", 50.0, [
+        {"svcid": "aabb", "qps5s": 1}, {"svcid": "xyz", "qps5s": 2},
+        {"svcid": "a%b", "qps5s": 3}, {"svcid": "aXb", "qps5s": 4}])
+    # NOT over an inexact (regex) clause must post-filter, not prune
+    rows = hs.query("svcstate", 0, 100,
+                    filter="not { svcstate.svcid like '^aa' }")
+    assert {r["svcid"] for r in rows} == {"xyz", "a%b", "aXb"}
+    # substr treats % as a literal, not a SQL wildcard
+    rows2 = hs.query("svcstate", 0, 100,
+                     filter="{ svcstate.svcid substr 'a%b' }")
+    assert {r["svcid"] for r in rows2} == {"a%b"}
+
+
+def test_history_like_postfilter():
+    hs = HistoryStore()
+    hs.write("svcstate", 50.0, [
+        {"svcid": "aabb", "qps5s": 10}, {"svcid": "ccdd", "qps5s": 20}])
+    rows = hs.query("svcstate", 0, 100,
+                    filter="{ svcstate.svcid like '^aa' }")
+    assert len(rows) == 1 and rows[0]["svcid"] == "aabb"
+    rows2 = hs.query("svcstate", 0, 100,
+                     filter="{ svcstate.qps5s >= 20 }")
+    assert len(rows2) == 1 and rows2[0]["svcid"] == "ccdd"
+
+
+def test_config_layering(tmp_path, monkeypatch):
+    cfgf = tmp_path / "gyt.json"
+    cfgf.write_text(json.dumps({
+        "engine": {"svc_capacity": 256, "n_hosts": 16, "resp_nbuckets": 128},
+        "runtime": {"history_every_ticks": 7}}))
+    c = load_engine_cfg(str(cfgf))
+    assert c.svc_capacity == 256 and c.n_hosts == 16
+    assert c.resp_spec.nbuckets == 128
+    # env beats file; kwargs beat env
+    c2 = load_engine_cfg(str(cfgf), env={"GYT_SVC_CAPACITY": "512"})
+    assert c2.svc_capacity == 512
+    c3 = load_engine_cfg(str(cfgf), env={"GYT_SVC_CAPACITY": "512"},
+                         svc_capacity=1024)
+    assert c3.svc_capacity == 1024
+    with pytest.raises(ValueError):
+        load_engine_cfg(None, env={}, bogus_key=1)
+    r = load_runtime_opts(str(cfgf), env={})
+    assert r.history_every_ticks == 7
+
+
+def test_hot_reload(tmp_path):
+    f = tmp_path / "runtime.json"
+    hr = HotReload(f, RuntimeOpts())
+    assert hr.poll().debug_level == 0
+    f.write_text(json.dumps({"debug_level": 3, "resp_sample_pct": 25.0,
+                             "checkpoint_dir": "/ignored"}))
+    opts = hr.poll()
+    assert opts.debug_level == 3
+    assert opts.resp_sample_pct == 25.0
+    assert opts.checkpoint_dir is None     # not hot-reloadable
+    f.write_text("{ bad json")
+    assert hr.poll().debug_level == 3      # malformed ignored
