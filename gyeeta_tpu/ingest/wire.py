@@ -160,11 +160,36 @@ RESP_SAMPLE_DT = np.dtype([
     ("host_id", "<u4"),
 ])
 
+# AGGR_TASK_STATE record — field-for-field vs gy_comm_proto.h:2114
+# (process-group 5s state; comm string interned, issue string dropped).
+AGGR_TASK_DT = np.dtype([
+    ("aggr_task_id", "<u8"),
+    ("comm_id", "<u8"),            # interned onecomm_[16]
+    ("related_listen_id", "<u8"),
+    ("tcp_kbytes", "<u4"),
+    ("tcp_conns", "<u4"),
+    ("total_cpu_pct", "<f4"),
+    ("rss_mb", "<u4"),
+    ("cpu_delay_msec", "<u4"),
+    ("vm_delay_msec", "<u4"),
+    ("blkio_delay_msec", "<u4"),
+    ("ntasks_total", "<u2"),
+    ("ntasks_issue", "<u2"),
+    ("curr_state", "u1"),
+    ("curr_issue", "u1"),
+    ("pad", "u1", (2,)),
+    ("host_id", "<u4"),
+    ("pad2", "u1", (4,)),
+])
+
+MAX_TASKS_PER_BATCH = 1200     # gy_comm_proto.h:2139 MAX_NUM_TASKS
+
 DTYPE_OF_SUBTYPE = {
     NOTIFY_TCP_CONN: TCP_CONN_DT,
     NOTIFY_LISTENER_STATE: LISTENER_STATE_DT,
     NOTIFY_HOST_STATE: HOST_STATE_DT,
     NOTIFY_RESP_SAMPLE: RESP_SAMPLE_DT,
+    NOTIFY_AGGR_TASK_STATE: AGGR_TASK_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -174,13 +199,15 @@ MAX_OF_SUBTYPE = {
     NOTIFY_LISTENER_STATE: MAX_LISTENERS_PER_BATCH,
     NOTIFY_HOST_STATE: MAX_HOSTS_PER_BATCH,
     NOTIFY_RESP_SAMPLE: MAX_RESP_PER_BATCH,
+    NOTIFY_AGGR_TASK_STATE: MAX_TASKS_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
                    ("TCP_CONN_DT", TCP_CONN_DT),
                    ("LISTENER_STATE_DT", LISTENER_STATE_DT),
                    ("HOST_STATE_DT", HOST_STATE_DT),
-                   ("RESP_SAMPLE_DT", RESP_SAMPLE_DT)]:
+                   ("RESP_SAMPLE_DT", RESP_SAMPLE_DT),
+                   ("AGGR_TASK_DT", AGGR_TASK_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 
