@@ -14,6 +14,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -22,6 +23,13 @@ PER_CHIP_TARGET = 12.5e6  # BASELINE.md north star / 8 chips
 
 def main() -> None:
     import jax
+
+    # local smoke runs: GYT_BENCH_PLATFORM=cpu forces the virtual CPU
+    # platform (the axon sitecustomize pins jax_platforms, so an env-var
+    # JAX_PLATFORMS override alone does not take effect)
+    plat = os.environ.get("GYT_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
 
     from gyeeta_tpu.engine import aggstate, step
     from gyeeta_tpu.engine.aggstate import EngineCfg
@@ -79,11 +87,36 @@ def main() -> None:
     value = calls * events_per_call / elapsed
     print(f"bench: {calls} calls x {K} microbatches in {elapsed:.2f}s "
           f"({per_call * 1e3 / K:.2f}ms/microbatch warm)", file=sys.stderr)
+
+    # feed-path throughput: the PRODUCT ingest loop (bytes → native deframe
+    # → decode → staged K-slab fold), not just the device fold — VERDICT r2
+    # required this within ~2x of fold_many. Frames are pre-generated so
+    # the sim's RNG cost isn't billed to the server path.
+    from gyeeta_tpu.runtime import Runtime
+    rt = Runtime(cfg)
+    n_bufs = 4
+    ev_per_buf = K * (cfg.conn_batch + cfg.resp_batch)
+    bufs = [sim.conn_frames(K * cfg.conn_batch)
+            + sim.resp_frames(K * cfg.resp_batch) for _ in range(n_bufs)]
+    rt.feed(bufs[0])
+    rt.flush()
+    jax.block_until_ready(rt.state)     # warm the compiled folds
+    t0 = time.perf_counter()
+    feed_calls = max(2, min(100, int(1.0 / max(per_call, 1e-6))))
+    for i in range(feed_calls):
+        rt.feed(bufs[i % n_bufs])
+    rt.flush()
+    jax.block_until_ready(rt.state)
+    feed_rate = feed_calls * ev_per_buf / (time.perf_counter() - t0)
+    print(f"bench: feed path {feed_rate:,.0f} ev/s "
+          f"({feed_rate / value:.2f}x of fold_many)", file=sys.stderr)
+
     print(json.dumps({
         "metric": "flow_events_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "events/sec",
         "vs_baseline": round(value / PER_CHIP_TARGET, 4),
+        "feed_path_events_per_sec": round(feed_rate, 1),
     }))
 
 

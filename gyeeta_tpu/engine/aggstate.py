@@ -61,6 +61,7 @@ class EngineCfg(NamedTuple):
     conn_batch: int = 2048            # static microbatch lanes
     resp_batch: int = 4096
     listener_batch: int = 512
+    fold_k: int = 16                  # microbatches per fold_many dispatch
 
 
 class AggState(NamedTuple):

@@ -48,6 +48,15 @@ def to_sql(tree, subsys: str):
         fd = fieldmaps.field_map(subsys)[tree.field]
         col = fd.json
         vals = list(tree.values)
+        if fd.kind == "enum":
+            # history rows store presentation strings (row_to_json);
+            # normalize query literals (numeric or string) through the
+            # codec so both execution paths compare in the same domain.
+            # Ordering comparators would compare lexicographically in SQL
+            # but by ordinal live — post-filter those instead of pruning.
+            if tree.op in ("<", "<=", ">", ">="):
+                return "1=1", [], False
+            vals = [fd.to_json(fd.from_json(v)) for v in vals]
         if tree.op == "=":
             return f"{col} = ?", [vals[0]], True
         if tree.op == "!=":

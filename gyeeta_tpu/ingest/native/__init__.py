@@ -2,8 +2,13 @@
 
 ``drain(buf)`` is the L1 ingest entry point: one pass over a byte stream →
 {subtype: contiguous record array} + bytes consumed. Uses the C++ fast
-path when ``libgytdeframe.so`` is built (``python -m
-gyeeta_tpu.ingest.native.build``), else ``wire.decode_frames``.
+path (built lazily on first use when g++ is available), else
+``wire.decode_frames``.
+
+The subtype table is pushed INTO the library from ``wire.DTYPE_OF_SUBTYPE``
+at load time (``gyt_set_table``) and echoed back (``gyt_layout``) — the
+native path structurally cannot drift from wire.py the way a compiled-in
+table could.
 """
 
 from __future__ import annotations
@@ -16,21 +21,46 @@ import numpy as np
 from gyeeta_tpu.ingest import wire
 
 _SO = pathlib.Path(__file__).resolve().parent / "libgytdeframe.so"
+_SRC = pathlib.Path(__file__).resolve().parent / "deframe.cpp"
 _lib = None
+_load_failed = False
 
 _ERRNAMES = {1: "bad magic", 2: "bad total_sz", 3: "batch cap exceeded",
-             4: "nevents overflows frame", 5: "output buffer full"}
+             4: "nevents overflows frame", 5: "output buffer full",
+             6: "bad subtype table"}
 
-# order must match kSubtypes in deframe.cpp
-_SCAN_ORDER = (wire.NOTIFY_TCP_CONN, wire.NOTIFY_LISTENER_STATE,
-               wire.NOTIFY_HOST_STATE, wire.NOTIFY_RESP_SAMPLE)
+# drain() output ordering; derived from wire.py, never hand-maintained
+_SCAN_ORDER = tuple(sorted(wire.DTYPE_OF_SUBTYPE))
+
+
+def _ensure_built() -> bool:
+    """Build (or rebuild, if deframe.cpp is newer) the shared object."""
+    try:
+        if _SO.exists() and (not _SRC.exists()
+                             or _SO.stat().st_mtime >= _SRC.stat().st_mtime):
+            return True
+        from gyeeta_tpu.ingest.native import build
+        build.build(verbose=False)
+        return True
+    except Exception:
+        return _SO.exists()
 
 
 def _load():
-    global _lib
-    if _lib is not None or not _SO.exists():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
         return _lib
-    lib = ctypes.CDLL(str(_SO))
+    if not _ensure_built():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError:
+        _load_failed = True      # unloadable .so: pure-Python fallback
+        return None
+    lib.gyt_set_table.restype = ctypes.c_int32
+    lib.gyt_set_table.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_int32]
     lib.gyt_extract.restype = ctypes.c_int32
     lib.gyt_extract.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
@@ -44,17 +74,26 @@ def _load():
     lib.gyt_layout.restype = ctypes.c_int32
     lib.gyt_layout.argtypes = [ctypes.POINTER(ctypes.c_int64),
                                ctypes.c_int64]
-    # layout handshake: a stale .so must never silently mis-slice records
-    tri = (ctypes.c_int64 * 12)()
-    n = lib.gyt_layout(tri, 4)
-    native = {int(tri[i * 3]): (int(tri[i * 3 + 1]), int(tri[i * 3 + 2]))
-              for i in range(n)}
+    # push the subtype table from wire.py (single source of truth) ...
+    n = len(_SCAN_ORDER)
+    tri = (ctypes.c_int64 * (3 * n))()
+    for i, st in enumerate(_SCAN_ORDER):
+        tri[i * 3 + 0] = st
+        tri[i * 3 + 1] = wire.DTYPE_OF_SUBTYPE[st].itemsize
+        tri[i * 3 + 2] = wire.MAX_OF_SUBTYPE[st]
+    rc = lib.gyt_set_table(tri, n)
+    if rc != 0:
+        raise RuntimeError(f"gyt_set_table: {_ERRNAMES.get(rc, rc)}")
+    # ... and verify the round-trip covers every subtype
+    back = (ctypes.c_int64 * (3 * n))()
+    got = lib.gyt_layout(back, n)
+    native = {int(back[i * 3]): (int(back[i * 3 + 1]), int(back[i * 3 + 2]))
+              for i in range(got)}
     expect = {st: (wire.DTYPE_OF_SUBTYPE[st].itemsize,
                    wire.MAX_OF_SUBTYPE[st]) for st in _SCAN_ORDER}
     if native != expect:
         raise RuntimeError(
-            f"native deframer layout mismatch: {native} != {expect}; "
-            f"rebuild with python -m gyeeta_tpu.ingest.native.build")
+            f"native deframer layout mismatch: {native} != {expect}")
     _lib = lib
     return _lib
 
@@ -72,18 +111,19 @@ def drain(buf: bytes) -> tuple[dict, int]:
     lib = _load()
     if lib is None:
         return _drain_py(buf)
-    counts = (ctypes.c_int64 * 4)()
+    n = len(_SCAN_ORDER)
+    counts = (ctypes.c_int64 * n)()
     consumed = ctypes.c_int64()
     rc = lib.gyt_scan(buf, len(buf), counts, ctypes.byref(consumed))
     if rc != 0:
         raise wire.FrameError(f"native scan: {_ERRNAMES.get(rc, rc)}")
     out = {}
     for i, subtype in enumerate(_SCAN_ORDER):
-        n = counts[i]
-        if n == 0:
+        nrecs = counts[i]
+        if nrecs == 0:
             continue
         dt = wire.DTYPE_OF_SUBTYPE[subtype]
-        rec = np.empty(n, dt)
+        rec = np.empty(nrecs, dt)
         c2 = ctypes.c_int64()
         nrec = ctypes.c_int64()
         tot = ctypes.c_int64()
@@ -93,7 +133,7 @@ def drain(buf: bytes) -> tuple[dict, int]:
             ctypes.byref(c2), ctypes.byref(nrec), ctypes.byref(tot))
         if rc != 0:
             raise wire.FrameError(f"native extract: {_ERRNAMES.get(rc, rc)}")
-        assert nrec.value == n, (nrec.value, n)
+        assert nrec.value == nrecs, (nrec.value, nrecs)
         out[subtype] = rec
     return out, int(consumed.value)
 

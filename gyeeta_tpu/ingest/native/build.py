@@ -8,6 +8,7 @@ back to the pure-Python decoder when absent.
 
 from __future__ import annotations
 
+import os
 import pathlib
 import subprocess
 import sys
@@ -18,11 +19,18 @@ OUT = HERE / "libgytdeframe.so"
 
 
 def build(verbose: bool = True) -> pathlib.Path:
+    # compile to a unique temp path + atomic rename: concurrent first-use
+    # builds (multiple processes) must never load a half-written .so
+    tmp = OUT.with_suffix(f".so.tmp{os.getpid()}")
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           "-Wall", "-Werror", str(SRC), "-o", str(OUT)]
+           "-Wall", "-Werror", str(SRC), "-o", str(tmp)]
     if verbose:
         print(" ".join(cmd))
-    subprocess.run(cmd, check=True)
+    try:
+        subprocess.run(cmd, check=True)
+        os.replace(tmp, OUT)
+    finally:
+        tmp.unlink(missing_ok=True)
     return OUT
 
 

@@ -8,10 +8,14 @@
 // Python does exactly one np.frombuffer per subtype per drain, no
 // per-frame interpreter work.
 //
-// Layouts mirror gyeeta_tpu/ingest/wire.py exactly (little-endian,
-// 8-aligned structured dtypes). Validation rules are identical to
-// wire.decode_frames: magic check, total_sz bounds, per-subtype batch
-// caps, nevents-fits-frame.
+// The subtype table (subtype, itemsize, cap) is NOT compiled in: the
+// Python loader pushes it via gyt_set_table() from wire.DTYPE_OF_SUBTYPE
+// at load time, so the native path can never drift from wire.py — the
+// single-source-of-truth discipline the reference gets from sharing one
+// gy_comm_proto.h between all components.
+//
+// Validation rules are identical to wire.decode_frames: magic check,
+// total_sz bounds, per-subtype batch caps, nevents-fits-frame.
 //
 // Build: ingest/native/build.py (g++ -O3 -shared). Loaded via ctypes
 // (ingest/native/__init__.py) with transparent fallback to the Python
@@ -30,6 +34,7 @@ constexpr uint32_t COMM_EVENT_NOTIFY = 1u;
 
 constexpr int64_t HDR_SZ = 16;   // HEADER_DT
 constexpr int64_t EV_SZ = 8;     // EVENT_NOTIFY_DT
+constexpr int32_t MAX_TYPES = 64;
 
 struct Header {
   uint32_t magic;
@@ -43,24 +48,24 @@ struct EventNotify {
   uint32_t nevents;
 };
 
-// per-subtype record sizes + caps, must match wire.py DTYPE_OF_SUBTYPE
 struct SubtypeInfo {
   uint32_t subtype;
   int64_t itemsize;
   uint32_t cap;
 };
 
-constexpr SubtypeInfo kSubtypes[] = {
-    {10, 240, 2048},   // TCP_CONN      (TCP_CONN_DT.itemsize)
-    {11, 104, 512},    // LISTENER_STATE
-    {12, 48, 4096},    // HOST_STATE
-    {13, 16, 4096},    // RESP_SAMPLE
-};
+SubtypeInfo g_table[MAX_TYPES];
+int32_t g_ntypes = 0;
+
+int32_t index_of(uint32_t subtype) {
+  for (int32_t i = 0; i < g_ntypes; i++)
+    if (g_table[i].subtype == subtype) return i;
+  return -1;
+}
 
 const SubtypeInfo* info_of(uint32_t subtype) {
-  for (const auto& s : kSubtypes)
-    if (s.subtype == subtype) return &s;
-  return nullptr;
+  const int32_t i = index_of(subtype);
+  return i >= 0 ? &g_table[i] : nullptr;
 }
 
 enum GytErr : int32_t {
@@ -70,11 +75,41 @@ enum GytErr : int32_t {
   GYT_CAP_EXCEEDED = 3,
   GYT_NEV_OVERFLOW = 4,
   GYT_OUT_FULL = 5,
+  GYT_BAD_TABLE = 6,
 };
 
 }  // namespace
 
 extern "C" {
+
+// Install the subtype table: n triples of (subtype, itemsize, cap).
+// Called once by the Python loader before any scan/extract; itemsizes
+// must be 8-aligned (wire.py asserts the same on its side).
+int32_t gyt_set_table(const int64_t* triples, int32_t n) {
+  if (n < 1 || n > MAX_TYPES) return GYT_BAD_TABLE;
+  for (int32_t i = 0; i < n; i++) {
+    const int64_t itemsize = triples[i * 3 + 1];
+    if (itemsize <= 0 || itemsize % 8 != 0) return GYT_BAD_TABLE;
+    g_table[i].subtype = static_cast<uint32_t>(triples[i * 3 + 0]);
+    g_table[i].itemsize = itemsize;
+    g_table[i].cap = static_cast<uint32_t>(triples[i * 3 + 2]);
+  }
+  g_ntypes = n;
+  return GYT_OK;
+}
+
+// Echo the installed table back (layout handshake round-trip).
+int32_t gyt_layout(int64_t* out, int64_t max_triples) {
+  int32_t n = 0;
+  for (int32_t i = 0; i < g_ntypes; i++) {
+    if (n >= max_triples) break;
+    out[n * 3 + 0] = g_table[i].subtype;
+    out[n * 3 + 1] = g_table[i].itemsize;
+    out[n * 3 + 2] = g_table[i].cap;
+    n++;
+  }
+  return n;
+}
 
 // Scan [buf, buf+len): validate frames; copy records of `subtype` into
 // out (capacity out_cap bytes). A trailing partial frame is left for
@@ -89,7 +124,7 @@ int32_t gyt_extract(const uint8_t* buf, int64_t len, uint32_t subtype,
   *consumed = 0;
   *out_nrec = 0;
   *total_nrec = 0;
-  if (want == nullptr) return GYT_BAD_TOTAL;
+  if (want == nullptr) return GYT_BAD_TABLE;
 
   while (off + HDR_SZ <= len) {
     Header h;
@@ -137,11 +172,11 @@ int32_t gyt_extract(const uint8_t* buf, int64_t len, uint32_t subtype,
 }
 
 // Count frames + records per subtype without copying (sizing pass).
-// counts: array of 4 int64 (order of kSubtypes). Returns error code.
+// counts: array of g_ntypes int64, in gyt_set_table order.
 int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
                  int64_t* consumed) {
   int64_t off = 0;
-  for (int i = 0; i < 4; i++) counts[i] = 0;
+  for (int32_t i = 0; i < g_ntypes; i++) counts[i] = 0;
   *consumed = 0;
   while (off + HDR_SZ <= len) {
     Header h;
@@ -155,34 +190,19 @@ int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
     if (h.data_type == COMM_EVENT_NOTIFY) {
       EventNotify ev;
       std::memcpy(&ev, buf + off + HDR_SZ, sizeof(ev));
-      for (int i = 0; i < 4; i++) {
-        if (kSubtypes[i].subtype == ev.subtype) {
-          if (ev.nevents > kSubtypes[i].cap) return GYT_CAP_EXCEEDED;
-          const int64_t need = HDR_SZ + EV_SZ +
-              static_cast<int64_t>(ev.nevents) * kSubtypes[i].itemsize;
-          if (need > total) return GYT_NEV_OVERFLOW;
-          counts[i] += ev.nevents;
-        }
+      const int32_t idx = index_of(ev.subtype);
+      if (idx >= 0) {
+        if (ev.nevents > g_table[idx].cap) return GYT_CAP_EXCEEDED;
+        const int64_t need = HDR_SZ + EV_SZ +
+            static_cast<int64_t>(ev.nevents) * g_table[idx].itemsize;
+        if (need > total) return GYT_NEV_OVERFLOW;
+        counts[idx] += ev.nevents;
       }
     }
     off += total;
   }
   *consumed = off;
   return GYT_OK;
-}
-
-// Layout handshake: fill (subtype, itemsize, cap) triples so the Python
-// loader can verify the compiled table matches wire.py before first use.
-int32_t gyt_layout(int64_t* out, int64_t max_triples) {
-  int32_t n = 0;
-  for (const auto& s : kSubtypes) {
-    if (n >= max_triples) break;
-    out[n * 3 + 0] = s.subtype;
-    out[n * 3 + 1] = s.itemsize;
-    out[n * 3 + 2] = s.cap;
-    n++;
-  }
-  return n;
 }
 
 }  // extern "C"

@@ -179,7 +179,6 @@ AGGR_TASK_DT = np.dtype([
     ("curr_issue", "u1"),
     ("pad", "u1", (2,)),
     ("host_id", "<u4"),
-    ("pad2", "u1", (4,)),
 ])
 
 MAX_TASKS_PER_BATCH = 1200     # gy_comm_proto.h:2139 MAX_NUM_TASKS

@@ -43,7 +43,9 @@ class Runtime:
                         if self.opts.history_db else None)
         self._clock = clock or time.time
         self._pending = b""           # partial-frame resume buffer
+        self._staged = []             # decoded (cb, rb) microbatch pairs
         self._fold = step.jit_fold_step(self.cfg)
+        self._fold_many = step.jit_fold_many(self.cfg)
         self._fold_lst = jax.jit(
             lambda s, b: step.ingest_listener(self.cfg, s, b))
         self._fold_host = jax.jit(
@@ -59,8 +61,17 @@ class Runtime:
     def feed(self, buf: bytes) -> int:
         """Ingest a byte stream (any number of frames, any mix of types).
 
-        Returns records folded. Trailing partial frames are buffered for
-        the next call (epoll partial-read resume semantics)."""
+        Returns records accepted. Trailing partial frames are buffered for
+        the next call (epoll partial-read resume semantics).
+
+        Hot-path discipline (the DB_WRITE_ARR batching of the reference,
+        ``server/gy_mconnhdlr.h:350``): conn/resp microbatches are STAGED
+        host-side and dispatched as K-deep ``lax.scan`` slabs via
+        ``jit_fold_many`` — one device dispatch per ``cfg.fold_k``
+        microbatches, no device readbacks anywhere in this path. A partial
+        slab stays staged until the next ``feed``/``flush()``;
+        ``run_tick``/``query`` flush first, so staged events are never
+        invisible at a cadence or query boundary."""
         data = self._pending + buf
         try:
             recs, consumed = native.drain(data)
@@ -72,28 +83,24 @@ class Runtime:
         n = 0
         conn = recs.get(wire.NOTIFY_TCP_CONN)
         resp = recs.get(wire.NOTIFY_RESP_SAMPLE)
-        # pair conn+resp chunks into fused fold steps
-        ci = ri = 0
-        while (conn is not None and ci < len(conn)) or \
-                (resp is not None and ri < len(resp)):
-            cchunk = (conn[ci:ci + self.cfg.conn_batch]
-                      if conn is not None else conn)
-            rchunk = (resp[ri:ri + self.cfg.resp_batch]
-                      if resp is not None else resp)
-            cb = (decode.conn_batch(cchunk, self.cfg.conn_batch)
+        CB, RB = self.cfg.conn_batch, self.cfg.resp_batch
+        nc = 0 if conn is None else len(conn)
+        nr = 0 if resp is None else len(resp)
+        npair = max(-(-nc // CB), -(-nr // RB))
+        for i in range(npair):
+            cchunk = conn[i * CB:(i + 1) * CB] if nc else None
+            rchunk = resp[i * RB:(i + 1) * RB] if nr else None
+            cb = (decode.conn_batch(cchunk, CB)
                   if cchunk is not None and len(cchunk)
                   else self._empty_conn)
-            rb = (decode.resp_batch(rchunk, self.cfg.resp_batch)
+            rb = (decode.resp_batch(rchunk, RB)
                   if rchunk is not None and len(rchunk)
                   else self._empty_resp)
-            self.state = self._fold(self.state, cb, rb)
-            nc = int(cb.valid.sum())
-            nr = int(rb.valid.sum())
-            ci += nc
-            ri += nr
-            n += nc + nr
-            self.stats.bump("conn_events", nc)
-            self.stats.bump("resp_events", nr)
+            self._staged.append((cb, rb))
+        n += nc + nr
+        self.stats.bump("conn_events", nc)
+        self.stats.bump("resp_events", nr)
+        self._dispatch_full_slabs()
         lst = recs.get(wire.NOTIFY_LISTENER_STATE)
         if lst is not None:
             for i in range(0, len(lst), self.cfg.listener_batch):
@@ -112,10 +119,33 @@ class Runtime:
             self.stats.bump("host_records", len(hst))
         return n
 
+    def _dispatch_full_slabs(self) -> None:
+        """Stack each full K-deep run of staged microbatches and fold it
+        in one scan'd device dispatch."""
+        K = self.cfg.fold_k
+        while len(self._staged) >= K:
+            chunk, self._staged = self._staged[:K], self._staged[K:]
+            cbs = jax.tree.map(lambda *xs: np.stack(xs),
+                               *[c for c, _ in chunk])
+            rbs = jax.tree.map(lambda *xs: np.stack(xs),
+                               *[r for _, r in chunk])
+            self.state = self._fold_many(self.state, cbs, rbs)
+            self.stats.bump("slab_dispatches")
+
+    def flush(self) -> int:
+        """Fold any staged partial slab (single-step path). Called at
+        every cadence/query boundary."""
+        n = len(self._staged)
+        for cb, rb in self._staged:
+            self.state = self._fold(self.state, cb, rb)
+        self._staged = []
+        return n
+
     # ------------------------------------------------------------ cadence
     def run_tick(self) -> dict:
         """Close one 5s window: classify → alerts → windows tick →
         maintenance cadences. Returns a tick report."""
+        self.flush()
         report = {}
         self.state = self._classify(self.state)
         fired = self.alerts.check(self.state)
@@ -166,6 +196,7 @@ class Runtime:
                 req["subsys"], float(req.get("tstart", 0)),
                 float(req.get("tend", now)), req.get("filter"),
                 int(req.get("maxrecs", 10000)))}
+        self.flush()                  # live queries see all staged events
         self.stats.bump("queries")
         return api.query_json(self.cfg, self.state, req)
 

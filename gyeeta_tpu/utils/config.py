@@ -23,7 +23,7 @@ ENV_PREFIX = "GYT_"
 _INT_FIELDS = {"svc_capacity", "n_hosts", "hll_p_svc", "hll_p_global",
                "cms_depth", "cms_width", "topk_capacity", "td_capacity",
                "td_route_cap", "conn_batch", "resp_batch",
-               "listener_batch"}
+               "listener_batch", "fold_k"}
 
 
 class RuntimeOpts(NamedTuple):

@@ -86,3 +86,22 @@ def test_native_faster_than_python_on_small_frames():
     t_py = best_of(native._drain_py)
     # be generous (CI noise): native should not be slower
     assert t_nat < t_py, (t_nat, t_py)
+
+
+def test_all_subtypes_covered_by_native_table():
+    """Every subtype wire.py registers must round-trip through drain() —
+    native and Python paths identically (the r2 native deframer silently
+    dropped AGGR_TASK frames; this pins the whole-vocabulary contract)."""
+    buf = b""
+    rng = np.random.default_rng(3)
+    for st, dt in sorted(wire.DTYPE_OF_SUBTYPE.items()):
+        recs = np.frombuffer(
+            rng.integers(0, 2 ** 63, 7 * dt.itemsize // 8,
+                         dtype=np.int64).tobytes(), dt)
+        buf += wire.encode_frame(st, recs)
+    nat, consumed_n = native.drain(buf)
+    py, consumed_p = native._drain_py(buf)
+    assert consumed_n == consumed_p == len(buf)
+    assert set(nat) == set(py) == set(wire.DTYPE_OF_SUBTYPE)
+    for st in nat:
+        assert np.array_equal(nat[st], py[st]), st
