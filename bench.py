@@ -43,7 +43,7 @@ def main() -> None:
     import numpy as np
 
     sim = ParthaSim(n_hosts=64, n_svcs=16, n_clients=4096)
-    K = 16  # microbatches folded per device dispatch (scan'd slab)
+    K = cfg.fold_k  # microbatches per device dispatch (scan'd slab)
 
     def stage():
         cbs = [decode.conn_batch(sim.conn_records(cfg.conn_batch))

@@ -51,6 +51,11 @@ class EngineCfg(NamedTuple):
     active_spec: loghist.LogHistSpec = loghist.LogHistSpec(
         vmin=1.0, vmax=1e5, nbuckets=32)
     levels: tuple = windows.LEVELS_DEFAULT
+    task_capacity: int = 2048         # process-group slab rows (power of 2)
+    # learned per-group CPU%% baseline (ref AGGR_TASK_HIST_STATS cpu pct
+    # histogram, gy_comm_proto.h:2966): 0.1%..10k% (100 cores)
+    taskcpu_spec: loghist.LogHistSpec = loghist.LogHistSpec(
+        vmin=0.1, vmax=1e4, nbuckets=32)
     hll_p_svc: int = 10               # per-svc distinct clients (±3.2%)
     hll_p_global: int = 14            # global distinct endpoints (±0.8%)
     cms_depth: int = 4
@@ -82,6 +87,18 @@ class AggState(NamedTuple):
     host_panel: jnp.ndarray           # (H, NHOSTCOL) last host state
     host_last_tick: jnp.ndarray       # (H,) int32 tick of last host report
     #                                   (-1 = never; staleness → Down)
+    # --- task tier (process groups, ref MAGGR_TASK server/gy_msocket.h) ---
+    task_tbl: table.Table             # aggr_task_id → row
+    task_stats: jnp.ndarray           # (T, NTASKSTAT) last 5s sweep gauges
+    task_state: jnp.ndarray           # (T,) int32 agent-classified state
+    task_issue: jnp.ndarray           # (T,) int32 issue source
+    task_host: jnp.ndarray            # (T,) int32 owning host (-1 unset)
+    task_comm_hi: jnp.ndarray         # (T,) interned comm id halves
+    task_comm_lo: jnp.ndarray
+    task_rel_hi: jnp.ndarray          # (T,) related listener id halves
+    task_rel_lo: jnp.ndarray
+    task_cpu_hist: jnp.ndarray        # (T, Bc) learned CPU%% baseline
+    task_last_tick: jnp.ndarray       # (T,) int32 tick of last sweep
     glob_hll: hll.HLL                 # distinct flow endpoints global
     cms: countmin.CMS                 # flow-key → bytes
     flow_topk: topk.TopK              # heavy-hitter flows by bytes
@@ -108,6 +125,19 @@ def init(cfg: EngineCfg) -> AggState:
         resp_hi_bits=jnp.zeros((S,), jnp.int32),
         host_panel=jnp.zeros((cfg.n_hosts, NHOSTCOL), jnp.float32),
         host_last_tick=jnp.full((cfg.n_hosts,), -1, jnp.int32),
+        task_tbl=table.init(cfg.task_capacity),
+        task_stats=jnp.zeros((cfg.task_capacity, decode.NTASKSTAT),
+                             jnp.float32),
+        task_state=jnp.zeros((cfg.task_capacity,), jnp.int32),
+        task_issue=jnp.zeros((cfg.task_capacity,), jnp.int32),
+        task_host=jnp.full((cfg.task_capacity,), -1, jnp.int32),
+        task_comm_hi=jnp.zeros((cfg.task_capacity,), jnp.uint32),
+        task_comm_lo=jnp.zeros((cfg.task_capacity,), jnp.uint32),
+        task_rel_hi=jnp.zeros((cfg.task_capacity,), jnp.uint32),
+        task_rel_lo=jnp.zeros((cfg.task_capacity,), jnp.uint32),
+        task_cpu_hist=jnp.zeros(
+            (cfg.task_capacity, cfg.taskcpu_spec.nbuckets), jnp.float32),
+        task_last_tick=jnp.full((cfg.task_capacity,), -1, jnp.int32),
         glob_hll=hll.init(p=cfg.hll_p_global),
         cms=countmin.init(cfg.cms_depth, cfg.cms_width),
         flow_topk=topk.init(cfg.topk_capacity),

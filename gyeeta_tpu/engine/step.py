@@ -115,6 +115,81 @@ def ingest_listener(cfg: EngineCfg, st: AggState, lb) -> AggState:
                        qps_hist=qps_hist, active_hist=active_hist)
 
 
+def ingest_task(cfg: EngineCfg, st: AggState, tb) -> AggState:
+    """Fold a TaskBatch (5s process-group sweep, ref MAGGR_TASK updates in
+    ``partha_aggr_task_state``): gauges + agent state + learned CPU%%
+    baseline + last-seen tick for ageing."""
+    valid = tb.valid
+    tbl, rows = table.upsert(st.task_tbl, tb.key_hi, tb.key_lo, valid)
+    ok = valid & (rows >= 0)
+    rowz = jnp.where(ok, rows, 0)
+    lanes = jnp.where(ok, rows, cfg.task_capacity)
+    stats = st.task_stats.at[lanes].set(tb.stats, mode="drop")
+    state = st.task_state.at[lanes].set(tb.state, mode="drop")
+    issue = st.task_issue.at[lanes].set(tb.issue, mode="drop")
+    host = st.task_host.at[lanes].set(tb.host_id, mode="drop")
+    c_hi = st.task_comm_hi.at[lanes].set(
+        tb.comm_hi.astype(jnp.uint32), mode="drop")
+    c_lo = st.task_comm_lo.at[lanes].set(
+        tb.comm_lo.astype(jnp.uint32), mode="drop")
+    r_hi = st.task_rel_hi.at[lanes].set(
+        tb.rel_hi.astype(jnp.uint32), mode="drop")
+    r_lo = st.task_rel_lo.at[lanes].set(
+        tb.rel_lo.astype(jnp.uint32), mode="drop")
+    from gyeeta_tpu.ingest import decode as D
+    cpu_hist = loghist.update_entities(
+        st.task_cpu_hist, cfg.taskcpu_spec, rowz,
+        tb.stats[:, D.TASK_CPU_PCT], valid=ok)
+    last = st.task_last_tick.at[lanes].set(st.resp_win.tick, mode="drop")
+    return st._replace(
+        task_tbl=tbl, task_stats=stats, task_state=state, task_issue=issue,
+        task_host=host, task_comm_hi=c_hi, task_comm_lo=c_lo,
+        task_rel_hi=r_hi, task_rel_lo=r_lo, task_cpu_hist=cpu_hist,
+        task_last_tick=last)
+
+
+def age_tasks(cfg: EngineCfg, st: AggState, max_age_ticks: int) -> AggState:
+    """Tombstone process groups not seen for ``max_age_ticks`` base ticks
+    (the reference ages MAGGR_TASK entries via ping/delete msgs,
+    ``gy_comm_proto.h:1384-1399``; we age by last-sweep tick)."""
+    seen = st.task_last_tick >= 0
+    stale = seen & (st.resp_win.tick - st.task_last_tick
+                    > jnp.int32(max_age_ticks))
+    tbl, killed = table.tombstone_rows(st.task_tbl, stale)
+    return st._replace(
+        task_tbl=tbl,
+        task_stats=jnp.where(killed[:, None], 0.0, st.task_stats),
+        task_state=jnp.where(killed, 0, st.task_state),
+        task_issue=jnp.where(killed, 0, st.task_issue),
+        task_host=jnp.where(killed, -1, st.task_host),
+        # cpu_hist is scatter-added, never overwritten: zero it here or a
+        # reclaimed slot inherits the dead group's learned baseline
+        task_cpu_hist=jnp.where(killed[:, None], 0.0, st.task_cpu_hist),
+        task_last_tick=jnp.where(killed, -1, st.task_last_tick),
+    )
+
+
+def compact_tasks(cfg: EngineCfg, st: AggState) -> AggState:
+    """Rebuild the task slab without tombstones (cf. compact_state)."""
+    cols = {
+        "stats": st.task_stats, "state": st.task_state,
+        "issue": st.task_issue, "host": st.task_host,
+        "comm_hi": st.task_comm_hi, "comm_lo": st.task_comm_lo,
+        "rel_hi": st.task_rel_hi, "rel_lo": st.task_rel_lo,
+        "cpu_hist": st.task_cpu_hist, "last": st.task_last_tick,
+    }
+    tbl, c = table.compact(st.task_tbl, cols)
+    live = table.live_mask(tbl)
+    return st._replace(
+        task_tbl=tbl, task_stats=c["stats"], task_state=c["state"],
+        task_issue=c["issue"],
+        task_host=jnp.where(live, c["host"], -1),
+        task_comm_hi=c["comm_hi"], task_comm_lo=c["comm_lo"],
+        task_rel_hi=c["rel_hi"], task_rel_lo=c["rel_lo"],
+        task_cpu_hist=c["cpu_hist"],
+        task_last_tick=jnp.where(live, c["last"], -1))
+
+
 def ingest_host(cfg: EngineCfg, st: AggState, hb) -> AggState:
     """Fold a HostBatch (decode.host_batch): dense panel write by host_id."""
     hid = jnp.where(hb.valid, hb.host_id, cfg.n_hosts)

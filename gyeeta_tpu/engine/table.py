@@ -190,6 +190,24 @@ def live_mask(tbl: Table):
         ~_is_tomb(tbl.key_hi, tbl.key_lo)
 
 
+def tombstone_rows(tbl: Table, row_mask):
+    """Tombstone every live row where ``row_mask`` is True.
+
+    The batched ageing primitive (the reference evicts idle entities via
+    per-entry timestamps walked by scheduler jobs, e.g. MAGGR_TASK
+    ageing): callers build the mask from a last-seen-tick column. Returns
+    (new_table, killed_mask); state columns at killed rows should be
+    zeroed by the caller (or left — compact zeroes them)."""
+    kill = live_mask(tbl) & row_mask
+    n = jnp.sum(kill).astype(jnp.int32)
+    return tbl._replace(
+        key_hi=jnp.where(kill, TOMB, tbl.key_hi),
+        key_lo=jnp.where(kill, TOMB, tbl.key_lo),
+        n_live=tbl.n_live - n,
+        n_tomb=tbl.n_tomb + n,
+    ), kill
+
+
 def compact(tbl: Table, state_cols):
     """Rebuild the slab without tombstones; permute state columns to match.
 

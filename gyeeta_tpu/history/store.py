@@ -25,6 +25,7 @@ _TABLES = {
     "svcstate": [f.json for f in fieldmaps.SVCSTATE_FIELDS],
     "hoststate": [f.json for f in fieldmaps.HOSTSTATE_FIELDS],
     "clusterstate": [f.json for f in fieldmaps.CLUSTERSTATE_FIELDS],
+    "taskstate": [f.json for f in fieldmaps.TASKSTATE_FIELDS],
 }
 
 

@@ -82,6 +82,21 @@ class HostBatch(NamedTuple):
     valid: np.ndarray
 
 
+class TaskBatch(NamedTuple):
+    """Columnar AGGR_TASK_STATE microbatch (process-group 5s sweep)."""
+    key_hi: np.ndarray        # aggr_task_id split — process-group key
+    key_lo: np.ndarray
+    comm_hi: np.ndarray       # interned comm id (name resolution)
+    comm_lo: np.ndarray
+    rel_hi: np.ndarray        # related_listen_id (task→svc join)
+    rel_lo: np.ndarray
+    stats: np.ndarray         # (B, NTASKSTAT) float32, TASK_* indices
+    state: np.ndarray         # int32 agent-classified state
+    issue: np.ndarray         # int32 agent-classified issue source
+    host_id: np.ndarray       # int32
+    valid: np.ndarray
+
+
 # stat column indices of ListenerBatch.stats
 STAT_NQRYS = 0
 STAT_TOTAL_RESP_MS = 1
@@ -100,6 +115,23 @@ STAT_SYS_CPU = 13
 STAT_RSS_MB = 14
 STAT_NTASKS_ISSUE = 15
 NSTAT = 16
+
+# task stat column indices of TaskBatch.stats (and AggState.task_stats)
+TASK_TCP_KB = 0
+TASK_TCP_CONNS = 1
+TASK_CPU_PCT = 2
+TASK_RSS_MB = 3
+TASK_CPU_DELAY_MS = 4
+TASK_VM_DELAY_MS = 5
+TASK_BLKIO_DELAY_MS = 6
+TASK_NTASKS = 7
+TASK_NTASKS_ISSUE = 8
+NTASKSTAT = 9
+
+_TASK_STAT_FIELDS = (
+    "tcp_kbytes", "tcp_conns", "total_cpu_pct", "rss_mb", "cpu_delay_msec",
+    "vm_delay_msec", "blkio_delay_msec", "ntasks_total", "ntasks_issue",
+)
 
 # host panel column indices of HostBatch.panel (and AggState.host_panel)
 HOST_NTASKS = 0
@@ -208,6 +240,32 @@ def listener_batch(recs: np.ndarray,
     return ListenerBatch(
         svc_hi=_pad(svc_hi, size), svc_lo=_pad(svc_lo, size),
         stats=_pad(stats, size),
+        host_id=_pad(r["host_id"].astype(np.int32), size),
+        valid=valid,
+    )
+
+
+def task_batch(recs: np.ndarray, size: int = wire.MAX_TASKS_PER_BATCH
+               ) -> TaskBatch:
+    """AGGR_TASK_STATE records → columnar microbatch (ref
+    AGGR_TASK_STATE_NOTIFY, gy_comm_proto.h:2114)."""
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    k_hi, k_lo = split_u64(r["aggr_task_id"])
+    c_hi, c_lo = split_u64(r["comm_id"])
+    rl_hi, rl_lo = split_u64(r["related_listen_id"])
+    stats = np.zeros((n, NTASKSTAT), np.float32)
+    for i, f in enumerate(_TASK_STAT_FIELDS):
+        stats[:, i] = r[f].astype(np.float32)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return TaskBatch(
+        key_hi=_pad(k_hi, size), key_lo=_pad(k_lo, size),
+        comm_hi=_pad(c_hi, size), comm_lo=_pad(c_lo, size),
+        rel_hi=_pad(rl_hi, size), rel_lo=_pad(rl_lo, size),
+        stats=_pad(stats, size),
+        state=_pad(r["curr_state"].astype(np.int32), size),
+        issue=_pad(r["curr_issue"].astype(np.int32), size),
         host_id=_pad(r["host_id"].astype(np.int32), size),
         valid=valid,
     )

@@ -55,9 +55,16 @@ def _load():
         return None
     try:
         lib = ctypes.CDLL(str(_SO))
-    except OSError:
-        _load_failed = True      # unloadable .so: pure-Python fallback
+        return _bind_and_handshake(lib)
+    except Exception:
+        # unloadable or stale .so (e.g. missing gyt_set_table symbol):
+        # fall back to the pure-Python decoder permanently
+        _load_failed = True
         return None
+
+
+def _bind_and_handshake(lib):
+    global _lib
     lib.gyt_set_table.restype = ctypes.c_int32
     lib.gyt_set_table.argtypes = [ctypes.POINTER(ctypes.c_int64),
                                   ctypes.c_int32]

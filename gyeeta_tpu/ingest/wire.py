@@ -46,6 +46,7 @@ NOTIFY_HOST_STATE = 12        # 5s per-host rollup
 NOTIFY_RESP_SAMPLE = 13       # raw response-time samples (TPU-first)
 NOTIFY_AGGR_TASK_STATE = 14   # 5s per-process-group state
 NOTIFY_CPU_MEM_STATE = 15     # 2s host cpu/mem state
+NOTIFY_NAME_INTERN = 16       # string-intern announcements (TPU-first)
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -183,12 +184,32 @@ AGGR_TASK_DT = np.dtype([
 
 MAX_TASKS_PER_BATCH = 1200     # gy_comm_proto.h:2139 MAX_NUM_TASKS
 
+# NAME_INTERN — the host-side half of the fixed-width record contract: the
+# reference carries comm[16]/cmdline/issue strings inline in every record
+# (e.g. gy_comm_proto.h:1708 trailing cmdline); we instead intern strings
+# to 64-bit ids at the agent and announce (id, kind, utf-8 bytes) once.
+# Queries resolve ids back to names via the InternTable (utils/intern.py).
+NAME_KIND_COMM = 1      # process comm / command name
+NAME_KIND_SVC = 2       # service (listener) name, id == glob_id
+NAME_KIND_HOST = 3      # hostname, id == host_id
+MAX_NAME_BYTES = 48
+
+NAME_INTERN_DT = np.dtype([
+    ("name_id", "<u8"),
+    ("kind", "<u4"),
+    ("nlen", "<u4"),
+    ("name", "u1", (MAX_NAME_BYTES,)),
+])
+
+MAX_NAMES_PER_BATCH = 1024
+
 DTYPE_OF_SUBTYPE = {
     NOTIFY_TCP_CONN: TCP_CONN_DT,
     NOTIFY_LISTENER_STATE: LISTENER_STATE_DT,
     NOTIFY_HOST_STATE: HOST_STATE_DT,
     NOTIFY_RESP_SAMPLE: RESP_SAMPLE_DT,
     NOTIFY_AGGR_TASK_STATE: AGGR_TASK_DT,
+    NOTIFY_NAME_INTERN: NAME_INTERN_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -199,6 +220,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_HOST_STATE: MAX_HOSTS_PER_BATCH,
     NOTIFY_RESP_SAMPLE: MAX_RESP_PER_BATCH,
     NOTIFY_AGGR_TASK_STATE: MAX_TASKS_PER_BATCH,
+    NOTIFY_NAME_INTERN: MAX_NAMES_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -206,7 +228,8 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("LISTENER_STATE_DT", LISTENER_STATE_DT),
                    ("HOST_STATE_DT", HOST_STATE_DT),
                    ("RESP_SAMPLE_DT", RESP_SAMPLE_DT),
-                   ("AGGR_TASK_DT", AGGR_TASK_DT)]:
+                   ("AGGR_TASK_DT", AGGR_TASK_DT),
+                   ("NAME_INTERN_DT", NAME_INTERN_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 

@@ -60,13 +60,25 @@ def _hex_id(hi, lo):
     return np.array([format(int(g), "016x") for g in gid], object)
 
 
-def svc_columns(cfg: EngineCfg, st: AggState) -> dict:
+def _names_of(names, kind, hi, lo):
+    """Resolve interned 64-bit ids to names (hex-id fallback)."""
+    if names is None:
+        return _hex_id(hi, lo)
+    ids = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+    return names.resolve_array(kind, ids)
+
+
+def svc_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     """svcstate subsystem columns (reference JSON names' units: msec)."""
+    from gyeeta_tpu.ingest import wire
+
     snap = {k: np.asarray(v)
             for k, v in readback.svcstate_snapshot(cfg, st).items()}
     g = snap["stats"]
     cols = {
         "svcid": _hex_id(snap["glob_id_hi"], snap["glob_id_lo"]),
+        "svcname": _names_of(names, wire.NAME_KIND_SVC,
+                             snap["glob_id_hi"], snap["glob_id_lo"]),
         "nqry5s": snap["nqry5s"],
         "qps5s": snap["qps5s"],
         "resp5s": snap["resp5s_us"] / 1e3,
@@ -103,7 +115,7 @@ def svc_columns(cfg: EngineCfg, st: AggState) -> dict:
 DOWN_AFTER_TICKS = 6
 
 
-def host_columns(cfg: EngineCfg, st: AggState) -> dict:
+def host_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     panel = np.asarray(st.host_panel)
     last = np.asarray(st.host_last_tick)
     now = int(np.asarray(st.resp_win.tick))
@@ -120,8 +132,18 @@ def host_columns(cfg: EngineCfg, st: AggState) -> dict:
         severe_mem=panel[:, D.HOST_SEVERE_MEM] > 0)
     from gyeeta_tpu.semantic.states import STATE_DOWN
     states = np.where(down, STATE_DOWN, states)
+    from gyeeta_tpu.ingest import wire
+
+    hostids = np.arange(panel.shape[0])
+    if names is None:
+        hostnames = np.array([str(h) for h in hostids], object)
+    else:
+        hostnames = np.array(
+            [names.lookup(wire.NAME_KIND_HOST, h) or str(h)
+             for h in hostids], object)
     cols = {
-        "hostid": np.arange(panel.shape[0]),
+        "hostid": hostids,
+        "hostname": hostnames,
         "nprocissue": panel[:, D.HOST_NTASKS_ISSUE],
         "nprocsevere": panel[:, D.HOST_NTASKS_SEVERE],
         "nproc": panel[:, D.HOST_NTASKS],
@@ -137,7 +159,37 @@ def host_columns(cfg: EngineCfg, st: AggState) -> dict:
     return cols, reported
 
 
-def flow_columns(cfg: EngineCfg, st: AggState, k: int = 128) -> dict:
+def task_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
+    """taskstate subsystem columns (ref MAGGR_TASK / aggrtaskstate)."""
+    from gyeeta_tpu.ingest import wire
+
+    snap = {k: np.asarray(v)
+            for k, v in readback.task_snapshot(cfg, st).items()}
+    g = snap["stats"]
+    cols = {
+        "taskid": _hex_id(snap["key_hi"], snap["key_lo"]),
+        "comm": _names_of(names, wire.NAME_KIND_COMM,
+                          snap["comm_hi"], snap["comm_lo"]),
+        "relsvcid": _hex_id(snap["rel_hi"], snap["rel_lo"]),
+        "tcpkb": g[:, D.TASK_TCP_KB],
+        "tcpconns": g[:, D.TASK_TCP_CONNS],
+        "cpu": g[:, D.TASK_CPU_PCT],
+        "cpup95": snap["cpu_p95"],
+        "rssmb": g[:, D.TASK_RSS_MB],
+        "cpudelms": g[:, D.TASK_CPU_DELAY_MS],
+        "vmdelms": g[:, D.TASK_VM_DELAY_MS],
+        "iodelms": g[:, D.TASK_BLKIO_DELAY_MS],
+        "ntasks": g[:, D.TASK_NTASKS],
+        "nissue": g[:, D.TASK_NTASKS_ISSUE],
+        "state": snap["state"],
+        "issue": snap["issue"],
+        "hostid": snap["hostid"],
+    }
+    return cols, snap["live"]
+
+
+def flow_columns(cfg: EngineCfg, st: AggState, k: int = 128,
+                 names=None) -> dict:
     snap = {kk: np.asarray(v)
             for kk, v in readback.flow_snapshot(cfg, st, k).items()}
     valid = snap["flow_bytes"] > 0
@@ -149,7 +201,7 @@ def flow_columns(cfg: EngineCfg, st: AggState, k: int = 128) -> dict:
     return cols, valid
 
 
-def cluster_columns(cfg: EngineCfg, st: AggState) -> dict:
+def cluster_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     hcols, reported = host_columns(cfg, st)
     c = hoststate.cluster_state(np.asarray(hcols["state"]), valid=reported)
     cols = {k: np.array([float(v)]) for k, v in c.items()}
@@ -161,14 +213,31 @@ _COLUMNS_OF = {
     fieldmaps.SUBSYS_HOSTSTATE: host_columns,
     fieldmaps.SUBSYS_CLUSTERSTATE: cluster_columns,
     fieldmaps.SUBSYS_FLOWSTATE: flow_columns,
+    fieldmaps.SUBSYS_TASKSTATE: task_columns,
+    fieldmaps.SUBSYS_TOPCPU: task_columns,
+    fieldmaps.SUBSYS_TOPRSS: task_columns,
+    fieldmaps.SUBSYS_TOPDELAY: task_columns,
+}
+
+# top-N views: preset sort + limit over taskstate columns
+# (ref TASK_TOP_PROCS top-15 CPU / top-8 RSS, gy_comm_proto.h:1415)
+_TOP_PRESETS = {
+    fieldmaps.SUBSYS_TOPCPU: ("cpu", 15),
+    fieldmaps.SUBSYS_TOPRSS: ("rssmb", 8),
+    fieldmaps.SUBSYS_TOPDELAY: ("cpudelms", 15),
 }
 
 
-def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions) -> dict:
+def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
+            names=None) -> dict:
     """Run one point-in-time query → {"recs": [...], "nrecs": N}."""
     if opts.subsys not in _COLUMNS_OF:
         raise ValueError(f"unknown subsystem {opts.subsys!r}")
-    cols, base_mask = _COLUMNS_OF[opts.subsys](cfg, st)
+    preset = _TOP_PRESETS.get(opts.subsys)
+    if preset is not None and opts.sortcol is None:
+        opts = opts._replace(sortcol=preset[0],
+                             maxrecs=min(opts.maxrecs, preset[1]))
+    cols, base_mask = _COLUMNS_OF[opts.subsys](cfg, st, names=names)
     tree = criteria.parse(opts.filter) if opts.filter else None
     mask = base_mask & criteria.evaluate(tree, cols, opts.subsys)
     idx = np.nonzero(mask)[0]
@@ -197,6 +266,7 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions) -> dict:
             "ntotal": int(base_mask.sum())}
 
 
-def query_json(cfg: EngineCfg, st: AggState, req: dict) -> dict:
+def query_json(cfg: EngineCfg, st: AggState, req: dict,
+               names=None) -> dict:
     """JSON-envelope entry point (the NM-conn QUERY_CMD analogue)."""
-    return execute(cfg, st, QueryOptions.from_json(req))
+    return execute(cfg, st, QueryOptions.from_json(req), names=names)

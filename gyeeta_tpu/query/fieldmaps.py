@@ -13,13 +13,20 @@ from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
-from gyeeta_tpu.semantic.states import ISSUE_NAMES, STATE_NAMES
+from gyeeta_tpu.semantic.states import ISSUE_NAMES, STATE_NAMES, \
+    TASK_ISSUE_NAMES
 
 SUBSYS_SVCSTATE = "svcstate"
 SUBSYS_HOSTSTATE = "hoststate"
 SUBSYS_CLUSTERSTATE = "clusterstate"
 SUBSYS_FLOWSTATE = "flowstate"      # heavy-hitter flows (TPU-first)
 SUBSYS_SVCINFO = "svcinfo"
+SUBSYS_TASKSTATE = "taskstate"      # ref aggrtaskstate
+# top-N process-group views (ref TASK_TOP_PROCS, gy_comm_proto.h:1415:
+# top CPU / PG CPU / RSS / forks — here: preset-sorted taskstate views)
+SUBSYS_TOPCPU = "topcpu"
+SUBSYS_TOPRSS = "toprss"
+SUBSYS_TOPDELAY = "topdelay"
 
 
 class FieldDef(NamedTuple):
@@ -51,6 +58,7 @@ def _enum_codec(names):
 
 _state_enc, _state_dec = _enum_codec(STATE_NAMES)
 _issue_enc, _issue_dec = _enum_codec(ISSUE_NAMES)
+_tissue_enc, _tissue_dec = _enum_codec(TASK_ISSUE_NAMES)
 
 
 def num(json, col, desc=""):
@@ -74,6 +82,7 @@ def string(json, col, desc=""):
 # keys of query.api.svc_columns()
 SVCSTATE_FIELDS = (
     string("svcid", "svcid", "Service glob id (hex)"),
+    string("svcname", "svcname", "Service name (interned)"),
     num("qps5s", "qps5s", "Current queries/sec"),
     num("nqry5s", "nqry5s", "Queries in last 5s window"),
     num("resp5s", "resp5s", "Mean response last 5s (msec)"),
@@ -107,6 +116,7 @@ SVCSTATE_FIELDS = (
 # ref json_db_hoststate_arr (gy_json_field_maps.h:785)
 HOSTSTATE_FIELDS = (
     num("hostid", "hostid", "Host id"),
+    string("hostname", "hostname", "Hostname (interned)"),
     num("nprocissue", "nprocissue", "Processes with issues"),
     num("nprocsevere", "nprocsevere", "Processes with severe issues"),
     num("nproc", "nproc", "Total processes"),
@@ -133,6 +143,28 @@ CLUSTERSTATE_FIELDS = (
     num("issuefrac", "issue_frac", "Fraction of hosts Bad/Severe"),
 )
 
+# -------------------------------------------------------------- taskstate
+# ref json_db_aggrtaskstate_arr / MAGGR_TASK fields (gy_comm_proto.h:2114,
+# server/gy_msocket.h MAGGR_TASK); comm resolved via the intern table
+TASKSTATE_FIELDS = (
+    string("taskid", "taskid", "Process-group (aggregate task) id (hex)"),
+    string("comm", "comm", "Process command name"),
+    string("relsvcid", "relsvcid", "Related listener (service) id (hex)"),
+    num("tcpkb", "tcpkb", "TCP KB transferred in last 5s"),
+    num("tcpconns", "tcpconns", "TCP connections"),
+    num("cpu", "cpu", "Total CPU %% (all group processes)"),
+    num("cpup95", "cpup95", "Learned p95 CPU %% baseline"),
+    num("rssmb", "rssmb", "Resident memory MB"),
+    num("cpudelms", "cpudelms", "CPU delay msec (taskstats)"),
+    num("vmdelms", "vmdelms", "VM (swap/reclaim) delay msec"),
+    num("iodelms", "iodelms", "Block IO delay msec"),
+    num("ntasks", "ntasks", "Processes in the group"),
+    num("nissue", "nissue", "Processes with issues"),
+    enum("state", "state", _state_enc, _state_dec, "Group state"),
+    enum("issue", "issue", _tissue_enc, _tissue_dec, "Issue source"),
+    num("hostid", "hostid", "Owning host id"),
+)
+
 # -------------------------------------------------------------- flowstate
 FLOWSTATE_FIELDS = (
     string("flowid", "flowid", "Flow key (hex)"),
@@ -145,6 +177,10 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_HOSTSTATE: HOSTSTATE_FIELDS,
     SUBSYS_CLUSTERSTATE: CLUSTERSTATE_FIELDS,
     SUBSYS_FLOWSTATE: FLOWSTATE_FIELDS,
+    SUBSYS_TASKSTATE: TASKSTATE_FIELDS,
+    SUBSYS_TOPCPU: TASKSTATE_FIELDS,
+    SUBSYS_TOPRSS: TASKSTATE_FIELDS,
+    SUBSYS_TOPDELAY: TASKSTATE_FIELDS,
 }
 
 

@@ -129,6 +129,29 @@ def host_snapshot(cfg: EngineCfg, st: AggState):
     return {"panel": st.host_panel}
 
 
+@partial(jax.jit, static_argnums=(0,))
+def task_snapshot(cfg: EngineCfg, st: AggState):
+    """Per-process-group live snapshot (the ``web_curr_aggrtaskstate``
+    analogue): gauges + agent classification + learned CPU baseline."""
+    cpu_p95 = loghist.quantiles(
+        st.task_cpu_hist, cfg.taskcpu_spec,
+        jnp.asarray([0.95], jnp.float32))[:, 0]
+    return {
+        "key_hi": st.task_tbl.key_hi,
+        "key_lo": st.task_tbl.key_lo,
+        "live": table.live_mask(st.task_tbl),
+        "stats": st.task_stats,
+        "state": st.task_state,
+        "issue": st.task_issue,
+        "hostid": st.task_host,
+        "comm_hi": st.task_comm_hi,
+        "comm_lo": st.task_comm_lo,
+        "rel_hi": st.task_rel_hi,
+        "rel_lo": st.task_rel_lo,
+        "cpu_p95": cpu_p95,
+    }
+
+
 def svc_rows_to_host(cfg: EngineCfg, snap: dict) -> list[dict]:
     """Device snapshot → list of per-service dicts (live rows only).
 

@@ -27,6 +27,7 @@ from gyeeta_tpu.query import api
 from gyeeta_tpu.semantic import derive
 from gyeeta_tpu.utils import checkpoint as ckpt
 from gyeeta_tpu.utils.config import RuntimeOpts
+from gyeeta_tpu.utils.intern import InternTable
 from gyeeta_tpu.utils.selfstats import Stats
 
 
@@ -50,7 +51,15 @@ class Runtime:
             lambda s, b: step.ingest_listener(self.cfg, s, b))
         self._fold_host = jax.jit(
             lambda s, b: step.ingest_host(self.cfg, s, b))
+        self._fold_task = jax.jit(
+            lambda s, b: step.ingest_task(self.cfg, s, b))
+        self._age_tasks = jax.jit(
+            lambda s: step.age_tasks(self.cfg, s,
+                                     self.opts.task_max_age_ticks))
+        self._compact_tasks = jax.jit(
+            lambda s: step.compact_tasks(self.cfg, s))
         self._tick = jax.jit(lambda s: step.tick_5s(self.cfg, s))
+        self.names = InternTable()
         self._classify = derive.jit_classify_pass(self.cfg)
         self._empty_conn = decode.conn_batch(
             np.empty(0, wire.TCP_CONN_DT), self.cfg.conn_batch)
@@ -117,6 +126,16 @@ class Runtime:
                 self.state = self._fold_host(self.state, hb)
                 n += int(hb.valid.sum())
             self.stats.bump("host_records", len(hst))
+        tsk = recs.get(wire.NOTIFY_AGGR_TASK_STATE)
+        if tsk is not None:
+            for i in range(0, len(tsk), wire.MAX_TASKS_PER_BATCH):
+                tb = decode.task_batch(tsk[i:i + wire.MAX_TASKS_PER_BATCH])
+                self.state = self._fold_task(self.state, tb)
+            n += len(tsk)
+            self.stats.bump("task_records", len(tsk))
+        nm = recs.get(wire.NOTIFY_NAME_INTERN)
+        if nm is not None:
+            self.stats.bump("names_interned", self.names.update(nm))
         return n
 
     def _dispatch_full_slabs(self) -> None:
@@ -159,22 +178,36 @@ class Runtime:
         if self.history and tick % self.opts.history_every_ticks == 0:
             now = self._clock()
             out = api.execute(self.cfg, self.state, api.QueryOptions(
-                subsys="svcstate", maxrecs=self.cfg.svc_capacity))
+                subsys="svcstate", maxrecs=self.cfg.svc_capacity),
+                names=self.names)
             self.history.write("svcstate", now, out["recs"])
             hout = api.execute(self.cfg, self.state, api.QueryOptions(
-                subsys="hoststate", maxrecs=self.cfg.n_hosts))
+                subsys="hoststate", maxrecs=self.cfg.n_hosts),
+                names=self.names)
             self.history.write("hoststate", now, hout["recs"])
             cout = api.execute(self.cfg, self.state, api.QueryOptions(
                 subsys="clusterstate"))
             self.history.write("clusterstate", now, cout["recs"])
-            report["history_rows"] = out["nrecs"] + hout["nrecs"] + 1
+            tout = api.execute(self.cfg, self.state, api.QueryOptions(
+                subsys="taskstate", maxrecs=self.cfg.task_capacity),
+                names=self.names)
+            self.history.write("taskstate", now, tout["recs"])
+            report["history_rows"] = (out["nrecs"] + hout["nrecs"]
+                                      + tout["nrecs"] + 1)
 
         self.state = self._tick(self.state)
+        if tick % self.opts.task_age_every_ticks == 0:
+            self.state = self._age_tasks(self.state)
         n_tomb = int(np.asarray(self.state.tbl.n_tomb))
         if n_tomb > self.cfg.svc_capacity * self.opts.compact_tomb_frac:
             self.state = compact.compact_state(self.cfg, self.state)
             self.stats.bump("compactions")
             report["compacted"] = True
+        nt_tomb = int(np.asarray(self.state.task_tbl.n_tomb))
+        if nt_tomb > self.cfg.task_capacity * self.opts.compact_tomb_frac:
+            self.state = self._compact_tasks(self.state)
+            self.stats.bump("task_compactions")
+            report["task_compacted"] = True
 
         if (self.opts.checkpoint_dir
                 and tick % self.opts.checkpoint_every_ticks == 0):
@@ -198,8 +231,12 @@ class Runtime:
                 int(req.get("maxrecs", 10000)))}
         self.flush()                  # live queries see all staged events
         self.stats.bump("queries")
-        return api.query_json(self.cfg, self.state, req)
+        return api.query_json(self.cfg, self.state, req, names=self.names)
 
     def restore(self, path) -> dict:
+        # drop staged microbatches and partial-frame bytes from before the
+        # restore: folding them into checkpointed state would double-count
+        self._staged = []
+        self._pending = b""
         self.state, extra = ckpt.restore(path, self.cfg, self.state)
         return extra

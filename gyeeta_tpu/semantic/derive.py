@@ -64,6 +64,29 @@ def signals(cfg: EngineCfg, st: AggState):
     delay_ms = (gauges[:, D.STAT_TASKS_DELAY_US]
                 + gauges[:, D.STAT_TASKS_CPUDELAY_US]
                 + gauges[:, D.STAT_TASKS_BLKIODELAY_US]) / 1000.0
+
+    # task-tier join: fold the process-group sweeps into per-service
+    # signals via related_listen_id (the reference joins MAGGR_TASK →
+    # MTCP_LISTENER through related_listen_id_ and feeds listener task
+    # counts from it). Segment-sum over the svc slab; elementwise max with
+    # the listener gauges (same underlying facts, different paths — the
+    # fresher/stronger signal wins, never double-counts).
+    from gyeeta_tpu.engine import table as _table
+    task_live = _table.live_mask(st.task_tbl)
+    rel_rows = _table.lookup(st.tbl, st.task_rel_hi, st.task_rel_lo,
+                             valid=task_live)
+    tgt = jnp.where(rel_rows >= 0, rel_rows, cfg.svc_capacity)
+    tstats = st.task_stats
+    t_issue_by_svc = jnp.zeros((cfg.svc_capacity,), jnp.float32).at[tgt].add(
+        tstats[:, D.TASK_NTASKS_ISSUE], mode="drop")
+    t_ntasks_by_svc = jnp.zeros((cfg.svc_capacity,), jnp.float32).at[tgt].add(
+        tstats[:, D.TASK_NTASKS], mode="drop")
+    t_delay_by_svc = jnp.zeros((cfg.svc_capacity,), jnp.float32).at[tgt].add(
+        tstats[:, D.TASK_CPU_DELAY_MS] + tstats[:, D.TASK_VM_DELAY_MS]
+        + tstats[:, D.TASK_BLKIO_DELAY_MS], mode="drop")
+    ntasks = jnp.maximum(ntasks, t_ntasks_by_svc)
+    ntasks_issue = jnp.maximum(ntasks_issue, t_issue_by_svc)
+    delay_ms = jnp.maximum(delay_ms, t_delay_by_svc)
     # simplified is_task_issue (ref gy_socket_stat.h:699): any flagged task
     # is an issue; severe when every task is flagged or delays are heavy
     task_issue = ntasks_issue > 0

@@ -20,3 +20,15 @@ ISSUE_OS_MEMORY = 6
 
 ISSUE_NAMES = ("none", "listener_tasks", "qps_high", "active_conn_high",
                "server_errors", "os_cpu", "os_memory")
+
+# process-group (aggregate task) issue sources
+# (ref TASK_ISSUE_SOURCE, common/gy_json_field_maps.h:317)
+TISSUE_NONE = 0
+TISSUE_CPU_DELAY = 1
+TISSUE_BLKIO_DELAY = 2
+TISSUE_VM_DELAY = 3
+TISSUE_HIGH_CPU = 4
+TISSUE_HIGH_RSS = 5
+
+TASK_ISSUE_NAMES = ("none", "cpu_delay", "blkio_delay", "vm_delay",
+                    "high_cpu", "high_rss")

@@ -26,10 +26,11 @@ from gyeeta_tpu.ingest import wire
 class ParthaSim:
     def __init__(self, n_hosts: int = 64, n_svcs: int = 16,
                  n_clients: int = 4096, seed: int = 42,
-                 zipf_a: float = 1.3):
+                 zipf_a: float = 1.3, n_groups: int = 8):
         self.n_hosts = n_hosts
         self.n_svcs = n_svcs
         self.n_clients = n_clients
+        self.n_groups = n_groups     # process groups per host
         self.rng = np.random.default_rng(seed)
         self.zipf_a = zipf_a
         # stable 64-bit glob_ids per (host, svc): mixed so ids look like the
@@ -45,6 +46,15 @@ class ParthaSim:
         self.cli_ips = self.rng.integers(
             0x0A000000, 0x0AFFFFFF, size=(n_clients,), dtype=np.uint32)
         self.tusec = np.uint64(1_700_000_000_000_000)
+        # stable process-group ids per (host, group) + interned comm ids
+        hs = np.arange(n_hosts, dtype=np.uint64)[:, None]
+        gr = np.arange(n_groups, dtype=np.uint64)[None, :]
+        self.task_ids = _splitmix64(
+            (hs << np.uint64(24)) | gr | np.uint64(0x7A5C << 48))
+        from gyeeta_tpu.utils.intern import InternTable
+        self.comm_ids = np.array(
+            [InternTable.intern(f"proc-{g}") for g in range(n_groups)],
+            np.uint64)
 
     # ------------------------------------------------------------ streams
     def resp_records(self, n: int) -> np.ndarray:
@@ -116,6 +126,63 @@ class ParthaSim:
         out["host_id"] = host
         return out
 
+    def aggr_task_records(self) -> np.ndarray:
+        """One 5s AGGR_TASK_STATE sweep: ``n_groups`` process groups per
+        host (ref AGGR_TASK_STATE_NOTIFY, gy_comm_proto.h:2114)."""
+        from gyeeta_tpu.semantic import states as S
+        r = self.rng
+        n = self.n_hosts * self.n_groups
+        host = np.repeat(np.arange(self.n_hosts, dtype=np.uint32),
+                         self.n_groups)
+        grp = np.tile(np.arange(self.n_groups, dtype=np.uint64),
+                      self.n_hosts)
+        out = np.zeros(n, wire.AGGR_TASK_DT)
+        out["aggr_task_id"] = self.task_ids.reshape(-1)
+        out["comm_id"] = self.comm_ids[grp]
+        # groups 0..n_svcs-1 serve the corresponding listener
+        svc = np.minimum(grp, self.n_svcs - 1).astype(np.int64)
+        serves = grp < self.n_svcs
+        out["related_listen_id"] = np.where(
+            serves, self.glob_ids[host, svc], 0)
+        out["tcp_kbytes"] = r.poisson(800, n) * serves
+        out["tcp_conns"] = r.poisson(30, n) * serves
+        cpu = (r.pareto(2.0, n) + 0.2) * 8.0
+        out["total_cpu_pct"] = np.minimum(cpu, 3200.0).astype(np.float32)
+        out["rss_mb"] = 64 + r.integers(0, 4096, n)
+        cpu_delay = (r.random(n) < 0.06) * r.integers(50, 2000, n)
+        io_delay = (r.random(n) < 0.04) * r.integers(20, 1500, n)
+        out["cpu_delay_msec"] = cpu_delay
+        out["blkio_delay_msec"] = io_delay
+        out["vm_delay_msec"] = (r.random(n) < 0.01) * r.integers(10, 500, n)
+        out["ntasks_total"] = 1 + r.integers(0, 16, n)
+        issue = (cpu_delay > 500) | (io_delay > 300)
+        out["ntasks_issue"] = issue * (1 + r.integers(
+            0, out["ntasks_total"].astype(np.int64), n))
+        out["curr_state"] = np.where(
+            issue, np.where(cpu_delay > 1200, S.STATE_SEVERE, S.STATE_BAD),
+            np.where(out["total_cpu_pct"] > 1.0, S.STATE_OK, S.STATE_IDLE)
+        ).astype(np.uint8)
+        out["curr_issue"] = np.where(
+            cpu_delay > 500, S.TISSUE_CPU_DELAY,
+            np.where(io_delay > 300, S.TISSUE_BLKIO_DELAY,
+                     S.TISSUE_NONE)).astype(np.uint8)
+        out["host_id"] = host
+        return out
+
+    def name_records(self) -> np.ndarray:
+        """Intern announcements for every name this agent fleet uses."""
+        from gyeeta_tpu.utils.intern import InternTable
+        entries = []
+        for g in range(self.n_groups):
+            entries.append((wire.NAME_KIND_COMM, self.comm_ids[g],
+                            f"proc-{g}"))
+        for h in range(self.n_hosts):
+            for s in range(self.n_svcs):
+                entries.append((wire.NAME_KIND_SVC, self.glob_ids[h, s],
+                                f"svc-{s}.host-{h}"))
+            entries.append((wire.NAME_KIND_HOST, h, f"host-{h}.sim"))
+        return InternTable.records(entries)
+
     def host_state_records(self) -> np.ndarray:
         r = self.rng
         n = self.n_hosts
@@ -152,6 +219,20 @@ class ParthaSim:
             wire.encode_frame(wire.NOTIFY_LISTENER_STATE,
                               recs[i:i + wire.MAX_LISTENERS_PER_BATCH])
             for i in range(0, len(recs), wire.MAX_LISTENERS_PER_BATCH))
+
+    def task_frames(self) -> bytes:
+        recs = self.aggr_task_records()
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_AGGR_TASK_STATE,
+                              recs[i:i + wire.MAX_TASKS_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_TASKS_PER_BATCH))
+
+    def name_frames(self) -> bytes:
+        recs = self.name_records()
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_NAME_INTERN,
+                              recs[i:i + wire.MAX_NAMES_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_NAMES_PER_BATCH))
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:

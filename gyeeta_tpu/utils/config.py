@@ -23,7 +23,7 @@ ENV_PREFIX = "GYT_"
 _INT_FIELDS = {"svc_capacity", "n_hosts", "hll_p_svc", "hll_p_global",
                "cms_depth", "cms_width", "topk_capacity", "td_capacity",
                "td_route_cap", "conn_batch", "resp_batch",
-               "listener_batch", "fold_k"}
+               "listener_batch", "fold_k", "task_capacity"}
 
 
 class RuntimeOpts(NamedTuple):
@@ -33,6 +33,8 @@ class RuntimeOpts(NamedTuple):
     history_db: Optional[str] = None
     history_every_ticks: int = 12           # 1 min
     compact_tomb_frac: float = 0.25         # compact when tombs exceed
+    task_age_every_ticks: int = 12          # ageing sweep cadence (1 min)
+    task_max_age_ticks: int = 36            # evict groups unseen for 3 min
     debug_level: int = 0                    # hot-reloadable
     resp_sample_pct: float = 100.0          # hot-reloadable duty cycle
 
@@ -48,7 +50,7 @@ def load_engine_cfg(cfg_file: Optional[str] = None,
                     **overrides) -> EngineCfg:
     """defaults ≺ JSON file ≺ GYT_<FIELD> env ≺ kwargs."""
     env = os.environ if env is None else env
-    spec_keys = {f"{n}_{p}" for n in ("resp", "qps", "active")
+    spec_keys = {f"{n}_{p}" for n in ("resp", "qps", "active", "taskcpu")
                  for p in ("vmin", "vmax", "nbuckets")}
     known = set(EngineCfg._fields) | spec_keys
     vals: dict = {}
@@ -64,7 +66,7 @@ def load_engine_cfg(cfg_file: Optional[str] = None,
             vals[k] = _coerce(k, ev)
     vals.update({k: _coerce(k, v) for k, v in overrides.items()})
     specs = {}
-    for name in ("resp", "qps", "active"):
+    for name in ("resp", "qps", "active", "taskcpu"):
         base = getattr(EngineCfg(), f"{name}_spec")
         parts = {}
         for p in ("vmin", "vmax", "nbuckets"):

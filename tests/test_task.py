@@ -1,0 +1,166 @@
+"""Task/process-group subsystem: wire → fold → query → ageing → history
+(ref: AGGR_TASK_STATE_NOTIFY gy_comm_proto.h:2114, MAGGR_TASK
+server/gy_msocket.h, rankings gy_task_handler.cc:655-756)."""
+
+import numpy as np
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.sketch import loghist
+from gyeeta_tpu.utils.config import RuntimeOpts
+from gyeeta_tpu.utils.intern import InternTable
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("svc_capacity", 128)
+    kw.setdefault("n_hosts", 8)
+    kw.setdefault("task_capacity", 256)
+    kw.setdefault("conn_batch", 128)
+    kw.setdefault("resp_batch", 128)
+    kw.setdefault("resp_spec",
+                  loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64))
+    return EngineCfg(**kw)
+
+
+def make_rt(**opts):
+    return Runtime(tiny_cfg(), RuntimeOpts(**opts))
+
+
+def test_task_feed_and_query():
+    rt = make_rt()
+    sim = ParthaSim(n_hosts=8, n_svcs=4, n_groups=6, seed=11)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.task_frames())
+    out = rt.query({"subsys": "taskstate", "maxrecs": 1000})
+    assert out["nrecs"] == 8 * 6
+    row = out["recs"][0]
+    # names resolved through the intern table, not hex ids
+    assert row["comm"].startswith("proc-")
+    assert set(row) >= {"taskid", "comm", "cpu", "rssmb", "cpudelms",
+                        "ntasks", "state", "issue", "hostid"}
+    # group 0..3 serve listeners → relsvcid joins to a real glob id
+    served = [r for r in out["recs"] if int(r["relsvcid"], 16) != 0]
+    assert len(served) == 8 * 4
+    gids = {int(g) for g in sim.glob_ids.reshape(-1)}
+    assert all(int(r["relsvcid"], 16) in gids for r in served)
+
+
+def test_topcpu_preset():
+    rt = make_rt()
+    sim = ParthaSim(n_hosts=8, n_svcs=4, n_groups=6, seed=12)
+    rt.feed(sim.task_frames())
+    out = rt.query({"subsys": "topcpu"})
+    assert 0 < out["nrecs"] <= 15
+    cpus = [r["cpu"] for r in out["recs"]]
+    assert cpus == sorted(cpus, reverse=True)
+    # and it is actually the global max
+    full = rt.query({"subsys": "taskstate", "maxrecs": 1000})
+    assert max(r["cpu"] for r in full["recs"]) == cpus[0]
+
+    rss = rt.query({"subsys": "toprss"})
+    assert 0 < rss["nrecs"] <= 8
+    rr = [r["rssmb"] for r in rss["recs"]]
+    assert rr == sorted(rr, reverse=True)
+
+
+def test_task_filter_by_state_and_comm():
+    rt = make_rt()
+    sim = ParthaSim(n_hosts=8, n_svcs=4, n_groups=6, seed=13)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.task_frames())
+    full = rt.query({"subsys": "taskstate", "maxrecs": 1000})
+    nbad = sum(r["state"] in ("Bad", "Severe") for r in full["recs"])
+    out = rt.query({"subsys": "taskstate",
+                    "filter": "{ taskstate.state in 'Bad','Severe' }",
+                    "maxrecs": 1000})
+    assert out["nrecs"] == nbad
+    one = rt.query({"subsys": "taskstate",
+                    "filter": "{ taskstate.comm = 'proc-3' }",
+                    "maxrecs": 1000})
+    assert one["nrecs"] == 8      # one group 3 per host
+    assert all(r["comm"] == "proc-3" for r in one["recs"])
+
+
+def test_task_state_updates_not_duplicates():
+    rt = make_rt()
+    sim = ParthaSim(n_hosts=8, n_svcs=4, n_groups=6, seed=14)
+    for _ in range(3):
+        rt.feed(sim.task_frames())
+    out = rt.query({"subsys": "taskstate", "maxrecs": 1000})
+    assert out["nrecs"] == 8 * 6          # upserts, not inserts
+    assert int(np.asarray(rt.state.task_tbl.n_live)) == 8 * 6
+
+
+def test_task_ageing_evicts_stale_groups():
+    rt = make_rt(task_age_every_ticks=1, task_max_age_ticks=2)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, n_groups=6, seed=15)
+    rt.feed(sim.task_frames())
+    assert rt.query({"subsys": "taskstate", "maxrecs": 1000})["nrecs"] == 48
+    for _ in range(4):                     # ticks advance past max age
+        rt.run_tick()
+    assert rt.query({"subsys": "taskstate", "maxrecs": 1000})["nrecs"] == 0
+    assert int(np.asarray(rt.state.task_tbl.n_live)) == 0
+
+
+def test_task_history_roundtrip():
+    rt = make_rt(history_db=":memory:", history_every_ticks=1)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, n_groups=6, seed=16)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.task_frames())
+    rt.run_tick()
+    rows = rt.query({"subsys": "taskstate", "tstart": 0,
+                     "filter": "{ taskstate.comm = 'proc-1' }"})
+    assert len(rows["recs"]) == 8
+    assert all(r["comm"] == "proc-1" for r in rows["recs"])
+
+
+def test_intern_roundtrip_via_wire():
+    t = InternTable()
+    recs = InternTable.records(
+        [(wire.NAME_KIND_COMM, InternTable.intern("nginx"), "nginx"),
+         (wire.NAME_KIND_HOST, 7, "web-7.prod")])
+    buf = wire.encode_frame(wire.NOTIFY_NAME_INTERN, recs)
+    frames, consumed = wire.decode_frames(buf)
+    assert consumed == len(buf)
+    t.update(frames[0][1])
+    assert t.lookup(wire.NAME_KIND_COMM, InternTable.intern("nginx")) \
+        == "nginx"
+    assert t.lookup(wire.NAME_KIND_HOST, 7) == "web-7.prod"
+    assert t.lookup(wire.NAME_KIND_HOST, 8) is None
+
+
+def test_task_join_feeds_svc_signals():
+    """Process-group sweeps joined via related_listen_id must surface in
+    the per-service classifier inputs (task-tier -> svc signal path)."""
+    import jax.numpy as jnp
+    from gyeeta_tpu.semantic import derive
+
+    rt = make_rt()
+    sim = ParthaSim(n_hosts=8, n_svcs=4, n_groups=6, seed=21)
+    rt.feed(sim.listener_frames())
+    base_sig, _ = derive.signals(rt.cfg, rt.state)
+    base = np.asarray(base_sig.ntasks_issue).sum()
+
+    # craft one task record with heavy issues serving host 0 / svc 0
+    rec = np.zeros(1, wire.AGGR_TASK_DT)
+    rec["aggr_task_id"] = 0xDEADBEEF
+    rec["related_listen_id"] = sim.glob_ids[0, 0]
+    rec["ntasks_total"] = 9
+    rec["ntasks_issue"] = 9
+    rec["cpu_delay_msec"] = 5000
+    rec["host_id"] = 0
+    rt.feed(wire.encode_frame(wire.NOTIFY_AGGR_TASK_STATE, rec))
+    rt.flush()
+
+    sig, _ = derive.signals(rt.cfg, rt.state)
+    assert np.asarray(sig.ntasks_issue).sum() >= base + 9
+    # the joined delay lands on the right service row
+    from gyeeta_tpu.engine import table
+    row = int(np.asarray(table.lookup(
+        rt.state.tbl,
+        jnp.asarray([sim.glob_ids[0, 0] >> 32], jnp.uint32),
+        jnp.asarray([sim.glob_ids[0, 0] & 0xFFFFFFFF], jnp.uint32)))[0])
+    assert row >= 0
+    assert float(np.asarray(sig.tasks_delay_msec)[row]) >= 5000.0
