@@ -38,6 +38,8 @@ MAX_COMM_DATA_SZ = 16 * 1024 * 1024   # 16MB frame cap (gy_comm_proto.h:31)
 COMM_EVENT_NOTIFY = 1
 COMM_QUERY_CMD = 2
 COMM_QUERY_RESP = 3
+COMM_REGISTER_REQ = 4     # agent handshake (ref PS_REGISTER_REQ_S :584)
+COMM_REGISTER_RESP = 5
 
 # NOTIFY_TYPE (EVENT_NOTIFY subtype_)
 NOTIFY_TCP_CONN = 10          # flow close/open records
@@ -231,6 +233,104 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("AGGR_TASK_DT", AGGR_TASK_DT),
                    ("NAME_INTERN_DT", NAME_INTERN_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
+
+
+# ----------------------------------------------------- control-plane msgs
+# Registration (ref PS_REGISTER_REQ_S/PM_CONNECT_CMD_S,
+# gy_comm_proto.h:584-952, version gates :55-56): one message class —
+# single-controller design collapses the partha→shyama→madhava two-step
+# into one handshake; machine-id → host_id stickiness replaces
+# assign_partha_madhava placement (gy_shconnhdlr.cc:5876).
+REGISTER_REQ_DT = np.dtype([
+    ("machine_id_hi", "<u8"),    # SYS_HARDWARE machine-id analogue
+    ("machine_id_lo", "<u8"),
+    ("wire_version", "<u4"),
+    ("conn_type", "<u4"),        # CONN_EVENT | CONN_QUERY
+    ("hostname_id", "<u8"),      # interned hostname (announce separately)
+])
+
+REGISTER_RESP_DT = np.dtype([
+    ("status", "<u4"),
+    ("host_id", "<u4"),          # assigned dense engine index
+    ("curr_version", "<u4"),
+    ("pad", "u1", (4,)),
+])
+
+CONN_EVENT = 1
+CONN_QUERY = 2
+
+REG_OK = 0
+REG_ERR_VERSION = 1              # older than MIN_WIRE_VERSION
+REG_ERR_CAPACITY = 2             # host slots exhausted (n_hosts)
+
+# Query multiplexing (ref QUERY_CMD/QUERY_RESPONSE, gy_comm_proto.h:502,
+# 536; ≤4K outstanding :53): seqid echoes back with the JSON response.
+QUERY_HDR_DT = np.dtype([
+    ("seqid", "<u8"),
+    ("status", "<u4"),           # req: 0; resp: QS_*
+    ("nbytes", "<u4"),           # JSON payload bytes (before pad)
+])
+
+QS_OK = 0
+QS_ERROR = 1                     # payload = {"error": msg}
+QS_BUSY = 2                      # too many outstanding queries
+
+MAX_OUTSTANDING_QUERIES = 64     # per conn (global 4K analogue)
+
+
+def _frame(data_type: int, payload: bytes, magic: int) -> bytes:
+    pad = (-len(payload)) % 8
+    total = HEADER_DT.itemsize + len(payload) + pad
+    if total >= MAX_COMM_DATA_SZ:
+        raise FrameError(f"frame {total} bytes exceeds 16MB cap")
+    hdr = np.zeros((), HEADER_DT)
+    hdr["magic"] = magic
+    hdr["total_sz"] = total
+    hdr["data_type"] = data_type
+    hdr["padding_sz"] = pad
+    return hdr.tobytes() + payload + b"\x00" * pad
+
+
+def encode_register_req(machine_id: int, conn_type: int,
+                        wire_version: int, hostname_id: int = 0) -> bytes:
+    r = np.zeros((), REGISTER_REQ_DT)
+    r["machine_id_hi"] = np.uint64((machine_id >> 64)
+                                   & 0xFFFFFFFFFFFFFFFF)
+    r["machine_id_lo"] = np.uint64(machine_id & 0xFFFFFFFFFFFFFFFF)
+    r["wire_version"] = wire_version
+    r["conn_type"] = conn_type
+    r["hostname_id"] = np.uint64(hostname_id)
+    return _frame(COMM_REGISTER_REQ, r.tobytes(), MAGIC_PM)
+
+
+def encode_register_resp(status: int, host_id: int,
+                         curr_version: int) -> bytes:
+    r = np.zeros((), REGISTER_RESP_DT)
+    r["status"] = status
+    r["host_id"] = host_id
+    r["curr_version"] = curr_version
+    return _frame(COMM_REGISTER_RESP, r.tobytes(), MAGIC_MS)
+
+
+def encode_query(seqid: int, obj, status: int = QS_OK,
+                 resp: bool = False) -> bytes:
+    import json as _json
+    payload = _json.dumps(obj).encode()
+    h = np.zeros((), QUERY_HDR_DT)
+    h["seqid"] = np.uint64(seqid)
+    h["status"] = status
+    h["nbytes"] = len(payload)
+    return _frame(COMM_QUERY_RESP if resp else COMM_QUERY_CMD,
+                  h.tobytes() + payload, MAGIC_NQ)
+
+
+def decode_query_payload(payload: bytes):
+    """QUERY_CMD/RESP frame payload → (seqid, status, json_obj)."""
+    import json as _json
+    h = np.frombuffer(payload, QUERY_HDR_DT, count=1)[0]
+    n = int(h["nbytes"])
+    body = payload[QUERY_HDR_DT.itemsize: QUERY_HDR_DT.itemsize + n]
+    return int(h["seqid"]), int(h["status"]), _json.loads(body or b"null")
 
 
 def encode_frame(subtype: int, records: np.ndarray,

@@ -1,0 +1,11 @@
+"""Network serving edge: the TCP boundary of the framework.
+
+``server.GytServer`` — accepts agent event streams + query clients over
+COMM_HEADER framing (the madhava L1 accept/recv role,
+``server/gy_mconnhdlr.cc:2430``). ``agent.NetAgent`` — a partha-equivalent
+client: registers, then streams simulator telemetry. ``agent.QueryClient``
+— the Node-webserver-equivalent query peer.
+"""
+
+from gyeeta_tpu.net.agent import NetAgent, QueryClient  # noqa: F401
+from gyeeta_tpu.net.server import GytServer  # noqa: F401

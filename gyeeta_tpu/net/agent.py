@@ -1,0 +1,165 @@
+"""Agent-side clients: NetAgent (partha equivalent) + QueryClient.
+
+NetAgent mirrors partha's connection bring-up (ref
+``partha/gy_paconnhdlr.cc:1200`` blocking_shyama_register →
+``:1665`` connect_madhava): open a TCP conn, send REGISTER_REQ with the
+machine-id, learn the assigned ``host_id``, then construct a single-host
+``ParthaSim`` at that global host index and stream its telemetry as
+EVENT_NOTIFY frames. On reconnect the machine-id maps back to the same
+host_id (sticky placement), and the agent resends its name announcements
+(the resend-inventory-on-reconnect recovery of the reference,
+``gy_socket_stat.h:1235``).
+
+QueryClient is the Node-webserver peer: a query-role conn multiplexing
+JSON queries by seqid.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import numpy as np
+
+from gyeeta_tpu import version
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.utils import hashing as H
+
+_HSZ = wire.HEADER_DT.itemsize
+
+
+async def _read_frame(reader) -> tuple[int, bytes]:
+    hdr_b = await reader.readexactly(_HSZ)
+    hdr = np.frombuffer(hdr_b, wire.HEADER_DT, count=1)[0]
+    total = int(hdr["total_sz"])
+    body = await reader.readexactly(total - _HSZ)
+    pad = int(hdr["padding_sz"])
+    return int(hdr["data_type"]), body[: len(body) - pad]
+
+
+async def register(host: str, port: int, machine_id: int, conn_type: int,
+                   wire_version: int = version.CURR_WIRE_VERSION,
+                   hostname_id: int = 0):
+    """Open + register one conn → (reader, writer, status, host_id)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(wire.encode_register_req(
+        machine_id, conn_type, wire_version, hostname_id))
+    await writer.drain()
+    dtype, payload = await _read_frame(reader)
+    if dtype != wire.COMM_REGISTER_RESP:
+        raise wire.FrameError(f"expected REGISTER_RESP, got {dtype}")
+    resp = np.frombuffer(payload, wire.REGISTER_RESP_DT, count=1)[0]
+    return reader, writer, int(resp["status"]), int(resp["host_id"])
+
+
+class NetAgent:
+    """One simulated host agent over a real socket."""
+
+    def __init__(self, machine_id: Optional[int] = None, seed: int = 0,
+                 n_svcs: int = 4, n_groups: int = 6,
+                 wire_version: int = version.CURR_WIRE_VERSION):
+        self.machine_id = machine_id if machine_id is not None \
+            else H.hash_bytes_np(f"sim-agent-{seed}".encode())
+        self.seed = seed
+        self.n_svcs = n_svcs
+        self.n_groups = n_groups
+        self.wire_version = wire_version
+        self.host_id: Optional[int] = None
+        self.sim: Optional[ParthaSim] = None
+        self._writer = None
+
+    async def connect(self, host: str, port: int) -> int:
+        """Register the event conn; returns assigned host_id."""
+        hostname_id = self.machine_id & 0xFFFFFFFF
+        reader, writer, status, hid = await register(
+            host, port, self.machine_id, wire.CONN_EVENT,
+            self.wire_version, hostname_id)
+        if status != wire.REG_OK:
+            writer.close()
+            raise ConnectionRefusedError(f"registration status {status}")
+        self.host_id = hid
+        self._writer = writer
+        # a fresh 1-host sim rooted at the assigned global host index —
+        # glob_ids/task_ids derive from it, so streams are fleet-unique
+        self.sim = ParthaSim(
+            n_hosts=1, n_svcs=self.n_svcs, n_groups=self.n_groups,
+            seed=1000 + hid, host_base=hid)
+        await self.send_names()
+        return hid
+
+    async def send_names(self) -> None:
+        buf = self.sim.name_frames() + wire.encode_frame(
+            wire.NOTIFY_NAME_INTERN,
+            wire_name_record(wire.NAME_KIND_HOST, self.host_id,
+                             f"agent-{self.host_id}.sim"))
+        self._writer.write(buf)
+        await self._writer.drain()
+
+    async def send_sweep(self, n_conn: int = 256, n_resp: int = 512
+                         ) -> None:
+        """One 5s-equivalent sweep: flows, resp samples, state records."""
+        s = self.sim
+        buf = (s.conn_frames(n_conn) + s.resp_frames(n_resp)
+               + s.listener_frames() + s.task_frames()
+               + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                   s.host_state_records()))
+        self._writer.write(buf)
+        await self._writer.drain()
+
+    async def close(self) -> None:
+        if self._writer:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self._writer = None
+
+
+def wire_name_record(kind: int, name_id: int, name: str) -> np.ndarray:
+    from gyeeta_tpu.utils.intern import InternTable
+    return InternTable.records([(kind, name_id, name)])
+
+
+class QueryClient:
+    """Query-role conn: JSON queries multiplexed by seqid."""
+
+    def __init__(self, machine_id: Optional[int] = None):
+        self.machine_id = machine_id if machine_id is not None \
+            else H.hash_bytes_np(b"query-client")
+        self._reader = None
+        self._writer = None
+        self._seq = 0
+
+    async def connect(self, host: str, port: int) -> None:
+        reader, writer, status, _ = await register(
+            host, port, self.machine_id, wire.CONN_QUERY)
+        if status != wire.REG_OK:
+            writer.close()
+            raise ConnectionRefusedError(f"registration status {status}")
+        self._reader, self._writer = reader, writer
+
+    async def query(self, req: dict) -> dict:
+        self._seq += 1
+        seq = self._seq
+        self._writer.write(wire.encode_query(seq, req))
+        await self._writer.drain()
+        dtype, payload = await _read_frame(self._reader)
+        if dtype != wire.COMM_QUERY_RESP:
+            raise wire.FrameError(f"expected QUERY_RESP, got {dtype}")
+        seqid, status, obj = wire.decode_query_payload(payload)
+        if seqid != seq:
+            raise wire.FrameError(f"seqid mismatch {seqid} != {seq}")
+        if status != wire.QS_OK:
+            raise RuntimeError(obj.get("error", f"query status {status}"))
+        return obj
+
+    async def close(self) -> None:
+        if self._writer:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self._writer = None

@@ -1,0 +1,209 @@
+"""GytServer: the TCP serving edge (asyncio, COMM_HEADER framing).
+
+The role of madhava's accept + L1 threads and shyama's registrar in one
+single-controller process (ref ``server/gy_mconnhdlr.cc:2430-2520`` recv/
+frame loop; ``server/gy_shconnhdlr.cc:7463`` partha registration,
+``:5876`` placement): agents connect, register their machine-id (version
+gated, ``common/gy_comm_proto.h:55-56``), get a sticky dense ``host_id``,
+and stream EVENT_NOTIFY frames that drain straight into ``Runtime.feed``;
+query clients multiplex JSON queries over the same framing (``QUERY_CMD``/
+``QUERY_RESPONSE``, :502,536).
+
+Connection roles commit at registration (the CLI_TYPE_E discipline,
+``gy_comm_proto.h:91-99``): an event conn switches to bulk reads — every
+``read()`` hands whatever bytes arrived to ``Runtime.feed``, which owns
+framing, partial-frame resume and the staged K-slab fold path, so the
+per-frame work stays in the native deframer, not in Python. A query conn
+stays frame-at-a-time and answers each ``QUERY_CMD`` with a framed JSON
+response (seqid echoed).
+
+Concurrency model: one asyncio loop owns the Runtime — the TPU device
+pipeline is the parallelism (no L2 worker pools).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import pathlib
+from typing import Optional
+
+import numpy as np
+
+from gyeeta_tpu import version
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+
+log = logging.getLogger("gyeeta_tpu.net")
+
+_HSZ = wire.HEADER_DT.itemsize
+_READ_SZ = 1 << 20
+
+
+class GytServer:
+    def __init__(self, rt: Runtime, host: str = "127.0.0.1",
+                 port: int = 0, tick_interval: Optional[float] = 5.0,
+                 hostmap_path: Optional[str] = None):
+        self.rt = rt
+        self.host = host
+        self.port = port
+        self.tick_interval = tick_interval
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._tick_task: Optional[asyncio.Task] = None
+        # machine-id → host_id stickiness (the pardbmap_ placement map,
+        # gy_shconnhdlr.cc:5876); optionally persisted across restarts
+        self._hostmap_path = pathlib.Path(hostmap_path) \
+            if hostmap_path else None
+        self.hostmap: dict[int, int] = self._load_hostmap()
+
+    # -------------------------------------------------------- registration
+    def _load_hostmap(self) -> dict:
+        if self._hostmap_path and self._hostmap_path.exists():
+            raw = json.loads(self._hostmap_path.read_text())
+            return {int(k): int(v) for k, v in raw.items()}
+        return {}
+
+    def _save_hostmap(self) -> None:
+        if self._hostmap_path:
+            tmp = self._hostmap_path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(
+                {str(k): v for k, v in self.hostmap.items()}))
+            tmp.replace(self._hostmap_path)
+
+    def _register(self, req: np.ndarray) -> tuple[int, int]:
+        """REGISTER_REQ record → (status, host_id)."""
+        ver = int(req["wire_version"])
+        if ver < version.MIN_WIRE_VERSION:
+            return wire.REG_ERR_VERSION, 0
+        if int(req["conn_type"]) != wire.CONN_EVENT:
+            return wire.REG_OK, 0xFFFFFFFF    # query conns hold no host slot
+        mid = (int(req["machine_id_hi"]) << 64) | int(req["machine_id_lo"])
+        hid = self.hostmap.get(mid)
+        if hid is None:
+            if len(self.hostmap) >= self.rt.cfg.n_hosts:
+                return wire.REG_ERR_CAPACITY, 0
+            used = set(self.hostmap.values())
+            hid = next(i for i in range(self.rt.cfg.n_hosts)
+                       if i not in used)
+            self.hostmap[mid] = hid
+            self._save_hostmap()
+            self.rt.stats.bump("agents_registered")
+        return wire.REG_OK, hid
+
+    # ------------------------------------------------------------- serving
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        sock = self._server.sockets[0].getsockname()
+        self.host, self.port = sock[0], sock[1]
+        if self.tick_interval:
+            self._tick_task = asyncio.create_task(self._tick_loop())
+        log.info("gyt server on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+            self._tick_task = None
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_interval)
+            try:
+                self.rt.run_tick()
+            except Exception:                     # pragma: no cover
+                log.exception("tick failed")
+
+    async def _read_frame(self, reader) -> tuple[int, bytes]:
+        """→ (data_type, payload_bytes). Raises IncompleteReadError at EOF."""
+        hdr_b = await reader.readexactly(_HSZ)
+        hdr = np.frombuffer(hdr_b, wire.HEADER_DT, count=1)[0]
+        if hdr["magic"] not in (wire.MAGIC_PM, wire.MAGIC_MS,
+                                wire.MAGIC_NQ):
+            raise wire.FrameError(f"bad magic {int(hdr['magic']):#x}")
+        total = int(hdr["total_sz"])
+        if total < _HSZ or total >= wire.MAX_COMM_DATA_SZ:
+            raise wire.FrameError(f"bad total_sz {total}")
+        body = await reader.readexactly(total - _HSZ)
+        pad = int(hdr["padding_sz"])
+        return int(hdr["data_type"]), body[: len(body) - pad]
+
+    async def _handle_conn(self, reader, writer) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            # every conn opens with one REGISTER_REQ declaring its role
+            try:
+                dtype, payload = await self._read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if dtype != wire.COMM_REGISTER_REQ:
+                self.rt.stats.bump("conns_unregistered")
+                return
+            req = np.frombuffer(payload, wire.REGISTER_REQ_DT, count=1)[0]
+            status, host_id = self._register(req)
+            writer.write(wire.encode_register_resp(
+                status, host_id, version.CURR_WIRE_VERSION))
+            await writer.drain()
+            if status != wire.REG_OK:
+                return
+            if int(req["conn_type"]) == wire.CONN_EVENT:
+                await self._event_loop(reader)
+            else:
+                await self._query_loop(reader, writer)
+        except wire.FrameError as e:
+            log.warning("conn %s: %s — closing", peer, e)
+            self.rt.stats.bump("conns_framing_errors")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):   # pragma: no cover
+                pass
+
+    async def _event_loop(self, reader) -> None:
+        """Bulk ingest: socket bytes → Runtime.feed (framing inside)."""
+        while True:
+            data = await reader.read(_READ_SZ)
+            if not data:
+                return
+            try:
+                self.rt.feed(data)
+            except wire.FrameError:
+                # poison frame: feed dropped its resume buffer; close the
+                # conn — the agent reconnects and resyncs (the reference
+                # closes on malformed COMM_HEADER too)
+                raise
+
+    async def _query_loop(self, reader, writer) -> None:
+        outstanding = 0
+        while True:
+            try:
+                dtype, payload = await self._read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if dtype != wire.COMM_QUERY_CMD:
+                self.rt.stats.bump("frames_unknown_type")
+                continue
+            seqid, _, req = wire.decode_query_payload(payload)
+            if outstanding >= wire.MAX_OUTSTANDING_QUERIES:
+                writer.write(wire.encode_query(
+                    seqid, {"error": "busy"}, wire.QS_BUSY, resp=True))
+                await writer.drain()
+                continue
+            outstanding += 1
+            try:
+                self.rt.stats.bump("net_queries")
+                out = self.rt.query(req)
+                resp = wire.encode_query(seqid, out, wire.QS_OK, resp=True)
+            except Exception as e:
+                resp = wire.encode_query(seqid, {"error": str(e)},
+                                         wire.QS_ERROR, resp=True)
+            finally:
+                outstanding -= 1
+            writer.write(resp)
+            await writer.drain()

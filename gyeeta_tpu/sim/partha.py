@@ -26,16 +26,22 @@ from gyeeta_tpu.ingest import wire
 class ParthaSim:
     def __init__(self, n_hosts: int = 64, n_svcs: int = 16,
                  n_clients: int = 4096, seed: int = 42,
-                 zipf_a: float = 1.3, n_groups: int = 8):
+                 zipf_a: float = 1.3, n_groups: int = 8,
+                 host_base: int = 0):
         self.n_hosts = n_hosts
         self.n_svcs = n_svcs
         self.n_clients = n_clients
         self.n_groups = n_groups     # process groups per host
+        self.host_base = host_base   # global id of local host 0 (net agents
+        #                              construct a 1-host sim at their
+        #                              server-assigned host_id)
         self.rng = np.random.default_rng(seed)
         self.zipf_a = zipf_a
         # stable 64-bit glob_ids per (host, svc): mixed so ids look like the
-        # reference's hashed listener ids, not small integers
-        hs = np.arange(n_hosts, dtype=np.uint64)[:, None]
+        # reference's hashed listener ids, not small integers; derived from
+        # the GLOBAL host id so sims on different agents never collide
+        hs = np.arange(host_base, host_base + n_hosts,
+                       dtype=np.uint64)[:, None]
         sv = np.arange(n_svcs, dtype=np.uint64)[None, :]
         raw = (hs << np.uint64(32)) | (sv + np.uint64(1))
         self.glob_ids = _splitmix64(raw)                    # (H, S)
@@ -47,7 +53,8 @@ class ParthaSim:
             0x0A000000, 0x0AFFFFFF, size=(n_clients,), dtype=np.uint32)
         self.tusec = np.uint64(1_700_000_000_000_000)
         # stable process-group ids per (host, group) + interned comm ids
-        hs = np.arange(n_hosts, dtype=np.uint64)[:, None]
+        hs = np.arange(host_base, host_base + n_hosts,
+                       dtype=np.uint64)[:, None]
         gr = np.arange(n_groups, dtype=np.uint64)[None, :]
         self.task_ids = _splitmix64(
             (hs << np.uint64(24)) | gr | np.uint64(0x7A5C << 48))
@@ -67,7 +74,7 @@ class ParthaSim:
         out = np.zeros(n, wire.RESP_SAMPLE_DT)
         out["glob_id"] = self.glob_ids[host, svc]
         out["resp_usec"] = np.minimum(lat, 4e9).astype(np.uint32)
-        out["host_id"] = host.astype(np.uint32)
+        out["host_id"] = (host + self.host_base).astype(np.uint32)
         return out
 
     def conn_records(self, n: int) -> np.ndarray:
@@ -82,7 +89,8 @@ class ParthaSim:
         sport = (20000 + (rank % 20000)).astype(np.uint16)
         out = np.zeros(n, wire.TCP_CONN_DT)
         _put_ipv4(out["cli"], cli_ip, sport)
-        ser_ip = (0xC0A80000 | (host.astype(np.uint32) & 0xFFFF))
+        ser_ip = (0xC0A80000
+                  | ((host.astype(np.uint32) + self.host_base) & 0xFFFF))
         _put_ipv4(out["ser"], ser_ip.astype(np.uint32),
                   (8000 + svc).astype(np.uint16))
         dur = (r.lognormal(1.0, 1.0, n) * 50_000).astype(np.uint64)
@@ -97,7 +105,7 @@ class ParthaSim:
         out["bytes_rcvd"] = np.minimum(nbytes * 9.0, 2**40).astype(np.uint64)
         out["cli_pid"] = cli.astype(np.int32) + 1000
         out["ser_pid"] = svc.astype(np.int32) + 300
-        out["host_id"] = host.astype(np.uint32)
+        out["host_id"] = (host + self.host_base).astype(np.uint32)
         out["flags"] = 1  # connect-observed
         self.tusec += np.uint64(5_000_000)
         return out
@@ -123,7 +131,7 @@ class ParthaSim:
         out["curr_kbytes_outbound"] = r.poisson(4000, n)
         out["ser_errors"] = (r.random(n) < 0.02) * r.poisson(3, n)
         out["tasks_delay_usec"] = r.poisson(100, n)
-        out["host_id"] = host
+        out["host_id"] = host + self.host_base
         return out
 
     def aggr_task_records(self) -> np.ndarray:
@@ -166,7 +174,7 @@ class ParthaSim:
             cpu_delay > 500, S.TISSUE_CPU_DELAY,
             np.where(io_delay > 300, S.TISSUE_BLKIO_DELAY,
                      S.TISSUE_NONE)).astype(np.uint8)
-        out["host_id"] = host
+        out["host_id"] = host + self.host_base
         return out
 
     def name_records(self) -> np.ndarray:
@@ -194,7 +202,7 @@ class ParthaSim:
         out["nlisten_issue"] = (r.random(n) < 0.1) * r.integers(1, 3, n)
         out["cpu_issue"] = r.random(n) < 0.05
         out["mem_issue"] = r.random(n) < 0.03
-        out["host_id"] = np.arange(n, dtype=np.uint32)
+        out["host_id"] = np.arange(n, dtype=np.uint32) + self.host_base
         return out
 
     # --------------------------------------------------------------- wire
