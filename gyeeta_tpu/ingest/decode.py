@@ -50,6 +50,8 @@ class ConnBatch(NamedTuple):
     cli_lo: np.ndarray
     cli_task_hi: np.ndarray   # client process-group id
     cli_task_lo: np.ndarray
+    cli_rel_hi: np.ndarray    # client related-listener id (0 = client is
+    cli_rel_lo: np.ndarray    #   not itself a service) — dep-graph identity
     bytes_sent: np.ndarray    # float32
     bytes_rcvd: np.ndarray    # float32
     duration_us: np.ndarray   # float32 (0 if still open)
@@ -192,6 +194,7 @@ def conn_batch(recs: np.ndarray, size: int = wire.MAX_CONNS_PER_BATCH
     c_hi = H.fmix32(cip_hi ^ np.uint32(0xC11E57))
     c_lo = H.fmix32(cip_lo ^ c_hi)
     t_hi, t_lo = split_u64(r["cli_task_aggr_id"])
+    rel_hi, rel_lo = split_u64(r["cli_related_listen_id"])
     closed = r["tusec_close"] > 0
     dur = np.where(closed, r["tusec_close"] - r["tusec_start"],
                    0).astype(np.float32)
@@ -202,6 +205,7 @@ def conn_batch(recs: np.ndarray, size: int = wire.MAX_CONNS_PER_BATCH
         flow_hi=_pad(f_hi, size), flow_lo=_pad(f_lo, size),
         cli_hi=_pad(c_hi, size), cli_lo=_pad(c_lo, size),
         cli_task_hi=_pad(t_hi, size), cli_task_lo=_pad(t_lo, size),
+        cli_rel_hi=_pad(rel_hi, size), cli_rel_lo=_pad(rel_lo, size),
         bytes_sent=_pad(r["bytes_sent"].astype(np.float32), size),
         bytes_rcvd=_pad(r["bytes_rcvd"].astype(np.float32), size),
         duration_us=_pad(dur, size),

@@ -14,7 +14,7 @@ madhavas per shyama) connected by TCP RPCs. Here the same roles map onto a
 """
 
 from gyeeta_tpu.parallel.mesh import HOST_AXIS, make_mesh, shard_of_host
-from gyeeta_tpu.parallel import sharded, rollup, pairing
+from gyeeta_tpu.parallel import sharded, rollup, pairing, depgraph
 
 __all__ = ["HOST_AXIS", "make_mesh", "shard_of_host", "sharded", "rollup",
-           "pairing"]
+           "pairing", "depgraph"]

@@ -1,0 +1,448 @@
+"""Service dependency graph: paired flows → svc→svc edge slab → clusters.
+
+This is the product feature the pairing collective exists for. The
+reference builds it in three stages: madhava records per-listener
+``DEPENDS_LISTENER`` maps from locally-resolved conns
+(``common/gy_socket_stat.h:721``), shyama pairs the cross-madhava halves in
+``glob_tcp_conn_tbl_`` and notifies both sides
+(``server/gy_shconnhdlr.cc:3790-3854``), and a periodic job coalesces
+listeners that talk to each other into service-mesh clusters
+(``coalesce_svc_mesh_clusters``, ``server/gy_shconnhdlr.cc:5198``).
+
+TPU-native redesign — three fixed-shape device structures per shard:
+
+- **half table**: flow-key-addressed slab holding unpaired conn halves
+  *with payloads* (client entity id, server glob id, bytes). Halves arrive
+  pre-routed to the flow-owner shard by the ``lax.all_to_all`` capacity
+  dispatch (``pairing._dispatch``); a row whose both halves have landed is
+  *drained the same step*: its edge is folded and the row tombstoned, so
+  the table holds only in-flight halves (the reference's unresolved-conn
+  cap, ``server/gy_mconnhdlr.h:94``, becomes the slab capacity + TTL).
+- **edge slab**: (cli_entity, ser_listener)-keyed table accumulating
+  nconn/bytes per dependency edge. The client entity is the caller's
+  related-listener id when it has one (svc→svc edge — the mesh), else its
+  process-group id (task→svc edge). Conn records that already carry both
+  sides (local / same-agent flows, the non-shyama path of the reference)
+  fold straight into the edge slab and skip pairing.
+- **cluster labels**: vectorized min-label propagation over the svc→svc
+  edges — the coalesce pass as a fixed-iteration jitted loop instead of
+  shyama's pointer-chasing set merge. Runs on the merged (rolled-up) edge
+  set, so every shard computes the same clusters ("every shard is shyama").
+
+Shard-merge of edge slabs is an ``all_gather`` + re-upsert (edges for one
+(cli,ser) key may accumulate on several shards; counts are additive).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gyeeta_tpu.engine import table
+from gyeeta_tpu.parallel.mesh import HOST_AXIS
+from gyeeta_tpu.parallel.pairing import owner_shard
+from gyeeta_tpu.utils import hashing as H
+
+_EDGE_SALT = 0x5E1FD0
+
+
+class DepGraph(NamedTuple):
+    # ---- unpaired halves, keyed by flow key (per-shard slice) ----
+    half_tbl: table.Table
+    h_cli_hi: jnp.ndarray    # (P,) client entity id (payload of cli half)
+    h_cli_lo: jnp.ndarray
+    h_cli_svc: jnp.ndarray   # (P,) bool — client entity is a listener
+    h_ser_hi: jnp.ndarray    # (P,) server glob id (payload of ser half)
+    h_ser_lo: jnp.ndarray
+    h_bytes: jnp.ndarray     # (P,) f32 — flow bytes (max of the two halves)
+    h_cli_seen: jnp.ndarray  # (P,) bool
+    h_ser_seen: jnp.ndarray  # (P,) bool
+    h_last_tick: jnp.ndarray  # (P,) i32 — for TTL eviction
+    # ---- dependency edges, keyed by mix(cli, ser) ----
+    edge_tbl: table.Table
+    e_cli_hi: jnp.ndarray    # (E,) endpoint ids (actual, not the hash key)
+    e_cli_lo: jnp.ndarray
+    e_cli_svc: jnp.ndarray   # (E,) bool — svc→svc edge (mesh member)
+    e_ser_hi: jnp.ndarray
+    e_ser_lo: jnp.ndarray
+    e_nconn: jnp.ndarray     # (E,) f32 — flows folded into this edge
+    e_bytes: jnp.ndarray     # (E,) f32
+    e_last_tick: jnp.ndarray  # (E,) i32
+    # ---- counters ----
+    n_paired: jnp.ndarray    # () f32 — halves joined into an edge
+    n_expired: jnp.ndarray   # () f32 — halves evicted unpaired (TTL)
+    n_dropped: jnp.ndarray   # () f32 — dispatch/table overflow drops
+
+
+def init(pair_capacity: int = 4096, edge_capacity: int = 2048) -> DepGraph:
+    Pc, E = pair_capacity, edge_capacity
+    z32 = lambda n: jnp.zeros((n,), jnp.uint32)        # noqa: E731
+    return DepGraph(
+        half_tbl=table.init(Pc),
+        h_cli_hi=z32(Pc), h_cli_lo=z32(Pc),
+        h_cli_svc=jnp.zeros((Pc,), bool),
+        h_ser_hi=z32(Pc), h_ser_lo=z32(Pc),
+        h_bytes=jnp.zeros((Pc,), jnp.float32),
+        h_cli_seen=jnp.zeros((Pc,), bool),
+        h_ser_seen=jnp.zeros((Pc,), bool),
+        h_last_tick=jnp.full((Pc,), -1, jnp.int32),
+        edge_tbl=table.init(E),
+        e_cli_hi=z32(E), e_cli_lo=z32(E),
+        e_cli_svc=jnp.zeros((E,), bool),
+        e_ser_hi=z32(E), e_ser_lo=z32(E),
+        e_nconn=jnp.zeros((E,), jnp.float32),
+        e_bytes=jnp.zeros((E,), jnp.float32),
+        e_last_tick=jnp.full((E,), -1, jnp.int32),
+        n_paired=jnp.zeros((), jnp.float32),
+        n_expired=jnp.zeros((), jnp.float32),
+        n_dropped=jnp.zeros((), jnp.float32),
+    )
+
+
+# ------------------------------------------------------------------ edges
+def edge_key(cli_hi, cli_lo, ser_hi, ser_lo):
+    """(cli, ser) → 64-bit edge table key as (hi, lo) u32 pair."""
+    khi = H.mix64(cli_hi, cli_lo, _EDGE_SALT) ^ ser_hi
+    klo = H.mix64(ser_hi, ser_lo, _EDGE_SALT) ^ cli_lo
+    return khi, klo
+
+
+def fold_edges(dep: DepGraph, cli_hi, cli_lo, cli_svc, ser_hi, ser_lo,
+               byts, valid, tick) -> DepGraph:
+    """Accumulate (cli→ser) flows into the edge slab (batched upsert)."""
+    khi, klo = edge_key(cli_hi, cli_lo, ser_hi, ser_lo)
+    tbl, rows = table.upsert(dep.edge_tbl, khi, klo, valid=valid)
+    ok = valid & (rows >= 0)
+    E = dep.e_nconn.shape[0]
+    lanes = jnp.where(ok, rows, E)
+    set_ = lambda col, v: col.at[lanes].set(v, mode="drop")  # noqa: E731
+    return dep._replace(
+        edge_tbl=tbl,
+        e_cli_hi=set_(dep.e_cli_hi, cli_hi.astype(jnp.uint32)),
+        e_cli_lo=set_(dep.e_cli_lo, cli_lo.astype(jnp.uint32)),
+        e_cli_svc=set_(dep.e_cli_svc, cli_svc),
+        e_ser_hi=set_(dep.e_ser_hi, ser_hi.astype(jnp.uint32)),
+        e_ser_lo=set_(dep.e_ser_lo, ser_lo.astype(jnp.uint32)),
+        e_nconn=dep.e_nconn.at[lanes].add(
+            jnp.where(ok, 1.0, 0.0), mode="drop"),
+        e_bytes=dep.e_bytes.at[lanes].add(
+            jnp.where(ok, byts, 0.0), mode="drop"),
+        e_last_tick=set_(dep.e_last_tick, jnp.int32(tick)),
+        n_dropped=dep.n_dropped
+        + jnp.sum(valid & (rows < 0)).astype(jnp.float32),
+    )
+
+
+# ------------------------------------------------------------------ halves
+class Halves(NamedTuple):
+    """Dispatch lanes for cross-shard pairing (all shape (B,))."""
+    flow_hi: jnp.ndarray
+    flow_lo: jnp.ndarray
+    is_cli: jnp.ndarray     # bool — this lane is the client-side half
+    pay_hi: jnp.ndarray     # payload: cli entity id / ser glob id
+    pay_lo: jnp.ndarray
+    pay_svc: jnp.ndarray    # bool — (cli halves) entity is a listener
+    byts: jnp.ndarray       # f32
+    valid: jnp.ndarray
+
+
+def halves_from_conn(cb):
+    """Split a ConnBatch into direct-edge lanes and pairing halves.
+
+    A conn record may know both sides (local flow / single-agent sim —
+    the reference resolves those without shyama), only its client side
+    (connect-observed, remote server), or only its server side
+    (accept-observed, remote client). Returns
+    ``(direct_lanes, halves)`` where direct_lanes is the tuple for
+    ``fold_edges`` and halves is a :class:`Halves` for pairing.
+    """
+    cli_id_hi = jnp.where(cb.cli_rel_hi | cb.cli_rel_lo,
+                          cb.cli_rel_hi, cb.cli_task_hi)
+    cli_id_lo = jnp.where(cb.cli_rel_hi | cb.cli_rel_lo,
+                          cb.cli_rel_lo, cb.cli_task_lo)
+    cli_svc = (cb.cli_rel_hi | cb.cli_rel_lo) != 0
+    know_cli = (cli_id_hi | cli_id_lo) != 0
+    know_ser = (cb.svc_hi | cb.svc_lo) != 0
+    byts = cb.bytes_sent + cb.bytes_rcvd
+    direct = (cli_id_hi, cli_id_lo, cli_svc, cb.svc_hi, cb.svc_lo,
+              byts, cb.valid & know_cli & know_ser)
+    one_sided = cb.valid & (know_cli ^ know_ser)
+    is_cli = know_cli
+    halves = Halves(
+        flow_hi=cb.flow_hi, flow_lo=cb.flow_lo, is_cli=is_cli,
+        pay_hi=jnp.where(is_cli, cli_id_hi, cb.svc_hi),
+        pay_lo=jnp.where(is_cli, cli_id_lo, cb.svc_lo),
+        pay_svc=cli_svc & is_cli,
+        byts=byts, valid=one_sided)
+    return direct, halves
+
+
+def pair_halves(dep: DepGraph, hv: Halves, tick) -> DepGraph:
+    """Land halves in the half table; drain rows that just completed."""
+    tbl, rows = table.upsert(dep.half_tbl, hv.flow_hi, hv.flow_lo,
+                             valid=hv.valid)
+    ok = hv.valid & (rows >= 0)
+    Pc = dep.h_bytes.shape[0]
+    cl = jnp.where(ok & hv.is_cli, rows, Pc)     # client-half lanes
+    sl = jnp.where(ok & ~hv.is_cli, rows, Pc)    # server-half lanes
+    cli_hi = dep.h_cli_hi.at[cl].set(hv.pay_hi.astype(jnp.uint32),
+                                     mode="drop")
+    cli_lo = dep.h_cli_lo.at[cl].set(hv.pay_lo.astype(jnp.uint32),
+                                     mode="drop")
+    cli_svc = dep.h_cli_svc.at[cl].set(hv.pay_svc, mode="drop")
+    ser_hi = dep.h_ser_hi.at[sl].set(hv.pay_hi.astype(jnp.uint32),
+                                     mode="drop")
+    ser_lo = dep.h_ser_lo.at[sl].set(hv.pay_lo.astype(jnp.uint32),
+                                     mode="drop")
+    lanes = jnp.where(ok, rows, Pc)
+    h_bytes = dep.h_bytes.at[lanes].max(jnp.where(ok, hv.byts, 0.0),
+                                        mode="drop")
+    cli_seen = dep.h_cli_seen.at[cl].set(True, mode="drop")
+    ser_seen = dep.h_ser_seen.at[sl].set(True, mode="drop")
+    last = dep.h_last_tick.at[lanes].set(jnp.int32(tick), mode="drop")
+
+    done = cli_seen & ser_seen            # rows now holding both halves
+    dep = dep._replace(
+        half_tbl=tbl, h_cli_hi=cli_hi, h_cli_lo=cli_lo, h_cli_svc=cli_svc,
+        h_ser_hi=ser_hi, h_ser_lo=ser_lo, h_bytes=h_bytes,
+        h_cli_seen=cli_seen, h_ser_seen=ser_seen, h_last_tick=last,
+        n_paired=dep.n_paired + jnp.sum(done).astype(jnp.float32),
+        n_dropped=dep.n_dropped
+        + jnp.sum(hv.valid & (rows < 0)).astype(jnp.float32),
+    )
+    # fold the completed rows' edges, then tombstone + clear them (drain —
+    # the table only ever holds in-flight halves)
+    dep = fold_edges(dep, dep.h_cli_hi, dep.h_cli_lo, dep.h_cli_svc,
+                     dep.h_ser_hi, dep.h_ser_lo, dep.h_bytes, done, tick)
+    return _clear_half_rows(dep, done)
+
+
+def _clear_half_rows(dep: DepGraph, kill) -> DepGraph:
+    tbl, killed = table.tombstone_rows(dep.half_tbl, kill)
+    z = jnp.uint32(0)
+    return dep._replace(
+        half_tbl=tbl,
+        h_cli_hi=jnp.where(killed, z, dep.h_cli_hi),
+        h_cli_lo=jnp.where(killed, z, dep.h_cli_lo),
+        h_cli_svc=jnp.where(killed, False, dep.h_cli_svc),
+        h_ser_hi=jnp.where(killed, z, dep.h_ser_hi),
+        h_ser_lo=jnp.where(killed, z, dep.h_ser_lo),
+        h_bytes=jnp.where(killed, 0.0, dep.h_bytes),
+        h_cli_seen=jnp.where(killed, False, dep.h_cli_seen),
+        h_ser_seen=jnp.where(killed, False, dep.h_ser_seen),
+        h_last_tick=jnp.where(killed, -1, dep.h_last_tick),
+    )
+
+
+def age(dep: DepGraph, tick, pair_ttl_ticks: int,
+        edge_ttl_ticks: int) -> DepGraph:
+    """TTL eviction: unpaired halves expire fast (the reference diag-dumps
+    and drops unresolved conns); edges linger for the query horizon."""
+    seen = dep.h_last_tick >= 0
+    stale_h = seen & (jnp.int32(tick) - dep.h_last_tick
+                      > jnp.int32(pair_ttl_ticks))
+    dep = dep._replace(
+        n_expired=dep.n_expired + jnp.sum(stale_h).astype(jnp.float32))
+    dep = _clear_half_rows(dep, stale_h)
+    e_seen = dep.e_last_tick >= 0
+    stale_e = e_seen & (jnp.int32(tick) - dep.e_last_tick
+                        > jnp.int32(edge_ttl_ticks))
+    etbl, ekilled = table.tombstone_rows(dep.edge_tbl, stale_e)
+    z = jnp.uint32(0)
+    return dep._replace(
+        edge_tbl=etbl,
+        e_cli_hi=jnp.where(ekilled, z, dep.e_cli_hi),
+        e_cli_lo=jnp.where(ekilled, z, dep.e_cli_lo),
+        e_cli_svc=jnp.where(ekilled, False, dep.e_cli_svc),
+        e_ser_hi=jnp.where(ekilled, z, dep.e_ser_hi),
+        e_ser_lo=jnp.where(ekilled, z, dep.e_ser_lo),
+        e_nconn=jnp.where(ekilled, 0.0, dep.e_nconn),
+        e_bytes=jnp.where(ekilled, 0.0, dep.e_bytes),
+        e_last_tick=jnp.where(ekilled, -1, dep.e_last_tick),
+    )
+
+
+# ------------------------------------------------------- single-shard step
+def dep_step(dep: DepGraph, cb, tick) -> DepGraph:
+    """One conn batch → edges (single shard: no dispatch, halves pair
+    locally — the n_shards=1 degenerate of the sharded step)."""
+    direct, hv = halves_from_conn(cb)
+    dep = fold_edges(dep, *direct, tick)
+    return pair_halves(dep, hv, tick)
+
+
+def dep_fold_many(dep: DepGraph, cbs, tick) -> DepGraph:
+    """K stacked conn batches in one traced scan (hot-path shape)."""
+
+    def body(carry, cb):
+        return dep_step(carry, cb, tick), None
+
+    out, _ = lax.scan(body, dep, cbs)
+    return out
+
+
+# ------------------------------------------------------------ sharded step
+def dep_step_fn(mesh, cap_per_dest: int):
+    """Compiled sharded step: (dep_stacked, conn_stacked, tick) → dep.
+
+    Direct (both-sides-known) lanes fold into the local shard's edge slab.
+    One-sided halves ride the capacity-disciplined ``all_to_all`` to the
+    flow-owner shard (payload columns travel with the key) and pair there.
+    """
+    n = mesh.devices.size
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS), P(HOST_AXIS),
+                                                 P()),
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _step(dep, cb, tick):
+        local = jax.tree.map(lambda x: x[0], dep)
+        cb = jax.tree.map(lambda x: x[0], cb)
+        direct, hv = halves_from_conn(cb)
+        local = fold_edges(local, *direct, tick)
+        routed, o_drop = _dispatch_halves(hv, n, cap_per_dest)
+        local = local._replace(n_dropped=local.n_dropped + o_drop)
+        local = pair_halves(local, routed, tick)
+        return jax.tree.map(lambda x: x[None], local)
+
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+def _dispatch_halves(hv: Halves, n: int, cap: int):
+    """all_to_all capacity dispatch of Halves → received Halves."""
+    B = hv.flow_hi.shape[0]
+    dest = owner_shard(hv.flow_hi, hv.flow_lo, n).astype(jnp.int32)
+    dest = jnp.where(hv.valid, dest, n)
+    order = jnp.argsort(dest)
+    d_s = dest[order]
+    counts = jnp.bincount(d_s, length=n + 1)
+    offsets = jnp.cumsum(counts) - counts
+    pos = jnp.arange(B, dtype=jnp.int32) - offsets[d_s]
+    keep = (d_s < n) & (pos < cap)
+    slot = jnp.where(keep, d_s * cap + pos, n * cap)
+
+    def scatter(x, fill):
+        buf = jnp.full((n * cap,) + x.shape[1:], fill, x.dtype)
+        return buf.at[slot].set(x[order], mode="drop")
+
+    routed = Halves(
+        flow_hi=scatter(hv.flow_hi.astype(jnp.uint32), 0),
+        flow_lo=scatter(hv.flow_lo.astype(jnp.uint32), 0),
+        is_cli=scatter(hv.is_cli, False),
+        pay_hi=scatter(hv.pay_hi.astype(jnp.uint32), 0),
+        pay_lo=scatter(hv.pay_lo.astype(jnp.uint32), 0),
+        pay_svc=scatter(hv.pay_svc, False),
+        byts=scatter(hv.byts, 0.0),
+        valid=jnp.zeros((n * cap,), bool).at[slot].set(keep, mode="drop"),
+    )
+
+    def a2a(x):
+        return lax.all_to_all(x.reshape((n, cap) + x.shape[1:]), HOST_AXIS,
+                              split_axis=0, concat_axis=0).reshape(
+                                  (n * cap,) + x.shape[1:])
+
+    dropped = (jnp.sum(hv.valid) - jnp.sum(keep)).astype(jnp.float32)
+    return jax.tree.map(a2a, routed), dropped
+
+
+# ------------------------------------------------------------ edge rollup
+class EdgeSet(NamedTuple):
+    """A dense merged edge view (replicated after rollup)."""
+    tbl: table.Table
+    cli_hi: jnp.ndarray
+    cli_lo: jnp.ndarray
+    cli_svc: jnp.ndarray
+    ser_hi: jnp.ndarray
+    ser_lo: jnp.ndarray
+    nconn: jnp.ndarray
+    byts: jnp.ndarray
+
+
+def _edge_merge(cap: int, cli_hi, cli_lo, cli_svc, ser_hi, ser_lo,
+                nconn, byts, valid) -> EdgeSet:
+    """Merge flat edge lanes (counts additive) into a fresh dense slab."""
+    khi, klo = edge_key(cli_hi, cli_lo, ser_hi, ser_lo)
+    tbl, rows = table.upsert(table.init(cap), khi, klo, valid=valid)
+    ok = valid & (rows >= 0)
+    lanes = jnp.where(ok, rows, cap)
+    set_ = lambda z, v: z.at[lanes].set(v, mode="drop")      # noqa: E731
+    zero32 = jnp.zeros((cap,), jnp.uint32)
+    return EdgeSet(
+        tbl=tbl,
+        cli_hi=set_(zero32, cli_hi.astype(jnp.uint32)),
+        cli_lo=set_(zero32, cli_lo.astype(jnp.uint32)),
+        cli_svc=set_(jnp.zeros((cap,), bool), cli_svc),
+        ser_hi=set_(zero32, ser_hi.astype(jnp.uint32)),
+        ser_lo=set_(zero32, ser_lo.astype(jnp.uint32)),
+        nconn=jnp.zeros((cap,), jnp.float32).at[lanes].add(
+            jnp.where(ok, nconn, 0.0), mode="drop"),
+        byts=jnp.zeros((cap,), jnp.float32).at[lanes].add(
+            jnp.where(ok, byts, 0.0), mode="drop"),
+    )
+
+
+def edges_local(dep: DepGraph) -> EdgeSet:
+    """Single-shard edge view (no collective) as an EdgeSet."""
+    live = table.live_mask(dep.edge_tbl)
+    cap = dep.e_nconn.shape[0]
+    return _edge_merge(cap, dep.e_cli_hi, dep.e_cli_lo, dep.e_cli_svc,
+                       dep.e_ser_hi, dep.e_ser_lo, dep.e_nconn,
+                       dep.e_bytes, live)
+
+
+def edge_rollup_fn(mesh, out_capacity: int):
+    """Compiled sharded DepGraph → replicated merged EdgeSet."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS), out_specs=P(),
+             check_vma=False)
+    def _roll(dep):
+        local = jax.tree.map(lambda x: x[0], dep)
+        live = table.live_mask(local.edge_tbl)
+        g = lambda x: lax.all_gather(x, HOST_AXIS, tiled=True)  # noqa: E731
+        return _edge_merge(
+            out_capacity, g(local.e_cli_hi), g(local.e_cli_lo),
+            g(local.e_cli_svc), g(local.e_ser_hi), g(local.e_ser_lo),
+            g(local.e_nconn), g(local.e_bytes), g(live))
+
+    return jax.jit(_roll)
+
+
+# --------------------------------------------------------- mesh clustering
+def mesh_clusters(es: EdgeSet, node_capacity: int, n_iters: int = 16):
+    """Svc-mesh coalescing: connected components of the svc→svc edges.
+
+    Returns ``(node_tbl, labels, sizes)``: a node table keyed by listener
+    id, a per-row cluster label (the min node row reachable — stable,
+    deterministic), and per-row member count of the row's cluster.
+    Vectorized min-label propagation, ``n_iters`` fixed sweeps ≥ graph
+    diameter (monitoring meshes are shallow; 16 covers 64k-node chains of
+    fanout ≥2). The coalesce analogue of ``server/gy_shconnhdlr.cc:5198``.
+    """
+    use = table.live_mask(es.tbl) & es.cli_svc
+    ntbl = table.init(node_capacity)
+    ntbl, cli_rows = table.upsert(ntbl, es.cli_hi, es.cli_lo, valid=use)
+    ntbl, ser_rows = table.upsert(ntbl, es.ser_hi, es.ser_lo, valid=use)
+    ok = use & (cli_rows >= 0) & (ser_rows >= 0)
+    cr = jnp.where(ok, cli_rows, node_capacity)
+    sr = jnp.where(ok, ser_rows, node_capacity)
+    labels = jnp.arange(node_capacity, dtype=jnp.int32)
+
+    def body(labels, _):
+        m = jnp.minimum(labels[jnp.where(ok, cli_rows, 0)],
+                        labels[jnp.where(ok, ser_rows, 0)])
+        m = jnp.where(ok, m, jnp.int32(node_capacity))
+        labels = labels.at[cr].min(m, mode="drop")
+        labels = labels.at[sr].min(m, mode="drop")
+        return labels, None
+
+    labels, _ = lax.scan(body, labels, None, length=n_iters)
+    live = table.live_mask(ntbl)
+    labels = jnp.where(live, labels, -1)
+    counts = jnp.zeros((node_capacity + 1,), jnp.int32).at[
+        jnp.where(live, labels, node_capacity)].add(1, mode="drop")
+    sizes = jnp.where(live, counts[jnp.where(live, labels, 0)], 0)
+    return ntbl, labels, sizes

@@ -208,6 +208,80 @@ def cluster_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     return cols, np.ones(1, bool)
 
 
+def _task_comm_names(st: AggState, names, task_hi, task_lo):
+    """Resolve process-group ids → comm names via the live task slab (the
+    reference resolves DEPENDS entries through MAGGR_TASK)."""
+    from gyeeta_tpu.ingest import wire
+
+    key = (np.asarray(st.task_tbl.key_hi).astype(np.uint64)
+           << np.uint64(32)) | np.asarray(st.task_tbl.key_lo)
+    comm = (np.asarray(st.task_comm_hi).astype(np.uint64)
+            << np.uint64(32)) | np.asarray(st.task_comm_lo)
+    live = np.asarray(
+        (st.task_tbl.key_hi != np.uint32(0xFFFFFFFF))
+        | (st.task_tbl.key_lo != np.uint32(0xFFFFFFFF)))
+    comm_of = dict(zip(key[live].tolist(), comm[live].tolist()))
+    want = ((task_hi.astype(np.uint64) << np.uint64(32))
+            | task_lo.astype(np.uint64))
+    comm_ids = np.array([comm_of.get(int(t), 0) for t in want], np.uint64)
+    if names is None:
+        return _hex_id(task_hi, task_lo)
+    resolved = names.resolve_array(wire.NAME_KIND_COMM, comm_ids)
+    fallback = _hex_id(task_hi, task_lo)
+    return np.where(comm_ids != 0, resolved, fallback)
+
+
+def dep_columns(cfg: EngineCfg, st: AggState, names=None,
+                dep=None) -> dict:
+    """svcdependency subsystem: one row per (caller → service) edge."""
+    from gyeeta_tpu.ingest import wire
+
+    if dep is None:
+        raise ValueError("svcdependency needs a dependency graph "
+                         "(runtime not configured with one)")
+    snap = {k: np.asarray(v)
+            for k, v in readback.dep_edges_snapshot(dep).items()}
+    cli_svc = snap["e_cli_svc"]
+    # caller name: listener name for svc→svc edges, comm (via the task
+    # slab) for task→svc edges
+    svc_names = _names_of(names, wire.NAME_KIND_SVC,
+                          snap["e_cli_hi"], snap["e_cli_lo"])
+    task_names = _task_comm_names(st, names, snap["e_cli_hi"],
+                                  snap["e_cli_lo"])
+    cols = {
+        "cliid": _hex_id(snap["e_cli_hi"], snap["e_cli_lo"]),
+        "cliname": np.where(cli_svc, svc_names, task_names),
+        "clisvc": cli_svc,
+        "serid": _hex_id(snap["e_ser_hi"], snap["e_ser_lo"]),
+        "sername": _names_of(names, wire.NAME_KIND_SVC,
+                             snap["e_ser_hi"], snap["e_ser_lo"]),
+        "nconn": snap["e_nconn"],
+        "bytes": snap["e_bytes"],
+    }
+    return cols, snap["e_live"]
+
+
+def mesh_columns(cfg: EngineCfg, st: AggState, names=None,
+                 dep=None) -> dict:
+    """svcmesh subsystem: one row per service in the dependency mesh,
+    labelled with its coalesced cluster (ref svc mesh clusters,
+    ``server/gy_shconnhdlr.h:1301``)."""
+    from gyeeta_tpu.ingest import wire
+
+    if dep is None:
+        raise ValueError("svcmesh needs a dependency graph")
+    snap = {k: np.asarray(v)
+            for k, v in readback.dep_mesh_snapshot(dep).items()}
+    cols = {
+        "svcid": _hex_id(snap["n_hi"], snap["n_lo"]),
+        "svcname": _names_of(names, wire.NAME_KIND_SVC,
+                             snap["n_hi"], snap["n_lo"]),
+        "clusterid": snap["n_label"],
+        "clustersize": snap["n_size"],
+    }
+    return cols, snap["n_mask"]
+
+
 _COLUMNS_OF = {
     fieldmaps.SUBSYS_SVCSTATE: svc_columns,
     fieldmaps.SUBSYS_HOSTSTATE: host_columns,
@@ -217,6 +291,12 @@ _COLUMNS_OF = {
     fieldmaps.SUBSYS_TOPCPU: task_columns,
     fieldmaps.SUBSYS_TOPRSS: task_columns,
     fieldmaps.SUBSYS_TOPDELAY: task_columns,
+}
+
+# subsystems whose columns come from the dependency graph, not AggState
+_DEP_COLUMNS_OF = {
+    fieldmaps.SUBSYS_SVCDEP: dep_columns,
+    fieldmaps.SUBSYS_SVCMESH: mesh_columns,
 }
 
 # top-N views: preset sort + limit over taskstate columns
@@ -229,15 +309,19 @@ _TOP_PRESETS = {
 
 
 def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
-            names=None) -> dict:
+            names=None, dep=None) -> dict:
     """Run one point-in-time query → {"recs": [...], "nrecs": N}."""
-    if opts.subsys not in _COLUMNS_OF:
+    if opts.subsys not in _COLUMNS_OF and opts.subsys not in _DEP_COLUMNS_OF:
         raise ValueError(f"unknown subsystem {opts.subsys!r}")
     preset = _TOP_PRESETS.get(opts.subsys)
     if preset is not None and opts.sortcol is None:
         opts = opts._replace(sortcol=preset[0],
                              maxrecs=min(opts.maxrecs, preset[1]))
-    cols, base_mask = _COLUMNS_OF[opts.subsys](cfg, st, names=names)
+    if opts.subsys in _DEP_COLUMNS_OF:
+        cols, base_mask = _DEP_COLUMNS_OF[opts.subsys](
+            cfg, st, names=names, dep=dep)
+    else:
+        cols, base_mask = _COLUMNS_OF[opts.subsys](cfg, st, names=names)
     tree = criteria.parse(opts.filter) if opts.filter else None
     mask = base_mask & criteria.evaluate(tree, cols, opts.subsys)
     idx = np.nonzero(mask)[0]
@@ -267,6 +351,7 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
 
 
 def query_json(cfg: EngineCfg, st: AggState, req: dict,
-               names=None) -> dict:
+               names=None, dep=None) -> dict:
     """JSON-envelope entry point (the NM-conn QUERY_CMD analogue)."""
-    return execute(cfg, st, QueryOptions.from_json(req), names=names)
+    return execute(cfg, st, QueryOptions.from_json(req), names=names,
+                   dep=dep)

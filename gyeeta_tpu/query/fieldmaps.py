@@ -27,6 +27,8 @@ SUBSYS_TASKSTATE = "taskstate"      # ref aggrtaskstate
 SUBSYS_TOPCPU = "topcpu"
 SUBSYS_TOPRSS = "toprss"
 SUBSYS_TOPDELAY = "topdelay"
+SUBSYS_SVCDEP = "svcdependency"     # ref DEPENDS_LISTENER / svcprocmap
+SUBSYS_SVCMESH = "svcmesh"          # ref svc mesh clusters (shyama)
 
 
 class FieldDef(NamedTuple):
@@ -165,6 +167,31 @@ TASKSTATE_FIELDS = (
     num("hostid", "hostid", "Owning host id"),
 )
 
+# ---------------------------------------------------------- svcdependency
+# ref DEPENDS_LISTENER (common/gy_socket_stat.h:721) +
+# LISTENER_DEPENDENCY_NOTIFY (gy_comm_proto.h:2333): one row per
+# caller→service edge of the dependency graph
+SVCDEP_FIELDS = (
+    string("cliid", "cliid", "Caller entity id (hex): listener or "
+           "process-group"),
+    string("cliname", "cliname", "Caller name (interned)"),
+    boolean("clisvc", "clisvc", "Caller is itself a service (mesh edge)"),
+    string("serid", "serid", "Callee service glob id (hex)"),
+    string("sername", "sername", "Callee service name"),
+    num("nconn", "nconn", "Flows folded into this edge"),
+    num("bytes", "bytes", "Total bytes over this edge"),
+)
+
+# -------------------------------------------------------------- svcmesh
+# ref coalesce_svc_mesh_clusters (server/gy_shconnhdlr.cc:5198): one row
+# per service in the svc→svc mesh, labelled by coalesced cluster
+SVCMESH_FIELDS = (
+    string("svcid", "svcid", "Service glob id (hex)"),
+    string("svcname", "svcname", "Service name (interned)"),
+    num("clusterid", "clusterid", "Cluster label (min reachable node row)"),
+    num("clustersize", "clustersize", "Services in this cluster"),
+)
+
 # -------------------------------------------------------------- flowstate
 FLOWSTATE_FIELDS = (
     string("flowid", "flowid", "Flow key (hex)"),
@@ -181,6 +208,8 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_TOPCPU: TASKSTATE_FIELDS,
     SUBSYS_TOPRSS: TASKSTATE_FIELDS,
     SUBSYS_TOPDELAY: TASKSTATE_FIELDS,
+    SUBSYS_SVCDEP: SVCDEP_FIELDS,
+    SUBSYS_SVCMESH: SVCMESH_FIELDS,
 }
 
 

@@ -152,6 +152,40 @@ def task_snapshot(cfg: EngineCfg, st: AggState):
     }
 
 
+@jax.jit
+def dep_edges_snapshot(dep):
+    """Dependency-edge columns (svcdependency): one device readback, no
+    clustering work (that is :func:`dep_mesh_snapshot`)."""
+    from gyeeta_tpu.parallel import depgraph as dg
+
+    es = dg.edges_local(dep)
+    return {
+        "e_live": table.live_mask(es.tbl),
+        "e_cli_hi": es.cli_hi, "e_cli_lo": es.cli_lo,
+        "e_cli_svc": es.cli_svc,
+        "e_ser_hi": es.ser_hi, "e_ser_lo": es.ser_lo,
+        "e_nconn": es.nconn, "e_bytes": es.byts,
+    }
+
+
+@partial(jax.jit, static_argnums=(1,))
+def dep_mesh_snapshot(dep, n_iters: int = 16):
+    """Mesh-cluster labels over the svc→svc edges (svcmesh): the
+    ``coalesce_svc_mesh_clusters`` readout
+    (``server/gy_shconnhdlr.cc:5198``). The node table holds up to two
+    distinct endpoints per edge, so it is sized 2× the edge slab."""
+    from gyeeta_tpu.parallel import depgraph as dg
+
+    es = dg.edges_local(dep)
+    node_capacity = 2 * es.nconn.shape[0]
+    ntbl, labels, sizes = dg.mesh_clusters(es, node_capacity, n_iters)
+    return {
+        "n_hi": ntbl.key_hi, "n_lo": ntbl.key_lo,
+        "n_mask": table.live_mask(ntbl),
+        "n_label": labels, "n_size": sizes,
+    }
+
+
 def svc_rows_to_host(cfg: EngineCfg, snap: dict) -> list[dict]:
     """Device snapshot → list of per-service dicts (live rows only).
 

@@ -21,6 +21,7 @@ import numpy as np
 from gyeeta_tpu.alerts import AlertManager
 from gyeeta_tpu.engine import aggstate, compact, step
 from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.parallel import depgraph as dg
 from gyeeta_tpu.history import HistoryStore
 from gyeeta_tpu.ingest import decode, native, wire
 from gyeeta_tpu.query import api
@@ -43,6 +44,7 @@ class Runtime:
         self.history = (HistoryStore(self.opts.history_db)
                         if self.opts.history_db else None)
         self._clock = clock or time.time
+        self._tick_no = 0             # host-side mirror of the window tick
         self._pending = b""           # partial-frame resume buffer
         self._staged = []             # decoded (cb, rb) microbatch pairs
         self._fold = step.jit_fold_step(self.cfg)
@@ -59,6 +61,16 @@ class Runtime:
         self._compact_tasks = jax.jit(
             lambda s: step.compact_tasks(self.cfg, s))
         self._tick = jax.jit(lambda s: step.tick_5s(self.cfg, s))
+        # dependency graph (single-shard slice; the sharded tier keeps its
+        # own stacked DepGraph — see parallel/depgraph.py)
+        self.dep = dg.init(self.opts.dep_pair_capacity,
+                           self.opts.dep_edge_capacity)
+        self._dep_step = jax.jit(dg.dep_step, donate_argnums=(0,))
+        self._dep_many = jax.jit(dg.dep_fold_many, donate_argnums=(0,))
+        self._dep_age = jax.jit(
+            lambda d, t: dg.age(d, t, self.opts.dep_pair_ttl_ticks,
+                                self.opts.dep_edge_ttl_ticks),
+            donate_argnums=(0,))
         self.names = InternTable()
         self._classify = derive.jit_classify_pass(self.cfg)
         self._empty_conn = decode.conn_batch(
@@ -149,6 +161,7 @@ class Runtime:
             rbs = jax.tree.map(lambda *xs: np.stack(xs),
                                *[r for _, r in chunk])
             self.state = self._fold_many(self.state, cbs, rbs)
+            self.dep = self._dep_many(self.dep, cbs, self._tick_no)
             self.stats.bump("slab_dispatches")
 
     def flush(self) -> int:
@@ -157,6 +170,7 @@ class Runtime:
         n = len(self._staged)
         for cb, rb in self._staged:
             self.state = self._fold(self.state, cb, rb)
+            self.dep = self._dep_step(self.dep, cb, self._tick_no)
         self._staged = []
         return n
 
@@ -173,7 +187,9 @@ class Runtime:
         # still readable (tick zeroes it)
         tick = int(np.asarray(self.state.resp_win.tick)) + 1
         report["tick"] = tick
+        self._tick_no = tick
         self.stats.gauge("tick", tick)
+        self.dep = self._dep_age(self.dep, tick)
 
         if self.history and tick % self.opts.history_every_ticks == 0:
             now = self._clock()
@@ -231,7 +247,8 @@ class Runtime:
                 int(req.get("maxrecs", 10000)))}
         self.flush()                  # live queries see all staged events
         self.stats.bump("queries")
-        return api.query_json(self.cfg, self.state, req, names=self.names)
+        return api.query_json(self.cfg, self.state, req, names=self.names,
+                              dep=self.dep)
 
     def restore(self, path) -> dict:
         # drop staged microbatches and partial-frame bytes from before the
@@ -239,4 +256,10 @@ class Runtime:
         self._staged = []
         self._pending = b""
         self.state, extra = ckpt.restore(path, self.cfg, self.state)
+        # the dep graph is not checkpointed: reset it (edges rebuild from
+        # live traffic) and realign the host tick mirror so TTL deltas
+        # never go negative
+        self.dep = dg.init(self.opts.dep_pair_capacity,
+                           self.opts.dep_edge_capacity)
+        self._tick_no = int(extra.get("tick", 0))
         return extra

@@ -110,6 +110,76 @@ class ParthaSim:
         self.tusec += np.uint64(5_000_000)
         return out
 
+    def svc_call_graph(self):
+        """The fleet's deterministic service→service call topology.
+
+        Each service (h, j) calls one downstream service: a fixed
+        pseudo-random permutation-ish map so cross-host edges dominate.
+        Returns (cal_h, cal_j, cee_h, cee_j) flat int arrays of length
+        n_hosts*n_svcs.
+        """
+        h = np.repeat(np.arange(self.n_hosts), self.n_svcs)
+        j = np.tile(np.arange(self.n_svcs), self.n_hosts)
+        cee_h = (h * 31 + j * 7 + 1) % self.n_hosts
+        cee_j = (j + 1) % self.n_svcs
+        return h, j, cee_h, cee_j
+
+    def svc_conn_records(self, n: int, split_halves: bool = False):
+        """n service→service flows drawn from the fleet call graph.
+
+        ``split_halves=False`` emits one record per flow carrying both
+        sides (the locally-resolved case — the reference's non-shyama
+        path). ``split_halves=True`` emits TWO half records per flow with
+        identical 5-tuples: a connect-observed record from the caller's
+        host (``ser_glob_id`` 0 — remote callee unknown) and an
+        accept-observed record from the callee's host (client identity 0),
+        the inputs the pairing tier joins (ref cross-madhava halves,
+        ``server/gy_shconnhdlr.cc:3790``). Returns one record array, or a
+        ``(cli_side, ser_side)`` tuple when ``split_halves``.
+        """
+        r = self.rng
+        cal_h, cal_j, cee_h, cee_j = self.svc_call_graph()
+        pick = r.integers(0, len(cal_h), n)
+        ch, cj = cal_h[pick], cal_j[pick]
+        sh, sj = cee_h[pick], cee_j[pick]
+        cli_ip = (0xC0A80000
+                  | ((ch.astype(np.uint32) + self.host_base) & 0xFFFF))
+        ser_ip = (0xC0A80000
+                  | ((sh.astype(np.uint32) + self.host_base) & 0xFFFF))
+        sport = (30000 + r.integers(0, 20000, n)).astype(np.uint16)
+        dport = (8000 + sj).astype(np.uint16)
+        # one byte draw per FLOW: both halves must report the same totals
+        nbytes = (r.pareto(1.5, n) + 1.0) * 3000.0
+
+        def base(hs) -> np.ndarray:
+            out = np.zeros(n, wire.TCP_CONN_DT)
+            _put_ipv4(out["cli"], cli_ip.astype(np.uint32), sport)
+            _put_ipv4(out["ser"], ser_ip.astype(np.uint32), dport)
+            out["tusec_start"] = self.tusec
+            out["tusec_close"] = self.tusec + np.uint64(100_000)
+            out["bytes_sent"] = np.minimum(nbytes, 2**40).astype(np.uint64)
+            out["bytes_rcvd"] = np.minimum(nbytes * 4, 2**40).astype(
+                np.uint64)
+            out["host_id"] = (hs + self.host_base).astype(np.uint32)
+            return out
+
+        cli_side = base(ch)
+        cli_side["cli_task_aggr_id"] = self.task_ids[
+            ch, cj % self.n_groups]
+        cli_side["cli_related_listen_id"] = self.glob_ids[ch, cj]
+        cli_side["flags"] = 1                    # connect-observed
+        if not split_halves:
+            cli_side["ser_glob_id"] = self.glob_ids[sh, sj]
+            cli_side["ser_related_listen_id"] = cli_side["ser_glob_id"]
+            self.tusec += np.uint64(1_000_000)
+            return cli_side
+        ser_side = base(sh)
+        ser_side["ser_glob_id"] = self.glob_ids[sh, sj]
+        ser_side["ser_related_listen_id"] = ser_side["ser_glob_id"]
+        ser_side["flags"] = 2                    # accept-observed
+        self.tusec += np.uint64(1_000_000)
+        return cli_side, ser_side
+
     def listener_state_records(self) -> np.ndarray:
         """One 5s LISTENER_STATE sweep over every (host, svc)."""
         r = self.rng

@@ -37,6 +37,11 @@ class RuntimeOpts(NamedTuple):
     task_max_age_ticks: int = 36            # evict groups unseen for 3 min
     debug_level: int = 0                    # hot-reloadable
     resp_sample_pct: float = 100.0          # hot-reloadable duty cycle
+    # dependency graph (parallel/depgraph.py): slab sizes + TTLs
+    dep_pair_capacity: int = 8192           # in-flight unpaired halves
+    dep_edge_capacity: int = 4096           # dependency edges tracked
+    dep_pair_ttl_ticks: int = 24            # unpaired halves expire (2 min)
+    dep_edge_ttl_ticks: int = 720           # idle edges expire (1 h)
 
 
 def _coerce(key: str, v: Any):

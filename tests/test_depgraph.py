@@ -1,0 +1,268 @@
+"""Dependency-graph tier: pairing payloads → edges → clusters.
+
+Covers the full shyama-analogue product path (ref
+``server/gy_shconnhdlr.cc:3790-3854`` half pairing,
+``:5198`` coalesce_svc_mesh_clusters): direct edge folds, cross-shard
+half pairing with same-step drain, TTL ageing, the all_gather edge
+rollup, and the vectorized mesh clustering.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine import table
+from gyeeta_tpu.ingest import decode
+from gyeeta_tpu.parallel import depgraph as dg
+from gyeeta_tpu.parallel import make_mesh
+from gyeeta_tpu.parallel.mesh import leading_sharding
+from gyeeta_tpu.sim.partha import ParthaSim
+
+
+def _edges_dict(dep):
+    """Device edge slab → {(cli, ser): (nconn, bytes)} for live rows."""
+    live = np.asarray(table.live_mask(dep.edge_tbl))
+    out = {}
+    for i in np.nonzero(live)[0]:
+        cli = (int(dep.e_cli_hi[i]) << 32) | int(dep.e_cli_lo[i])
+        ser = (int(dep.e_ser_hi[i]) << 32) | int(dep.e_ser_lo[i])
+        out[(cli, ser)] = (float(dep.e_nconn[i]), float(dep.e_bytes[i]))
+    return out
+
+
+def _expected_edges(recs):
+    """Numpy oracle: (cli_entity, ser_glob) → (nconn, bytes)."""
+    acc = collections.defaultdict(lambda: [0.0, 0.0])
+    for r in recs:
+        cli = int(r["cli_related_listen_id"]) or int(r["cli_task_aggr_id"])
+        ser = int(r["ser_glob_id"])
+        if not cli or not ser:
+            continue
+        e = acc[(cli, ser)]
+        e[0] += 1.0
+        e[1] += float(r["bytes_sent"]) + float(r["bytes_rcvd"])
+    return acc
+
+
+def test_direct_edges_match_oracle():
+    sim = ParthaSim(n_hosts=4, n_svcs=4, seed=3)
+    recs = sim.svc_conn_records(256)
+    cb = decode.conn_batch(recs, 256)
+    dep = dg.init(pair_capacity=512, edge_capacity=512)
+    dep = jax.jit(dg.dep_step)(dep, jax.tree.map(jnp.asarray, cb), 1)
+    got = _edges_dict(dep)
+    want = _expected_edges(recs)
+    assert set(got) == set(want)
+    for k, (nc, nb) in want.items():
+        assert got[k][0] == nc
+        assert np.isclose(got[k][1], nb, rtol=1e-5)
+    # all cli entities are services here → every edge is a mesh edge
+    live = np.asarray(table.live_mask(dep.edge_tbl))
+    assert np.asarray(dep.e_cli_svc)[live].all()
+    assert float(dep.n_paired) == 0        # nothing went through pairing
+
+
+def test_half_pairing_drains_and_matches():
+    sim = ParthaSim(n_hosts=4, n_svcs=4, seed=5)
+    cli_side, ser_side = sim.svc_conn_records(128, split_halves=True)
+    dep = dg.init(pair_capacity=512, edge_capacity=512)
+    step = jax.jit(dg.dep_step)
+    # halves arrive in separate batches — join must happen across steps
+    dep = step(dep, jax.tree.map(
+        jnp.asarray, decode.conn_batch(cli_side, 128)), 1)
+    assert not _edges_dict(dep)            # nothing pairable yet
+    n_inflight = int(dep.half_tbl.n_live)
+    assert n_inflight > 0
+    dep = step(dep, jax.tree.map(
+        jnp.asarray, decode.conn_batch(ser_side, 128)), 2)
+    got = _edges_dict(dep)
+    # oracle: the same flows with both sides merged
+    merged = cli_side.copy()
+    merged["ser_glob_id"] = ser_side["ser_glob_id"]
+    want = _expected_edges(merged)
+    assert set(got) == set(want)
+    for k, (nc, _) in want.items():
+        assert got[k][0] == nc
+    # drained: completed rows were tombstoned the same step
+    assert int(dep.half_tbl.n_live) == 0
+    assert float(dep.n_paired) > 0
+
+
+def test_unpaired_halves_expire():
+    sim = ParthaSim(n_hosts=2, n_svcs=2, seed=7)
+    cli_side, _ = sim.svc_conn_records(64, split_halves=True)
+    dep = dg.init(pair_capacity=256, edge_capacity=128)
+    dep = jax.jit(dg.dep_step)(dep, jax.tree.map(
+        jnp.asarray, decode.conn_batch(cli_side, 64)), 10)
+    before = int(dep.half_tbl.n_live)
+    assert before > 0
+    aged = jax.jit(dg.age, static_argnums=(2, 3))(dep, 12, 4, 100)
+    assert int(aged.half_tbl.n_live) == before     # not stale yet
+    aged = jax.jit(dg.age, static_argnums=(2, 3))(dep, 20, 4, 100)
+    assert int(aged.half_tbl.n_live) == 0
+    assert float(aged.n_expired) == before
+
+
+def test_edge_ttl_eviction():
+    sim = ParthaSim(n_hosts=2, n_svcs=2, seed=11)
+    recs = sim.svc_conn_records(64)
+    dep = dg.init(pair_capacity=256, edge_capacity=128)
+    dep = jax.jit(dg.dep_step)(dep, jax.tree.map(
+        jnp.asarray, decode.conn_batch(recs, 64)), 1)
+    assert _edges_dict(dep)
+    aged = jax.jit(dg.age, static_argnums=(2, 3))(dep, 1000, 4, 360)
+    assert not _edges_dict(aged)
+    assert int(aged.edge_tbl.n_live) == 0
+
+
+def test_sharded_pairing_and_rollup():
+    """Cross-shard halves pair at the flow owner; rollup merges edges."""
+    mesh = make_mesh(8)
+    n = 8
+    sim = ParthaSim(n_hosts=16, n_svcs=4, seed=13)
+    cli_side, ser_side = sim.svc_conn_records(256, split_halves=True)
+    B = 64
+
+    def stacked(recs):
+        shards = []
+        for s in range(n):
+            shards.append(decode.conn_batch(
+                recs[recs["host_id"] % n == s], B))
+        return jax.device_put(
+            jax.tree.map(lambda *xs: np.stack(xs), *shards),
+            leading_sharding(mesh))
+
+    # each record lands on its OBSERVING host's shard — halves of one flow
+    # genuinely start on different shards
+    dep = jax.device_put(
+        jax.tree.map(lambda x: np.broadcast_to(
+            np.asarray(x)[None], (n,) + np.asarray(x).shape),
+            dg.init(1024, 512)),
+        leading_sharding(mesh))
+    step = dg.dep_step_fn(mesh, cap_per_dest=B)
+    dep = step(dep, stacked(cli_side), jnp.int32(1))
+    dep = step(dep, stacked(ser_side), jnp.int32(2))
+    assert float(jnp.sum(dep.n_dropped)) == 0
+    # every flow paired somewhere
+    merged = cli_side.copy()
+    merged["ser_glob_id"] = ser_side["ser_glob_id"]
+    want = _expected_edges(merged)
+    assert float(jnp.sum(dep.n_paired)) == sum(
+        v[0] for v in want.values())
+
+    es = dg.edge_rollup_fn(mesh, out_capacity=1024)(dep)
+    live = np.asarray(table.live_mask(es.tbl))
+    got = {}
+    for i in np.nonzero(live)[0]:
+        cli = (int(es.cli_hi[i]) << 32) | int(es.cli_lo[i])
+        ser = (int(es.ser_hi[i]) << 32) | int(es.ser_lo[i])
+        got[(cli, ser)] = float(es.nconn[i])
+    assert got == {k: v[0] for k, v in want.items()}
+
+
+def test_mesh_clusters_two_rings():
+    """Two disjoint service rings → exactly two clusters, right sizes."""
+    def ring(ids):
+        return [(ids[i], ids[(i + 1) % len(ids)]) for i in range(len(ids))]
+
+    ring_a = [0x1000 + i for i in range(5)]
+    ring_b = [0x2000 + i for i in range(3)]
+    edges = ring(ring_a) + ring(ring_b)
+    E = 32
+    cli = np.zeros(E, np.uint64)
+    ser = np.zeros(E, np.uint64)
+    for i, (c, s) in enumerate(edges):
+        cli[i], ser[i] = c, s
+    valid = np.arange(E) < len(edges)
+    cli_hi, cli_lo = decode.split_u64(cli)
+    ser_hi, ser_lo = decode.split_u64(ser)
+    dep = dg.init(pair_capacity=64, edge_capacity=E)
+    dep = jax.jit(dg.fold_edges)(
+        dep, jnp.asarray(cli_hi), jnp.asarray(cli_lo),
+        jnp.ones(E, bool), jnp.asarray(ser_hi), jnp.asarray(ser_lo),
+        jnp.ones(E, jnp.float32), jnp.asarray(valid), 1)
+    es = dg.edges_local(dep)
+    ntbl, labels, sizes = jax.jit(
+        dg.mesh_clusters, static_argnums=(1, 2))(es, 64, 16)
+    live = np.asarray(table.live_mask(ntbl))
+    labels = np.asarray(labels)[live]
+    sizes = np.asarray(sizes)[live]
+    assert len(labels) == len(ring_a) + len(ring_b)
+    uniq = collections.Counter(labels.tolist())
+    assert sorted(uniq.values()) == [3, 5]
+    assert {3, 5} == set(sizes.tolist())
+
+
+def test_runtime_svcdependency_query():
+    """Wire bytes → Runtime.feed → svcdependency/svcmesh queries."""
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.runtime import Runtime
+
+    cfg = EngineCfg(n_hosts=4, svc_capacity=128, conn_batch=128,
+                    resp_batch=128, fold_k=2)
+    rt = Runtime(cfg)
+    sim = ParthaSim(n_hosts=4, n_svcs=3, seed=19)
+    rt.feed(sim.name_frames())
+    recs = sim.svc_conn_records(256)
+    buf = b"".join(
+        wire.encode_frame(wire.NOTIFY_TCP_CONN, recs[i:i + 128])
+        for i in range(0, 256, 128))
+    rt.feed(buf)
+    out = rt.query({"subsys": "svcdependency", "sortcol": "nconn"})
+    want = _expected_edges(recs)
+    assert out["nrecs"] == len(want)
+    assert sum(r["nconn"] for r in out["recs"]) == sum(
+        v[0] for v in want.values())
+    assert all(r["clisvc"] for r in out["recs"])
+    assert all(r["sername"].startswith("svc-") for r in out["recs"])
+    assert all(r["cliname"].startswith("svc-") for r in out["recs"])
+    mesh = rt.query({"subsys": "svcmesh"})
+    assert mesh["nrecs"] > 0
+    assert all(r["clustersize"] >= 1 for r in mesh["recs"])
+    # filtered edge query goes through the normal criteria path
+    top = out["recs"][0]
+    f = rt.query({"subsys": "svcdependency",
+                  "filter": f"{{ svcdependency.serid = '{top['serid']}' }}"})
+    assert all(r["serid"] == top["serid"] for r in f["recs"])
+    assert f["nrecs"] >= 1
+
+
+def test_task_edge_cliname_resolves_via_comm():
+    """task→svc edges resolve caller names through the task slab (comm)."""
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.runtime import Runtime
+
+    cfg = EngineCfg(n_hosts=4, svc_capacity=128, conn_batch=128,
+                    resp_batch=128, fold_k=2)
+    rt = Runtime(cfg)
+    sim = ParthaSim(n_hosts=4, n_svcs=3, seed=23)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.task_frames())          # populate the task slab
+    recs = sim.svc_conn_records(128)
+    recs["cli_related_listen_id"] = 0   # caller known only as a task group
+    rt.feed(wire.encode_frame(wire.NOTIFY_TCP_CONN, recs))
+    out = rt.query({"subsys": "svcdependency"})
+    assert out["nrecs"] > 0
+    assert not any(r["clisvc"] for r in out["recs"])
+    assert all(r["cliname"].startswith("proc-") for r in out["recs"])
+
+
+def test_mixed_direct_and_external_traffic():
+    """External client flows produce task→svc edges (cli_svc False)."""
+    sim = ParthaSim(n_hosts=2, n_svcs=2, n_clients=8, seed=17)
+    recs = sim.conn_records(64)
+    dep = dg.init(pair_capacity=256, edge_capacity=256)
+    dep = jax.jit(dg.dep_step)(dep, jax.tree.map(
+        jnp.asarray, decode.conn_batch(recs, 64)), 1)
+    want = _expected_edges(recs)
+    got = _edges_dict(dep)
+    assert set(got) == set(want)
+    live = np.asarray(table.live_mask(dep.edge_tbl))
+    assert not np.asarray(dep.e_cli_svc)[live].any()

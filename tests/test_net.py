@@ -18,7 +18,6 @@ from gyeeta_tpu import version
 from gyeeta_tpu.engine.aggstate import EngineCfg
 from gyeeta_tpu.ingest import wire
 from gyeeta_tpu.net import GytServer, NetAgent, QueryClient
-from gyeeta_tpu.net.agent import register
 from gyeeta_tpu.runtime import Runtime
 
 
