@@ -35,23 +35,33 @@ class QueryOptions(NamedTuple):
     sortcol: Optional[str] = None
     sortdesc: bool = True
     maxrecs: int = 1000
+    aggr: Optional[tuple] = None       # e.g. ("avg(qps5s)", "count(*)")
+    groupby: Optional[tuple] = None    # e.g. ("hostid",)
 
     @classmethod
     def from_json(cls, req: dict) -> "QueryOptions":
         known = {"subsys", "filter", "columns", "sortcol", "sortdesc",
-                 "maxrecs"}
+                 "maxrecs", "aggr", "groupby"}
         unknown = set(req) - known
         if unknown:
             raise ValueError(f"unknown query options: {sorted(unknown)}")
         if "subsys" not in req:
             raise ValueError("query needs 'subsys'")
         cols = req.get("columns")
+        ag = req.get("aggr")
+        gb = req.get("groupby")
+        if isinstance(ag, str):
+            ag = [ag]
+        if isinstance(gb, str):
+            gb = [gb]
         return cls(
             subsys=req["subsys"], filter=req.get("filter"),
             columns=tuple(cols) if cols else None,
             sortcol=req.get("sortcol"),
             sortdesc=bool(req.get("sortdesc", True)),
             maxrecs=int(req.get("maxrecs", 1000)),
+            aggr=tuple(ag) if ag else None,
+            groupby=tuple(gb) if gb else None,
         )
 
 
@@ -325,6 +335,25 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
     tree = criteria.parse(opts.filter) if opts.filter else None
     mask = base_mask & criteria.evaluate(tree, cols, opts.subsys)
     idx = np.nonzero(mask)[0]
+
+    if opts.aggr:
+        from gyeeta_tpu.query import aggr as A
+
+        if opts.groupby and "time" in opts.groupby:
+            raise ValueError("groupby 'time' is historical-only")
+        specs = [A.parse_aggr(s, opts.subsys) for s in opts.aggr]
+        gb = A.parse_groupby(opts.groupby, opts.subsys)
+        fmap = fieldmaps.field_map(opts.subsys)
+        recs = A.aggregate_columns(cols, idx, specs, gb, fmap)
+        if opts.sortcol:
+            if opts.sortcol not in (tuple(s.alias for s in specs) + gb):
+                raise ValueError(
+                    f"sortcol {opts.sortcol!r} must be a groupby field "
+                    f"or aggregation alias")
+            recs.sort(key=lambda r: r[opts.sortcol],
+                      reverse=opts.sortdesc)
+        return {"recs": recs[: opts.maxrecs], "nrecs":
+                min(len(recs), opts.maxrecs), "ngroups": len(recs)}
 
     if opts.sortcol:
         fmap = fieldmaps.field_map(opts.subsys)

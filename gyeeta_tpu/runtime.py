@@ -241,6 +241,14 @@ class Runtime:
             if not self.history:
                 raise ValueError("no history store configured")
             now = self._clock()
+            if req.get("aggr"):
+                recs = self.history.aggr_query(
+                    req["subsys"], float(req.get("tstart", 0)),
+                    float(req.get("tend", now)), req["aggr"],
+                    groupby=req.get("groupby"), filter=req.get("filter"),
+                    step=float(req["step"]) if req.get("step") else None,
+                    maxrecs=int(req.get("maxrecs", 10000)))
+                return {"recs": recs, "nrecs": len(recs)}
             return {"recs": self.history.query(
                 req["subsys"], float(req.get("tstart", 0)),
                 float(req.get("tend", now)), req.get("filter"),
