@@ -126,9 +126,12 @@ class AlertManager:
                 return True
         return False
 
-    def check(self, st) -> list[Alert]:
+    def check(self, st, columns_fn=None) -> list[Alert]:
         """Evaluate all defs against live engine state → newly-notified
-        alerts (grouped per def, routed to actions)."""
+        alerts (grouped per def, routed to actions).
+
+        ``columns_fn(subsys) -> (cols, mask)`` overrides the column source
+        (the sharded runtime evaluates alerts on gathered readbacks)."""
         now = self._clock()
         self.stats["nchecks"] += 1
         notified: list[Alert] = []
@@ -138,8 +141,9 @@ class AlertManager:
             if not ad.enabled:
                 continue
             if ad.subsys not in cols_cache:
-                cols_cache[ad.subsys] = api._COLUMNS_OF[ad.subsys](
-                    self.cfg, st)
+                cols_cache[ad.subsys] = (
+                    columns_fn(ad.subsys) if columns_fn is not None
+                    else api._COLUMNS_OF[ad.subsys](self.cfg, st))
             cols, base = cols_cache[ad.subsys]
             tree = self._trees.get(f"def:{ad.name}") \
                 or criteria.parse(ad.filter)

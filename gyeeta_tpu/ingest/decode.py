@@ -275,6 +275,45 @@ def task_batch(recs: np.ndarray, size: int = wire.MAX_TASKS_PER_BATCH
     )
 
 
+def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
+                 listener_batch: int):
+    """Drained records-by-subtype → a fold plan of lane-sized chunks.
+
+    Shared by the single-node and sharded runtimes so the per-type
+    chunking discipline (conn/resp paired into aligned microbatches;
+    every stream split at its lane size) lives in exactly one place.
+    Yields ``(kind, *chunks)`` with kind in ``connresp | listener |
+    host | task | names``.
+    """
+    conn = recs.get(wire.NOTIFY_TCP_CONN)
+    resp = recs.get(wire.NOTIFY_RESP_SAMPLE)
+    nc = 0 if conn is None else len(conn)
+    nr = 0 if resp is None else len(resp)
+    npair = max(-(-nc // conn_batch), -(-nr // resp_batch)) \
+        if (nc or nr) else 0
+    for i in range(npair):
+        cchunk = conn[i * conn_batch:(i + 1) * conn_batch] if nc \
+            else np.empty(0, wire.TCP_CONN_DT)
+        rchunk = resp[i * resp_batch:(i + 1) * resp_batch] if nr \
+            else np.empty(0, wire.RESP_SAMPLE_DT)
+        yield ("connresp", cchunk, rchunk)
+    lst = recs.get(wire.NOTIFY_LISTENER_STATE)
+    if lst is not None:
+        for i in range(0, len(lst), listener_batch):
+            yield ("listener", lst[i:i + listener_batch])
+    hst = recs.get(wire.NOTIFY_HOST_STATE)
+    if hst is not None:
+        for i in range(0, len(hst), wire.MAX_HOSTS_PER_BATCH):
+            yield ("host", hst[i:i + wire.MAX_HOSTS_PER_BATCH])
+    tsk = recs.get(wire.NOTIFY_AGGR_TASK_STATE)
+    if tsk is not None:
+        for i in range(0, len(tsk), wire.MAX_TASKS_PER_BATCH):
+            yield ("task", tsk[i:i + wire.MAX_TASKS_PER_BATCH])
+    nm = recs.get(wire.NOTIFY_NAME_INTERN)
+    if nm is not None:
+        yield ("names", nm)
+
+
 def host_batch(recs: np.ndarray, size: int = wire.MAX_HOSTS_PER_BATCH
                ) -> HostBatch:
     n = _check_fit(recs, size)

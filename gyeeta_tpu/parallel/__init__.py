@@ -17,4 +17,12 @@ from gyeeta_tpu.parallel.mesh import HOST_AXIS, make_mesh, shard_of_host
 from gyeeta_tpu.parallel import sharded, rollup, pairing, depgraph
 
 __all__ = ["HOST_AXIS", "make_mesh", "shard_of_host", "sharded", "rollup",
-           "pairing", "depgraph"]
+           "pairing", "depgraph", "ShardedRuntime"]
+
+
+def __getattr__(name):
+    # lazy: shardedrt pulls in the query/alerts tiers; keep base imports light
+    if name == "ShardedRuntime":
+        from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+        return ShardedRuntime
+    raise AttributeError(name)

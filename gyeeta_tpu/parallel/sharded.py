@@ -111,3 +111,34 @@ def ingest_host_sharded(cfg: aggstate.EngineCfg, mesh):
         return _relocal(step.ingest_host(cfg, _local(st), _local(hb)))
 
     return jax.jit(_fold, donate_argnums=(0,))
+
+
+def ingest_task_sharded(cfg: aggstate.EngineCfg, mesh):
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _fold(st, tb):
+        return _relocal(step.ingest_task(cfg, _local(st), _local(tb)))
+
+    return jax.jit(_fold, donate_argnums=(0,))
+
+
+def classify_sharded(cfg: aggstate.EngineCfg, mesh):
+    """Per-shard 5s classify pass (embarrassingly parallel: each shard
+    classifies its own services/hosts — the per-madhava sweep)."""
+    from gyeeta_tpu.semantic import derive
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _cls(st):
+        return _relocal(derive.classify_pass(cfg, _local(st)))
+
+    return jax.jit(_cls, donate_argnums=(0,))
+
+
+def age_tasks_sharded(cfg: aggstate.EngineCfg, mesh, max_age_ticks: int):
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _age(st):
+        return _relocal(step.age_tasks(cfg, _local(st), max_age_ticks))
+
+    return jax.jit(_age, donate_argnums=(0,))

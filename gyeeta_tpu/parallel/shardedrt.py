@@ -1,0 +1,281 @@
+"""ShardedRuntime: the full product loop on an n-device mesh.
+
+The single-node :class:`~gyeeta_tpu.runtime.Runtime` is one madhava. This
+is the whole tier: every mesh shard owns the engine state for its slice of
+the host space (DP over ``HOST_AXIS``), and the subsystems that the
+reference runs as madhava→shyama RPCs become collectives:
+
+- **ingest**: host-side routing of decoded records by ``host_id % n``
+  (shyama's ``assign_partha_madhava`` placement, stateless) + shard_map'd
+  folds — zero collectives in the hot path;
+- **tick**: per-shard classify (each madhava classifies its own
+  listeners), per-shard window tick/ageing, dep-graph TTL;
+- **pairing / dep graph**: ``all_to_all`` to flow owners
+  (``parallel/depgraph.py``);
+- **queries & alerts**: gather per-shard snapshot columns and run the
+  SAME filter/sort/aggregation pipeline on the merged columns — the
+  multi-madhava scatter the reference's Node webserver performs
+  (``server/gy_mnodehandle.cc:203``), done once here so alertdefs, JSON
+  queries and history writes all see a cluster-wide view.
+
+Everything stacked ``(n_shards, ...)`` with a leading-axis sharding, so
+the same program runs on one chip (n=1), a v5e-8 slice, or a multi-slice
+DCN mesh.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from gyeeta_tpu.alerts import AlertManager
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import decode, native, wire
+from gyeeta_tpu.parallel import depgraph as dg
+from gyeeta_tpu.parallel import pairing, rollup, sharded
+from gyeeta_tpu.parallel.mesh import leading_sharding, shard_of_host
+from gyeeta_tpu.query import api, fieldmaps, readback
+from gyeeta_tpu.query.api import QueryOptions
+from gyeeta_tpu.sketch import topk
+from gyeeta_tpu.utils.config import RuntimeOpts
+from gyeeta_tpu.utils.intern import InternTable
+from gyeeta_tpu.utils.selfstats import Stats
+
+
+class ShardedRuntime:
+    def __init__(self, cfg: Optional[EngineCfg] = None, mesh=None,
+                 opts: Optional[RuntimeOpts] = None, clock=None):
+        from gyeeta_tpu.parallel.mesh import make_mesh
+
+        self.cfg = cfg or EngineCfg()
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n = self.mesh.devices.size
+        self.opts = opts or RuntimeOpts()
+        self.stats = Stats()
+        self.names = InternTable()
+        self.alerts = AlertManager(self.cfg, clock=clock)
+        self._clock = clock or time.time
+        self._tick_no = 0
+        self._pending = b""
+
+        self.state = sharded.init_sharded(self.cfg, self.mesh)
+        shd = leading_sharding(self.mesh)
+        self.dep = jax.device_put(
+            jax.tree.map(
+                lambda x: np.broadcast_to(
+                    np.asarray(x)[None], (self.n,) + np.asarray(x).shape),
+                dg.init(self.opts.dep_pair_capacity,
+                        self.opts.dep_edge_capacity)), shd)
+
+        self._fold = sharded.fold_step_sharded(self.cfg, self.mesh)
+        self._fold_lst = sharded.ingest_listener_sharded(self.cfg,
+                                                         self.mesh)
+        self._fold_host = sharded.ingest_host_sharded(self.cfg, self.mesh)
+        self._fold_task = sharded.ingest_task_sharded(self.cfg, self.mesh)
+        self._classify = sharded.classify_sharded(self.cfg, self.mesh)
+        self._tick = sharded.tick_5s_sharded(self.cfg, self.mesh)
+        self._age_tasks = sharded.age_tasks_sharded(
+            self.cfg, self.mesh, self.opts.task_max_age_ticks)
+        self._dep_step = dg.dep_step_fn(
+            self.mesh, cap_per_dest=self.cfg.conn_batch)
+        self._rollup = rollup.rollup_fn(self.cfg, self.mesh)
+        self._edge_roll = dg.edge_rollup_fn(
+            self.mesh, out_capacity=self.opts.dep_edge_capacity)
+
+        from functools import partial
+        from jax.sharding import PartitionSpec as P
+
+        from gyeeta_tpu.parallel.mesh import HOST_AXIS
+        pttl, ettl = (self.opts.dep_pair_ttl_ticks,
+                      self.opts.dep_edge_ttl_ticks)
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(P(HOST_AXIS), P()), out_specs=P(HOST_AXIS),
+                 check_vma=False)
+        def _dep_age(dep, tick):
+            local = jax.tree.map(lambda x: x[0], dep)
+            return jax.tree.map(lambda x: x[None],
+                                dg.age(local, tick, pttl, ettl))
+
+        self._dep_age = jax.jit(_dep_age, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- ingest
+    def _stack(self, builder, recs, lanes):
+        return sharded.put_sharded(self.mesh, sharded.shard_batches(
+            self.cfg, self.mesh, (builder, lanes), recs, recs["host_id"]))
+
+    def feed(self, buf: bytes) -> int:
+        """Byte stream → routed stacked batches → sharded folds."""
+        data = self._pending + buf
+        try:
+            recs, consumed = native.drain(data)
+        except wire.FrameError:
+            self.stats.bump("frames_bad")
+            self._pending = b""
+            raise
+        self._pending = data[consumed:]
+        n = 0
+        # a chunk of B global records may route up to B lanes onto one
+        # shard, so the shared plan's global lane-size chunking is safe
+        for kind, *chunks in decode.drain_chunks(
+                recs, self.cfg.conn_batch, self.cfg.resp_batch,
+                self.cfg.listener_batch):
+            if kind == "connresp":
+                cchunk, rchunk = chunks
+                cbs = self._stack(decode.conn_batch, cchunk,
+                                  self.cfg.conn_batch)
+                rbs = self._stack(decode.resp_batch, rchunk,
+                                  self.cfg.resp_batch)
+                self.state = self._fold(self.state, cbs, rbs)
+                self.dep = self._dep_step(self.dep, cbs,
+                                          np.int32(self._tick_no))
+                n += len(cchunk) + len(rchunk)
+            elif kind == "listener":
+                self.state = self._fold_lst(self.state, self._stack(
+                    decode.listener_batch, chunks[0],
+                    self.cfg.listener_batch))
+                n += len(chunks[0])
+            elif kind == "host":
+                self.state = self._fold_host(self.state, self._stack(
+                    decode.host_batch, chunks[0],
+                    wire.MAX_HOSTS_PER_BATCH))
+                n += len(chunks[0])
+            elif kind == "task":
+                self.state = self._fold_task(self.state, self._stack(
+                    decode.task_batch, chunks[0],
+                    wire.MAX_TASKS_PER_BATCH))
+                n += len(chunks[0])
+            elif kind == "names":
+                self.stats.bump("names_interned",
+                                self.names.update(chunks[0]))
+        return n
+
+    # ---------------------------------------------------- merged columns
+    def _shard_state(self, s: int):
+        """Shard s's state slice, read from its addressable buffer
+        directly — no cross-device XLA gather on the query path."""
+        def take(x):
+            if hasattr(x, "addressable_shards"):
+                for sh in x.addressable_shards:
+                    idx = sh.index[0] if sh.index else None
+                    if (isinstance(idx, slice) and idx.start is not None
+                            and idx.stop is not None
+                            and idx.start <= s < idx.stop):
+                        return np.asarray(sh.data)[s - idx.start]
+            return np.asarray(x)[s]
+
+        return jax.tree.map(take, self.state)
+
+    def _merged_columns(self, subsys: str):
+        """Cluster-wide (cols, mask): per-shard provider outputs
+        concatenated, or collective-rollup-backed for global subsystems."""
+        if subsys in (fieldmaps.SUBSYS_SVCDEP, fieldmaps.SUBSYS_SVCMESH):
+            es = self._edge_roll(self.dep)
+            return self._dep_cols_from_edgeset(subsys, es)
+        if subsys == fieldmaps.SUBSYS_FLOWSTATE:
+            ru = self._rollup(self.state)
+            k = min(128, int(ru.flow_topk.counts.shape[0]))
+            f_hi, f_lo, f_bytes = topk.query(ru.flow_topk, k)
+            f_hi, f_lo = np.asarray(f_hi), np.asarray(f_lo)
+            f_bytes = np.asarray(f_bytes)
+            cols = {
+                "flowid": api._hex_id(f_hi, f_lo),
+                "bytes": f_bytes,
+                "evictedbytes": np.full(len(f_bytes),
+                                        float(ru.flow_topk.evicted)),
+            }
+            return cols, f_bytes > 0
+        if subsys == fieldmaps.SUBSYS_CLUSTERSTATE:
+            from gyeeta_tpu.semantic import hoststate as HS
+            hcols, reported = self._merged_columns(
+                fieldmaps.SUBSYS_HOSTSTATE)
+            c = HS.cluster_state(np.asarray(hcols["state"]),
+                                 valid=reported)
+            return ({k: np.array([float(v)]) for k, v in c.items()},
+                    np.ones(1, bool))
+        provider = api._COLUMNS_OF[subsys]
+        parts = [provider(self.cfg, self._shard_state(s), names=self.names)
+                 for s in range(self.n)]
+        cols = {k: np.concatenate([p[0][k] for p in parts])
+                for k in parts[0][0]}
+        mask = np.concatenate([p[1] for p in parts])
+        return cols, mask
+
+    def _dep_cols_from_edgeset(self, subsys: str, es):
+        from gyeeta_tpu.engine import table
+
+        if subsys == fieldmaps.SUBSYS_SVCMESH:
+            cap = 2 * es.nconn.shape[0]
+            ntbl, labels, sizes = jax.jit(
+                dg.mesh_clusters, static_argnums=(1,))(es, cap)
+            n_hi, n_lo = np.asarray(ntbl.key_hi), np.asarray(ntbl.key_lo)
+            cols = {
+                "svcid": api._hex_id(n_hi, n_lo),
+                "svcname": api._names_of(self.names, wire.NAME_KIND_SVC,
+                                         n_hi, n_lo),
+                "clusterid": np.asarray(labels),
+                "clustersize": np.asarray(sizes),
+            }
+            return cols, np.asarray(table.live_mask(ntbl))
+        live = np.asarray(table.live_mask(es.tbl))
+        cli_hi, cli_lo = np.asarray(es.cli_hi), np.asarray(es.cli_lo)
+        ser_hi, ser_lo = np.asarray(es.ser_hi), np.asarray(es.ser_lo)
+        cli_svc = np.asarray(es.cli_svc)
+        svc_names = api._names_of(self.names, wire.NAME_KIND_SVC,
+                                  cli_hi, cli_lo)
+        # task→svc callers resolve via the gathered task slabs (comm join)
+        keys, comms, lives = [], [], []
+        for s in range(self.n):
+            k, c, lv = api._task_slab_arrays(self._shard_state(s))
+            keys.append(k)
+            comms.append(c)
+            lives.append(lv)
+        task_names = api.task_comm_names_from(
+            self.names, np.concatenate(keys), np.concatenate(comms),
+            np.concatenate(lives), cli_hi, cli_lo)
+        cols = {
+            "cliid": api._hex_id(cli_hi, cli_lo),
+            "cliname": np.where(cli_svc, svc_names, task_names),
+            "clisvc": cli_svc,
+            "serid": api._hex_id(ser_hi, ser_lo),
+            "sername": api._names_of(self.names, wire.NAME_KIND_SVC,
+                                     ser_hi, ser_lo),
+            "nconn": np.asarray(es.nconn),
+            "bytes": np.asarray(es.byts),
+        }
+        return cols, live
+
+    # ------------------------------------------------------------ cadence
+    def run_tick(self) -> dict:
+        """Sharded 5s pass: classify → alerts on merged columns → window
+        tick → ageing."""
+        report = {}
+        self.state = self._classify(self.state)
+        fired = self.alerts.check(None, columns_fn=self._merged_columns)
+        report["alerts_fired"] = len(fired)
+        self._tick_no += 1
+        report["tick"] = self._tick_no
+        self.state = self._tick(self.state)
+        if self._tick_no % self.opts.task_age_every_ticks == 0:
+            self.state = self._age_tasks(self.state)
+        self.dep = self._dep_age(self.dep, np.int32(self._tick_no))
+        return report
+
+    # -------------------------------------------------------------- query
+    def query(self, req: dict) -> dict:
+        self.stats.bump("queries")
+        return api.execute(self.cfg, None, QueryOptions.from_json(req),
+                           names=self.names,
+                           columns_fn=self._merged_columns)
+
+    def rollup_stats(self) -> dict:
+        """Replicated cluster totals (the MS_CLUSTER_STATE analogue)."""
+        ru = self._rollup(self.state)
+        return {
+            "n_conn": float(ru.n_conn), "n_resp": float(ru.n_resp),
+            "n_svc_live": float(ru.n_svc_live),
+            "n_hosts_up": float(ru.n_hosts_up),
+        }

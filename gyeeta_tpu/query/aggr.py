@@ -146,6 +146,10 @@ def aggregate_columns(cols: dict, idx: np.ndarray, specs: list,
     groups = collections.defaultdict(list)
     for pos, k in enumerate(keys):
         groups[k].append(pos)
+    if not groups and not groupby:
+        # one zero row for a global aggregate over zero matches — the SQL
+        # path and aggregate_rows agree on this shape
+        groups[()] = []
     out = []
     for key, members in groups.items():
         rec = {}

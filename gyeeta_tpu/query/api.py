@@ -218,18 +218,12 @@ def cluster_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     return cols, np.ones(1, bool)
 
 
-def _task_comm_names(st: AggState, names, task_hi, task_lo):
-    """Resolve process-group ids → comm names via the live task slab (the
-    reference resolves DEPENDS entries through MAGGR_TASK)."""
+def task_comm_names_from(names, key, comm, live, task_hi, task_lo):
+    """Resolve process-group ids → comm names given task-slab arrays
+    (key/comm as u64, live mask) — shared by the single-node provider and
+    the sharded runtime's gathered slabs."""
     from gyeeta_tpu.ingest import wire
 
-    key = (np.asarray(st.task_tbl.key_hi).astype(np.uint64)
-           << np.uint64(32)) | np.asarray(st.task_tbl.key_lo)
-    comm = (np.asarray(st.task_comm_hi).astype(np.uint64)
-            << np.uint64(32)) | np.asarray(st.task_comm_lo)
-    live = np.asarray(
-        (st.task_tbl.key_hi != np.uint32(0xFFFFFFFF))
-        | (st.task_tbl.key_lo != np.uint32(0xFFFFFFFF)))
     comm_of = dict(zip(key[live].tolist(), comm[live].tolist()))
     want = ((task_hi.astype(np.uint64) << np.uint64(32))
             | task_lo.astype(np.uint64))
@@ -239,6 +233,24 @@ def _task_comm_names(st: AggState, names, task_hi, task_lo):
     resolved = names.resolve_array(wire.NAME_KIND_COMM, comm_ids)
     fallback = _hex_id(task_hi, task_lo)
     return np.where(comm_ids != 0, resolved, fallback)
+
+
+def _task_slab_arrays(st: AggState):
+    key = (np.asarray(st.task_tbl.key_hi).astype(np.uint64)
+           << np.uint64(32)) | np.asarray(st.task_tbl.key_lo)
+    comm = (np.asarray(st.task_comm_hi).astype(np.uint64)
+            << np.uint64(32)) | np.asarray(st.task_comm_lo)
+    live = np.asarray(
+        (st.task_tbl.key_hi != np.uint32(0xFFFFFFFF))
+        | (st.task_tbl.key_lo != np.uint32(0xFFFFFFFF)))
+    return key, comm, live
+
+
+def _task_comm_names(st: AggState, names, task_hi, task_lo):
+    """Resolve process-group ids → comm names via the live task slab (the
+    reference resolves DEPENDS entries through MAGGR_TASK)."""
+    key, comm, live = _task_slab_arrays(st)
+    return task_comm_names_from(names, key, comm, live, task_hi, task_lo)
 
 
 def dep_columns(cfg: EngineCfg, st: AggState, names=None,
@@ -319,15 +331,27 @@ _TOP_PRESETS = {
 
 
 def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
-            names=None, dep=None) -> dict:
-    """Run one point-in-time query → {"recs": [...], "nrecs": N}."""
-    if opts.subsys not in _COLUMNS_OF and opts.subsys not in _DEP_COLUMNS_OF:
+            names=None, dep=None, columns_fn=None) -> dict:
+    """Run one point-in-time query → {"recs": [...], "nrecs": N}.
+
+    ``columns_fn(subsys) -> (cols, base_mask)`` overrides the column
+    source — the sharded runtime injects gathered/merged columns here so
+    filter/sort/aggregation/projection run identically on one shard or a
+    whole mesh (the multi-madhava scatter the Node webserver performs,
+    ``server/gy_mnodehandle.cc:203``).
+    """
+    if opts.subsys not in fieldmaps.FIELDS_OF_SUBSYS:
+        raise ValueError(f"unknown subsystem {opts.subsys!r}")
+    if columns_fn is None and opts.subsys not in _COLUMNS_OF \
+            and opts.subsys not in _DEP_COLUMNS_OF:
         raise ValueError(f"unknown subsystem {opts.subsys!r}")
     preset = _TOP_PRESETS.get(opts.subsys)
-    if preset is not None and opts.sortcol is None:
+    if preset is not None and opts.sortcol is None and not opts.aggr:
         opts = opts._replace(sortcol=preset[0],
                              maxrecs=min(opts.maxrecs, preset[1]))
-    if opts.subsys in _DEP_COLUMNS_OF:
+    if columns_fn is not None:
+        cols, base_mask = columns_fn(opts.subsys)
+    elif opts.subsys in _DEP_COLUMNS_OF:
         cols, base_mask = _DEP_COLUMNS_OF[opts.subsys](
             cfg, st, names=names, dep=dep)
     else:

@@ -102,52 +102,39 @@ class Runtime:
             raise
         self._pending = data[consumed:]
         n = 0
-        conn = recs.get(wire.NOTIFY_TCP_CONN)
-        resp = recs.get(wire.NOTIFY_RESP_SAMPLE)
-        CB, RB = self.cfg.conn_batch, self.cfg.resp_batch
-        nc = 0 if conn is None else len(conn)
-        nr = 0 if resp is None else len(resp)
-        npair = max(-(-nc // CB), -(-nr // RB))
-        for i in range(npair):
-            cchunk = conn[i * CB:(i + 1) * CB] if nc else None
-            rchunk = resp[i * RB:(i + 1) * RB] if nr else None
-            cb = (decode.conn_batch(cchunk, CB)
-                  if cchunk is not None and len(cchunk)
-                  else self._empty_conn)
-            rb = (decode.resp_batch(rchunk, RB)
-                  if rchunk is not None and len(rchunk)
-                  else self._empty_resp)
-            self._staged.append((cb, rb))
-        n += nc + nr
-        self.stats.bump("conn_events", nc)
-        self.stats.bump("resp_events", nr)
-        self._dispatch_full_slabs()
-        lst = recs.get(wire.NOTIFY_LISTENER_STATE)
-        if lst is not None:
-            for i in range(0, len(lst), self.cfg.listener_batch):
-                lb = decode.listener_batch(
-                    lst[i:i + self.cfg.listener_batch],
-                    self.cfg.listener_batch)
+        for kind, *chunks in decode.drain_chunks(
+                recs, self.cfg.conn_batch, self.cfg.resp_batch,
+                self.cfg.listener_batch):
+            if kind == "connresp":
+                cchunk, rchunk = chunks
+                cb = (decode.conn_batch(cchunk, self.cfg.conn_batch)
+                      if len(cchunk) else self._empty_conn)
+                rb = (decode.resp_batch(rchunk, self.cfg.resp_batch)
+                      if len(rchunk) else self._empty_resp)
+                self._staged.append((cb, rb))
+                n += len(cchunk) + len(rchunk)
+                self.stats.bump("conn_events", len(cchunk))
+                self.stats.bump("resp_events", len(rchunk))
+            elif kind == "listener":
+                lb = decode.listener_batch(chunks[0],
+                                           self.cfg.listener_batch)
                 self.state = self._fold_lst(self.state, lb)
-                n += int(lb.valid.sum())
-            self.stats.bump("listener_records", len(lst))
-        hst = recs.get(wire.NOTIFY_HOST_STATE)
-        if hst is not None:
-            for i in range(0, len(hst), wire.MAX_HOSTS_PER_BATCH):
-                hb = decode.host_batch(hst[i:i + wire.MAX_HOSTS_PER_BATCH])
+                n += len(chunks[0])
+                self.stats.bump("listener_records", len(chunks[0]))
+            elif kind == "host":
+                hb = decode.host_batch(chunks[0])
                 self.state = self._fold_host(self.state, hb)
-                n += int(hb.valid.sum())
-            self.stats.bump("host_records", len(hst))
-        tsk = recs.get(wire.NOTIFY_AGGR_TASK_STATE)
-        if tsk is not None:
-            for i in range(0, len(tsk), wire.MAX_TASKS_PER_BATCH):
-                tb = decode.task_batch(tsk[i:i + wire.MAX_TASKS_PER_BATCH])
+                n += len(chunks[0])
+                self.stats.bump("host_records", len(chunks[0]))
+            elif kind == "task":
+                tb = decode.task_batch(chunks[0])
                 self.state = self._fold_task(self.state, tb)
-            n += len(tsk)
-            self.stats.bump("task_records", len(tsk))
-        nm = recs.get(wire.NOTIFY_NAME_INTERN)
-        if nm is not None:
-            self.stats.bump("names_interned", self.names.update(nm))
+                n += len(chunks[0])
+                self.stats.bump("task_records", len(chunks[0]))
+            elif kind == "names":
+                self.stats.bump("names_interned",
+                                self.names.update(chunks[0]))
+        self._dispatch_full_slabs()
         return n
 
     def _dispatch_full_slabs(self) -> None:
