@@ -16,6 +16,9 @@ from gyeeta_tpu.query import criteria, fieldmaps
 SEVERITIES = ("info", "warning", "critical")
 
 
+ALERT_MODES = ("realtime", "db")
+
+
 class AlertDef(NamedTuple):
     name: str
     subsys: str
@@ -27,6 +30,16 @@ class AlertDef(NamedTuple):
     labels: tuple = ()             # ((key, value), ...) — immutable
     annotations: tuple = ()
     enabled: bool = True
+    # mode "realtime": evaluated on the live snapshot every 5s check
+    # mode "db": evaluated as periodic criteria-SQL over the history
+    # store (ref MDB_ALERTDEF periodic queries, server/gy_malerts.cc) —
+    # ``querysec`` is both the evaluation period and the lookback window
+    mode: str = "realtime"
+    querysec: float = 300.0
+    # notification group-wait: alerts buffer for this many seconds after
+    # the group opens, then emit as one batch (ref ALERT_GROUP
+    # group-wait windows, server/gy_alertmgr.h:574). 0 = immediate.
+    groupwaitsec: float = 0.0
 
     @classmethod
     def from_json(cls, d: dict) -> "AlertDef":
@@ -37,6 +50,9 @@ class AlertDef(NamedTuple):
         sev = d.get("severity", "warning")
         if sev not in SEVERITIES:
             raise ValueError(f"severity must be one of {SEVERITIES}")
+        mode = d.get("mode", "realtime")
+        if mode not in ALERT_MODES:
+            raise ValueError(f"mode must be one of {ALERT_MODES}")
         tree = criteria.parse(d["filter"])     # validate at definition time
         if tree is None:
             raise ValueError("alertdef filter must be non-empty")
@@ -51,6 +67,9 @@ class AlertDef(NamedTuple):
             annotations=tuple(sorted(dict(d.get("annotations", {}))
                                      .items())),
             enabled=bool(d.get("enabled", True)),
+            mode=mode,
+            querysec=max(1.0, float(d.get("querysec", 300.0))),
+            groupwaitsec=max(0.0, float(d.get("groupwaitsec", 0.0))),
         )
 
 

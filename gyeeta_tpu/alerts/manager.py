@@ -9,11 +9,21 @@ walk, ``server/gy_malerts.cc:1869``), then advances per-entity lifecycle:
 
 Silences and inhibits gate *notification*, not detection (matching the
 reference: a silenced alert still tracks state, ``gy_alertmgr.cc:5117``).
-Grouping batches notifications per (alertname, severity) within a check —
-the degenerate group-wait window of the reference's ALERT_GROUP (:574)
-under batch semantics. Actions are pluggable callables; "log" is built in
-(EMAIL/SLACK/PAGERDUTY/WEBHOOK of ``gy_alertmgr.h:50`` register the same
-way; network egress is deployment-specific).
+
+Two evaluation modes (the reference's RT vs MDB alertdef split,
+``server/gy_malerts.cc``): realtime defs run in ``check()`` against the
+live snapshot; db-mode defs run in ``check_db()`` as periodic
+criteria-SQL over the history store every ``querysec`` (db-row silences
+match by alertname/time only — history rows carry presentation strings,
+not live ordinals).
+
+Notification grouping (ref ALERT_GROUP group-wait windows,
+``gy_alertmgr.h:574``): a def with ``groupwaitsec > 0`` buffers its
+notifications from the moment the group opens and emits them as one
+batch when the wait expires (``flush_groups``, called from ``check``).
+Actions are pluggable callables; "log" is built in (EMAIL/SLACK/
+PAGERDUTY/WEBHOOK of ``gy_alertmgr.h:50`` register the same way;
+network egress is deployment-specific).
 """
 
 from __future__ import annotations
@@ -46,10 +56,19 @@ class _EntityState(NamedTuple):
 
 
 def _entity_key_of(subsys: str, cols: dict, i: int) -> str:
-    for k in ("svcid", "hostid", "flowid"):
+    for k in ("svcid", "taskid", "hostid", "flowid"):
         if k in cols:
             return f"{k}={cols[k][i]}"
     return f"row={i}"
+
+
+def _entity_key_of_row(row: dict) -> str:
+    for k in ("svcid", "taskid", "hostid", "flowid"):
+        if k in row and row[k] is not None:
+            return f"{k}={row[k]}"
+    # id-less subsystems (clusterstate): the whole subsystem is one
+    # entity — per-row keys would defeat dedup/numcheckfor entirely
+    return "all"
 
 
 class AlertManager:
@@ -67,9 +86,13 @@ class AlertManager:
         }
         self._state: dict[tuple, _EntityState] = {}
         self._trees: dict[str, object] = {}     # parsed filter cache
+        self._groups: dict[str, list] = {}      # name → [deadline, alerts]
+        self._next_db: dict[str, float] = {}    # db-def → next eval time
+        self._last_db: dict[str, float] = {}    # db-def → last eval time
         self._clock = clock or time.time
         self.stats = {"nchecks": 0, "nfired": 0, "nsilenced": 0,
-                      "ninhibited": 0, "nresolved": 0}
+                      "ninhibited": 0, "nresolved": 0, "ndbchecks": 0,
+                      "ngroups_flushed": 0}
 
     # ------------------------------------------------------------- CRUD
     def add_def(self, d: dict | AlertDef) -> AlertDef:
@@ -138,7 +161,7 @@ class AlertManager:
         cols_cache: dict[str, tuple] = {}
 
         for ad in self.defs.values():
-            if not ad.enabled:
+            if not ad.enabled or ad.mode != "realtime":
                 continue
             if ad.subsys not in cols_cache:
                 cols_cache[ad.subsys] = (
@@ -188,11 +211,123 @@ class AlertManager:
                     self.stats["nresolved"] += 1
                 del self._state[key]
 
-            if group:
-                self.stats["nfired"] += len(group)
-                notified.extend(group)
-                for act in ad.actions:
-                    fn = self.actions.get(act)
-                    if fn is not None:
-                        fn(group)
+            self._emit(ad, group, now, notified)
+        notified.extend(self.flush_groups(now))
         return notified
+
+    # -------------------------------------------------- grouping/routing
+    def _route(self, ad: AlertDef, group: list) -> None:
+        for act in ad.actions:
+            fn = self.actions.get(act)
+            if fn is not None:
+                fn(group)
+
+    def _emit(self, ad: AlertDef, group: list, now: float,
+              notified: list) -> None:
+        if not group:
+            return
+        self.stats["nfired"] += len(group)
+        if ad.groupwaitsec > 0:
+            g = self._groups.get(ad.name)
+            if g is None:
+                # group opens with its first alert; the wait clock starts
+                self._groups[ad.name] = [now + ad.groupwaitsec,
+                                         list(group)]
+            else:
+                g[1].extend(group)
+            return
+        notified.extend(group)
+        self._route(ad, group)
+
+    def flush_groups(self, now: Optional[float] = None) -> list:
+        """Emit groups whose wait window expired → flushed alerts."""
+        now = self._clock() if now is None else now
+        out: list = []
+        for name in list(self._groups):
+            deadline, alerts = self._groups[name]
+            if now < deadline:
+                continue
+            del self._groups[name]
+            ad = self.defs.get(name)
+            if ad is None:
+                continue
+            self.stats["ngroups_flushed"] += 1
+            out.extend(alerts)
+            self._route(ad, alerts)
+        return out
+
+    # ---------------------------------------------------- db-mode check
+    def check_db(self, history, now: Optional[float] = None) -> list:
+        """Evaluate due db-mode defs as criteria-SQL over the history
+        store (the MDB_ALERTDEF periodic path, ``server/gy_malerts.cc``):
+        each def runs every ``querysec`` over its own lookback window;
+        matched rows advance the same entity lifecycle as realtime defs.
+        """
+        now = self._clock() if now is None else now
+        notified: list = []
+        for ad in self.defs.values():
+            if not ad.enabled or ad.mode != "db":
+                continue
+            due = self._next_db.get(ad.name, 0.0)
+            if now < due:
+                continue
+            # window starts at the PREVIOUS eval time, not now-querysec:
+            # tick-grain scheduling slip would otherwise leave a sliver
+            # of history no eval ever covers
+            tstart = self._last_db.get(ad.name, now - ad.querysec)
+            self._next_db[ad.name] = now + ad.querysec
+            self._last_db[ad.name] = now
+            self.stats["ndbchecks"] += 1
+            rows = history.query(ad.subsys, tstart, now, ad.filter)
+            inhibited = self._inhibited(ad)
+            group: list = []
+            seen_keys = set()
+            seen_entities = set()
+            for row in rows:
+                ent = _entity_key_of_row(row)
+                if ent in seen_entities:
+                    continue           # one alert per entity per eval
+                seen_entities.add(ent)
+                key = (ad.name, ent)
+                seen_keys.add(key)
+                es = self._state.get(key, _EntityState())
+                nhits = es.nhits + 1
+                firing = nhits >= ad.numcheckfor
+                notify = (firing
+                          and now - es.tlast_notify >= ad.repeataftersec)
+                if notify and self._silenced_db(ad, now):
+                    self.stats["nsilenced"] += 1
+                    notify = False
+                if notify and inhibited:
+                    self.stats["ninhibited"] += 1
+                    notify = False
+                if notify:
+                    group.append(Alert(
+                        alertname=ad.name, severity=ad.severity,
+                        subsys=ad.subsys, entity=ent, tfired=now,
+                        labels=dict(ad.labels),
+                        annotations=dict(ad.annotations),
+                        row=dict(row)))
+                    es = es._replace(tlast_notify=now)
+                self._state[key] = es._replace(nhits=nhits, firing=firing)
+            for key in [k for k in self._state
+                        if k[0] == ad.name and k not in seen_keys]:
+                if self._state[key].firing:
+                    self.stats["nresolved"] += 1
+                del self._state[key]
+            self._emit(ad, group, now, notified)
+        notified.extend(self.flush_groups(now))
+        return notified
+
+    def _silenced_db(self, ad: AlertDef, now: float) -> bool:
+        """db-row silencing: alertname + time window only (history rows
+        are presentation-domain; filter silences apply to realtime)."""
+        for s in self.silences.values():
+            if not (s.tstart <= now <= s.tend):
+                continue
+            if s.alertnames and ad.name not in s.alertnames:
+                continue
+            if s.filter:
+                continue
+            return True
+        return False

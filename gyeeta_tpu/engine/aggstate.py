@@ -87,6 +87,14 @@ class AggState(NamedTuple):
     host_panel: jnp.ndarray           # (H, NHOSTCOL) last host state
     host_last_tick: jnp.ndarray       # (H,) int32 tick of last host report
     #                                   (-1 = never; staleness → Down)
+    # --- 2s cpu/mem path (ref CPU_MEM_STATE_NOTIFY gy_comm_proto.h:2024,
+    #     classified server-side by semantic/cpumem.py) ---
+    host_cm: jnp.ndarray              # (H, NCM) last raw 2s gauges
+    cm_cpu_state: jnp.ndarray         # (H,) int32 STATE_*
+    cm_cpu_issue: jnp.ndarray         # (H,) int32 CISSUE_*
+    cm_mem_state: jnp.ndarray         # (H,) int32 STATE_*
+    cm_mem_issue: jnp.ndarray         # (H,) int32 MISSUE_*
+    cm_last_tick: jnp.ndarray         # (H,) int32
     # --- task tier (process groups, ref MAGGR_TASK server/gy_msocket.h) ---
     task_tbl: table.Table             # aggr_task_id → row
     task_stats: jnp.ndarray           # (T, NTASKSTAT) last 5s sweep gauges
@@ -125,6 +133,12 @@ def init(cfg: EngineCfg) -> AggState:
         resp_hi_bits=jnp.zeros((S,), jnp.int32),
         host_panel=jnp.zeros((cfg.n_hosts, NHOSTCOL), jnp.float32),
         host_last_tick=jnp.full((cfg.n_hosts,), -1, jnp.int32),
+        host_cm=jnp.zeros((cfg.n_hosts, decode.NCM), jnp.float32),
+        cm_cpu_state=jnp.zeros((cfg.n_hosts,), jnp.int32),
+        cm_cpu_issue=jnp.zeros((cfg.n_hosts,), jnp.int32),
+        cm_mem_state=jnp.zeros((cfg.n_hosts,), jnp.int32),
+        cm_mem_issue=jnp.zeros((cfg.n_hosts,), jnp.int32),
+        cm_last_tick=jnp.full((cfg.n_hosts,), -1, jnp.int32),
         task_tbl=table.init(cfg.task_capacity),
         task_stats=jnp.zeros((cfg.task_capacity, decode.NTASKSTAT),
                              jnp.float32),

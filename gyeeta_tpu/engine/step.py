@@ -199,6 +199,24 @@ def ingest_host(cfg: EngineCfg, st: AggState, hb) -> AggState:
     return st._replace(host_panel=panel, host_last_tick=last)
 
 
+def ingest_cpumem(cfg: EngineCfg, st: AggState, cm) -> AggState:
+    """Fold a CpuMemBatch (the 2s path): panel write + fleet-wide
+    server-side classification (``semantic/cpumem.py`` — the SYS_CPU/
+    SYS_MEM issue scans, ``common/gy_sys_stat.h:131``)."""
+    from gyeeta_tpu.semantic import cpumem as CM
+
+    hid = jnp.where(cm.valid, cm.host_id, cfg.n_hosts)
+    vals = st.host_cm.at[hid].set(cm.vals.astype(jnp.float32),
+                                  mode="drop")
+    cpu_state, cpu_issue = CM.classify_cpu(vals)
+    mem_state, mem_issue = CM.classify_mem(vals)
+    last = st.cm_last_tick.at[hid].set(st.resp_win.tick, mode="drop")
+    return st._replace(
+        host_cm=vals, cm_cpu_state=cpu_state, cm_cpu_issue=cpu_issue,
+        cm_mem_state=mem_state, cm_mem_issue=mem_issue,
+        cm_last_tick=last)
+
+
 def tick_5s(cfg: EngineCfg, st: AggState) -> AggState:
     """Close the 5s base slab on all windowed state."""
     return st._replace(

@@ -26,6 +26,7 @@ _TABLES = {
     "hoststate": [f.json for f in fieldmaps.HOSTSTATE_FIELDS],
     "clusterstate": [f.json for f in fieldmaps.CLUSTERSTATE_FIELDS],
     "taskstate": [f.json for f in fieldmaps.TASKSTATE_FIELDS],
+    "cpumem": [f.json for f in fieldmaps.CPUMEM_FIELDS],
 }
 
 

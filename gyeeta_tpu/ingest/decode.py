@@ -84,6 +84,13 @@ class HostBatch(NamedTuple):
     valid: np.ndarray
 
 
+class CpuMemBatch(NamedTuple):
+    """Columnar CPU_MEM_STATE (2s) microbatch: raw gauges by host."""
+    host_id: np.ndarray       # int32
+    vals: np.ndarray          # (B, NCM) float32, CM_* indices
+    valid: np.ndarray
+
+
 class TaskBatch(NamedTuple):
     """Columnar AGGR_TASK_STATE microbatch (process-group 5s sweep)."""
     key_hi: np.ndarray        # aggr_task_id split — process-group key
@@ -153,6 +160,32 @@ _HOST_PANEL_FIELDS = (
     "ntasks", "ntasks_issue", "ntasks_severe", "nlisten", "nlisten_issue",
     "nlisten_severe", "cpu_issue", "mem_issue", "severe_cpu_issue",
     "severe_mem_issue", "curr_state",
+)
+
+# cpu/mem column indices of CpuMemBatch.vals (and AggState.host_cm)
+CM_CPU_PCT = 0
+CM_USERCPU_PCT = 1
+CM_SYSCPU_PCT = 2
+CM_IOWAIT_PCT = 3
+CM_MAX_CORE_CPU_PCT = 4
+CM_CS_SEC = 5
+CM_FORKS_SEC = 6
+CM_PROCS_RUNNING = 7
+CM_RSS_PCT = 8
+CM_COMMIT_PCT = 9
+CM_SWAP_FREE_PCT = 10
+CM_PG_INOUT_SEC = 11
+CM_SWAP_INOUT_SEC = 12
+CM_ALLOCSTALL_SEC = 13
+CM_OOM_KILLS = 14
+CM_NCPUS = 15
+NCM = 16
+
+_CM_FIELDS = (
+    "cpu_pct", "usercpu_pct", "syscpu_pct", "iowait_pct",
+    "max_core_cpu_pct", "cs_sec", "forks_sec", "procs_running",
+    "rss_pct", "commit_pct", "swap_free_pct", "pg_inout_sec",
+    "swap_inout_sec", "allocstall_sec", "oom_kills", "ncpus",
 )
 
 _LISTENER_STAT_FIELDS = (
@@ -309,9 +342,29 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
     if tsk is not None:
         for i in range(0, len(tsk), wire.MAX_TASKS_PER_BATCH):
             yield ("task", tsk[i:i + wire.MAX_TASKS_PER_BATCH])
+    cm = recs.get(wire.NOTIFY_CPU_MEM_STATE)
+    if cm is not None:
+        for i in range(0, len(cm), wire.MAX_CPUMEM_PER_BATCH):
+            yield ("cpumem", cm[i:i + wire.MAX_CPUMEM_PER_BATCH])
     nm = recs.get(wire.NOTIFY_NAME_INTERN)
     if nm is not None:
         yield ("names", nm)
+
+
+def cpumem_batch(recs: np.ndarray, size: int = wire.MAX_CPUMEM_PER_BATCH
+                 ) -> CpuMemBatch:
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    vals = np.zeros((n, NCM), np.float32)
+    for i, f in enumerate(_CM_FIELDS):
+        vals[:, i] = r[f].astype(np.float32)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return CpuMemBatch(
+        host_id=_pad(r["host_id"].astype(np.int32), size),
+        vals=_pad(vals, size),
+        valid=valid,
+    )
 
 
 def host_batch(recs: np.ndarray, size: int = wire.MAX_HOSTS_PER_BATCH

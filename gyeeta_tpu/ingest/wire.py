@@ -186,6 +186,35 @@ AGGR_TASK_DT = np.dtype([
 
 MAX_TASKS_PER_BATCH = 1200     # gy_comm_proto.h:2139 MAX_NUM_TASKS
 
+# CPU_MEM_STATE record — the 2s host cpu/mem path (field content of
+# CPU_MEM_STATE_NOTIFY, gy_comm_proto.h:2024: cpu pcts, context switches,
+# forks, runnable procs, RSS/commit pcts, swap, paging, reclaim stalls,
+# OOM kills). Agent sends raw gauges every 2s; the server classifies
+# (semantic/cpumem.py), unlike the 5s HOST_STATE which carries the
+# agent's own verdicts.
+CPU_MEM_DT = np.dtype([
+    ("cpu_pct", "<f4"),
+    ("usercpu_pct", "<f4"),
+    ("syscpu_pct", "<f4"),
+    ("iowait_pct", "<f4"),
+    ("max_core_cpu_pct", "<f4"),   # hottest single core
+    ("cs_sec", "<f4"),             # context switches/sec
+    ("forks_sec", "<f4"),
+    ("procs_running", "<f4"),
+    ("rss_pct", "<f4"),
+    ("commit_pct", "<f4"),
+    ("swap_free_pct", "<f4"),
+    ("pg_inout_sec", "<f4"),       # pages in+out/sec
+    ("swap_inout_sec", "<f4"),
+    ("allocstall_sec", "<f4"),     # direct-reclaim stalls/sec
+    ("oom_kills", "<f4"),
+    ("ncpus", "<f4"),
+    ("host_id", "<u4"),
+    ("pad", "u1", (4,)),
+])
+
+MAX_CPUMEM_PER_BATCH = 4096
+
 # NAME_INTERN — the host-side half of the fixed-width record contract: the
 # reference carries comm[16]/cmdline/issue strings inline in every record
 # (e.g. gy_comm_proto.h:1708 trailing cmdline); we instead intern strings
@@ -211,6 +240,7 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_HOST_STATE: HOST_STATE_DT,
     NOTIFY_RESP_SAMPLE: RESP_SAMPLE_DT,
     NOTIFY_AGGR_TASK_STATE: AGGR_TASK_DT,
+    NOTIFY_CPU_MEM_STATE: CPU_MEM_DT,
     NOTIFY_NAME_INTERN: NAME_INTERN_DT,
 }
 
@@ -222,6 +252,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_HOST_STATE: MAX_HOSTS_PER_BATCH,
     NOTIFY_RESP_SAMPLE: MAX_RESP_PER_BATCH,
     NOTIFY_AGGR_TASK_STATE: MAX_TASKS_PER_BATCH,
+    NOTIFY_CPU_MEM_STATE: MAX_CPUMEM_PER_BATCH,
     NOTIFY_NAME_INTERN: MAX_NAMES_PER_BATCH,
 }
 
@@ -231,6 +262,7 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("HOST_STATE_DT", HOST_STATE_DT),
                    ("RESP_SAMPLE_DT", RESP_SAMPLE_DT),
                    ("AGGR_TASK_DT", AGGR_TASK_DT),
+                   ("CPU_MEM_DT", CPU_MEM_DT),
                    ("NAME_INTERN_DT", NAME_INTERN_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 

@@ -103,7 +103,9 @@ class NetAgent:
         buf = (s.conn_frames(n_conn) + s.resp_frames(n_resp)
                + s.listener_frames() + s.task_frames()
                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
-                                   s.host_state_records()))
+                                   s.host_state_records())
+               + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
+                                   s.cpu_mem_records()))
         self._writer.write(buf)
         await self._writer.drain()
 

@@ -113,6 +113,15 @@ def ingest_host_sharded(cfg: aggstate.EngineCfg, mesh):
     return jax.jit(_fold, donate_argnums=(0,))
 
 
+def ingest_cpumem_sharded(cfg: aggstate.EngineCfg, mesh):
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _fold(st, cm):
+        return _relocal(step.ingest_cpumem(cfg, _local(st), _local(cm)))
+
+    return jax.jit(_fold, donate_argnums=(0,))
+
+
 def ingest_task_sharded(cfg: aggstate.EngineCfg, mesh):
     @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
              out_specs=P(HOST_AXIS), check_vma=False)

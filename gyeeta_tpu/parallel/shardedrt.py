@@ -75,6 +75,7 @@ class ShardedRuntime:
                                                          self.mesh)
         self._fold_host = sharded.ingest_host_sharded(self.cfg, self.mesh)
         self._fold_task = sharded.ingest_task_sharded(self.cfg, self.mesh)
+        self._fold_cm = sharded.ingest_cpumem_sharded(self.cfg, self.mesh)
         self._classify = sharded.classify_sharded(self.cfg, self.mesh)
         self._tick = sharded.tick_5s_sharded(self.cfg, self.mesh)
         self._age_tasks = sharded.age_tasks_sharded(
@@ -101,6 +102,8 @@ class ShardedRuntime:
                                 dg.age(local, tick, pttl, ettl))
 
         self._dep_age = jax.jit(_dep_age, donate_argnums=(0,))
+        self._mesh_clusters = jax.jit(dg.mesh_clusters,
+                                      static_argnums=(1,))
 
     # ------------------------------------------------------------- ingest
     def _stack(self, builder, recs, lanes):
@@ -148,6 +151,11 @@ class ShardedRuntime:
                     decode.task_batch, chunks[0],
                     wire.MAX_TASKS_PER_BATCH))
                 n += len(chunks[0])
+            elif kind == "cpumem":
+                self.state = self._fold_cm(self.state, self._stack(
+                    decode.cpumem_batch, chunks[0],
+                    wire.MAX_CPUMEM_PER_BATCH))
+                n += len(chunks[0])
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
@@ -156,7 +164,9 @@ class ShardedRuntime:
     # ---------------------------------------------------- merged columns
     def _shard_state(self, s: int):
         """Shard s's state slice, read from its addressable buffer
-        directly — no cross-device XLA gather on the query path."""
+        directly — no cross-device XLA gather on the query path, and no
+        host transfer: leaves stay device arrays (the provider's jitted
+        snapshot consumes them; only its outputs come to host)."""
         def take(x):
             if hasattr(x, "addressable_shards"):
                 for sh in x.addressable_shards:
@@ -164,7 +174,8 @@ class ShardedRuntime:
                     if (isinstance(idx, slice) and idx.start is not None
                             and idx.stop is not None
                             and idx.start <= s < idx.stop):
-                        return np.asarray(sh.data)[s - idx.start]
+                        # sh.data is single-device: slicing it is local
+                        return sh.data[s - idx.start]
             return np.asarray(x)[s]
 
         return jax.tree.map(take, self.state)
@@ -209,8 +220,7 @@ class ShardedRuntime:
 
         if subsys == fieldmaps.SUBSYS_SVCMESH:
             cap = 2 * es.nconn.shape[0]
-            ntbl, labels, sizes = jax.jit(
-                dg.mesh_clusters, static_argnums=(1,))(es, cap)
+            ntbl, labels, sizes = self._mesh_clusters(es, cap)
             n_hi, n_lo = np.asarray(ntbl.key_hi), np.asarray(ntbl.key_lo)
             cols = {
                 "svcid": api._hex_id(n_hi, n_lo),

@@ -142,15 +142,7 @@ def host_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
         severe_mem=panel[:, D.HOST_SEVERE_MEM] > 0)
     from gyeeta_tpu.semantic.states import STATE_DOWN
     states = np.where(down, STATE_DOWN, states)
-    from gyeeta_tpu.ingest import wire
-
-    hostids = np.arange(panel.shape[0])
-    if names is None:
-        hostnames = np.array([str(h) for h in hostids], object)
-    else:
-        hostnames = np.array(
-            [names.lookup(wire.NAME_KIND_HOST, h) or str(h)
-             for h in hostids], object)
+    hostids, hostnames = _host_name_cols(panel.shape[0], names)
     cols = {
         "hostid": hostids,
         "hostname": hostnames,
@@ -209,6 +201,52 @@ def flow_columns(cfg: EngineCfg, st: AggState, k: int = 128,
         "evictedbytes": np.full(len(valid), float(snap["evicted_bytes"])),
     }
     return cols, valid
+
+
+def _host_name_cols(n: int, names):
+    """(hostids, hostnames) shared by every host-axis subsystem."""
+    from gyeeta_tpu.ingest import wire
+
+    hostids = np.arange(n)
+    if names is None:
+        hostnames = np.array([str(h) for h in hostids], object)
+    else:
+        hostnames = np.array(
+            [names.lookup(wire.NAME_KIND_HOST, h) or str(h)
+             for h in hostids], object)
+    return hostids, hostnames
+
+
+def cpumem_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
+    """cpumem subsystem: raw 2s gauges + server-side classification."""
+    vals = np.asarray(st.host_cm)
+    last = np.asarray(st.cm_last_tick)
+    reported = last >= 0
+    hostids, hostnames = _host_name_cols(vals.shape[0], names)
+    cols = {
+        "hostid": hostids,
+        "hostname": hostnames,
+        "cpu": vals[:, D.CM_CPU_PCT],
+        "usercpu": vals[:, D.CM_USERCPU_PCT],
+        "syscpu": vals[:, D.CM_SYSCPU_PCT],
+        "iowait": vals[:, D.CM_IOWAIT_PCT],
+        "corecpu": vals[:, D.CM_MAX_CORE_CPU_PCT],
+        "cs": vals[:, D.CM_CS_SEC],
+        "forks": vals[:, D.CM_FORKS_SEC],
+        "runq": vals[:, D.CM_PROCS_RUNNING],
+        "rsspct": vals[:, D.CM_RSS_PCT],
+        "commitpct": vals[:, D.CM_COMMIT_PCT],
+        "swapfreepct": vals[:, D.CM_SWAP_FREE_PCT],
+        "pginout": vals[:, D.CM_PG_INOUT_SEC],
+        "swapinout": vals[:, D.CM_SWAP_INOUT_SEC],
+        "allocstall": vals[:, D.CM_ALLOCSTALL_SEC],
+        "oom": vals[:, D.CM_OOM_KILLS],
+        "cpustate": np.asarray(st.cm_cpu_state),
+        "cpuissue": np.asarray(st.cm_cpu_issue),
+        "memstate": np.asarray(st.cm_mem_state),
+        "memissue": np.asarray(st.cm_mem_issue),
+    }
+    return cols, reported
 
 
 def cluster_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
@@ -313,6 +351,7 @@ _COLUMNS_OF = {
     fieldmaps.SUBSYS_TOPCPU: task_columns,
     fieldmaps.SUBSYS_TOPRSS: task_columns,
     fieldmaps.SUBSYS_TOPDELAY: task_columns,
+    fieldmaps.SUBSYS_CPUMEM: cpumem_columns,
 }
 
 # subsystems whose columns come from the dependency graph, not AggState

@@ -13,8 +13,8 @@ from typing import Callable, NamedTuple, Optional
 
 import numpy as np
 
-from gyeeta_tpu.semantic.states import ISSUE_NAMES, STATE_NAMES, \
-    TASK_ISSUE_NAMES
+from gyeeta_tpu.semantic.states import CPU_ISSUE_NAMES, ISSUE_NAMES, \
+    MEM_ISSUE_NAMES, STATE_NAMES, TASK_ISSUE_NAMES
 
 SUBSYS_SVCSTATE = "svcstate"
 SUBSYS_HOSTSTATE = "hoststate"
@@ -29,6 +29,7 @@ SUBSYS_TOPRSS = "toprss"
 SUBSYS_TOPDELAY = "topdelay"
 SUBSYS_SVCDEP = "svcdependency"     # ref DEPENDS_LISTENER / svcprocmap
 SUBSYS_SVCMESH = "svcmesh"          # ref svc mesh clusters (shyama)
+SUBSYS_CPUMEM = "cpumem"            # ref cpumem (2s host cpu/mem state)
 
 
 class FieldDef(NamedTuple):
@@ -61,6 +62,8 @@ def _enum_codec(names):
 _state_enc, _state_dec = _enum_codec(STATE_NAMES)
 _issue_enc, _issue_dec = _enum_codec(ISSUE_NAMES)
 _tissue_enc, _tissue_dec = _enum_codec(TASK_ISSUE_NAMES)
+_cissue_enc, _cissue_dec = _enum_codec(CPU_ISSUE_NAMES)
+_missue_enc, _missue_dec = _enum_codec(MEM_ISSUE_NAMES)
 
 
 def num(json, col, desc=""):
@@ -192,6 +195,36 @@ SVCMESH_FIELDS = (
     num("clustersize", "clustersize", "Services in this cluster"),
 )
 
+# ---------------------------------------------------------------- cpumem
+# ref json_db_cpumem_arr (the 2s CPU_MEM_STATE path, gy_comm_proto.h:2024)
+CPUMEM_FIELDS = (
+    num("hostid", "hostid", "Host id"),
+    string("hostname", "hostname", "Hostname (interned)"),
+    num("cpu", "cpu", "Total CPU %"),
+    num("usercpu", "usercpu", "User CPU %"),
+    num("syscpu", "syscpu", "System CPU %"),
+    num("iowait", "iowait", "IO-wait %"),
+    num("corecpu", "corecpu", "Hottest core CPU %"),
+    num("cs", "cs", "Context switches/sec"),
+    num("forks", "forks", "Forks/sec"),
+    num("runq", "runq", "Runnable processes"),
+    num("rsspct", "rsspct", "Resident memory %"),
+    num("commitpct", "commitpct", "Committed memory %"),
+    num("swapfreepct", "swapfreepct", "Swap free %"),
+    num("pginout", "pginout", "Pages in+out/sec"),
+    num("swapinout", "swapinout", "Swap pages in+out/sec"),
+    num("allocstall", "allocstall", "Direct-reclaim stalls/sec"),
+    num("oom", "oom", "OOM kills in window"),
+    enum("cpustate", "cpustate", _state_enc, _state_dec,
+         "CPU state per 2s analysis"),
+    enum("cpuissue", "cpuissue", _cissue_enc, _cissue_dec,
+         "CPU issue source"),
+    enum("memstate", "memstate", _state_enc, _state_dec,
+         "Memory state per 2s analysis"),
+    enum("memissue", "memissue", _missue_enc, _missue_dec,
+         "Memory issue source"),
+)
+
 # -------------------------------------------------------------- flowstate
 FLOWSTATE_FIELDS = (
     string("flowid", "flowid", "Flow key (hex)"),
@@ -210,6 +243,7 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_TOPDELAY: TASKSTATE_FIELDS,
     SUBSYS_SVCDEP: SVCDEP_FIELDS,
     SUBSYS_SVCMESH: SVCMESH_FIELDS,
+    SUBSYS_CPUMEM: CPUMEM_FIELDS,
 }
 
 

@@ -55,6 +55,8 @@ class Runtime:
             lambda s, b: step.ingest_host(self.cfg, s, b))
         self._fold_task = jax.jit(
             lambda s, b: step.ingest_task(self.cfg, s, b))
+        self._fold_cm = jax.jit(
+            lambda s, b: step.ingest_cpumem(self.cfg, s, b))
         self._age_tasks = jax.jit(
             lambda s: step.age_tasks(self.cfg, s,
                                      self.opts.task_max_age_ticks))
@@ -131,6 +133,11 @@ class Runtime:
                 self.state = self._fold_task(self.state, tb)
                 n += len(chunks[0])
                 self.stats.bump("task_records", len(chunks[0]))
+            elif kind == "cpumem":
+                cmb = decode.cpumem_batch(chunks[0])
+                self.state = self._fold_cm(self.state, cmb)
+                n += len(chunks[0])
+                self.stats.bump("cpumem_records", len(chunks[0]))
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
@@ -169,7 +176,6 @@ class Runtime:
         report = {}
         self.state = self._classify(self.state)
         fired = self.alerts.check(self.state)
-        report["alerts_fired"] = len(fired)
         # history snapshots BEFORE the window tick: the closing 5s slab is
         # still readable (tick zeroes it)
         tick = int(np.asarray(self.state.resp_win.tick)) + 1
@@ -195,8 +201,19 @@ class Runtime:
                 subsys="taskstate", maxrecs=self.cfg.task_capacity),
                 names=self.names)
             self.history.write("taskstate", now, tout["recs"])
+            mout = api.execute(self.cfg, self.state, api.QueryOptions(
+                subsys="cpumem", maxrecs=self.cfg.n_hosts),
+                names=self.names)
+            self.history.write("cpumem", now, mout["recs"])
             report["history_rows"] = (out["nrecs"] + hout["nrecs"]
-                                      + tout["nrecs"] + 1)
+                                      + tout["nrecs"] + mout["nrecs"] + 1)
+
+        # db-mode alertdefs run AFTER the history write so a due def sees
+        # the snapshot from this very tick (ref: MDB alerts query the DB
+        # the madhava just wrote, server/gy_malerts.cc)
+        if self.history:
+            fired += self.alerts.check_db(self.history)
+        report["alerts_fired"] = len(fired)
 
         self.state = self._tick(self.state)
         if tick % self.opts.task_age_every_ticks == 0:

@@ -1,0 +1,110 @@
+"""Host CPU/memory issue classification for the 2s path.
+
+The tensor re-expression of the reference's ``SYS_CPU_STATS`` /
+``SYS_MEM_STATS`` analyzers (``common/gy_sys_stat.h:131``,
+``common/gy_sys_stat.cc`` cpu/mem issue scans): every 2s sweep, raw host
+gauges are judged against saturation thresholds and each host gets a
+(state, issue-source) pair per dimension. The reference walks per-host
+ring buffers one CPU at a time; here the whole fleet classifies in one
+branch-free pass — rules ordered most-severe-first exactly like the
+service-state cascade.
+
+Severity model (mirrors the reference's Bad/Severe split):
+- **Severe**: hard saturation (cpu ≳ 98%, OOM kill, swap exhausted while
+  swapping, reclaim stalls).
+- **Bad**: sustained pressure (cpu ≳ 90%, iowait, hot core, fork/runq
+  storms; rss/commit beyond watermark, heavy paging).
+- **OK**: elevated but sub-threshold (≥ 70% cpu / ≥ 75% rss).
+- **Good / Idle**: quiet.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from gyeeta_tpu.ingest import decode as D
+from gyeeta_tpu.semantic import states as S
+
+
+def classify_cpu(vals):
+    """(H, NCM) gauges → (state, issue) int32 per host (CPU dimension)."""
+    cpu = vals[:, D.CM_CPU_PCT]
+    core = vals[:, D.CM_MAX_CORE_CPU_PCT]
+    iow = vals[:, D.CM_IOWAIT_PCT]
+    cs = vals[:, D.CM_CS_SEC]
+    forks = vals[:, D.CM_FORKS_SEC]
+    runq = vals[:, D.CM_PROCS_RUNNING]
+    ncpu = jnp.maximum(vals[:, D.CM_NCPUS], 1.0)
+
+    sev_cpu = cpu >= 98.0
+    bad_cpu = cpu >= 90.0
+    ok_cpu = cpu >= 70.0
+    bad_core = core >= 95.0
+    bad_iow = iow >= 25.0
+    sev_iow = iow >= 50.0
+    bad_cs = cs >= 100_000.0 * ncpu
+    bad_forks = forks >= 300.0
+    bad_runq = runq >= 4.0 * ncpu
+
+    issue = jnp.full(cpu.shape, S.CISSUE_NONE, jnp.int32)
+    state = jnp.full(cpu.shape, S.STATE_GOOD, jnp.int32)
+    state = jnp.where(cpu < 10.0, S.STATE_IDLE, state)
+    state = jnp.where(ok_cpu, S.STATE_OK, state)
+
+    def rule(cond, st, isrc, state, issue):
+        hit = cond & (issue == S.CISSUE_NONE)
+        return (jnp.where(hit, st, state), jnp.where(hit, isrc, issue))
+
+    # most-severe-first; first hit wins the issue source
+    state, issue = rule(sev_cpu, S.STATE_SEVERE, S.CISSUE_CPU_SATURATED,
+                        state, issue)
+    state, issue = rule(sev_iow, S.STATE_SEVERE, S.CISSUE_IOWAIT,
+                        state, issue)
+    state, issue = rule(bad_cpu, S.STATE_BAD, S.CISSUE_CPU_SATURATED,
+                        state, issue)
+    state, issue = rule(bad_iow, S.STATE_BAD, S.CISSUE_IOWAIT,
+                        state, issue)
+    state, issue = rule(bad_core, S.STATE_BAD, S.CISSUE_CORE_SATURATED,
+                        state, issue)
+    state, issue = rule(bad_cs, S.STATE_BAD, S.CISSUE_CONTEXT_SWITCH,
+                        state, issue)
+    state, issue = rule(bad_forks, S.STATE_BAD, S.CISSUE_FORKS,
+                        state, issue)
+    state, issue = rule(bad_runq, S.STATE_BAD, S.CISSUE_PROCS_RUNNING,
+                        state, issue)
+    return state, issue
+
+
+def classify_mem(vals):
+    """(H, NCM) gauges → (state, issue) int32 per host (memory)."""
+    rss = vals[:, D.CM_RSS_PCT]
+    commit = vals[:, D.CM_COMMIT_PCT]
+    swap_free = vals[:, D.CM_SWAP_FREE_PCT]
+    pgio = vals[:, D.CM_PG_INOUT_SEC]
+    swapio = vals[:, D.CM_SWAP_INOUT_SEC]
+    stalls = vals[:, D.CM_ALLOCSTALL_SEC]
+    oom = vals[:, D.CM_OOM_KILLS]
+
+    issue = jnp.full(rss.shape, S.MISSUE_NONE, jnp.int32)
+    state = jnp.full(rss.shape, S.STATE_GOOD, jnp.int32)
+    state = jnp.where(rss >= 75.0, S.STATE_OK, state)
+
+    def rule(cond, st, isrc, state, issue):
+        hit = cond & (issue == S.MISSUE_NONE)
+        return (jnp.where(hit, st, state), jnp.where(hit, isrc, issue))
+
+    state, issue = rule(oom > 0, S.STATE_SEVERE, S.MISSUE_OOM_KILL,
+                        state, issue)
+    state, issue = rule((swap_free <= 5.0) & (swapio > 0),
+                        S.STATE_SEVERE, S.MISSUE_SWAP_FULL, state, issue)
+    state, issue = rule(stalls >= 50.0, S.STATE_SEVERE,
+                        S.MISSUE_RECLAIM_STALLS, state, issue)
+    state, issue = rule(commit >= 95.0, S.STATE_BAD, S.MISSUE_COMMIT,
+                        state, issue)
+    state, issue = rule(rss >= 90.0, S.STATE_BAD, S.MISSUE_RSS,
+                        state, issue)
+    state, issue = rule(swapio >= 100.0, S.STATE_BAD, S.MISSUE_SWAP_IO,
+                        state, issue)
+    state, issue = rule(pgio >= 10_000.0, S.STATE_BAD, S.MISSUE_PAGE_IO,
+                        state, issue)
+    return state, issue

@@ -32,3 +32,28 @@ TISSUE_HIGH_RSS = 5
 
 TASK_ISSUE_NAMES = ("none", "cpu_delay", "blkio_delay", "vm_delay",
                     "high_cpu", "high_rss")
+
+# host cpu/mem issue sources of the 2s path
+# (ref CPU_ISSUE_SOURCE/MEM_ISSUE_SOURCE, common/gy_sys_stat.h:131)
+CISSUE_NONE = 0
+CISSUE_CPU_SATURATED = 1
+CISSUE_CORE_SATURATED = 2
+CISSUE_IOWAIT = 3
+CISSUE_CONTEXT_SWITCH = 4
+CISSUE_FORKS = 5
+CISSUE_PROCS_RUNNING = 6
+
+CPU_ISSUE_NAMES = ("none", "cpu_saturated", "core_saturated", "iowait",
+                   "context_switch", "new_forks", "procs_running")
+
+MISSUE_NONE = 0
+MISSUE_RSS = 1
+MISSUE_COMMIT = 2
+MISSUE_SWAP_FULL = 3
+MISSUE_SWAP_IO = 4
+MISSUE_RECLAIM_STALLS = 5
+MISSUE_PAGE_IO = 6
+MISSUE_OOM_KILL = 7
+
+MEM_ISSUE_NAMES = ("none", "rss_pct", "commit_pct", "swap_full",
+                   "swap_io", "reclaim_stalls", "page_io", "oom_kill")

@@ -247,6 +247,38 @@ class ParthaSim:
         out["host_id"] = host + self.host_base
         return out
 
+    def cpu_mem_records(self, hot_cpu=(), hot_mem=()) -> np.ndarray:
+        """One 2s CPU_MEM_STATE sweep. ``hot_cpu``/``hot_mem`` are local
+        host indices forced into saturation (pathological fixtures for
+        the server-side classifier)."""
+        r = self.rng
+        n = self.n_hosts
+        out = np.zeros(n, wire.CPU_MEM_DT)
+        cpu = np.clip(r.normal(35.0, 15.0, n), 1.0, 85.0)
+        out["cpu_pct"] = cpu
+        out["usercpu_pct"] = cpu * 0.7
+        out["syscpu_pct"] = cpu * 0.3
+        out["iowait_pct"] = np.clip(r.exponential(2.0, n), 0.0, 15.0)
+        out["max_core_cpu_pct"] = np.clip(cpu * 1.5, 0.0, 90.0)
+        out["cs_sec"] = r.poisson(20_000, n)
+        out["forks_sec"] = r.poisson(20, n)
+        out["procs_running"] = r.poisson(3, n)
+        out["rss_pct"] = np.clip(r.normal(50.0, 12.0, n), 5.0, 72.0)
+        out["commit_pct"] = np.clip(r.normal(60.0, 10.0, n), 10.0, 90.0)
+        out["swap_free_pct"] = np.clip(r.normal(90.0, 5.0, n), 50.0, 100.0)
+        out["pg_inout_sec"] = r.poisson(200, n)
+        out["ncpus"] = 16.0
+        hot_cpu = np.asarray(list(hot_cpu), int)
+        hot_mem = np.asarray(list(hot_mem), int)
+        if len(hot_cpu):
+            out["cpu_pct"][hot_cpu] = 99.0
+            out["usercpu_pct"][hot_cpu] = 95.0
+        if len(hot_mem):
+            out["rss_pct"][hot_mem] = 96.0
+            out["oom_kills"][hot_mem] = 1.0
+        out["host_id"] = np.arange(n, dtype=np.uint32) + self.host_base
+        return out
+
     def name_records(self) -> np.ndarray:
         """Intern announcements for every name this agent fleet uses."""
         from gyeeta_tpu.utils.intern import InternTable
