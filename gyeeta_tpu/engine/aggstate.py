@@ -52,6 +52,11 @@ class EngineCfg(NamedTuple):
         vmin=1.0, vmax=1e5, nbuckets=32)
     levels: tuple = windows.LEVELS_DEFAULT
     task_capacity: int = 2048         # process-group slab rows (power of 2)
+    api_capacity: int = 4096          # (svc, api) trace slab rows (pow 2)
+    # per-API response-time loghist (north-star config #5): 1us..100s,
+    # 128 γ-buckets → ~±7% quantile error
+    apiresp_spec: loghist.LogHistSpec = loghist.LogHistSpec(
+        vmin=1.0, vmax=1e8, nbuckets=128)
     # learned per-group CPU%% baseline (ref AGGR_TASK_HIST_STATS cpu pct
     # histogram, gy_comm_proto.h:2966): 0.1%..10k% (100 cores)
     taskcpu_spec: loghist.LogHistSpec = loghist.LogHistSpec(
@@ -107,6 +112,18 @@ class AggState(NamedTuple):
     task_rel_lo: jnp.ndarray
     task_cpu_hist: jnp.ndarray        # (T, Bc) learned CPU%% baseline
     task_last_tick: jnp.ndarray       # (T,) int32 tick of last sweep
+    # --- request-trace tier (per-(svc, api) aggregates, ref
+    #     REQ_TRACE_TRAN fan-in gy_comm_proto.h:3288) ---
+    api_tbl: table.Table              # mix(svc, api) → row
+    api_svc_hi: jnp.ndarray           # (A,) service glob id halves
+    api_svc_lo: jnp.ndarray
+    api_id_hi: jnp.ndarray            # (A,) interned api signature halves
+    api_id_lo: jnp.ndarray
+    api_proto: jnp.ndarray            # (A,) int32 trace.PROTO_*
+    api_resp_hist: jnp.ndarray        # (A, Ba) response-time loghist
+    api_ctr: jnp.ndarray              # (A, 4) nreq/nerr/bytes_in/bytes_out
+    api_host: jnp.ndarray             # (A,) int32 last reporting host
+    api_last_tick: jnp.ndarray        # (A,) int32
     glob_hll: hll.HLL                 # distinct flow endpoints global
     cms: countmin.CMS                 # flow-key → bytes
     flow_topk: topk.TopK              # heavy-hitter flows by bytes
@@ -152,6 +169,17 @@ def init(cfg: EngineCfg) -> AggState:
         task_cpu_hist=jnp.zeros(
             (cfg.task_capacity, cfg.taskcpu_spec.nbuckets), jnp.float32),
         task_last_tick=jnp.full((cfg.task_capacity,), -1, jnp.int32),
+        api_tbl=table.init(cfg.api_capacity),
+        api_svc_hi=jnp.zeros((cfg.api_capacity,), jnp.uint32),
+        api_svc_lo=jnp.zeros((cfg.api_capacity,), jnp.uint32),
+        api_id_hi=jnp.zeros((cfg.api_capacity,), jnp.uint32),
+        api_id_lo=jnp.zeros((cfg.api_capacity,), jnp.uint32),
+        api_proto=jnp.zeros((cfg.api_capacity,), jnp.int32),
+        api_resp_hist=jnp.zeros(
+            (cfg.api_capacity, cfg.apiresp_spec.nbuckets), jnp.float32),
+        api_ctr=jnp.zeros((cfg.api_capacity, 4), jnp.float32),
+        api_host=jnp.full((cfg.api_capacity,), -1, jnp.int32),
+        api_last_tick=jnp.full((cfg.api_capacity,), -1, jnp.int32),
         glob_hll=hll.init(p=cfg.hll_p_global),
         cms=countmin.init(cfg.cms_depth, cfg.cms_width),
         flow_topk=topk.init(cfg.topk_capacity),

@@ -148,6 +148,68 @@ def ingest_task(cfg: EngineCfg, st: AggState, tb) -> AggState:
         task_last_tick=last)
 
 
+# api_ctr column indices
+APIC_NREQ = 0
+APIC_NERR = 1
+APIC_BYTES_IN = 2
+APIC_BYTES_OUT = 3
+
+
+def ingest_trace(cfg: EngineCfg, st: AggState, tb) -> AggState:
+    """Fold a TraceBatch into the per-(svc, api) slab: counters +
+    response-time loghist (the REQ_TRACE_TRAN fan-in aggregation,
+    ``gy_comm_proto.h:3288`` — per-API latency sketches, north-star
+    config #5)."""
+    valid = tb.valid
+    tbl, rows = table.upsert(st.api_tbl, tb.key_hi, tb.key_lo, valid)
+    ok = valid & (rows >= 0)
+    rowz = jnp.where(ok, rows, 0)
+    A = cfg.api_capacity
+    lanes = jnp.where(ok, rows, A)
+    set_ = lambda col, v: col.at[lanes].set(v, mode="drop")  # noqa: E731
+    ctr = st.api_ctr
+    ctr = ctr.at[lanes, APIC_NREQ].add(jnp.where(ok, 1.0, 0.0),
+                                       mode="drop")
+    ctr = ctr.at[lanes, APIC_NERR].add(
+        jnp.where(ok & tb.is_err, 1.0, 0.0), mode="drop")
+    ctr = ctr.at[lanes, APIC_BYTES_IN].add(jnp.where(ok, tb.byin, 0.0),
+                                           mode="drop")
+    ctr = ctr.at[lanes, APIC_BYTES_OUT].add(jnp.where(ok, tb.byout, 0.0),
+                                            mode="drop")
+    hist = loghist.update_entities(st.api_resp_hist, cfg.apiresp_spec,
+                                   rowz, tb.resp_us, valid=ok)
+    return st._replace(
+        api_tbl=tbl,
+        api_svc_hi=set_(st.api_svc_hi, tb.svc_hi.astype(jnp.uint32)),
+        api_svc_lo=set_(st.api_svc_lo, tb.svc_lo.astype(jnp.uint32)),
+        api_id_hi=set_(st.api_id_hi, tb.api_hi.astype(jnp.uint32)),
+        api_id_lo=set_(st.api_id_lo, tb.api_lo.astype(jnp.uint32)),
+        api_proto=set_(st.api_proto, tb.proto),
+        api_resp_hist=hist, api_ctr=ctr,
+        api_host=set_(st.api_host, tb.host_id),
+        api_last_tick=set_(st.api_last_tick, st.resp_win.tick),
+    )
+
+
+def age_apis(cfg: EngineCfg, st: AggState, max_age_ticks: int) -> AggState:
+    """Tombstone (svc, api) rows unseen for ``max_age_ticks`` ticks."""
+    seen = st.api_last_tick >= 0
+    stale = seen & (st.resp_win.tick - st.api_last_tick
+                    > jnp.int32(max_age_ticks))
+    tbl, killed = table.tombstone_rows(st.api_tbl, stale)
+    z32 = lambda col: jnp.where(killed, jnp.uint32(0), col)  # noqa: E731
+    return st._replace(
+        api_tbl=tbl,
+        api_svc_hi=z32(st.api_svc_hi), api_svc_lo=z32(st.api_svc_lo),
+        api_id_hi=z32(st.api_id_hi), api_id_lo=z32(st.api_id_lo),
+        api_proto=jnp.where(killed, 0, st.api_proto),
+        api_resp_hist=jnp.where(killed[:, None], 0.0, st.api_resp_hist),
+        api_ctr=jnp.where(killed[:, None], 0.0, st.api_ctr),
+        api_host=jnp.where(killed, -1, st.api_host),
+        api_last_tick=jnp.where(killed, -1, st.api_last_tick),
+    )
+
+
 def age_tasks(cfg: EngineCfg, st: AggState, max_age_ticks: int) -> AggState:
     """Tombstone process groups not seen for ``max_age_ticks`` base ticks
     (the reference ages MAGGR_TASK entries via ping/delete msgs,

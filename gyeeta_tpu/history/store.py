@@ -27,6 +27,7 @@ _TABLES = {
     "clusterstate": [f.json for f in fieldmaps.CLUSTERSTATE_FIELDS],
     "taskstate": [f.json for f in fieldmaps.TASKSTATE_FIELDS],
     "cpumem": [f.json for f in fieldmaps.CPUMEM_FIELDS],
+    "tracereq": [f.json for f in fieldmaps.TRACEREQ_FIELDS],
 }
 
 

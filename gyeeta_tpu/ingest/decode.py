@@ -91,6 +91,24 @@ class CpuMemBatch(NamedTuple):
     valid: np.ndarray
 
 
+class TraceBatch(NamedTuple):
+    """Columnar REQ_TRACE microbatch: one parsed transaction per lane."""
+    key_hi: np.ndarray        # mix(svc, api) — per-API routing key
+    key_lo: np.ndarray
+    svc_hi: np.ndarray        # service glob id halves (readback)
+    svc_lo: np.ndarray
+    api_hi: np.ndarray        # interned api signature halves
+    api_lo: np.ndarray
+    resp_us: np.ndarray       # float32
+    byin: np.ndarray          # float32
+    byout: np.ndarray         # float32
+    proto: np.ndarray         # int32
+    is_err: np.ndarray        # bool (status stays in the raw record;
+    #                           the engine aggregates only the error bit)
+    host_id: np.ndarray       # int32
+    valid: np.ndarray
+
+
 class TaskBatch(NamedTuple):
     """Columnar AGGR_TASK_STATE microbatch (process-group 5s sweep)."""
     key_hi: np.ndarray        # aggr_task_id split — process-group key
@@ -346,9 +364,38 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
     if cm is not None:
         for i in range(0, len(cm), wire.MAX_CPUMEM_PER_BATCH):
             yield ("cpumem", cm[i:i + wire.MAX_CPUMEM_PER_BATCH])
+    tr = recs.get(wire.NOTIFY_REQ_TRACE)
+    if tr is not None:
+        for i in range(0, len(tr), wire.MAX_TRACE_PER_BATCH):
+            yield ("trace", tr[i:i + wire.MAX_TRACE_PER_BATCH])
     nm = recs.get(wire.NOTIFY_NAME_INTERN)
     if nm is not None:
         yield ("names", nm)
+
+
+def trace_batch(recs: np.ndarray, size: int = wire.MAX_TRACE_PER_BATCH
+                ) -> TraceBatch:
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    svc_hi, svc_lo = split_u64(r["svc_glob_id"])
+    api_hi, api_lo = split_u64(r["api_id"])
+    # per-API slab key: one mixed 64-bit id over (svc, api)
+    k_hi = H.mix64(svc_hi ^ api_hi, svc_lo, 0xA91D)
+    k_lo = H.mix64(api_lo, svc_lo ^ api_lo, 0x77E1)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return TraceBatch(
+        key_hi=_pad(k_hi, size), key_lo=_pad(k_lo, size),
+        svc_hi=_pad(svc_hi, size), svc_lo=_pad(svc_lo, size),
+        api_hi=_pad(api_hi, size), api_lo=_pad(api_lo, size),
+        resp_us=_pad(r["resp_usec"].astype(np.float32), size),
+        byin=_pad(r["bytes_in"].astype(np.float32), size),
+        byout=_pad(r["bytes_out"].astype(np.float32), size),
+        proto=_pad(r["proto"].astype(np.int32), size),
+        is_err=_pad(r["is_error"].astype(bool), size),
+        host_id=_pad(r["host_id"].astype(np.int32), size),
+        valid=valid,
+    )
 
 
 def cpumem_batch(recs: np.ndarray, size: int = wire.MAX_CPUMEM_PER_BATCH

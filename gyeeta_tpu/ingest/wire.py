@@ -49,6 +49,7 @@ NOTIFY_RESP_SAMPLE = 13       # raw response-time samples (TPU-first)
 NOTIFY_AGGR_TASK_STATE = 14   # 5s per-process-group state
 NOTIFY_CPU_MEM_STATE = 15     # 2s host cpu/mem state
 NOTIFY_NAME_INTERN = 16       # string-intern announcements (TPU-first)
+NOTIFY_REQ_TRACE = 17         # request-trace transactions (per-API)
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -215,6 +216,26 @@ CPU_MEM_DT = np.dtype([
 
 MAX_CPUMEM_PER_BATCH = 4096
 
+# REQ_TRACE record — one parsed request/response transaction (field
+# content of REQ_TRACE_TRAN, gy_comm_proto.h:3288: api signature +
+# latency + status + sizes; the signature string is interned host-side
+# like every other string, NAME_KIND_API announcements).
+REQ_TRACE_DT = np.dtype([
+    ("svc_glob_id", "<u8"),
+    ("api_id", "<u8"),            # interned normalized signature
+    ("tusec", "<u8"),             # request first-byte time
+    ("resp_usec", "<u4"),
+    ("bytes_in", "<u4"),
+    ("bytes_out", "<u4"),
+    ("status", "<u2"),            # HTTP status / PG 0-ok 1-err
+    ("proto", "u1"),              # trace.PROTO_*
+    ("is_error", "u1"),
+    ("host_id", "<u4"),
+    ("pad", "u1", (4,)),
+])
+
+MAX_TRACE_PER_BATCH = 4096
+
 # NAME_INTERN — the host-side half of the fixed-width record contract: the
 # reference carries comm[16]/cmdline/issue strings inline in every record
 # (e.g. gy_comm_proto.h:1708 trailing cmdline); we instead intern strings
@@ -223,6 +244,7 @@ MAX_CPUMEM_PER_BATCH = 4096
 NAME_KIND_COMM = 1      # process comm / command name
 NAME_KIND_SVC = 2       # service (listener) name, id == glob_id
 NAME_KIND_HOST = 3      # hostname, id == host_id
+NAME_KIND_API = 4       # normalized API signature, id == hash(signature)
 MAX_NAME_BYTES = 48
 
 NAME_INTERN_DT = np.dtype([
@@ -242,6 +264,7 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_AGGR_TASK_STATE: AGGR_TASK_DT,
     NOTIFY_CPU_MEM_STATE: CPU_MEM_DT,
     NOTIFY_NAME_INTERN: NAME_INTERN_DT,
+    NOTIFY_REQ_TRACE: REQ_TRACE_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -254,6 +277,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_AGGR_TASK_STATE: MAX_TASKS_PER_BATCH,
     NOTIFY_CPU_MEM_STATE: MAX_CPUMEM_PER_BATCH,
     NOTIFY_NAME_INTERN: MAX_NAMES_PER_BATCH,
+    NOTIFY_REQ_TRACE: MAX_TRACE_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -263,7 +287,8 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("RESP_SAMPLE_DT", RESP_SAMPLE_DT),
                    ("AGGR_TASK_DT", AGGR_TASK_DT),
                    ("CPU_MEM_DT", CPU_MEM_DT),
-                   ("NAME_INTERN_DT", NAME_INTERN_DT)]:
+                   ("NAME_INTERN_DT", NAME_INTERN_DT),
+                   ("REQ_TRACE_DT", REQ_TRACE_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 

@@ -122,6 +122,15 @@ def ingest_cpumem_sharded(cfg: aggstate.EngineCfg, mesh):
     return jax.jit(_fold, donate_argnums=(0,))
 
 
+def ingest_trace_sharded(cfg: aggstate.EngineCfg, mesh):
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _fold(st, tb):
+        return _relocal(step.ingest_trace(cfg, _local(st), _local(tb)))
+
+    return jax.jit(_fold, donate_argnums=(0,))
+
+
 def ingest_task_sharded(cfg: aggstate.EngineCfg, mesh):
     @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
              out_specs=P(HOST_AXIS), check_vma=False)
@@ -149,5 +158,14 @@ def age_tasks_sharded(cfg: aggstate.EngineCfg, mesh, max_age_ticks: int):
              out_specs=P(HOST_AXIS), check_vma=False)
     def _age(st):
         return _relocal(step.age_tasks(cfg, _local(st), max_age_ticks))
+
+    return jax.jit(_age, donate_argnums=(0,))
+
+
+def age_apis_sharded(cfg: aggstate.EngineCfg, mesh, max_age_ticks: int):
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
+             out_specs=P(HOST_AXIS), check_vma=False)
+    def _age(st):
+        return _relocal(step.age_apis(cfg, _local(st), max_age_ticks))
 
     return jax.jit(_age, donate_argnums=(0,))

@@ -76,10 +76,14 @@ class ShardedRuntime:
         self._fold_host = sharded.ingest_host_sharded(self.cfg, self.mesh)
         self._fold_task = sharded.ingest_task_sharded(self.cfg, self.mesh)
         self._fold_cm = sharded.ingest_cpumem_sharded(self.cfg, self.mesh)
+        self._fold_trace = sharded.ingest_trace_sharded(self.cfg,
+                                                        self.mesh)
         self._classify = sharded.classify_sharded(self.cfg, self.mesh)
         self._tick = sharded.tick_5s_sharded(self.cfg, self.mesh)
         self._age_tasks = sharded.age_tasks_sharded(
             self.cfg, self.mesh, self.opts.task_max_age_ticks)
+        self._age_apis = sharded.age_apis_sharded(
+            self.cfg, self.mesh, self.opts.api_max_age_ticks)
         self._dep_step = dg.dep_step_fn(
             self.mesh, cap_per_dest=self.cfg.conn_batch)
         self._rollup = rollup.rollup_fn(self.cfg, self.mesh)
@@ -155,6 +159,11 @@ class ShardedRuntime:
                 self.state = self._fold_cm(self.state, self._stack(
                     decode.cpumem_batch, chunks[0],
                     wire.MAX_CPUMEM_PER_BATCH))
+                n += len(chunks[0])
+            elif kind == "trace":
+                self.state = self._fold_trace(self.state, self._stack(
+                    decode.trace_batch, chunks[0],
+                    wire.MAX_TRACE_PER_BATCH))
                 n += len(chunks[0])
             elif kind == "names":
                 self.stats.bump("names_interned",
@@ -271,6 +280,7 @@ class ShardedRuntime:
         self.state = self._tick(self.state)
         if self._tick_no % self.opts.task_age_every_ticks == 0:
             self.state = self._age_tasks(self.state)
+            self.state = self._age_apis(self.state)
         self.dep = self._dep_age(self.dep, np.int32(self._tick_no))
         return report
 

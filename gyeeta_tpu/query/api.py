@@ -249,6 +249,33 @@ def cpumem_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     return cols, reported
 
 
+def trace_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
+    """tracereq subsystem: per-(service, API) latency aggregates."""
+    from gyeeta_tpu.engine import step as S
+    from gyeeta_tpu.ingest import wire
+
+    snap = {k: np.asarray(v)
+            for k, v in readback.trace_snapshot(cfg, st).items()}
+    ctr = snap["ctr"]
+    cols = {
+        "svcid": _hex_id(snap["svc_hi"], snap["svc_lo"]),
+        "svcname": _names_of(names, wire.NAME_KIND_SVC,
+                             snap["svc_hi"], snap["svc_lo"]),
+        "api": _names_of(names, wire.NAME_KIND_API,
+                         snap["api_hi"], snap["api_lo"]),
+        "proto": snap["proto"],
+        "nreq": ctr[:, S.APIC_NREQ],
+        "nerr": ctr[:, S.APIC_NERR],
+        "bytesin": ctr[:, S.APIC_BYTES_IN],
+        "bytesout": ctr[:, S.APIC_BYTES_OUT],
+        "p50resp": snap["p50_us"] / 1e3,
+        "p95resp": snap["p95_us"] / 1e3,
+        "p99resp": snap["p99_us"] / 1e3,
+        "hostid": snap["hostid"],
+    }
+    return cols, snap["live"]
+
+
 def cluster_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     hcols, reported = host_columns(cfg, st)
     c = hoststate.cluster_state(np.asarray(hcols["state"]), valid=reported)
@@ -352,6 +379,7 @@ _COLUMNS_OF = {
     fieldmaps.SUBSYS_TOPRSS: task_columns,
     fieldmaps.SUBSYS_TOPDELAY: task_columns,
     fieldmaps.SUBSYS_CPUMEM: cpumem_columns,
+    fieldmaps.SUBSYS_TRACEREQ: trace_columns,
 }
 
 # subsystems whose columns come from the dependency graph, not AggState

@@ -30,6 +30,7 @@ SUBSYS_TOPDELAY = "topdelay"
 SUBSYS_SVCDEP = "svcdependency"     # ref DEPENDS_LISTENER / svcprocmap
 SUBSYS_SVCMESH = "svcmesh"          # ref svc mesh clusters (shyama)
 SUBSYS_CPUMEM = "cpumem"            # ref cpumem (2s host cpu/mem state)
+SUBSYS_TRACEREQ = "tracereq"        # ref tracereq (request tracing)
 
 
 class FieldDef(NamedTuple):
@@ -225,6 +226,29 @@ CPUMEM_FIELDS = (
          "Memory issue source"),
 )
 
+# --------------------------------------------------------------- tracereq
+# ref json_db_tracereq_arr (request-trace aggregates): one row per
+# (service, normalized API signature)
+from gyeeta_tpu.trace.proto import PROTO_NAMES as _PROTO_NAMES  # noqa: E402
+
+_proto_enc, _proto_dec = _enum_codec(_PROTO_NAMES)
+
+TRACEREQ_FIELDS = (
+    string("svcid", "svcid", "Service glob id (hex)"),
+    string("svcname", "svcname", "Service name (interned)"),
+    string("api", "api", "Normalized API signature (interned)"),
+    enum("proto", "proto", _proto_enc, _proto_dec,
+         "Application protocol"),
+    num("nreq", "nreq", "Transactions folded"),
+    num("nerr", "nerr", "Errored transactions"),
+    num("bytesin", "bytesin", "Request bytes"),
+    num("bytesout", "bytesout", "Response bytes"),
+    num("p50resp", "p50resp", "p50 latency (msec)"),
+    num("p95resp", "p95resp", "p95 latency (msec)"),
+    num("p99resp", "p99resp", "p99 latency (msec)"),
+    num("hostid", "hostid", "Last reporting host"),
+)
+
 # -------------------------------------------------------------- flowstate
 FLOWSTATE_FIELDS = (
     string("flowid", "flowid", "Flow key (hex)"),
@@ -244,6 +268,7 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_SVCDEP: SVCDEP_FIELDS,
     SUBSYS_SVCMESH: SVCMESH_FIELDS,
     SUBSYS_CPUMEM: CPUMEM_FIELDS,
+    SUBSYS_TRACEREQ: TRACEREQ_FIELDS,
 }
 
 

@@ -186,6 +186,23 @@ def dep_mesh_snapshot(dep, n_iters: int = 16):
     }
 
 
+@partial(jax.jit, static_argnums=(0,))
+def trace_snapshot(cfg: EngineCfg, st: AggState):
+    """Per-(svc, api) live snapshot: counters + latency percentiles
+    (the ``web_curr_tracereq`` analogue; north-star config #5)."""
+    qs = jnp.asarray((0.5, 0.95, 0.99), jnp.float32)
+    q = loghist.quantiles(st.api_resp_hist, cfg.apiresp_spec, qs)
+    return {
+        "live": table.live_mask(st.api_tbl),
+        "svc_hi": st.api_svc_hi, "svc_lo": st.api_svc_lo,
+        "api_hi": st.api_id_hi, "api_lo": st.api_id_lo,
+        "proto": st.api_proto,
+        "ctr": st.api_ctr,
+        "p50_us": q[:, 0], "p95_us": q[:, 1], "p99_us": q[:, 2],
+        "hostid": st.api_host,
+    }
+
+
 def svc_rows_to_host(cfg: EngineCfg, snap: dict) -> list[dict]:
     """Device snapshot → list of per-service dicts (live rows only).
 

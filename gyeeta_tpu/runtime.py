@@ -57,6 +57,11 @@ class Runtime:
             lambda s, b: step.ingest_task(self.cfg, s, b))
         self._fold_cm = jax.jit(
             lambda s, b: step.ingest_cpumem(self.cfg, s, b))
+        self._fold_trace = jax.jit(
+            lambda s, b: step.ingest_trace(self.cfg, s, b))
+        self._age_apis = jax.jit(
+            lambda s: step.age_apis(self.cfg, s,
+                                    self.opts.api_max_age_ticks))
         self._age_tasks = jax.jit(
             lambda s: step.age_tasks(self.cfg, s,
                                      self.opts.task_max_age_ticks))
@@ -138,6 +143,11 @@ class Runtime:
                 self.state = self._fold_cm(self.state, cmb)
                 n += len(chunks[0])
                 self.stats.bump("cpumem_records", len(chunks[0]))
+            elif kind == "trace":
+                trb = decode.trace_batch(chunks[0])
+                self.state = self._fold_trace(self.state, trb)
+                n += len(chunks[0])
+                self.stats.bump("trace_records", len(chunks[0]))
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
@@ -205,8 +215,13 @@ class Runtime:
                 subsys="cpumem", maxrecs=self.cfg.n_hosts),
                 names=self.names)
             self.history.write("cpumem", now, mout["recs"])
-            report["history_rows"] = (out["nrecs"] + hout["nrecs"]
-                                      + tout["nrecs"] + mout["nrecs"] + 1)
+            trout = api.execute(self.cfg, self.state, api.QueryOptions(
+                subsys="tracereq", maxrecs=self.cfg.api_capacity),
+                names=self.names)
+            self.history.write("tracereq", now, trout["recs"])
+            report["history_rows"] = (
+                out["nrecs"] + hout["nrecs"] + tout["nrecs"]
+                + mout["nrecs"] + trout["nrecs"] + 1)
 
         # db-mode alertdefs run AFTER the history write so a due def sees
         # the snapshot from this very tick (ref: MDB alerts query the DB
@@ -218,6 +233,7 @@ class Runtime:
         self.state = self._tick(self.state)
         if tick % self.opts.task_age_every_ticks == 0:
             self.state = self._age_tasks(self.state)
+            self.state = self._age_apis(self.state)
         n_tomb = int(np.asarray(self.state.tbl.n_tomb))
         if n_tomb > self.cfg.svc_capacity * self.opts.compact_tomb_frac:
             self.state = compact.compact_state(self.cfg, self.state)

@@ -247,6 +247,50 @@ class ParthaSim:
         out["host_id"] = host + self.host_base
         return out
 
+    # API signature pool for the trace stream (announced via
+    # name_records; ids are content hashes like the agent would compute)
+    API_SIGS = ("GET /v1/items/{}", "POST /v1/items",
+                "GET /v1/search", "SELECT * FROM items WHERE id=$",
+                "INSERT INTO events VALUES ($)")
+
+    def trace_records(self, n: int, err_pct: float = 0.02) -> np.ndarray:
+        """n REQ_TRACE transactions over the fleet's services (the
+        volume path of request tracing; the parser path is exercised by
+        trace/proto.py on real byte conversations)."""
+        from gyeeta_tpu.trace import PROTO_HTTP1, PROTO_POSTGRES
+        from gyeeta_tpu.utils import hashing as HH
+
+        r = self.rng
+        host = r.integers(0, self.n_hosts, n)
+        svc = r.integers(0, self.n_svcs, n)
+        api_i = r.integers(0, len(self.API_SIGS), n)
+        out = np.zeros(n, wire.REQ_TRACE_DT)
+        out["svc_glob_id"] = self.glob_ids[host, svc]
+        api_ids = np.array([HH.hash_bytes_np(s.encode())
+                            for s in self.API_SIGS], np.uint64)
+        out["api_id"] = api_ids[api_i]
+        out["tusec"] = self.tusec
+        lat = self.svc_latency_us[host, svc]
+        out["resp_usec"] = (r.lognormal(0.0, 0.8, n) * lat).astype(
+            np.uint32)
+        is_sql = api_i >= 3
+        err = r.random(n) < err_pct
+        out["status"] = np.where(is_sql, err.astype(np.uint16),
+                                 np.where(err, 500, 200))
+        out["proto"] = np.where(is_sql, PROTO_POSTGRES, PROTO_HTTP1)
+        out["is_error"] = err
+        out["bytes_in"] = r.integers(100, 2000, n)
+        out["bytes_out"] = r.integers(200, 50_000, n)
+        out["host_id"] = (host + self.host_base).astype(np.uint32)
+        return out
+
+    def trace_frames(self, n: int) -> bytes:
+        recs = self.trace_records(n)
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_REQ_TRACE,
+                              recs[i:i + wire.MAX_TRACE_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_TRACE_PER_BATCH))
+
     def cpu_mem_records(self, hot_cpu=(), hot_mem=()) -> np.ndarray:
         """One 2s CPU_MEM_STATE sweep. ``hot_cpu``/``hot_mem`` are local
         host indices forced into saturation (pathological fixtures for
@@ -281,8 +325,12 @@ class ParthaSim:
 
     def name_records(self) -> np.ndarray:
         """Intern announcements for every name this agent fleet uses."""
+        from gyeeta_tpu.utils import hashing as HH
         from gyeeta_tpu.utils.intern import InternTable
         entries = []
+        for sig in self.API_SIGS:
+            entries.append((wire.NAME_KIND_API,
+                            HH.hash_bytes_np(sig.encode()), sig))
         for g in range(self.n_groups):
             entries.append((wire.NAME_KIND_COMM, self.comm_ids[g],
                             f"proc-{g}"))

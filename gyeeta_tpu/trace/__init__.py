@@ -1,0 +1,24 @@
+"""Request tracing: protocol parsers + per-API device aggregation.
+
+The reference's request-trace pipeline (``API_PARSE_HDLR``,
+``common/gy_proto_parser.h:674``) captures request/response byte streams
+in the agent, detects the application protocol, reassembles transactions
+(request → response pairing), normalizes the request into an *API
+signature* (HTTP route template / SQL shape), and ships
+``REQ_TRACE_TRAN`` records upstream (``common/gy_comm_proto.h:3288``)
+where per-service API aggregates are maintained.
+
+Here the same split, TPU-style: parsing is host/agent-side byte work
+(``trace/proto.py`` — HTTP/1 and Postgres transaction parsers + the
+protocol detector), API signatures travel as interned 64-bit ids
+(NAME_INTERN announcements), and the aggregation is a device slab keyed
+by (service, api) folding whole trace batches: windowed counters +
+per-API response-time loghist (north-star config #5: per-API latency
+sketches across the fleet).
+"""
+
+from gyeeta_tpu.trace.proto import (  # noqa: F401
+    PROTO_UNKNOWN, PROTO_HTTP1, PROTO_POSTGRES, PROTO_NAMES,
+    HttpParser, PostgresParser, detect_protocol, normalize_http,
+    normalize_sql, Transaction, transactions_to_records,
+)

@@ -35,6 +35,7 @@ class RuntimeOpts(NamedTuple):
     compact_tomb_frac: float = 0.25         # compact when tombs exceed
     task_age_every_ticks: int = 12          # ageing sweep cadence (1 min)
     task_max_age_ticks: int = 36            # evict groups unseen for 3 min
+    api_max_age_ticks: int = 360            # evict idle (svc,api) rows 30m
     debug_level: int = 0                    # hot-reloadable
     resp_sample_pct: float = 100.0          # hot-reloadable duty cycle
     # dependency graph (parallel/depgraph.py): slab sizes + TTLs

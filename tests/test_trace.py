@@ -1,0 +1,232 @@
+"""Request tracing: protocol parsers, wire path, per-API aggregation.
+
+VERDICT r2 missing item 3 (``API_PARSE_HDLR`` common/gy_proto_parser.h;
+HTTP parser common/gy_http_proto.cc; ``REQ_TRACE_TRAN`` fan-in
+gy_comm_proto.h:3288). North-star config #5: per-API latency sketches.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from gyeeta_tpu import trace as T
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+
+CFG = EngineCfg(n_hosts=4, svc_capacity=64, conn_batch=64, resp_batch=64,
+                api_capacity=256, fold_k=2)
+
+
+# ------------------------------------------------------------- detection
+def test_detect_protocol():
+    assert T.detect_protocol(b"GET /x HTTP/1.1\r\n") == T.PROTO_HTTP1
+    assert T.detect_protocol(b"POST /y HTTP/1.1\r\n") == T.PROTO_HTTP1
+    startup = (8 + 4).to_bytes(4, "big") + (196608).to_bytes(4, "big")
+    assert T.detect_protocol(startup) == T.PROTO_POSTGRES
+    sslreq = (8).to_bytes(4, "big") + (80877103).to_bytes(4, "big")
+    assert T.detect_protocol(sslreq) == T.PROTO_POSTGRES
+    assert T.detect_protocol(b"\x16\x03\x01\x02\x00xxxx") == \
+        T.PROTO_UNKNOWN
+
+
+# --------------------------------------------------------- normalization
+def test_normalize_http():
+    assert T.normalize_http(b"GET", b"/users/1234/orders?page=2") == \
+        "GET /users/{}/orders"
+    assert T.normalize_http(
+        b"GET",
+        b"/o/9f8b4a2c-1234-4abc-9def-001122334455/x") == "GET /o/{}/x"
+    assert T.normalize_http(b"POST", b"/api/items") == "POST /api/items"
+    assert T.normalize_http(b"GET", b"/d/deadbeefdeadbeefdd") == \
+        "GET /d/{}"
+    assert T.normalize_http(b"GET", b"") == "GET /"
+
+
+def test_normalize_sql():
+    assert T.normalize_sql(
+        b"SELECT * FROM t  WHERE id = 42 AND name='bob''s'") == \
+        "SELECT * FROM t WHERE id = $ AND name=$"
+    assert T.normalize_sql(b"INSERT INTO x VALUES (1, 'a'), (2, 'b')") \
+        == "INSERT INTO x VALUES ($, $), ($, $)"
+
+
+# ------------------------------------------------------------ HTTP parser
+def _http_req(method=b"GET", path=b"/users/7", body=b""):
+    head = b"%s %s HTTP/1.1\r\nHost: x\r\n" % (method, path)
+    if body:
+        head += b"Content-Length: %d\r\n" % len(body)
+    return head + b"\r\n" + body
+
+
+def _http_resp(status=200, body=b"ok"):
+    return (b"HTTP/1.1 %d X\r\nContent-Length: %d\r\n\r\n"
+            % (status, len(body))) + body
+
+
+def test_http_single_transaction():
+    p = T.HttpParser()
+    p.feed_request(_http_req(), 1000)
+    p.feed_response(_http_resp(200), 3500)
+    (t,) = p.drain()
+    assert t.api == "GET /users/{}"
+    assert t.resp_usec == 2500 and t.status == 200 and not t.is_error
+
+
+def test_http_pipelined_and_errors():
+    p = T.HttpParser()
+    p.feed_request(_http_req(path=b"/a") + _http_req(path=b"/b"), 100)
+    p.feed_response(_http_resp(200), 200)
+    p.feed_response(_http_resp(503), 400)
+    a, b = p.drain()
+    assert a.api == "GET /a" and a.status == 200
+    assert b.api == "GET /b" and b.status == 503 and b.is_error
+
+
+def test_http_partial_feeds_and_bodies():
+    p = T.HttpParser()
+    req = _http_req(method=b"POST", path=b"/items", body=b"x" * 300)
+    for i in range(0, len(req), 7):        # torn at every 7 bytes
+        p.feed_request(req[i:i + 7], 50)
+    resp = _http_resp(201, body=b"y" * 1000)
+    for i in range(0, len(resp), 11):
+        p.feed_response(resp[i:i + 11], 90)
+    (t,) = p.drain()
+    assert t.api == "POST /items" and t.status == 201
+    # a second exchange on the same conn still parses (body fully skipped)
+    p.feed_request(_http_req(path=b"/next"), 100)
+    p.feed_response(_http_resp(200), 120)
+    (t2,) = p.drain()
+    assert t2.api == "GET /next"
+
+
+def test_http_chunked_response_body():
+    p = T.HttpParser()
+    p.feed_request(_http_req(path=b"/c"), 10)
+    resp = (b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+            b"4\r\nwiki\r\n5\r\npedia\r\n0\r\n\r\n")
+    p.feed_response(resp, 20)
+    p.feed_request(_http_req(path=b"/after"), 30)
+    p.feed_response(_http_resp(200), 40)
+    a, b = p.drain()
+    assert a.api == "GET /c" and b.api == "GET /after"
+
+
+# -------------------------------------------------------------- PG parser
+def _pg_msg(typ: bytes, body: bytes) -> bytes:
+    return typ + (len(body) + 4).to_bytes(4, "big") + body
+
+
+def _pg_startup() -> bytes:
+    body = (196608).to_bytes(4, "big") + b"user\x00u\x00\x00"
+    return (len(body) + 4).to_bytes(4, "big") + body
+
+
+def test_postgres_simple_query():
+    p = T.PostgresParser()
+    p.feed_request(_pg_startup(), 0)
+    p.feed_request(_pg_msg(b"Q", b"SELECT * FROM t WHERE id=5\x00"), 100)
+    p.feed_response(_pg_msg(b"T", b"row desc") + _pg_msg(b"D", b"data")
+                    + _pg_msg(b"C", b"SELECT 1\x00")
+                    + _pg_msg(b"Z", b"I"), 700)
+    (t,) = p.drain()
+    assert t.api == "SELECT * FROM t WHERE id=$"
+    assert t.proto == T.PROTO_POSTGRES
+    assert t.resp_usec == 600 and not t.is_error
+
+
+def test_postgres_error_and_extended():
+    p = T.PostgresParser()
+    p.feed_request(_pg_startup(), 0)
+    p.feed_request(_pg_msg(b"P", b"\x00UPDATE t SET x=$1\x00\x00\x00"),
+                   10)
+    p.feed_response(_pg_msg(b"E", b"ERROR\x00") + _pg_msg(b"Z", b"I"), 30)
+    (t,) = p.drain()
+    assert t.api == "UPDATE t SET x=$$"  # $1 → $$ after number folding
+    assert t.is_error and t.status == 1
+
+
+# -------------------------------------------- parser → wire → aggregation
+def test_parsed_transactions_to_tracereq_query():
+    p = T.HttpParser()
+    for i in range(20):
+        p.feed_request(_http_req(path=b"/users/%d" % i), i * 1000)
+        p.feed_response(_http_resp(500 if i < 2 else 200),
+                        i * 1000 + 4000)
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=3)
+    svc = int(sim.glob_ids[0, 0])
+    recs, name_recs = T.transactions_to_records(p.drain(), svc, 0)
+    rt = Runtime(CFG)
+    rt.feed(sim.name_frames())
+    rt.feed(wire.encode_frame(wire.NOTIFY_NAME_INTERN, name_recs)
+            + wire.encode_frame(wire.NOTIFY_REQ_TRACE, recs))
+    out = rt.query({"subsys": "tracereq"})
+    assert out["nrecs"] == 1                  # one normalized API
+    r = out["recs"][0]
+    assert r["api"] == "GET /users/{}"
+    assert r["nreq"] == 20 and r["nerr"] == 2
+    assert r["proto"] == "http1"
+    # all latencies 4000us; the 128-bucket γ-hist carries ~±8% error
+    assert 3.6 <= r["p50resp"] <= 4.4
+    assert r["svcname"].startswith("svc-")
+
+
+def test_volume_trace_stream_matches_oracle():
+    rt = Runtime(CFG)
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=9)
+    rt.feed(sim.name_frames())
+    recs = sim.trace_records(2048)
+    rt.feed(b"".join(
+        wire.encode_frame(wire.NOTIFY_REQ_TRACE, recs[i:i + 1024])
+        for i in (0, 1024)))
+    out = rt.query({"subsys": "tracereq", "maxrecs": 500,
+                    "sortcol": "nreq"})
+    want = collections.Counter(
+        (int(r["svc_glob_id"]), int(r["api_id"])) for r in recs)
+    assert out["nrecs"] == len(want)
+    assert sum(r["nreq"] for r in out["recs"]) == 2048
+    assert out["recs"][0]["nreq"] == max(want.values())
+    # aggregation across the trace slab
+    agg = rt.query({"subsys": "tracereq", "aggr": ["sum(nreq)",
+                                                   "sum(nerr)"],
+                    "groupby": "api"})
+    assert sum(r["sum(nreq)"] for r in agg["recs"]) == 2048
+    assert {r["api"] for r in agg["recs"]} <= set(sim.API_SIGS)
+
+
+def test_trace_ageing():
+    import jax
+
+    from gyeeta_tpu.engine import aggstate, step
+    from gyeeta_tpu.ingest import decode
+
+    st = aggstate.init(CFG)
+    sim = ParthaSim(n_hosts=2, n_svcs=2, seed=5)
+    tb = jax.tree.map(jax.numpy.asarray,
+                      decode.trace_batch(sim.trace_records(64)))
+    st = jax.jit(lambda s, b: step.ingest_trace(CFG, s, b))(st, tb)
+    n0 = int(np.asarray(st.api_tbl.n_live))
+    assert n0 > 0
+    for _ in range(5):
+        st = jax.jit(lambda s: step.tick_5s(CFG, s))(st)
+    st = jax.jit(lambda s: step.age_apis(CFG, s, 3))(st)
+    assert int(np.asarray(st.api_tbl.n_live)) == 0
+
+
+def test_sharded_trace_matches_single():
+    from gyeeta_tpu.parallel import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+
+    sim = ParthaSim(n_hosts=8, n_svcs=2, seed=11)
+    buf = sim.name_frames() + sim.trace_frames(512)
+    rt = Runtime(CFG._replace(n_hosts=8))
+    srt = ShardedRuntime(CFG._replace(n_hosts=8), make_mesh(8))
+    rt.feed(buf)
+    srt.feed(buf)
+    q = {"subsys": "tracereq", "maxrecs": 500}
+    a = {(r["svcid"], r["api"]): r["nreq"] for r in rt.query(q)["recs"]}
+    b = {(r["svcid"], r["api"]): r["nreq"] for r in srt.query(q)["recs"]}
+    assert a == b and sum(a.values()) == 512
