@@ -1,0 +1,12 @@
+"""TPU ablation driver: run the fold bench with components removed."""
+import json, os, subprocess, sys
+combos = ["", "topk", "tdigest", "topk,tdigest", "upsert",
+          "svchll", "globhll", "cms", "loghist", "ctr",
+          "topk,tdigest,svchll,globhll,cms,loghist,ctr,upsert"]
+for ab in combos:
+    env = dict(os.environ, GYT_BENCH_ABLATE=ab)
+    p = subprocess.run([sys.executable, "bench.py"], env=env,
+                       capture_output=True, text=True, timeout=900)
+    line = [l for l in p.stdout.splitlines() if l.startswith("{")]
+    ms = [l for l in p.stderr.splitlines() if "ms/microbatch" in l]
+    print(f"{ab or 'FULL':44s} {ms[0].split('(')[-1] if ms else p.stderr[-200:]}")

@@ -22,6 +22,8 @@ jit). `fold_step` is the fused flagship step used by bench + __graft_entry__.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -34,33 +36,50 @@ from gyeeta_tpu.sketch import countmin, hyperloglog as hll, loghist, \
     tdigest, topk, windows
 
 
+# Bench-only ablation switch: GYT_BENCH_ABLATE="topk,tdigest" compiles the
+# fold WITHOUT those components so per-component device cost can be
+# attributed on real hardware. Read ONCE at module import — set it in the
+# environment before the process starts (the _ablate.py driver spawns
+# subprocesses for exactly this reason). Never set in production.
+_ABLATE = frozenset(
+    os.environ.get("GYT_BENCH_ABLATE", "").split(",")) - {""}
+
+
 def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
     """Fold a ConnBatch. cb fields are (B,) device arrays."""
     valid = cb.valid
-    tbl, rows = table.upsert(st.tbl, cb.svc_hi, cb.svc_lo, valid)
+    if "upsert" in _ABLATE:
+        tbl, rows = st.tbl, table.lookup(st.tbl, cb.svc_hi, cb.svc_lo,
+                                         valid)
+    else:
+        tbl, rows = table.upsert(st.tbl, cb.svc_hi, cb.svc_lo, valid)
     ok = valid & (rows >= 0)
     rowz = jnp.where(ok, rows, 0)
     S = cfg.svc_capacity
 
     # per-svc windowed counters: one scatter-add over (row, ctr) pairs
-    cur = st.ctr_win.cur
+    ctr_win = st.ctr_win
     lanes = jnp.where(ok, rowz, S)  # S = dropped (mode=drop)
-    cur = cur.at[lanes, CTR_BYTES_SENT].add(cb.bytes_sent, mode="drop")
-    cur = cur.at[lanes, CTR_BYTES_RCVD].add(cb.bytes_rcvd, mode="drop")
-    cur = cur.at[lanes, CTR_NCONN_CLOSED].add(
-        cb.is_close.astype(jnp.float32), mode="drop")
-    cur = cur.at[lanes, CTR_DUR_SUM_US].add(cb.duration_us, mode="drop")
-    ctr_win = st.ctr_win._replace(cur=cur)
+    if "ctr" not in _ABLATE:
+        cur = st.ctr_win.cur
+        cur = cur.at[lanes, CTR_BYTES_SENT].add(cb.bytes_sent, mode="drop")
+        cur = cur.at[lanes, CTR_BYTES_RCVD].add(cb.bytes_rcvd, mode="drop")
+        cur = cur.at[lanes, CTR_NCONN_CLOSED].add(
+            cb.is_close.astype(jnp.float32), mode="drop")
+        cur = cur.at[lanes, CTR_DUR_SUM_US].add(cb.duration_us,
+                                                mode="drop")
+        ctr_win = st.ctr_win._replace(cur=cur)
 
     svc_host = st.svc_host.at[lanes].set(cb.host_id, mode="drop")
-    svc_hll = hll.update_entities(st.svc_hll, rowz, cb.cli_hi, cb.cli_lo,
-                                  valid=ok)
-    glob_hll = hll.update(st.glob_hll, cb.flow_hi, cb.flow_lo, valid=valid)
+    svc_hll = st.svc_hll if "svchll" in _ABLATE else hll.update_entities(
+        st.svc_hll, rowz, cb.cli_hi, cb.cli_lo, valid=ok)
+    glob_hll = st.glob_hll if "globhll" in _ABLATE else hll.update(
+        st.glob_hll, cb.flow_hi, cb.flow_lo, valid=valid)
     tot_bytes = cb.bytes_sent + cb.bytes_rcvd
-    cms = countmin.update(st.cms, cb.flow_hi, cb.flow_lo, tot_bytes,
-                          valid=valid)
-    flow_topk = topk.update(st.flow_topk, cb.flow_hi, cb.flow_lo, tot_bytes,
-                            valid=valid)
+    cms = st.cms if "cms" in _ABLATE else countmin.update(
+        st.cms, cb.flow_hi, cb.flow_lo, tot_bytes, valid=valid)
+    flow_topk = st.flow_topk if "topk" in _ABLATE else topk.update(
+        st.flow_topk, cb.flow_hi, cb.flow_lo, tot_bytes, valid=valid)
     return st._replace(
         tbl=tbl, ctr_win=ctr_win, svc_host=svc_host, svc_hll=svc_hll,
         glob_hll=glob_hll, cms=cms, flow_topk=flow_topk,
@@ -71,15 +90,24 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
 def ingest_resp(cfg: EngineCfg, st: AggState, rb) -> AggState:
     """Fold a RespBatch of raw (glob_id, resp_us) samples."""
     valid = rb.valid
-    tbl, rows = table.upsert(st.tbl, rb.svc_hi, rb.svc_lo, valid)
+    if "upsert" in _ABLATE:
+        tbl, rows = st.tbl, table.lookup(st.tbl, rb.svc_hi, rb.svc_lo,
+                                         valid)
+    else:
+        tbl, rows = table.upsert(st.tbl, rb.svc_hi, rb.svc_lo, valid)
     ok = valid & (rows >= 0)
     rowz = jnp.where(ok, rows, 0)
-    cur = loghist.update_entities(
-        st.resp_win.cur, cfg.resp_spec, rowz, rb.resp_us, valid=ok)
-    resp_win = st.resp_win._replace(cur=cur)
-    svc_td, n_over = tdigest.update_routed(
-        st.svc_td, jnp.where(ok, rows, -1), rb.resp_us,
-        route_cap=cfg.td_route_cap)
+    resp_win = st.resp_win
+    if "loghist" not in _ABLATE:
+        cur = loghist.update_entities(
+            st.resp_win.cur, cfg.resp_spec, rowz, rb.resp_us, valid=ok)
+        resp_win = st.resp_win._replace(cur=cur)
+    if "tdigest" in _ABLATE:
+        svc_td, n_over = st.svc_td, jnp.int32(0)
+    else:
+        svc_td, n_over = tdigest.update_routed(
+            st.svc_td, jnp.where(ok, rows, -1), rb.resp_us,
+            route_cap=cfg.td_route_cap)
     return st._replace(
         tbl=tbl, resp_win=resp_win, svc_td=svc_td,
         n_resp=st.n_resp + jnp.sum(valid).astype(jnp.float32),

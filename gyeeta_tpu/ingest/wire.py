@@ -331,8 +331,16 @@ QUERY_HDR_DT = np.dtype([
 QS_OK = 0
 QS_ERROR = 1                     # payload = {"error": msg}
 QS_BUSY = 2                      # too many outstanding queries
+QS_PARTIAL = 3                   # streamed chunk; more frames follow
 
 MAX_OUTSTANDING_QUERIES = 64     # per conn (global 4K analogue)
+
+# streamed-response chunk size: large results ride as a sequence of
+# QS_PARTIAL frames closed by the final status frame — the reference
+# streams web responses in 16MB heap-buffer chunks up to 4GB total
+# (gy_msg_comm.h buffer discipline); 1MB chunks keep frames well under
+# the 16MB frame cap with room for framing
+QUERY_CHUNK_BYTES = 1 << 20
 
 
 def _frame(data_type: int, payload: bytes, magic: int) -> bytes:
@@ -379,6 +387,43 @@ def encode_query(seqid: int, obj, status: int = QS_OK,
     h["nbytes"] = len(payload)
     return _frame(COMM_QUERY_RESP if resp else COMM_QUERY_CMD,
                   h.tobytes() + payload, MAGIC_NQ)
+
+
+def iter_query_frames(seqid: int, obj, status: int = QS_OK,
+                      chunk_bytes: int = QUERY_CHUNK_BYTES):
+    """Yield a streamed frame sequence for a (possibly large) JSON
+    response: N-1 QS_PARTIAL chunks + one final frame carrying
+    ``status``. A small response is exactly one ordinary frame.
+    Writers send each frame as it yields (bounded transport memory; the
+    JSON text itself is materialized once — ``json.dumps`` — so peak is
+    ~1× payload, vs ~3× when the whole frame blob is pre-joined)."""
+    import json as _json
+    payload = _json.dumps(obj).encode()
+    for off in range(0, max(len(payload), 1), chunk_bytes):
+        body = payload[off: off + chunk_bytes]
+        last = off + chunk_bytes >= len(payload)
+        h = np.zeros((), QUERY_HDR_DT)
+        h["seqid"] = np.uint64(seqid)
+        h["status"] = status if last else QS_PARTIAL
+        h["nbytes"] = len(body)
+        yield _frame(COMM_QUERY_RESP, h.tobytes() + body, MAGIC_NQ)
+
+
+def encode_query_frames(seqid: int, obj, status: int = QS_OK,
+                        chunk_bytes: int = QUERY_CHUNK_BYTES) -> bytes:
+    """Joined form of :func:`iter_query_frames` (tests / small results)."""
+    return b"".join(iter_query_frames(seqid, obj, status, chunk_bytes))
+
+
+def decode_query_chunk(payload: bytes):
+    """QUERY_RESP frame payload → (seqid, status, raw_body_bytes).
+
+    Callers accumulate QS_PARTIAL bodies and JSON-parse once the final
+    status arrives (the streamed-response read side)."""
+    h = np.frombuffer(payload, QUERY_HDR_DT, count=1)[0]
+    n = int(h["nbytes"])
+    body = payload[QUERY_HDR_DT.itemsize: QUERY_HDR_DT.itemsize + n]
+    return int(h["seqid"]), int(h["status"]), body
 
 
 def decode_query_payload(payload: bytes):

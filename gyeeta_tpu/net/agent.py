@@ -143,16 +143,24 @@ class QueryClient:
         self._reader, self._writer = reader, writer
 
     async def query(self, req: dict) -> dict:
+        import json
+
         self._seq += 1
         seq = self._seq
         self._writer.write(wire.encode_query(seq, req))
         await self._writer.drain()
-        dtype, payload = await _read_frame(self._reader)
-        if dtype != wire.COMM_QUERY_RESP:
-            raise wire.FrameError(f"expected QUERY_RESP, got {dtype}")
-        seqid, status, obj = wire.decode_query_payload(payload)
-        if seqid != seq:
-            raise wire.FrameError(f"seqid mismatch {seqid} != {seq}")
+        chunks = []       # joined once at the end: O(N) for GB responses
+        while True:       # streamed responses: QS_PARTIAL chunks → final
+            dtype, payload = await _read_frame(self._reader)
+            if dtype != wire.COMM_QUERY_RESP:
+                raise wire.FrameError(f"expected QUERY_RESP, got {dtype}")
+            seqid, status, chunk = wire.decode_query_chunk(payload)
+            if seqid != seq:
+                raise wire.FrameError(f"seqid mismatch {seqid} != {seq}")
+            chunks.append(chunk)
+            if status != wire.QS_PARTIAL:
+                break
+        obj = json.loads(b"".join(chunks) or b"null")
         if status != wire.QS_OK:
             raise RuntimeError(obj.get("error", f"query status {status}"))
         return obj

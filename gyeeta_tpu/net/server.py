@@ -199,11 +199,19 @@ class GytServer:
             try:
                 self.rt.stats.bump("net_queries")
                 out = self.rt.query(req)
-                resp = wire.encode_query(seqid, out, wire.QS_OK, resp=True)
             except Exception as e:
-                resp = wire.encode_query(seqid, {"error": str(e)},
-                                         wire.QS_ERROR, resp=True)
+                outstanding -= 1
+                writer.write(wire.encode_query(seqid, {"error": str(e)},
+                                               wire.QS_ERROR, resp=True))
+                await writer.drain()
+                continue
+            try:
+                # large results stream as QS_PARTIAL chunks with a drain
+                # per chunk: bounded transport memory (the 16MB-frame /
+                # multi-GB discipline of the reference webserver)
+                for frame in wire.iter_query_frames(seqid, out,
+                                                    wire.QS_OK):
+                    writer.write(frame)
+                    await writer.drain()
             finally:
                 outstanding -= 1
-            writer.write(resp)
-            await writer.drain()

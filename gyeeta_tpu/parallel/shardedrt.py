@@ -286,10 +286,14 @@ class ShardedRuntime:
 
     # -------------------------------------------------------------- query
     def query(self, req: dict) -> dict:
+        if req.get("subsys") == "selfstats":
+            from gyeeta_tpu.utils.selfstats import selfstats_response
+            return selfstats_response(self.stats, self.alerts)
         self.stats.bump("queries")
-        return api.execute(self.cfg, None, QueryOptions.from_json(req),
-                           names=self.names,
-                           columns_fn=self._merged_columns)
+        with self.stats.timeit("query"):
+            return api.execute(self.cfg, None, QueryOptions.from_json(req),
+                               names=self.names,
+                               columns_fn=self._merged_columns)
 
     def rollup_stats(self) -> dict:
         """Replicated cluster totals (the MS_CLUSTER_STATE analogue)."""

@@ -102,7 +102,8 @@ class Runtime:
         invisible at a cadence or query boundary."""
         data = self._pending + buf
         try:
-            recs, consumed = native.drain(data)
+            with self.stats.timeit("deframe"):
+                recs, consumed = native.drain(data)
         except wire.FrameError:
             self.stats.bump("frames_bad")
             self._pending = b""       # poison frame: drop buffer, resync
@@ -160,12 +161,13 @@ class Runtime:
         K = self.cfg.fold_k
         while len(self._staged) >= K:
             chunk, self._staged = self._staged[:K], self._staged[K:]
-            cbs = jax.tree.map(lambda *xs: np.stack(xs),
-                               *[c for c, _ in chunk])
-            rbs = jax.tree.map(lambda *xs: np.stack(xs),
-                               *[r for _, r in chunk])
-            self.state = self._fold_many(self.state, cbs, rbs)
-            self.dep = self._dep_many(self.dep, cbs, self._tick_no)
+            with self.stats.timeit("fold_dispatch"):
+                cbs = jax.tree.map(lambda *xs: np.stack(xs),
+                                   *[c for c, _ in chunk])
+                rbs = jax.tree.map(lambda *xs: np.stack(xs),
+                                   *[r for _, r in chunk])
+                self.state = self._fold_many(self.state, cbs, rbs)
+                self.dep = self._dep_many(self.dep, cbs, self._tick_no)
             self.stats.bump("slab_dispatches")
 
     def flush(self) -> int:
@@ -180,6 +182,10 @@ class Runtime:
 
     # ------------------------------------------------------------ cadence
     def run_tick(self) -> dict:
+        with self.stats.timeit("tick"):
+            return self._run_tick()
+
+    def _run_tick(self) -> dict:
         """Close one 5s window: classify → alerts → windows tick →
         maintenance cadences. Returns a tick report."""
         self.flush()
@@ -257,6 +263,15 @@ class Runtime:
     # -------------------------------------------------------------- query
     def query(self, req: dict) -> dict:
         """Point-in-time (live) or historical (time-ranged) JSON query."""
+        if req.get("subsys") == "selfstats":
+            # process self-metrics (the print_stats surface): counters +
+            # per-stage latency histograms, no engine readback involved
+            from gyeeta_tpu.utils.selfstats import selfstats_response
+            return selfstats_response(self.stats, self.alerts)
+        with self.stats.timeit("query"):
+            return self._query(req)
+
+    def _query(self, req: dict) -> dict:
         if "tstart" in req or "tend" in req:
             if not self.history:
                 raise ValueError("no history store configured")

@@ -8,7 +8,11 @@ remote endpoints). For *dense* tracked entities (service rows) use
 
 Algorithm (Misra-Gries-style truncation, fully vectorized):
   1. concat candidate table with the microbatch's (key, value) lanes,
-  2. lexicographic sort by (hi, lo) via ``lax.sort`` with num_keys=2,
+  2. group equal 64-bit keys adjacently with a two-pass stable radix
+     sort (argsort by lo, then stable argsort by hi) — two single-key
+     sorts are the TPU-fast path; a measured multi-key ``lax.sort`` on
+     u32 pairs lowered ~200× slower. Exact lexicographic grouping, no
+     hash-collision caveats,
   3. segment-sum duplicate keys (boundary detection + segment ids),
   4. keep the top `capacity` segment totals via ``lax.top_k``.
 Evicted keys lose their history (undercount bound = mass evicted); pair with
@@ -48,8 +52,19 @@ def init(capacity: int = 256) -> TopK:
 
 
 def _combine(hi, lo, vals, capacity: int, evicted) -> TopK:
-    """Sort by key, merge duplicates, keep heaviest ``capacity`` entries."""
-    hi_s, lo_s, v_s = jax.lax.sort((hi, lo, vals), num_keys=2)
+    """Radix-group by 64-bit key, merge dups, keep heaviest ``capacity``.
+
+    Two stable single-key argsorts (LSD radix over the u32 halves;
+    bitcast to i32 only changes the order, not equality-grouping).
+    """
+    lo_i = jax.lax.bitcast_convert_type(lo, jnp.int32)
+    hi_i = jax.lax.bitcast_convert_type(hi, jnp.int32)
+    o1 = jnp.argsort(lo_i, stable=True)
+    o2 = jnp.argsort(hi_i[o1], stable=True)
+    order = o1[o2]
+    hi_s = hi[order]
+    lo_s = lo[order]
+    v_s = vals[order]
     first = jnp.concatenate([
         jnp.ones((1,), bool),
         (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1]),

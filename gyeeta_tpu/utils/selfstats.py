@@ -1,16 +1,29 @@
-"""Framework self-metrics: named counters/gauges + periodic snapshots.
+"""Framework self-metrics: counters/gauges + per-stage timing histograms.
 
 The reference instruments itself with per-subsystem ``STATS_STR_MAP``
 counters printed on a cadence (``server/gy_mconnhdlr.h:46``,
-``print_stats()`` on pools/captures) and a deferred print-offload thread.
-Here: a process-wide registry with O(1) bumps on the ingest path and a
-``snapshot()``/``delta()`` readback the runtime logs each minute.
+``print_stats()`` on pools/captures), per-stage latency histograms
+(``GY_HISTOGRAM`` wrappers around the hot paths) and a deferred
+print-offload thread. Here: a process-wide registry with O(1) bumps on
+the ingest path, geometric-bucket timing histograms recorded via a
+``timeit`` context manager, and a ``snapshot()``/``delta()``/
+``timing_rows()`` readback surfaced by the ``selfstats`` query subsystem.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
+import math
 import time
+
+import numpy as np
+
+# timing buckets: 10us .. ~1000s, ×1.35 geometric (64 buckets)
+_T_VMIN_MS = 0.01
+_T_GAMMA = 1.35
+_T_NB = 64
+_T_LOG_GAMMA = math.log(_T_GAMMA)
 
 
 class Stats:
@@ -18,6 +31,8 @@ class Stats:
         self.counters: collections.Counter = collections.Counter()
         self.gauges: dict = {}
         self._last: dict = {}
+        self._timings: dict[str, np.ndarray] = {}
+        self._t_sum_ms: collections.Counter = collections.Counter()
         self.t_start = time.time()
 
     def bump(self, name: str, n=1):
@@ -25,6 +40,46 @@ class Stats:
 
     def gauge(self, name: str, v):
         self.gauges[name] = v
+
+    # ------------------------------------------------------------ timing
+    def observe_ms(self, name: str, ms: float) -> None:
+        h = self._timings.get(name)
+        if h is None:
+            h = self._timings[name] = np.zeros(_T_NB, np.int64)
+        b = 0 if ms <= _T_VMIN_MS else min(
+            _T_NB - 1, int(math.log(ms / _T_VMIN_MS) / _T_LOG_GAMMA) + 1)
+        h[b] += 1
+        self._t_sum_ms[name] += ms
+
+    @contextlib.contextmanager
+    def timeit(self, name: str):
+        """Per-stage wall-time histogram (the GY_HISTOGRAM analogue)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe_ms(name, (time.perf_counter() - t0) * 1e3)
+
+    @staticmethod
+    def _bucket_ms(b: int) -> float:
+        return _T_VMIN_MS * _T_GAMMA ** max(0, b - 1)
+
+    def timing_rows(self) -> list[dict]:
+        """One row per timed stage: count + p50/p95/p99 + total."""
+        out = []
+        for name, h in sorted(self._timings.items()):
+            n = int(h.sum())
+            if n == 0:
+                continue
+            cum = np.cumsum(h)
+            row = {"stage": name, "count": n,
+                   "totalms": round(float(self._t_sum_ms[name]), 3)}
+            for q, col in ((0.5, "p50ms"), (0.95, "p95ms"),
+                           (0.99, "p99ms")):
+                b = int(np.searchsorted(cum, q * n))
+                row[col] = round(self._bucket_ms(b), 4)
+            out.append(row)
+        return out
 
     def snapshot(self) -> dict:
         out = dict(self.counters)
@@ -38,3 +93,11 @@ class Stats:
         out = {k: v - self._last.get(k, 0) for k, v in cur.items()}
         self._last = cur
         return {k: v for k, v in out.items() if v}
+
+
+def selfstats_response(stats: Stats, alerts=None) -> dict:
+    """The ``selfstats`` query-subsystem payload (shared by both
+    runtimes so the surface cannot drift)."""
+    return {"counters": stats.snapshot(),
+            "timings": stats.timing_rows(),
+            "alerts": dict(alerts.stats) if alerts is not None else {}}

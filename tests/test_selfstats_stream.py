@@ -1,0 +1,119 @@
+"""Self-profiling timings + streamed (chunked) query responses.
+
+Aux-subsystem coverage: per-stage timing histograms (ref GY_HISTOGRAM
+wrappers + print_stats cadence) and the webserver's large-response
+streaming discipline (16MB frame chunks).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.net import GytServer, NetAgent, QueryClient
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.utils.selfstats import Stats
+
+CFG = EngineCfg(n_hosts=4, svc_capacity=64, conn_batch=64, resp_batch=64,
+                fold_k=2)
+
+
+def test_timing_histogram_percentiles():
+    s = Stats()
+    for ms in (1.0,) * 90 + (100.0,) * 10:
+        s.observe_ms("stage", ms)
+    (row,) = s.timing_rows()
+    assert row["count"] == 100
+    assert 0.5 <= row["p50ms"] <= 2.0
+    assert 60.0 <= row["p99ms"] <= 180.0
+    assert abs(row["totalms"] - (90 + 1000)) < 1e-6
+
+
+def test_timeit_context():
+    import time
+
+    s = Stats()
+    with s.timeit("sleepy"):
+        time.sleep(0.01)
+    (row,) = s.timing_rows()
+    assert row["stage"] == "sleepy" and row["count"] == 1
+    assert row["totalms"] >= 9.0
+
+
+def test_runtime_selfstats_surface():
+    rt = Runtime(CFG)
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=3)
+    rt.feed(sim.conn_frames(128) + sim.resp_frames(128))
+    rt.run_tick()
+    rt.query({"subsys": "svcstate"})
+    out = rt.query({"subsys": "selfstats"})
+    stages = {r["stage"] for r in out["timings"]}
+    assert {"deframe", "fold_dispatch", "tick", "query"} <= stages
+    assert out["counters"]["conn_events"] == 128
+    assert "nchecks" in out["alerts"]
+
+
+def test_query_frames_roundtrip_small_and_large():
+    small = {"a": 1}
+    buf = wire.encode_query_frames(7, small)
+    frames, consumed = wire.decode_frames(buf)
+    assert consumed == len(buf) and len(frames) == 0  # QUERY_RESP ≠ EVENT
+    # decode manually: one frame
+    hdr = np.frombuffer(buf, wire.HEADER_DT, count=1)[0]
+    payload = buf[wire.HEADER_DT.itemsize: int(hdr["total_sz"])
+                  - int(hdr["padding_sz"])]
+    seq, status, body = wire.decode_query_chunk(payload)
+    assert (seq, status) == (7, wire.QS_OK)
+
+    big = {"rows": ["x" * 100] * 40_000}       # ~4MB JSON
+    buf = wire.encode_query_frames(9, big, chunk_bytes=1 << 20)
+    # walk frames: all QS_PARTIAL except the last
+    off, statuses, body = 0, [], b""
+    while off < len(buf):
+        hdr = np.frombuffer(buf, wire.HEADER_DT, count=1, offset=off)[0]
+        total, pad = int(hdr["total_sz"]), int(hdr["padding_sz"])
+        payload = buf[off + wire.HEADER_DT.itemsize: off + total - pad]
+        seq, status, chunk = wire.decode_query_chunk(payload)
+        assert seq == 9
+        statuses.append(status)
+        body += chunk
+        off += total
+    assert statuses[-1] == wire.QS_OK
+    assert all(s == wire.QS_PARTIAL for s in statuses[:-1])
+    assert len(statuses) > 3
+    import json
+    assert json.loads(body) == big
+
+
+def test_large_response_over_socket():
+    """A >1MB query response streams in chunks and reassembles."""
+
+    async def scenario():
+        cfg = CFG._replace(svc_capacity=2048, n_hosts=8,
+                           task_capacity=4096)
+        rt = Runtime(cfg)
+        srv = GytServer(rt, tick_interval=None)
+        host, port = await srv.start()
+        a = NetAgent(seed=0, n_svcs=4, n_groups=200)
+        await a.connect(host, port)
+        for _ in range(2):
+            await a.send_sweep(n_conn=256, n_resp=256)
+        await asyncio.sleep(0.05)
+        rt.flush()
+        qc = QueryClient()
+        await qc.connect(host, port)
+        out = await qc.query({"subsys": "taskstate", "maxrecs": 4096})
+        # and selfstats over the wire too
+        ss = await qc.query({"subsys": "selfstats"})
+        await qc.close()
+        await a.close()
+        await srv.stop()
+        return out, ss
+
+    out, ss = asyncio.run(scenario())
+    assert out["nrecs"] == 200
+    assert ss["counters"]["net_queries"] >= 1
