@@ -7,6 +7,5 @@ for ab in combos:
     env = dict(os.environ, GYT_BENCH_ABLATE=ab)
     p = subprocess.run([sys.executable, "bench.py"], env=env,
                        capture_output=True, text=True, timeout=900)
-    line = [l for l in p.stdout.splitlines() if l.startswith("{")]
     ms = [l for l in p.stderr.splitlines() if "ms/microbatch" in l]
     print(f"{ab or 'FULL':44s} {ms[0].split('(')[-1] if ms else p.stderr[-200:]}")

@@ -209,9 +209,22 @@ class GytServer:
                 # large results stream as QS_PARTIAL chunks with a drain
                 # per chunk: bounded transport memory (the 16MB-frame /
                 # multi-GB discipline of the reference webserver)
-                for frame in wire.iter_query_frames(seqid, out,
-                                                    wire.QS_OK):
-                    writer.write(frame)
-                    await writer.drain()
+                sent = 0
+                try:
+                    for frame in wire.iter_query_frames(seqid, out,
+                                                        wire.QS_OK):
+                        writer.write(frame)
+                        await writer.drain()
+                        sent += 1
+                except Exception as e:
+                    if sent == 0 and not isinstance(e, ConnectionError):
+                        # e.g. unserializable result: the query still
+                        # gets its QS_ERROR and the conn survives
+                        writer.write(wire.encode_query(
+                            seqid, {"error": str(e)}, wire.QS_ERROR,
+                            resp=True))
+                        await writer.drain()
+                    else:
+                        raise   # mid-stream failure: close (resync)
             finally:
                 outstanding -= 1

@@ -291,20 +291,26 @@ def dep_step_fn(mesh, cap_per_dest: int):
     """Compiled sharded step: (dep_stacked, conn_stacked, tick) → dep.
 
     Direct (both-sides-known) lanes fold into the local shard's edge slab.
-    One-sided halves ride the capacity-disciplined ``all_to_all`` to the
-    flow-owner shard (payload columns travel with the key) and pair there.
+    One-sided halves ride the capacity-disciplined staged ``all_to_all``
+    to the flow-owner shard (payload columns travel with the key; on a
+    multi-slice mesh the DCN axis is crossed at most once) and pair there.
     """
-    n = mesh.devices.size
+    from gyeeta_tpu.parallel.mesh import axes_of
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS), P(HOST_AXIS),
-                                                 P()),
-             out_specs=P(HOST_AXIS), check_vma=False)
+    n = mesh.devices.size
+    axes = axes_of(mesh)
+    sizes = tuple(mesh.shape[a] for a in axes)
+    spec = P(axes)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, P()),
+             out_specs=spec, check_vma=False)
     def _step(dep, cb, tick):
         local = jax.tree.map(lambda x: x[0], dep)
         cb = jax.tree.map(lambda x: x[0], cb)
         direct, hv = halves_from_conn(cb)
         local = fold_edges(local, *direct, tick)
-        routed, o_drop = _dispatch_halves(hv, n, cap_per_dest)
+        routed, o_drop = _dispatch_halves(hv, axes, sizes, n,
+                                          cap_per_dest)
         local = local._replace(n_dropped=local.n_dropped + o_drop)
         local = pair_halves(local, routed, tick)
         return jax.tree.map(lambda x: x[None], local)
@@ -312,41 +318,25 @@ def dep_step_fn(mesh, cap_per_dest: int):
     return jax.jit(_step, donate_argnums=(0,))
 
 
-def _dispatch_halves(hv: Halves, n: int, cap: int):
-    """all_to_all capacity dispatch of Halves → received Halves."""
-    B = hv.flow_hi.shape[0]
-    dest = owner_shard(hv.flow_hi, hv.flow_lo, n).astype(jnp.int32)
-    dest = jnp.where(hv.valid, dest, n)
-    order = jnp.argsort(dest)
-    d_s = dest[order]
-    counts = jnp.bincount(d_s, length=n + 1)
-    offsets = jnp.cumsum(counts) - counts
-    pos = jnp.arange(B, dtype=jnp.int32) - offsets[d_s]
-    keep = (d_s < n) & (pos < cap)
-    slot = jnp.where(keep, d_s * cap + pos, n * cap)
+def _dispatch_halves(hv: Halves, axes, sizes, n: int, cap: int):
+    """Staged all_to_all capacity dispatch of Halves → received Halves."""
+    from gyeeta_tpu.parallel.pairing import dispatch_fields
 
-    def scatter(x, fill):
-        buf = jnp.full((n * cap,) + x.shape[1:], fill, x.dtype)
-        return buf.at[slot].set(x[order], mode="drop")
-
-    routed = Halves(
-        flow_hi=scatter(hv.flow_hi.astype(jnp.uint32), 0),
-        flow_lo=scatter(hv.flow_lo.astype(jnp.uint32), 0),
-        is_cli=scatter(hv.is_cli, False),
-        pay_hi=scatter(hv.pay_hi.astype(jnp.uint32), 0),
-        pay_lo=scatter(hv.pay_lo.astype(jnp.uint32), 0),
-        pay_svc=scatter(hv.pay_svc, False),
-        byts=scatter(hv.byts, 0.0),
-        valid=jnp.zeros((n * cap,), bool).at[slot].set(keep, mode="drop"),
-    )
-
-    def a2a(x):
-        return lax.all_to_all(x.reshape((n, cap) + x.shape[1:]), HOST_AXIS,
-                              split_axis=0, concat_axis=0).reshape(
-                                  (n * cap,) + x.shape[1:])
-
-    dropped = (jnp.sum(hv.valid) - jnp.sum(keep)).astype(jnp.float32)
-    return jax.tree.map(a2a, routed), dropped
+    owner = owner_shard(hv.flow_hi, hv.flow_lo, n)
+    routed, r_val, dropped = dispatch_fields(
+        {"fhi": (hv.flow_hi.astype(jnp.uint32), 0),
+         "flo": (hv.flow_lo.astype(jnp.uint32), 0),
+         "cli": (hv.is_cli, False),
+         "phi": (hv.pay_hi.astype(jnp.uint32), 0),
+         "plo": (hv.pay_lo.astype(jnp.uint32), 0),
+         "psvc": (hv.pay_svc, False),
+         "byts": (hv.byts, 0.0)},
+        hv.valid, owner, axes, sizes, cap)
+    return Halves(
+        flow_hi=routed["fhi"], flow_lo=routed["flo"],
+        is_cli=routed["cli"], pay_hi=routed["phi"],
+        pay_lo=routed["plo"], pay_svc=routed["psvc"],
+        byts=routed["byts"], valid=r_val), dropped
 
 
 # ------------------------------------------------------------ edge rollup
@@ -396,13 +386,18 @@ def edges_local(dep: DepGraph) -> EdgeSet:
 
 def edge_rollup_fn(mesh, out_capacity: int):
     """Compiled sharded DepGraph → replicated merged EdgeSet."""
+    from gyeeta_tpu.parallel.mesh import axes_of
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS), out_specs=P(),
+    axes = axes_of(mesh)
+
+    from gyeeta_tpu.parallel.mesh import gather_all
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axes), out_specs=P(),
              check_vma=False)
     def _roll(dep):
         local = jax.tree.map(lambda x: x[0], dep)
         live = table.live_mask(local.edge_tbl)
-        g = lambda x: lax.all_gather(x, HOST_AXIS, tiled=True)  # noqa: E731
+        g = lambda x: gather_all(x, axes)       # noqa: E731
         return _edge_merge(
             out_capacity, g(local.e_cli_hi), g(local.e_cli_lo),
             g(local.e_cli_svc), g(local.e_ser_hi), g(local.e_ser_lo),

@@ -60,39 +60,75 @@ def owner_shard(flow_hi, flow_lo, n_shards: int):
     return H.mix64(flow_hi, flow_lo, _OWNER_SALT) % n_shards
 
 
-def _dispatch(flow_hi, flow_lo, is_cli, valid, n: int, cap: int):
-    """Capacity-limited all_to_all dispatch of (B,) lanes → received lanes.
+def dispatch_fields(fields: dict, valid, owner, axes: tuple,
+                    sizes: tuple, cap: int):
+    """Route lanes to their owner device: one capacity-bucketed
+    ``all_to_all`` per mesh axis, outermost (DCN) first.
 
-    Returns (r_hi, r_lo, r_cli, r_valid) of shape (n*cap,) on each shard,
-    plus the local count of overflow-dropped lanes.
+    ``fields``: {name: ((B,) array, fill)}; ``owner``: (B,) global owner
+    device index (row-major over ``sizes``). On a 1-D mesh this is the
+    single-stage EP-style dispatch; on a multi-slice mesh each lane
+    crosses the DCN axis at most once (to its owner slice) and then hops
+    ICI to the owner lane — the hierarchical madhava→shyama routing.
+    Stage k's per-destination cap is ``cap × (owners downstream)`` so an
+    outer stage never throttles below the final per-owner capacity.
+    Returns (routed_fields, routed_valid, dropped_count).
     """
-    B = flow_hi.shape[0]
-    dest = owner_shard(flow_hi, flow_lo, n).astype(jnp.int32)
-    dest = jnp.where(valid, dest, n)                   # invalid → trash bin
-    order = jnp.argsort(dest)                          # stable
-    d_s = dest[order]
-    counts = jnp.bincount(d_s, length=n + 1)
-    offsets = jnp.cumsum(counts) - counts              # exclusive prefix
-    pos = jnp.arange(B, dtype=jnp.int32) - offsets[d_s]
-    keep = (d_s < n) & (pos < cap)
-    slot = jnp.where(keep, d_s * cap + pos, n * cap)
+    names = list(fields)
+    arrs = {k: fields[k][0] for k in names}
+    fills = {k: fields[k][1] for k in names}
+    owner = owner.astype(jnp.int32)
+    dropped = jnp.zeros((), jnp.float32)
+    stride = 1
+    for s in sizes[1:]:
+        stride *= s
+    for k, (ax, m) in enumerate(zip(axes, sizes)):
+        B = valid.shape[0]
+        cap_k = cap * stride
+        dest = jnp.where(valid, (owner // stride) % m, m)
+        order = jnp.argsort(dest)                      # stable
+        d_s = dest[order]
+        counts = jnp.bincount(d_s, length=m + 1)
+        offsets = jnp.cumsum(counts) - counts          # exclusive prefix
+        pos = jnp.arange(B, dtype=jnp.int32) - offsets[d_s]
+        keep = (d_s < m) & (pos < cap_k)
+        slot = jnp.where(keep, d_s * cap_k + pos, m * cap_k)
 
-    def scatter(x, fill):
-        buf = jnp.full((n * cap,) + x.shape[1:], fill, x.dtype)
-        return buf.at[slot].set(x[order], mode="drop")
+        def scatter(x, fill):
+            buf = jnp.full((m * cap_k,) + x.shape[1:], fill, x.dtype)
+            return buf.at[slot].set(x[order], mode="drop")
 
-    b_hi = scatter(flow_hi.astype(jnp.uint32), 0)
-    b_lo = scatter(flow_lo.astype(jnp.uint32), 0)
-    b_cli = scatter(is_cli, False)
-    b_val = jnp.zeros((n * cap,), bool).at[slot].set(keep, mode="drop")
+        def a2a(x):
+            return lax.all_to_all(
+                x.reshape((m, cap_k) + x.shape[1:]), ax,
+                split_axis=0, concat_axis=0).reshape(
+                    (m * cap_k,) + x.shape[1:])
 
-    def a2a(x):
-        return lax.all_to_all(x.reshape((n, cap) + x.shape[1:]), HOST_AXIS,
-                              split_axis=0, concat_axis=0).reshape(
-                                  (n * cap,) + x.shape[1:])
+        dropped = dropped + (jnp.sum(valid)
+                             - jnp.sum(keep)).astype(jnp.float32)
+        new_valid = jnp.zeros((m * cap_k,), bool).at[slot].set(
+            keep, mode="drop")
+        arrs = {kk: a2a(scatter(arrs[kk], fills[kk])) for kk in names}
+        valid = a2a(new_valid)
+        if k + 1 < len(sizes):
+            # owner only rides along while later stages still route by it
+            owner = a2a(scatter(owner, 0))
+            stride //= sizes[k + 1]
+    return arrs, valid, dropped
 
-    dropped = (jnp.sum(valid) - jnp.sum(keep)).astype(jnp.float32)
-    return a2a(b_hi), a2a(b_lo), a2a(b_cli), a2a(b_val), dropped
+
+def _dispatch(flow_hi, flow_lo, is_cli, valid, axes, sizes, cap: int):
+    """Pairing-lane dispatch (see :func:`dispatch_fields`)."""
+    n = 1
+    for s in sizes:
+        n *= s
+    owner = owner_shard(flow_hi, flow_lo, n)
+    routed, r_val, dropped = dispatch_fields(
+        {"hi": (flow_hi.astype(jnp.uint32), 0),
+         "lo": (flow_lo.astype(jnp.uint32), 0),
+         "cli": (is_cli, False)},
+        valid, owner, axes, sizes, cap)
+    return routed["hi"], routed["lo"], routed["cli"], r_val, dropped
 
 
 def _pair_local(pt: PairTable, r_hi, r_lo, r_cli, r_valid) -> PairTable:
@@ -115,10 +151,10 @@ def _pair_local(pt: PairTable, r_hi, r_lo, r_cli, r_valid) -> PairTable:
 
 
 def pair_init_sharded(mesh, capacity: int) -> PairTable:
-    """Stacked (n_shards, ...) pair table laid out over the mesh axis."""
-    from jax.sharding import NamedSharding
+    """Stacked (n_shards, ...) pair table laid out over the mesh axes."""
+    from gyeeta_tpu.parallel.mesh import leading_sharding
     n = mesh.devices.size
-    shd = NamedSharding(mesh, P(HOST_AXIS))
+    shd = leading_sharding(mesh)
 
     @partial(jax.jit, out_shardings=shd)
     def _init():
@@ -134,23 +170,29 @@ def pairing_fn(mesh, cap_per_dest: int):
 
     ``halves`` leaves are (n_shards, B) stacked: flow_hi, flow_lo, is_cli,
     valid. ``stats`` is replicated: total pairs completed, total dropped.
+    Works on 1-D and multi-slice meshes (staged dispatch).
     """
-    n = mesh.devices.size
+    from gyeeta_tpu.parallel.mesh import axes_of
+
+    axes = axes_of(mesh)
+    sizes = tuple(mesh.shape[a] for a in axes)
+    spec = P(axes)
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P(HOST_AXIS),) * 5, out_specs=(P(HOST_AXIS), P()),
+             in_specs=(spec,) * 5, out_specs=(spec, P()),
              check_vma=False)
     def _step(pt, fhi, flo, is_cli, valid):
         local = jax.tree.map(lambda x: x[0], pt)
         r_hi, r_lo, r_cli, r_val, o_drop = _dispatch(
-            fhi[0], flo[0], is_cli[0], valid[0], n, cap_per_dest)
+            fhi[0], flo[0], is_cli[0], valid[0], axes, sizes,
+            cap_per_dest)
         local = local._replace(n_dropped=local.n_dropped + o_drop)
         local = _pair_local(local, r_hi, r_lo, r_cli, r_val)
         stats = {
-            "n_paired": lax.psum(local.n_paired, HOST_AXIS),
-            "n_dropped": lax.psum(local.n_dropped, HOST_AXIS),
+            "n_paired": lax.psum(local.n_paired, axes),
+            "n_dropped": lax.psum(local.n_dropped, axes),
             "n_table_live": lax.psum(
-                local.tbl.n_live.astype(jnp.float32), HOST_AXIS),
+                local.tbl.n_live.astype(jnp.float32), axes),
         }
         return jax.tree.map(lambda x: x[None], local), stats
 

@@ -43,15 +43,22 @@ class GlobalRollup(NamedTuple):
     n_hosts_up: jnp.ndarray    # () hosts that have reported
 
 
-def _rollup_local(st: aggstate.AggState) -> GlobalRollup:
-    """Collective merge of one shard's state (runs inside shard_map)."""
-    regs = lax.pmax(st.glob_hll.regs, HOST_AXIS)
-    cms_counts = lax.psum(st.cms.counts, HOST_AXIS)
+from gyeeta_tpu.parallel.mesh import gather_all as _gather_all  # noqa: E402
 
-    hi = lax.all_gather(st.flow_topk.key_hi, HOST_AXIS, tiled=True)
-    lo = lax.all_gather(st.flow_topk.key_lo, HOST_AXIS, tiled=True)
-    cnt = lax.all_gather(st.flow_topk.counts, HOST_AXIS, tiled=True)
-    evicted = lax.psum(st.flow_topk.evicted, HOST_AXIS)
+
+def _rollup_local(st: aggstate.AggState,
+                  axes=(HOST_AXIS,)) -> GlobalRollup:
+    """Collective merge of one shard's state (runs inside shard_map).
+    ``axes`` covers every mesh axis: on a multi-slice mesh the psum/pmax
+    ride ICI within a slice first, then DCN across slices — XLA routes
+    the named-axis reduction hierarchically."""
+    regs = lax.pmax(st.glob_hll.regs, axes)
+    cms_counts = lax.psum(st.cms.counts, axes)
+
+    hi = _gather_all(st.flow_topk.key_hi, axes)
+    lo = _gather_all(st.flow_topk.key_lo, axes)
+    cnt = _gather_all(st.flow_topk.counts, axes)
+    evicted = lax.psum(st.flow_topk.evicted, axes)
     cap = st.flow_topk.counts.shape[0]
     merged_topk = topk._combine(hi, lo, cnt, cap, evicted)
 
@@ -61,23 +68,26 @@ def _rollup_local(st: aggstate.AggState) -> GlobalRollup:
         glob_hll=hll.HLL(regs=regs),
         cms=countmin.CMS(counts=cms_counts),
         flow_topk=merged_topk,
-        n_conn=lax.psum(st.n_conn, HOST_AXIS),
-        n_resp=lax.psum(st.n_resp, HOST_AXIS),
-        n_svc_live=lax.psum(live, HOST_AXIS),
+        n_conn=lax.psum(st.n_conn, axes),
+        n_resp=lax.psum(st.n_resp, axes),
+        n_svc_live=lax.psum(live, axes),
         host_totals=lax.psum(
             jnp.sum(jnp.where(reported[:, None], st.host_panel, 0.0),
-                    axis=0), HOST_AXIS),
+                    axis=0), axes),
         n_hosts_up=lax.psum(jnp.sum(reported).astype(jnp.float32),
-                            HOST_AXIS),
+                            axes),
     )
 
 
 def rollup_fn(cfg: aggstate.EngineCfg, mesh):
     """Compiled sharded-state → replicated GlobalRollup."""
+    from gyeeta_tpu.parallel.mesh import axes_of
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
+    axes = axes_of(mesh)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axes),
              out_specs=P(), check_vma=False)
     def _roll(st):
-        return _rollup_local(jax.tree.map(lambda x: x[0], st))
+        return _rollup_local(jax.tree.map(lambda x: x[0], st), axes)
 
     return jax.jit(_roll)

@@ -19,8 +19,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from gyeeta_tpu.engine import aggstate, step
-from gyeeta_tpu.parallel.mesh import HOST_AXIS, leading_sharding, \
-    shard_of_host
+from gyeeta_tpu.parallel.mesh import HOST_AXIS, axes_of, \
+    leading_sharding, shard_of_host
 
 
 def _local(tree):
@@ -77,8 +77,8 @@ def put_sharded(mesh, batch):
 def fold_step_sharded(cfg: aggstate.EngineCfg, mesh):
     """Compiled sharded flagship step: (state, conn, resp) → state."""
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 3,
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 3,
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _step(st, cb, rb):
         return _relocal(step.fold_step(cfg, _local(st), _local(cb),
                                        _local(rb)))
@@ -87,8 +87,8 @@ def fold_step_sharded(cfg: aggstate.EngineCfg, mesh):
 
 
 def tick_5s_sharded(cfg: aggstate.EngineCfg, mesh):
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axes_of(mesh)),
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _tick(st):
         return _relocal(step.tick_5s(cfg, _local(st)))
 
@@ -96,8 +96,8 @@ def tick_5s_sharded(cfg: aggstate.EngineCfg, mesh):
 
 
 def ingest_listener_sharded(cfg: aggstate.EngineCfg, mesh):
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 2,
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _fold(st, lb):
         return _relocal(step.ingest_listener(cfg, _local(st), _local(lb)))
 
@@ -105,8 +105,8 @@ def ingest_listener_sharded(cfg: aggstate.EngineCfg, mesh):
 
 
 def ingest_host_sharded(cfg: aggstate.EngineCfg, mesh):
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 2,
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _fold(st, hb):
         return _relocal(step.ingest_host(cfg, _local(st), _local(hb)))
 
@@ -114,8 +114,8 @@ def ingest_host_sharded(cfg: aggstate.EngineCfg, mesh):
 
 
 def ingest_cpumem_sharded(cfg: aggstate.EngineCfg, mesh):
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 2,
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _fold(st, cm):
         return _relocal(step.ingest_cpumem(cfg, _local(st), _local(cm)))
 
@@ -123,8 +123,8 @@ def ingest_cpumem_sharded(cfg: aggstate.EngineCfg, mesh):
 
 
 def ingest_trace_sharded(cfg: aggstate.EngineCfg, mesh):
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 2,
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _fold(st, tb):
         return _relocal(step.ingest_trace(cfg, _local(st), _local(tb)))
 
@@ -132,8 +132,8 @@ def ingest_trace_sharded(cfg: aggstate.EngineCfg, mesh):
 
 
 def ingest_task_sharded(cfg: aggstate.EngineCfg, mesh):
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(HOST_AXIS),) * 2,
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 2,
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _fold(st, tb):
         return _relocal(step.ingest_task(cfg, _local(st), _local(tb)))
 
@@ -145,8 +145,8 @@ def classify_sharded(cfg: aggstate.EngineCfg, mesh):
     classifies its own services/hosts — the per-madhava sweep)."""
     from gyeeta_tpu.semantic import derive
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axes_of(mesh)),
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _cls(st):
         return _relocal(derive.classify_pass(cfg, _local(st)))
 
@@ -154,8 +154,8 @@ def classify_sharded(cfg: aggstate.EngineCfg, mesh):
 
 
 def age_tasks_sharded(cfg: aggstate.EngineCfg, mesh, max_age_ticks: int):
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axes_of(mesh)),
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _age(st):
         return _relocal(step.age_tasks(cfg, _local(st), max_age_ticks))
 
@@ -163,8 +163,8 @@ def age_tasks_sharded(cfg: aggstate.EngineCfg, mesh, max_age_ticks: int):
 
 
 def age_apis_sharded(cfg: aggstate.EngineCfg, mesh, max_age_ticks: int):
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(HOST_AXIS),
-             out_specs=P(HOST_AXIS), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axes_of(mesh)),
+             out_specs=P(axes_of(mesh)), check_vma=False)
     def _age(st):
         return _relocal(step.age_apis(cfg, _local(st), max_age_ticks))
 

@@ -93,12 +93,13 @@ class ShardedRuntime:
         from functools import partial
         from jax.sharding import PartitionSpec as P
 
-        from gyeeta_tpu.parallel.mesh import HOST_AXIS
+        from gyeeta_tpu.parallel.mesh import axes_of
         pttl, ettl = (self.opts.dep_pair_ttl_ticks,
                       self.opts.dep_edge_ttl_ticks)
+        _axes = axes_of(self.mesh)
 
         @partial(jax.shard_map, mesh=self.mesh,
-                 in_specs=(P(HOST_AXIS), P()), out_specs=P(HOST_AXIS),
+                 in_specs=(P(_axes), P()), out_specs=P(_axes),
                  check_vma=False)
         def _dep_age(dep, tick):
             local = jax.tree.map(lambda x: x[0], dep)

@@ -1,0 +1,107 @@
+"""Multi-slice (DCN) mesh: the 2-D tier of SURVEY §2.6.
+
+The 8 virtual CPU devices form a 4-slice × 2-host mesh; every result
+must match the 1-D mesh and single-node runtime on identical streams.
+The pairing dispatch is staged (one all_to_all per axis) so flows cross
+the slice (DCN) axis at most once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.parallel import make_mesh
+from gyeeta_tpu.parallel.mesh import axes_of, make_mesh2d
+from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.utils.config import RuntimeOpts
+
+CFG = EngineCfg(n_hosts=16, svc_capacity=256, task_capacity=256,
+                conn_batch=256, resp_batch=512, listener_batch=64,
+                fold_k=2)
+OPTS = RuntimeOpts(dep_pair_capacity=2048, dep_edge_capacity=512)
+
+
+def test_mesh2d_shape_and_axes():
+    mesh = make_mesh2d(4, 2)
+    assert axes_of(mesh) == ("slices", "hosts")
+    assert mesh.shape == {"slices": 4, "hosts": 2}
+
+
+def test_full_loop_matches_1d_and_single():
+    sim = ParthaSim(n_hosts=16, n_svcs=3, seed=51)
+    bufs = [sim.name_frames()]
+    for _ in range(2):
+        bufs.append(sim.conn_frames(512) + sim.resp_frames(1024)
+                    + sim.listener_frames() + sim.task_frames()
+                    + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                        sim.host_state_records()))
+    rt = Runtime(CFG, OPTS)
+    s1 = ShardedRuntime(CFG, make_mesh(8), OPTS)
+    s2 = ShardedRuntime(CFG, make_mesh2d(4, 2), OPTS)
+    for i, buf in enumerate(bufs):
+        for r in (rt, s1, s2):
+            r.feed(buf)
+        if i:
+            for r in (rt, s1, s2):
+                r.run_tick()
+    rt.flush()
+    q = {"subsys": "svcstate", "maxrecs": 1000}
+    a = {r["svcid"]: r for r in rt.query(q)["recs"]}
+    b = {r["svcid"]: r for r in s1.query(q)["recs"]}
+    c = {r["svcid"]: r for r in s2.query(q)["recs"]}
+    assert set(a) == set(b) == set(c) and len(a) == 48
+    for k in a:
+        assert a[k]["nqry5s"] == c[k]["nqry5s"]
+        assert a[k]["state"] == c[k]["state"]
+        assert np.isclose(b[k]["p95resp5s"], c[k]["p95resp5s"],
+                          rtol=1e-5)
+    # collective rollup across both axes
+    r1, r2 = s1.rollup_stats(), s2.rollup_stats()
+    assert r1 == r2
+    # flowstate rides pmax/psum/all_gather over (slices, hosts)
+    f1 = s1.query({"subsys": "flowstate", "maxrecs": 10})
+    f2 = s2.query({"subsys": "flowstate", "maxrecs": 10})
+    assert f1["recs"][0]["flowid"] == f2["recs"][0]["flowid"]
+
+
+def test_staged_pairing_crosses_dcn_once():
+    """Cross-shard halves pair correctly through the 2-stage dispatch."""
+    sim = ParthaSim(n_hosts=16, n_svcs=4, seed=53)
+    cli_side, ser_side = sim.svc_conn_records(256, split_halves=True)
+    s2 = ShardedRuntime(CFG, make_mesh2d(4, 2), OPTS)
+    s2.feed(sim.name_frames())
+    s2.feed(wire.encode_frame(wire.NOTIFY_TCP_CONN, cli_side))
+    s2.feed(wire.encode_frame(wire.NOTIFY_TCP_CONN, ser_side))
+    out = s2.query({"subsys": "svcdependency", "maxrecs": 512})
+    assert sum(r["nconn"] for r in out["recs"]) == 256
+    assert all(r["clisvc"] for r in out["recs"])
+    mesh_out = s2.query({"subsys": "svcmesh", "maxrecs": 512})
+    assert mesh_out["nrecs"] > 0
+
+
+def test_pairing_fn_2d_completes_all():
+    import jax
+
+    from gyeeta_tpu.parallel import pairing
+
+    mesh = make_mesh2d(2, 4)
+    n, B = 8, 64
+    pt = pairing.pair_init_sharded(mesh, 512)
+    rng = np.random.default_rng(7)
+    from gyeeta_tpu.parallel.mesh import leading_sharding
+    put = lambda x: jax.device_put(x, leading_sharding(mesh))  # noqa
+    fhi = rng.integers(1, 2**31, (n, B)).astype(np.uint32)
+    flo = rng.integers(1, 2**31, (n, B)).astype(np.uint32)
+    valid = np.ones((n, B), bool)
+    pair = pairing.pairing_fn(mesh, cap_per_dest=2 * B)
+    pt, _ = pair(pt, put(fhi), put(flo),
+                 put(np.ones((n, B), bool)), put(valid))
+    pt, stats = pair(pt, put(fhi), put(flo),
+                     put(np.zeros((n, B), bool)), put(valid))
+    assert float(stats["n_paired"]) == n * B
+    assert float(stats["n_dropped"]) == 0.0
