@@ -1,0 +1,3 @@
+from gyeeta_tpu.server_main import main
+
+main()

@@ -235,12 +235,22 @@ def conn_batch(recs: np.ndarray, size: int = wire.MAX_CONNS_PER_BATCH
     n = _check_fit(recs, size)
     r = recs[:n]
     svc_hi, svc_lo = split_u64(r["ser_glob_id"])
-    cip_hi, cip_lo = fold_ip(r["cli"]["ip"])
-    sip_hi, sip_lo = fold_ip(r["ser"]["ip"])
+    # NAT-aware flow identity: when conntrack resolved a translated
+    # tuple (nat_cli/nat_ser nonzero), both halves key on the POST-NAT
+    # 5-tuple — the only view the two sides share (the reference pairs
+    # via conntrack-translated tuples, common/gy_socket_stat.h NAT notes)
+    nat_c = r["nat_cli"]["ip"].any(axis=1)
+    nat_s = r["nat_ser"]["ip"].any(axis=1)
+    eff_cli = np.where(nat_c[:, None], r["nat_cli"]["ip"], r["cli"]["ip"])
+    eff_ser = np.where(nat_s[:, None], r["nat_ser"]["ip"], r["ser"]["ip"])
+    eff_cport = np.where(nat_c, r["nat_cli"]["port"], r["cli"]["port"])
+    eff_sport = np.where(nat_s, r["nat_ser"]["port"], r["ser"]["port"])
+    cip_hi, cip_lo = fold_ip(np.ascontiguousarray(eff_cli))
+    sip_hi, sip_lo = fold_ip(np.ascontiguousarray(eff_ser))
     proto = np.full(n, 6, np.uint32)  # TCP
     f_hi, f_lo = H.flow_key(cip_hi, cip_lo, sip_hi, sip_lo,
-                            r["cli"]["port"].astype(np.uint32),
-                            r["ser"]["port"].astype(np.uint32), proto)
+                            eff_cport.astype(np.uint32),
+                            eff_sport.astype(np.uint32), proto)
     # client endpoint identity = address hash only (distinct clients)
     c_hi = H.fmix32(cip_hi ^ np.uint32(0xC11E57))
     c_lo = H.fmix32(cip_lo ^ c_hi)
@@ -368,6 +378,9 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
     if tr is not None:
         for i in range(0, len(tr), wire.MAX_TRACE_PER_BATCH):
             yield ("trace", tr[i:i + wire.MAX_TRACE_PER_BATCH])
+    li = recs.get(wire.NOTIFY_LISTENER_INFO)
+    if li is not None:
+        yield ("listener_info", li)
     nm = recs.get(wire.NOTIFY_NAME_INTERN)
     if nm is not None:
         yield ("names", nm)

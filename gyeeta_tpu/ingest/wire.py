@@ -50,6 +50,7 @@ NOTIFY_AGGR_TASK_STATE = 14   # 5s per-process-group state
 NOTIFY_CPU_MEM_STATE = 15     # 2s host cpu/mem state
 NOTIFY_NAME_INTERN = 16       # string-intern announcements (TPU-first)
 NOTIFY_REQ_TRACE = 17         # request-trace transactions (per-API)
+NOTIFY_LISTENER_INFO = 18     # listener static metadata (ip/port/cmdline)
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -236,6 +237,29 @@ REQ_TRACE_DT = np.dtype([
 
 MAX_TRACE_PER_BATCH = 4096
 
+# LISTENER_INFO record — static listener metadata announced once per
+# listener (+ on reconnect): the field content of the reference's
+# NEW_LISTENER / LISTENER_INFO_REQ path (``gy_comm_proto.h:2499``,
+# listener tables ``common/gy_socket_stat.h``). Low-rate metadata: kept
+# host-side by the server (not a device slab) and joined into svcinfo
+# query rows.
+LISTENER_INFO_DT = np.dtype([
+    ("glob_id", "<u8"),
+    ("addr", IP_PORT_DT),
+    ("tusec_start", "<u8"),
+    ("cmdline_id", "<u8"),        # interned command line
+    ("comm_id", "<u8"),           # interned process comm
+    ("related_listen_id", "<u8"),
+    ("pid", "<i4"),
+    ("is_any_ip", "u1"),
+    ("is_http", "u1"),
+    ("pad", "u1", (2,)),
+    ("host_id", "<u4"),
+    ("pad2", "u1", (4,)),
+])
+
+MAX_LISTENER_INFO_PER_BATCH = 1024
+
 # NAME_INTERN — the host-side half of the fixed-width record contract: the
 # reference carries comm[16]/cmdline/issue strings inline in every record
 # (e.g. gy_comm_proto.h:1708 trailing cmdline); we instead intern strings
@@ -265,6 +289,7 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_CPU_MEM_STATE: CPU_MEM_DT,
     NOTIFY_NAME_INTERN: NAME_INTERN_DT,
     NOTIFY_REQ_TRACE: REQ_TRACE_DT,
+    NOTIFY_LISTENER_INFO: LISTENER_INFO_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -278,6 +303,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_CPU_MEM_STATE: MAX_CPUMEM_PER_BATCH,
     NOTIFY_NAME_INTERN: MAX_NAMES_PER_BATCH,
     NOTIFY_REQ_TRACE: MAX_TRACE_PER_BATCH,
+    NOTIFY_LISTENER_INFO: MAX_LISTENER_INFO_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -288,7 +314,8 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("AGGR_TASK_DT", AGGR_TASK_DT),
                    ("CPU_MEM_DT", CPU_MEM_DT),
                    ("NAME_INTERN_DT", NAME_INTERN_DT),
-                   ("REQ_TRACE_DT", REQ_TRACE_DT)]:
+                   ("REQ_TRACE_DT", REQ_TRACE_DT),
+                   ("LISTENER_INFO_DT", LISTENER_INFO_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 

@@ -89,10 +89,14 @@ class NetAgent:
         return hid
 
     async def send_names(self) -> None:
-        buf = self.sim.name_frames() + wire.encode_frame(
+        """Announce inventory: names + listener metadata (the reference
+        agent resends its listener inventory on reconnect)."""
+        buf = (self.sim.name_frames() + wire.encode_frame(
             wire.NOTIFY_NAME_INTERN,
             wire_name_record(wire.NAME_KIND_HOST, self.host_id,
                              f"agent-{self.host_id}.sim"))
+            + wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
+                                self.sim.listener_info_records()))
         self._writer.write(buf)
         await self._writer.drain()
 

@@ -56,6 +56,8 @@ class ShardedRuntime:
         self.opts = opts or RuntimeOpts()
         self.stats = Stats()
         self.names = InternTable()
+        from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
+        self.svcreg = SvcInfoRegistry()
         self.alerts = AlertManager(self.cfg, clock=clock)
         self._clock = clock or time.time
         self._tick_no = 0
@@ -166,6 +168,10 @@ class ShardedRuntime:
                     decode.trace_batch, chunks[0],
                     wire.MAX_TRACE_PER_BATCH))
                 n += len(chunks[0])
+            elif kind == "listener_info":
+                self.stats.bump("listener_infos",
+                                self.svcreg.update(chunks[0]))
+                n += len(chunks[0])
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
@@ -193,7 +199,10 @@ class ShardedRuntime:
     def _merged_columns(self, subsys: str):
         """Cluster-wide (cols, mask): per-shard provider outputs
         concatenated, or collective-rollup-backed for global subsystems."""
-        if subsys in (fieldmaps.SUBSYS_SVCDEP, fieldmaps.SUBSYS_SVCMESH):
+        if subsys == fieldmaps.SUBSYS_SVCINFO:
+            return self.svcreg.columns(self.names)
+        if subsys in (fieldmaps.SUBSYS_SVCDEP, fieldmaps.SUBSYS_SVCMESH,
+                      fieldmaps.SUBSYS_ACTIVECONN):
             es = self._edge_roll(self.dep)
             return self._dep_cols_from_edgeset(subsys, es)
         if subsys == fieldmaps.SUBSYS_FLOWSTATE:
@@ -228,6 +237,16 @@ class ShardedRuntime:
     def _dep_cols_from_edgeset(self, subsys: str, es):
         from gyeeta_tpu.engine import table
 
+        if subsys == fieldmaps.SUBSYS_ACTIVECONN:
+            snap = {
+                "e_live": np.asarray(table.live_mask(es.tbl)),
+                "e_ser_hi": np.asarray(es.ser_hi),
+                "e_ser_lo": np.asarray(es.ser_lo),
+                "e_nconn": np.asarray(es.nconn),
+                "e_bytes": np.asarray(es.byts),
+                "e_cli_svc": np.asarray(es.cli_svc),
+            }
+            return api.activeconn_from_edges(snap, self.names)
         if subsys == fieldmaps.SUBSYS_SVCMESH:
             cap = 2 * es.nconn.shape[0]
             ntbl, labels, sizes = self._mesh_clusters(es, cap)

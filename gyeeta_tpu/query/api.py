@@ -382,10 +382,65 @@ _COLUMNS_OF = {
     fieldmaps.SUBSYS_TRACEREQ: trace_columns,
 }
 
+def activeconn_from_edges(snap: dict, names=None):
+    """Group a dep-edge column snapshot by server service (shared by the
+    single-node and sharded activeconn providers). Vectorized: one
+    np.unique over packed server ids + np.add.at segment sums."""
+    from gyeeta_tpu.ingest import wire
+
+    live = np.nonzero(snap["e_live"])[0]
+    ser = ((snap["e_ser_hi"][live].astype(np.uint64) << np.uint64(32))
+           | snap["e_ser_lo"][live].astype(np.uint64))
+    ids, inv = np.unique(ser, return_inverse=True)
+    n = len(ids)
+
+    def segsum(vals):
+        out = np.zeros(n, np.float64)
+        np.add.at(out, inv, vals.astype(np.float64))
+        return out
+
+    hi = (ids >> np.uint64(32)).astype(np.uint32)
+    lo = (ids & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    cols = {
+        "svcid": _hex_id(hi, lo),
+        "svcname": _names_of(names, wire.NAME_KIND_SVC, hi, lo),
+        "nclients": segsum(np.ones(len(live))),
+        "nconn": segsum(snap["e_nconn"][live]),
+        "bytes": segsum(snap["e_bytes"][live]),
+        "nsvccli": segsum(snap["e_cli_svc"][live]),
+    }
+    return cols, np.ones(n, bool)
+
+
+def activeconn_columns(cfg: EngineCfg, st: AggState, names=None,
+                       dep=None) -> dict:
+    """activeconn subsystem: per-service caller rollup of the dep edges
+    (ref activeconn/clientconn views over DEPENDS maps)."""
+    if dep is None:
+        raise ValueError("activeconn needs a dependency graph")
+    snap = {k: np.asarray(v)
+            for k, v in readback.dep_edges_snapshot(dep).items()}
+    return activeconn_from_edges(snap, names)
+
+
+def svcinfo_columns(cfg: EngineCfg, st: AggState, names=None,
+                    svcreg=None) -> dict:
+    """svcinfo subsystem: host-side listener-metadata registry."""
+    if svcreg is None:
+        raise ValueError("svcinfo needs the listener-info registry")
+    return svcreg.columns(names)
+
+
 # subsystems whose columns come from the dependency graph, not AggState
 _DEP_COLUMNS_OF = {
     fieldmaps.SUBSYS_SVCDEP: dep_columns,
     fieldmaps.SUBSYS_SVCMESH: mesh_columns,
+    fieldmaps.SUBSYS_ACTIVECONN: activeconn_columns,
+}
+
+# subsystems backed by the host-side listener-metadata registry
+_SVCREG_COLUMNS_OF = {
+    fieldmaps.SUBSYS_SVCINFO: svcinfo_columns,
 }
 
 # top-N views: preset sort + limit over taskstate columns
@@ -398,7 +453,7 @@ _TOP_PRESETS = {
 
 
 def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
-            names=None, dep=None, columns_fn=None) -> dict:
+            names=None, dep=None, columns_fn=None, svcreg=None) -> dict:
     """Run one point-in-time query → {"recs": [...], "nrecs": N}.
 
     ``columns_fn(subsys) -> (cols, base_mask)`` overrides the column
@@ -409,8 +464,9 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
     """
     if opts.subsys not in fieldmaps.FIELDS_OF_SUBSYS:
         raise ValueError(f"unknown subsystem {opts.subsys!r}")
-    if columns_fn is None and opts.subsys not in _COLUMNS_OF \
-            and opts.subsys not in _DEP_COLUMNS_OF:
+    if columns_fn is None and not any(
+            opts.subsys in m for m in (_COLUMNS_OF, _DEP_COLUMNS_OF,
+                                       _SVCREG_COLUMNS_OF)):
         raise ValueError(f"unknown subsystem {opts.subsys!r}")
     preset = _TOP_PRESETS.get(opts.subsys)
     if preset is not None and opts.sortcol is None and not opts.aggr:
@@ -418,6 +474,9 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
                              maxrecs=min(opts.maxrecs, preset[1]))
     if columns_fn is not None:
         cols, base_mask = columns_fn(opts.subsys)
+    elif opts.subsys in _SVCREG_COLUMNS_OF:
+        cols, base_mask = _SVCREG_COLUMNS_OF[opts.subsys](
+            cfg, st, names=names, svcreg=svcreg)
     elif opts.subsys in _DEP_COLUMNS_OF:
         cols, base_mask = _DEP_COLUMNS_OF[opts.subsys](
             cfg, st, names=names, dep=dep)
@@ -471,7 +530,7 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
 
 
 def query_json(cfg: EngineCfg, st: AggState, req: dict,
-               names=None, dep=None) -> dict:
+               names=None, dep=None, svcreg=None) -> dict:
     """JSON-envelope entry point (the NM-conn QUERY_CMD analogue)."""
     return execute(cfg, st, QueryOptions.from_json(req), names=names,
-                   dep=dep)
+                   dep=dep, svcreg=svcreg)

@@ -31,6 +31,7 @@ SUBSYS_SVCDEP = "svcdependency"     # ref DEPENDS_LISTENER / svcprocmap
 SUBSYS_SVCMESH = "svcmesh"          # ref svc mesh clusters (shyama)
 SUBSYS_CPUMEM = "cpumem"            # ref cpumem (2s host cpu/mem state)
 SUBSYS_TRACEREQ = "tracereq"        # ref tracereq (request tracing)
+SUBSYS_ACTIVECONN = "activeconn"    # ref activeconn (per-svc client view)
 
 
 class FieldDef(NamedTuple):
@@ -249,6 +250,35 @@ TRACEREQ_FIELDS = (
     num("hostid", "hostid", "Last reporting host"),
 )
 
+# ---------------------------------------------------------------- svcinfo
+# ref json_db_svcinfo_arr: static listener metadata (announce-rate,
+# host-side registry utils/svcreg.py)
+SVCINFO_FIELDS = (
+    string("svcid", "svcid", "Service glob id (hex)"),
+    string("svcname", "svcname", "Service name (interned)"),
+    string("ip", "ip", "Bind address"),
+    num("port", "port", "Listen port"),
+    num("tstart", "tstart", "Listener start time (epoch sec)"),
+    string("comm", "comm", "Listener process comm"),
+    string("cmdline", "cmdline", "Command line (interned)"),
+    num("pid", "pid", "Listener pid"),
+    boolean("anyip", "anyip", "Bound to ANY address"),
+    boolean("ishttp", "ishttp", "Serves HTTP"),
+    num("hostid", "hostid", "Owning host id"),
+)
+
+# -------------------------------------------------------------- activeconn
+# ref json_db_activeconn_arr: the per-service client view of the
+# dependency edges (who talks to this service, how much)
+ACTIVECONN_FIELDS = (
+    string("svcid", "svcid", "Service glob id (hex)"),
+    string("svcname", "svcname", "Service name (interned)"),
+    num("nclients", "nclients", "Distinct caller entities"),
+    num("nconn", "nconn", "Flows folded"),
+    num("bytes", "bytes", "Total bytes"),
+    num("nsvccli", "nsvccli", "Callers that are services"),
+)
+
 # -------------------------------------------------------------- flowstate
 FLOWSTATE_FIELDS = (
     string("flowid", "flowid", "Flow key (hex)"),
@@ -269,6 +299,8 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_SVCMESH: SVCMESH_FIELDS,
     SUBSYS_CPUMEM: CPUMEM_FIELDS,
     SUBSYS_TRACEREQ: TRACEREQ_FIELDS,
+    SUBSYS_SVCINFO: SVCINFO_FIELDS,
+    SUBSYS_ACTIVECONN: ACTIVECONN_FIELDS,
 }
 
 

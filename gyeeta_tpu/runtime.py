@@ -79,6 +79,8 @@ class Runtime:
                                 self.opts.dep_edge_ttl_ticks),
             donate_argnums=(0,))
         self.names = InternTable()
+        from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
+        self.svcreg = SvcInfoRegistry()
         self._classify = derive.jit_classify_pass(self.cfg)
         self._empty_conn = decode.conn_batch(
             np.empty(0, wire.TCP_CONN_DT), self.cfg.conn_batch)
@@ -149,6 +151,10 @@ class Runtime:
                 self.state = self._fold_trace(self.state, trb)
                 n += len(chunks[0])
                 self.stats.bump("trace_records", len(chunks[0]))
+            elif kind == "listener_info":
+                self.stats.bump("listener_infos",
+                                self.svcreg.update(chunks[0]))
+                n += len(chunks[0])
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
@@ -291,7 +297,7 @@ class Runtime:
         self.flush()                  # live queries see all staged events
         self.stats.bump("queries")
         return api.query_json(self.cfg, self.state, req, names=self.names,
-                              dep=self.dep)
+                              dep=self.dep, svcreg=self.svcreg)
 
     def restore(self, path) -> dict:
         # drop staged microbatches and partial-frame bytes from before the

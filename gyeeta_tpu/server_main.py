@@ -1,0 +1,140 @@
+"""gyt-server: the deployable aggregation-server daemon.
+
+The process-hardening tier the reference builds in ``common/gy_init_proc``
+(+ madhava's ``main()``): config layering, structured startup logging,
+SIGTERM/SIGINT graceful shutdown (drain staged slabs, final checkpoint),
+SIGHUP hot-reload of runtime knobs, and a periodic self-stats report.
+Run as ``python -m gyeeta_tpu --port 10038 --config gyt.json``.
+
+Single-controller design: one asyncio loop owns the Runtime; the TPU
+pipeline is the concurrency (no forked child processes — the reference's
+parent/child split guards a multi-threaded C++ address space, which this
+architecture does not have).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+from typing import Optional
+
+from gyeeta_tpu.net.server import GytServer
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.utils import config as C
+
+log = logging.getLogger("gyeeta_tpu.daemon")
+
+
+class Daemon:
+    def __init__(self, args: argparse.Namespace):
+        self.args = args
+        cfg = C.load_engine_cfg(args.config)
+        opts = C.load_runtime_opts(
+            args.config,
+            **({"history_db": args.history_db} if args.history_db else {}),
+            **({"checkpoint_dir": args.checkpoint_dir}
+               if args.checkpoint_dir else {}))
+        self.rt = Runtime(cfg, opts)
+        if args.restore:
+            extra = self.rt.restore(args.restore)
+            log.info("restored checkpoint %s (tick %s)", args.restore,
+                     extra.get("tick"))
+        self.srv = GytServer(self.rt, host=args.host, port=args.port,
+                             tick_interval=args.tick_interval,
+                             hostmap_path=args.hostmap)
+        self._hot = C.HotReload(args.config, opts) if args.config else None
+        self.stop_event = asyncio.Event()
+
+    async def run(self) -> None:
+        host, port = await self.srv.start()
+        log.info("gyt-server listening on %s:%d (svc_capacity=%d, "
+                 "n_hosts=%d)", host, port, self.rt.cfg.svc_capacity,
+                 self.rt.cfg.n_hosts)
+        stats_task = asyncio.create_task(self._stats_loop())
+        try:
+            await self.stop_event.wait()
+        finally:
+            stats_task.cancel()
+            await self.shutdown()
+
+    async def _stats_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.args.stats_interval)
+            d = self.rt.stats.delta()
+            if d:
+                log.info("stats %s", json.dumps(d, default=str))
+            if self._hot:
+                new = self._hot.poll()
+                if new is not self.rt.opts:
+                    self.rt.opts = new
+                    log.info("hot-reloaded runtime knobs")
+
+    async def shutdown(self) -> None:
+        """Graceful stop: stop accepting, drain staged folds, final
+        checkpoint (the SIGTERM path of the reference's init proc)."""
+        log.info("shutting down: draining staged slabs")
+        await self.srv.stop()
+        self.rt.flush()
+        if self.rt.opts.checkpoint_dir:
+            from gyeeta_tpu.utils import checkpoint as ckpt
+            tick = self.rt._tick_no
+            path = ckpt.save(
+                f"{self.rt.opts.checkpoint_dir}/gyt_final_{tick:08d}.npz",
+                self.rt.cfg, self.rt.state, extra={"tick": tick})
+            log.info("final checkpoint: %s", path)
+        log.info("bye")
+
+    def handle_signal(self, sig: int) -> None:
+        if sig == signal.SIGHUP:
+            # hot-reload when a config file backs the knobs; a stray
+            # HUP (logrotate, tty hangup) must never stop the server
+            if self._hot:
+                new = self._hot.poll()
+                if new is not self.rt.opts:
+                    self.rt.opts = new
+                    log.info("SIGHUP: hot-reloaded runtime knobs")
+            else:
+                log.info("SIGHUP ignored (no --config)")
+            return
+        log.info("signal %d: stopping", sig)
+        self.stop_event.set()
+
+
+def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="gyeeta_tpu",
+        description="TPU-native fleet observability aggregation server")
+    ap.add_argument("--config", help="JSON config ({engine:…, runtime:…})")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=10038)
+    ap.add_argument("--history-db", help="sqlite history path")
+    ap.add_argument("--checkpoint-dir")
+    ap.add_argument("--restore", help="checkpoint .npz to restore")
+    ap.add_argument("--hostmap", help="machine-id→host-id placement file")
+    ap.add_argument("--tick-interval", type=float, default=5.0)
+    ap.add_argument("--stats-interval", type=float, default=60.0)
+    ap.add_argument("--log-level", default="INFO")
+    return ap.parse_args(argv)
+
+
+def main(argv: Optional[list] = None) -> None:
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    async def amain():
+        d = Daemon(args)
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+            loop.add_signal_handler(sig, d.handle_signal, sig)
+        await d.run()
+
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

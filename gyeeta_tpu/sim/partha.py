@@ -124,7 +124,31 @@ class ParthaSim:
         cee_j = (j + 1) % self.n_svcs
         return h, j, cee_h, cee_j
 
-    def svc_conn_records(self, n: int, split_halves: bool = False):
+    def listener_info_records(self) -> np.ndarray:
+        """Static metadata announcements for every listener (ref
+        NEW_LISTENER path, gy_comm_proto.h:2499)."""
+        n = self.n_hosts * self.n_svcs
+        host = np.repeat(np.arange(self.n_hosts, dtype=np.uint32),
+                         self.n_svcs)
+        svc = np.tile(np.arange(self.n_svcs, dtype=np.uint32),
+                      self.n_hosts)
+        out = np.zeros(n, wire.LISTENER_INFO_DT)
+        out["glob_id"] = self.glob_ids.reshape(-1)
+        ser_ip = (0xC0A80000
+                  | ((host + np.uint32(self.host_base)) & 0xFFFF))
+        _put_ipv4(out["addr"], ser_ip, (8000 + svc).astype(np.uint16))
+        out["tusec_start"] = self.tusec - np.uint64(3_600_000_000)
+        out["comm_id"] = self.comm_ids[svc % self.n_groups]
+        out["cmdline_id"] = self.comm_ids[svc % self.n_groups]
+        out["related_listen_id"] = out["glob_id"]
+        out["pid"] = (300 + svc).astype(np.int32)
+        out["is_any_ip"] = 1
+        out["is_http"] = (svc % 2 == 0)
+        out["host_id"] = host + self.host_base
+        return out
+
+    def svc_conn_records(self, n: int, split_halves: bool = False,
+                         nat: bool = False):
         """n service→service flows drawn from the fleet call graph.
 
         ``split_halves=False`` emits one record per flow carrying both
@@ -168,6 +192,16 @@ class ParthaSim:
             ch, cj % self.n_groups]
         cli_side["cli_related_listen_id"] = self.glob_ids[ch, cj]
         cli_side["flags"] = 1                    # connect-observed
+        if nat:
+            # callee behind a VIP: the client dials the VIP but its
+            # conntrack resolves the DNAT'd tuple — the flow key must
+            # come from the post-NAT view both sides share
+            vip = (0x0AFE0000 | sj.astype(np.uint32))
+            _put_ipv4(cli_side["ser"], vip, (80 + sj).astype(np.uint16))
+            _put_ipv4(cli_side["nat_cli"], cli_ip.astype(np.uint32),
+                      sport)
+            _put_ipv4(cli_side["nat_ser"], ser_ip.astype(np.uint32),
+                      dport)
         if not split_halves:
             cli_side["ser_glob_id"] = self.glob_ids[sh, sj]
             cli_side["ser_related_listen_id"] = cli_side["ser_glob_id"]
