@@ -9,16 +9,33 @@ signature* (HTTP route template / SQL shape), and ships
 where per-service API aggregates are maintained.
 
 Here the same split, TPU-style: parsing is host/agent-side byte work
-(``trace/proto.py`` — HTTP/1 and Postgres transaction parsers + the
-protocol detector), API signatures travel as interned 64-bit ids
-(NAME_INTERN announcements), and the aggregation is a device slab keyed
-by (service, api) folding whole trace batches: windowed counters +
-per-API response-time loghist (north-star config #5: per-API latency
-sketches across the fleet).
+(HTTP/1 + Postgres in ``trace/proto.py``, MongoDB in ``trace/mongo.py``,
+HTTP/2+gRPC with full HPACK in ``trace/http2.py``, TLS ClientHello
+SNI/ALPN in ``trace/tls.py``, plus the protocol detector), API
+signatures travel as interned 64-bit ids (NAME_INTERN announcements),
+and the aggregation is a device slab keyed by (service, api) folding
+whole trace batches: windowed counters + per-API response-time loghist
+(north-star config #5: per-API latency sketches across the fleet).
 """
 
 from gyeeta_tpu.trace.proto import (  # noqa: F401
-    PROTO_UNKNOWN, PROTO_HTTP1, PROTO_POSTGRES, PROTO_NAMES,
+    PROTO_UNKNOWN, PROTO_HTTP1, PROTO_POSTGRES, PROTO_MONGO,
+    PROTO_HTTP2, PROTO_TLS, PROTO_NAMES,
     HttpParser, PostgresParser, detect_protocol, normalize_http,
     normalize_sql, Transaction, transactions_to_records,
 )
+from gyeeta_tpu.trace.http2 import (  # noqa: F401
+    HpackDecoder, Http2Parser, huffman_decode,
+)
+from gyeeta_tpu.trace.mongo import MongoParser, bson_elements  # noqa: F401
+from gyeeta_tpu.trace.tls import (  # noqa: F401
+    TlsInfo, TlsParser, parse_client_hello,
+)
+
+PARSER_OF_PROTO = {
+    PROTO_HTTP1: HttpParser,
+    PROTO_POSTGRES: PostgresParser,
+    PROTO_MONGO: MongoParser,
+    PROTO_HTTP2: Http2Parser,
+    PROTO_TLS: TlsParser,
+}

@@ -30,10 +30,15 @@ from typing import NamedTuple, Optional
 PROTO_UNKNOWN = 0
 PROTO_HTTP1 = 1
 PROTO_POSTGRES = 2
-PROTO_NAMES = ("unknown", "http1", "postgres")
+PROTO_MONGO = 3
+PROTO_HTTP2 = 4
+PROTO_TLS = 5
+PROTO_NAMES = ("unknown", "http1", "postgres", "mongo", "http2", "tls")
 
 _HTTP_METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ",
                  b"OPTIONS ", b"PATCH ", b"TRACE ", b"CONNECT ")
+_H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+_MONGO_OPS = (2013, 2004, 2010, 2011, 1, 2001, 2002, 2005, 2006, 2007, 2012)
 
 
 class Transaction(NamedTuple):
@@ -50,9 +55,17 @@ class Transaction(NamedTuple):
 
 def detect_protocol(first_bytes: bytes) -> int:
     """Classify a connection from its first client payload bytes (the
-    reference sniffs the same way before attaching a parser)."""
+    reference sniffs the same way before attaching a parser,
+    ``common/gy_proto_parser.h`` PROTO_DETECT; TLS record sniff
+    ``common/gy_tls_proto.h``)."""
+    if first_bytes.startswith(_H2_PREFACE[: max(4, len(first_bytes))]) and \
+            len(first_bytes) >= 4:
+        return PROTO_HTTP2
     if any(first_bytes.startswith(m) for m in _HTTP_METHODS):
         return PROTO_HTTP1
+    if len(first_bytes) >= 5 and first_bytes[0] == 0x16 and \
+            first_bytes[1] == 0x03 and first_bytes[2] <= 0x04:
+        return PROTO_TLS
     if len(first_bytes) >= 8:
         # PG startup: int32 length, int32 protocol (196608 = 3.0) or
         # SSLRequest code 80877103
@@ -60,6 +73,12 @@ def detect_protocol(first_bytes: bytes) -> int:
         code = int.from_bytes(first_bytes[4:8], "big")
         if 8 <= ln <= 10000 and code in (196608, 80877103, 80877102):
             return PROTO_POSTGRES
+    if len(first_bytes) >= 16:
+        # Mongo header: msglen, requestID, responseTo, opcode — all LE
+        ln = int.from_bytes(first_bytes[:4], "little")
+        op = int.from_bytes(first_bytes[12:16], "little")
+        if 16 <= ln <= 48_000_000 and op in _MONGO_OPS:
+            return PROTO_MONGO
     return PROTO_UNKNOWN
 
 

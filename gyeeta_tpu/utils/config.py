@@ -136,7 +136,13 @@ class HotReload:
             data = json.loads(self.path.read_text())
         except (json.JSONDecodeError, OSError):
             return self.opts          # malformed hot file is ignored
+        # knobs live under "runtime" in the daemon config shape
+        # ({engine:…, runtime:…}); accept top-level too for bare knob files
+        src = data.get("runtime", data) if isinstance(data, dict) else {}
         hot = {k: type(getattr(self.opts, k))(v)
-               for k, v in data.items() if k in self.HOT_FIELDS}
-        self.opts = self.opts._replace(**hot)
+               for k, v in src.items()
+               if k in self.HOT_FIELDS and
+               type(getattr(self.opts, k))(v) != getattr(self.opts, k)}
+        if hot:   # unchanged file content must keep object identity
+            self.opts = self.opts._replace(**hot)
         return self.opts

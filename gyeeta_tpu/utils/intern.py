@@ -21,6 +21,7 @@ from gyeeta_tpu.utils import hashing as H
 class InternTable:
     def __init__(self):
         self._names: dict[tuple[int, int], str] = {}
+        self.version = 0    # bumped per update; caches key on this
 
     def __len__(self) -> int:
         return len(self._names)
@@ -34,6 +35,8 @@ class InternTable:
             name = bytes(r["name"][:nlen]).decode("utf-8", "replace")
             self._names[(int(r["kind"]), int(r["name_id"]))] = name
             n += 1
+        if n:
+            self.version += 1
         return n
 
     # ------------------------------------------------------------- lookup

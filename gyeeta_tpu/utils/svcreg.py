@@ -25,8 +25,11 @@ def format_ip(ip16: np.ndarray) -> str:
 class SvcInfoRegistry:
     def __init__(self):
         self._by_id: dict[int, dict] = {}
+        self._cols_cache = None     # built columns; invalidated on update
 
     def update(self, recs: np.ndarray) -> int:
+        if len(recs):
+            self._cols_cache = None
         for r in recs:
             gid = int(r["glob_id"])
             self._by_id[gid] = {
@@ -50,8 +53,19 @@ class SvcInfoRegistry:
         return len(self._by_id)
 
     def columns(self, names=None):
-        """Dense presentation columns for the svcinfo subsystem."""
+        """Dense presentation columns for the svcinfo subsystem.
+
+        Built columns are cached until the next ``update`` — metadata is
+        announce-rate while queries are interactive-rate, so per-query
+        Python row loops would stall the ingest loop at 65k listeners.
+        (Cache keys on the names registry identity: resolved names can
+        change when late NAME_INTERN announcements land, which bumps
+        ``names.version``.)"""
         from gyeeta_tpu.ingest import wire
+
+        ver = getattr(names, "version", None)
+        if self._cols_cache is not None and self._cols_cache[0] == ver:
+            return self._cols_cache[1]
 
         ids = sorted(self._by_id)
         rows = [self._by_id[i] for i in ids]
@@ -83,4 +97,6 @@ class SvcInfoRegistry:
             "ishttp": np.array([r["is_http"] for r in rows], bool),
             "hostid": num("hostid"),
         }
-        return cols, np.ones(n, bool)
+        out = (cols, np.ones(n, bool))
+        self._cols_cache = (ver, out)
+        return out

@@ -1,0 +1,222 @@
+"""Tests for the MongoDB, HTTP/2 (+gRPC, HPACK), and TLS parsers."""
+
+import struct
+
+from gyeeta_tpu import trace as T
+from gyeeta_tpu.trace import http2 as H2
+from gyeeta_tpu.trace import mongo as M
+from gyeeta_tpu.trace import tls as TLS
+
+
+# ------------------------------------------------------------------- BSON
+def _bson_doc(*items) -> bytes:
+    """Build a BSON doc from (name, value) items (str/int/float only)."""
+    body = b""
+    for name, val in items:
+        nm = name.encode() + b"\x00"
+        if isinstance(val, bool):
+            body += b"\x08" + nm + (b"\x01" if val else b"\x00")
+        elif isinstance(val, float):
+            body += b"\x01" + nm + struct.pack("<d", val)
+        elif isinstance(val, int):
+            body += b"\x10" + nm + struct.pack("<i", val)
+        else:
+            s = val.encode() + b"\x00"
+            body += b"\x02" + nm + struct.pack("<i", len(s)) + s
+    full = struct.pack("<i", 4 + len(body) + 1) + body + b"\x00"
+    return full
+
+
+def _mongo_msg(reqid: int, respto: int, op: int, body: bytes) -> bytes:
+    return struct.pack("<iiii", 16 + len(body), reqid, respto, op) + body
+
+
+def test_bson_walk():
+    doc = _bson_doc(("find", "orders"), ("limit", 5), ("ok", 1.0))
+    els = M.bson_elements(doc)
+    assert els == [("find", "orders"), ("limit", 5), ("ok", 1.0)]
+    assert M.bson_first_element(doc) == ("find", "orders")
+    assert M.bson_first_element(b"\x03") == (None, None)
+
+
+def test_mongo_op_msg_roundtrip():
+    p = M.MongoParser()
+    cmd = b"\x00\x00\x00\x00" + b"\x00" + _bson_doc(("find", "orders"))
+    p.feed_request(_mongo_msg(11, 0, M.OP_MSG, cmd), tusec=1000)
+    ok = b"\x00\x00\x00\x00" + b"\x00" + _bson_doc(("ok", 1.0))
+    p.feed_response(_mongo_msg(99, 11, M.OP_MSG, ok), tusec=4000)
+    (t,) = p.drain()
+    assert t.api == "find orders"
+    assert t.proto == T.PROTO_MONGO
+    assert t.resp_usec == 3000
+    assert not t.is_error
+
+
+def test_mongo_error_and_partial_frames():
+    p = M.MongoParser()
+    cmd = b"\x00\x00\x00\x00" + b"\x00" + _bson_doc(("insert", "users"))
+    msg = _mongo_msg(5, 0, M.OP_MSG, cmd)
+    p.feed_request(msg[:10], tusec=0)      # partial frame resumes
+    p.feed_request(msg[10:], tusec=0)
+    err = b"\x00\x00\x00\x00" + b"\x00" + _bson_doc(
+        ("ok", 0.0), ("errmsg", "dup key"))
+    p.feed_response(_mongo_msg(6, 5, M.OP_MSG, err), tusec=500)
+    (t,) = p.drain()
+    assert t.api == "insert users"
+    assert t.is_error
+
+
+def test_mongo_admin_commands_skipped():
+    p = M.MongoParser()
+    cmd = b"\x00\x00\x00\x00" + b"\x00" + _bson_doc(("ping", 1))
+    p.feed_request(_mongo_msg(1, 0, M.OP_MSG, cmd), tusec=0)
+    p.feed_response(_mongo_msg(2, 1, M.OP_MSG,
+                               b"\x00\x00\x00\x00" + b"\x00" +
+                               _bson_doc(("ok", 1.0))), tusec=10)
+    assert p.drain() == []
+
+
+def test_mongo_legacy_op_query():
+    p = M.MongoParser()
+    q = (b"\x00\x00\x00\x00" + b"app.orders\x00" +
+         struct.pack("<ii", 0, 1) + _bson_doc(("status", "x")))
+    p.feed_request(_mongo_msg(3, 0, M.OP_QUERY, q), tusec=0)
+    reply = struct.pack("<iqii", 0, 0, 0, 1) + _bson_doc(("a", 1))
+    p.feed_response(_mongo_msg(4, 3, M.OP_REPLY, reply), tusec=100)
+    (t,) = p.drain()
+    assert t.api == "query app.orders"
+
+
+# ------------------------------------------------------------------ HPACK
+def test_huffman_decode_rfc_vector():
+    # RFC 7541 C.4.1: "www.example.com"
+    data = bytes.fromhex("f1e3c2e5f23a6ba0ab90f4ff")
+    assert H2.huffman_decode(data) == b"www.example.com"
+    # C.6.1: "302"
+    assert H2.huffman_decode(bytes.fromhex("6402")) == b"302"
+
+
+def _lit(name: bytes, value: bytes) -> bytes:
+    """Literal header, never indexed, plain strings."""
+    out = b"\x10"
+    out += bytes([len(name)]) + name
+    out += bytes([len(value)]) + value
+    return out
+
+
+def test_hpack_static_and_dynamic():
+    d = H2.HpackDecoder()
+    # indexed :method GET (static 2), literal w/ incremental indexing
+    block = b"\x82" + b"\x40" + b"\x04path" + b"\x02/x"
+    hdrs = d.decode(block)
+    assert hdrs == [(":method", "GET"), ("path", "/x")]
+    # dynamic entry now at index 62
+    assert d.decode(b"\xbe") == [("path", "/x")]
+
+
+def _h2_frame(ftype: int, flags: int, sid: int, payload: bytes) -> bytes:
+    return (len(payload).to_bytes(3, "big") + bytes([ftype, flags]) +
+            sid.to_bytes(4, "big") + payload)
+
+
+def test_http2_transaction():
+    p = H2.Http2Parser()
+    req_block = (b"\x82" +                       # :method GET
+                 _lit(b":path", b"/users/42/orders"))
+    p.feed_request(H2._PREFACE +
+                   _h2_frame(H2.FRAME_HEADERS,
+                             H2.FLAG_END_HEADERS | 0x1, 1, req_block),
+                   tusec=100)
+    resp_block = b"\x88"                         # :status 200
+    p.feed_response(_h2_frame(H2.FRAME_HEADERS,
+                              H2.FLAG_END_HEADERS | 0x1, 1, resp_block),
+                    tusec=350)
+    (t,) = p.drain()
+    assert t.api == "GET /users/{}/orders"
+    assert t.status == 200
+    assert t.resp_usec == 250
+    assert not t.is_error
+
+
+def test_http2_grpc_trailers():
+    p = H2.Http2Parser()
+    req_block = (b"\x83" +                       # :method POST
+                 _lit(b":path", b"/pkg.Svc/DoThing") +
+                 _lit(b"content-type", b"application/grpc"))
+    p.feed_request(H2._PREFACE +
+                   _h2_frame(H2.FRAME_HEADERS, H2.FLAG_END_HEADERS, 1,
+                             req_block), tusec=0)
+    # initial metadata (no END_STREAM), then trailers with grpc-status
+    p.feed_response(_h2_frame(H2.FRAME_HEADERS, H2.FLAG_END_HEADERS, 1,
+                              b"\x88"), tusec=10)
+    assert p.drain() == []
+    trailers = _lit(b"grpc-status", b"13")
+    p.feed_response(_h2_frame(H2.FRAME_HEADERS,
+                              H2.FLAG_END_HEADERS | 0x1, 1, trailers),
+                    tusec=900)
+    (t,) = p.drain()
+    assert t.api == "POST /pkg.Svc/DoThing"     # exact, not templated
+    assert t.is_error
+    assert t.resp_usec == 900
+
+
+def test_http2_continuation_and_padding():
+    p = H2.Http2Parser()
+    block = b"\x82" + _lit(b":path", b"/a")
+    # split header block across HEADERS + CONTINUATION; pad the HEADERS
+    pad = 3
+    payload = bytes([pad]) + block[:2] + b"\x00" * pad
+    p.feed_request(H2._PREFACE +
+                   _h2_frame(H2.FRAME_HEADERS, H2.FLAG_PADDED, 1,
+                             payload) +
+                   _h2_frame(H2.FRAME_CONTINUATION, H2.FLAG_END_HEADERS,
+                             1, block[2:]), tusec=0)
+    p.feed_response(_h2_frame(H2.FRAME_HEADERS,
+                              H2.FLAG_END_HEADERS | 0x1, 1, b"\x88"),
+                    tusec=5)
+    (t,) = p.drain()
+    assert t.api == "GET /a"
+
+
+# -------------------------------------------------------------------- TLS
+def _client_hello(sni: bytes, alpn: bytes = b"h2") -> bytes:
+    sni_ext = (struct.pack(">HBH", len(sni) + 3, 0, len(sni)) + sni)
+    sni_ext = struct.pack(">HH", TLS.EXT_SNI, len(sni_ext)) + sni_ext
+    alpn_list = bytes([len(alpn)]) + alpn
+    alpn_ext = struct.pack(">H", len(alpn_list)) + alpn_list
+    alpn_ext = struct.pack(">HH", TLS.EXT_ALPN, len(alpn_ext)) + alpn_ext
+    exts = sni_ext + alpn_ext
+    body = (struct.pack(">H", 0x0303) + b"\x00" * 32 +   # version+random
+            b"\x00" +                                     # session id
+            struct.pack(">H", 2) + b"\x13\x01" +          # ciphers
+            b"\x01\x00" +                                 # compression
+            struct.pack(">H", len(exts)) + exts)
+    hs = b"\x01" + len(body).to_bytes(3, "big") + body
+    return b"\x16\x03\x01" + struct.pack(">H", len(hs)) + hs
+
+
+def test_tls_sni_alpn():
+    hello = _client_hello(b"api.example.com")
+    info = TLS.parse_client_hello(hello)
+    assert info == TLS.TlsInfo("api.example.com", "h2", 0x0303)
+
+
+def test_tls_split_records_and_partial():
+    hello = _client_hello(b"svc.internal", alpn=b"http/1.1")
+    # split into two TLS records of the same handshake
+    hs = hello[5:]
+    part1, part2 = hs[:20], hs[20:]
+    rec = (b"\x16\x03\x01" + struct.pack(">H", len(part1)) + part1 +
+           b"\x16\x03\x01" + struct.pack(">H", len(part2)) + part2)
+    p = TLS.TlsParser()
+    p.feed_request(rec[:10], 0)
+    assert p.info is None
+    p.feed_request(rec[10:], 0)
+    assert p.info is not None
+    assert p.info.sni == "svc.internal"
+    assert p.info.alpn == "http/1.1"
+
+
+def test_parser_registry():
+    assert T.PARSER_OF_PROTO[T.PROTO_MONGO] is M.MongoParser
+    assert T.PARSER_OF_PROTO[T.PROTO_HTTP2] is H2.Http2Parser

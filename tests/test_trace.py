@@ -30,6 +30,13 @@ def test_detect_protocol():
     sslreq = (8).to_bytes(4, "big") + (80877103).to_bytes(4, "big")
     assert T.detect_protocol(sslreq) == T.PROTO_POSTGRES
     assert T.detect_protocol(b"\x16\x03\x01\x02\x00xxxx") == \
+        T.PROTO_TLS
+    assert T.detect_protocol(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n") == \
+        T.PROTO_HTTP2
+    mongo = (32).to_bytes(4, "little") + (7).to_bytes(4, "little") + \
+        (0).to_bytes(4, "little") + (2013).to_bytes(4, "little") + b"x" * 16
+    assert T.detect_protocol(mongo) == T.PROTO_MONGO
+    assert T.detect_protocol(b"\x00\x01\x02\x03garbage") == \
         T.PROTO_UNKNOWN
 
 
