@@ -381,6 +381,12 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
     li = recs.get(wire.NOTIFY_LISTENER_INFO)
     if li is not None:
         yield ("listener_info", li)
+    hi = recs.get(wire.NOTIFY_HOST_INFO)
+    if hi is not None:
+        yield ("host_info", hi)
+    cg = recs.get(wire.NOTIFY_CGROUP_STATE)
+    if cg is not None:
+        yield ("cgroup", cg)
     nm = recs.get(wire.NOTIFY_NAME_INTERN)
     if nm is not None:
         yield ("names", nm)

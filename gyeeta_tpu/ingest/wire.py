@@ -51,6 +51,8 @@ NOTIFY_CPU_MEM_STATE = 15     # 2s host cpu/mem state
 NOTIFY_NAME_INTERN = 16       # string-intern announcements (TPU-first)
 NOTIFY_REQ_TRACE = 17         # request-trace transactions (per-API)
 NOTIFY_LISTENER_INFO = 18     # listener static metadata (ip/port/cmdline)
+NOTIFY_HOST_INFO = 19         # static host inventory (hw/os/cloud)
+NOTIFY_CGROUP_STATE = 20      # 5s per-cgroup stats
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -260,6 +262,58 @@ LISTENER_INFO_DT = np.dtype([
 
 MAX_LISTENER_INFO_PER_BATCH = 1024
 
+# HOST_INFO record — static host inventory announced at registration
+# (+ on change): the field content of HOST_INFO_NOTIFY
+# (``gy_comm_proto.h:2843``) — distribution/kernel/processor strings,
+# core/memory topology (``common/gy_sys_hardware.h`` SYS_HARDWARE),
+# cloud instance metadata (``common/gy_cloud_metadata.h`` IMDS fields).
+# All strings interned (NAME_KIND_MISC); announce-rate → host-side
+# registry, never a device slab.
+HOST_INFO_DT = np.dtype([
+    ("host_id", "<u4"),
+    ("ncpus", "<u2"),              # online cores
+    ("nnuma", "<u2"),
+    ("ram_mb", "<u4"),
+    ("swap_mb", "<u4"),
+    ("boot_tusec", "<u8"),
+    ("kern_ver_id", "<u8"),        # interned "6.1.0-18-amd64"
+    ("distro_id", "<u8"),          # interned distribution name
+    ("cputype_id", "<u8"),         # interned processor model
+    ("instance_id", "<u8"),        # interned cloud instance id
+    ("region_id", "<u8"),          # interned region name
+    ("zone_id", "<u8"),            # interned zone name
+    ("virt_type", "u1"),           # 0 none, 1 vm, 2 container
+    ("cloud_type", "u1"),          # 0 none, 1 aws, 2 gcp, 3 azure
+    ("is_k8s", "u1"),
+    ("pad", "u1", (5,)),
+])
+
+MAX_HOST_INFO_PER_BATCH = 1024
+
+# CGROUP_STATE record — 5s per-cgroup sweep: the queryable essence of the
+# reference's cgroup tier (``common/gy_cgroup_stat.h`` CGROUP_HANDLE: v1
+# cpuacct/cpu/memory/blkio + v2 unified stats, throttling, limits).
+# Agents send the top-N cgroups by usage; cg_id is the path hash, the
+# path string is interned.
+CGROUP_DT = np.dtype([
+    ("cg_id", "<u8"),              # hash of cgroup path
+    ("dir_id", "<u8"),             # interned path string
+    ("cpu_pct", "<f4"),
+    ("cpu_limit_pct", "<f4"),      # <0 = no limit
+    ("cpu_throttled_pct", "<f4"),  # fraction of periods throttled
+    ("rss_mb", "<f4"),
+    ("memory_limit_mb", "<f4"),    # <0 = no limit
+    ("pgmajfault_sec", "<f4"),
+    ("nprocs", "<u4"),
+    ("is_v2", "u1"),
+    ("state", "u1"),               # OBJ_STATE_E verdict from the agent
+    ("pad", "u1", (2,)),
+    ("host_id", "<u4"),
+    ("pad2", "u1", (4,)),
+])
+
+MAX_CGROUPS_PER_BATCH = 2048
+
 # NAME_INTERN — the host-side half of the fixed-width record contract: the
 # reference carries comm[16]/cmdline/issue strings inline in every record
 # (e.g. gy_comm_proto.h:1708 trailing cmdline); we instead intern strings
@@ -269,6 +323,7 @@ NAME_KIND_COMM = 1      # process comm / command name
 NAME_KIND_SVC = 2       # service (listener) name, id == glob_id
 NAME_KIND_HOST = 3      # hostname, id == host_id
 NAME_KIND_API = 4       # normalized API signature, id == hash(signature)
+NAME_KIND_MISC = 5      # host-info / cgroup-path / other metadata strings
 MAX_NAME_BYTES = 48
 
 NAME_INTERN_DT = np.dtype([
@@ -290,6 +345,8 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_NAME_INTERN: NAME_INTERN_DT,
     NOTIFY_REQ_TRACE: REQ_TRACE_DT,
     NOTIFY_LISTENER_INFO: LISTENER_INFO_DT,
+    NOTIFY_HOST_INFO: HOST_INFO_DT,
+    NOTIFY_CGROUP_STATE: CGROUP_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -304,6 +361,8 @@ MAX_OF_SUBTYPE = {
     NOTIFY_NAME_INTERN: MAX_NAMES_PER_BATCH,
     NOTIFY_REQ_TRACE: MAX_TRACE_PER_BATCH,
     NOTIFY_LISTENER_INFO: MAX_LISTENER_INFO_PER_BATCH,
+    NOTIFY_HOST_INFO: MAX_HOST_INFO_PER_BATCH,
+    NOTIFY_CGROUP_STATE: MAX_CGROUPS_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -315,7 +374,9 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("CPU_MEM_DT", CPU_MEM_DT),
                    ("NAME_INTERN_DT", NAME_INTERN_DT),
                    ("REQ_TRACE_DT", REQ_TRACE_DT),
-                   ("LISTENER_INFO_DT", LISTENER_INFO_DT)]:
+                   ("LISTENER_INFO_DT", LISTENER_INFO_DT),
+                   ("HOST_INFO_DT", HOST_INFO_DT),
+                   ("CGROUP_DT", CGROUP_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 

@@ -89,14 +89,15 @@ class NetAgent:
         return hid
 
     async def send_names(self) -> None:
-        """Announce inventory: names + listener metadata (the reference
-        agent resends its listener inventory on reconnect)."""
+        """Announce inventory: names + listener metadata + host info
+        (the reference agent resends its inventory on reconnect)."""
         buf = (self.sim.name_frames() + wire.encode_frame(
             wire.NOTIFY_NAME_INTERN,
             wire_name_record(wire.NAME_KIND_HOST, self.host_id,
                              f"agent-{self.host_id}.sim"))
             + wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
-                                self.sim.listener_info_records()))
+                                self.sim.listener_info_records())
+            + self.sim.host_info_frames())
         self._writer.write(buf)
         await self._writer.drain()
 
@@ -106,6 +107,7 @@ class NetAgent:
         s = self.sim
         buf = (s.conn_frames(n_conn) + s.resp_frames(n_resp)
                + s.listener_frames() + s.task_frames()
+               + s.cgroup_frames()
                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
                                    s.host_state_records())
                + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,

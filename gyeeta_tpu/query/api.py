@@ -452,8 +452,26 @@ _TOP_PRESETS = {
 }
 
 
+def columns_for(cfg: EngineCfg, st: AggState, subsys: str, names=None,
+                dep=None, svcreg=None, aux=None):
+    """Resolve a subsystem to its (cols, base_mask) column source —
+    the ONE dispatch over aux providers ≻ host-side registries ≻
+    dep-graph views ≻ device-slab readbacks. Shared by query execution
+    and realtime alertdef evaluation so a subsystem added to one is
+    automatically visible to the other."""
+    if aux is not None and subsys in aux:
+        return aux[subsys]()
+    if subsys in _SVCREG_COLUMNS_OF:
+        return _SVCREG_COLUMNS_OF[subsys](cfg, st, names=names,
+                                          svcreg=svcreg)
+    if subsys in _DEP_COLUMNS_OF:
+        return _DEP_COLUMNS_OF[subsys](cfg, st, names=names, dep=dep)
+    return _COLUMNS_OF[subsys](cfg, st, names=names)
+
+
 def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
-            names=None, dep=None, columns_fn=None, svcreg=None) -> dict:
+            names=None, dep=None, columns_fn=None, svcreg=None,
+            aux=None) -> dict:
     """Run one point-in-time query → {"recs": [...], "nrecs": N}.
 
     ``columns_fn(subsys) -> (cols, base_mask)`` overrides the column
@@ -461,12 +479,17 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
     filter/sort/aggregation/projection run identically on one shard or a
     whole mesh (the multi-madhava scatter the Node webserver performs,
     ``server/gy_mnodehandle.cc:203``).
+
+    ``aux`` maps extra subsystem names to zero-arg column providers —
+    host-side registries (hostinfo, cgroupstate) and alert-manager views
+    (alerts/alertdef/silences/inhibits) plug in here without this module
+    importing them.
     """
     if opts.subsys not in fieldmaps.FIELDS_OF_SUBSYS:
         raise ValueError(f"unknown subsystem {opts.subsys!r}")
     if columns_fn is None and not any(
             opts.subsys in m for m in (_COLUMNS_OF, _DEP_COLUMNS_OF,
-                                       _SVCREG_COLUMNS_OF)):
+                                       _SVCREG_COLUMNS_OF, aux or {})):
         raise ValueError(f"unknown subsystem {opts.subsys!r}")
     preset = _TOP_PRESETS.get(opts.subsys)
     if preset is not None and opts.sortcol is None and not opts.aggr:
@@ -474,14 +497,9 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
                              maxrecs=min(opts.maxrecs, preset[1]))
     if columns_fn is not None:
         cols, base_mask = columns_fn(opts.subsys)
-    elif opts.subsys in _SVCREG_COLUMNS_OF:
-        cols, base_mask = _SVCREG_COLUMNS_OF[opts.subsys](
-            cfg, st, names=names, svcreg=svcreg)
-    elif opts.subsys in _DEP_COLUMNS_OF:
-        cols, base_mask = _DEP_COLUMNS_OF[opts.subsys](
-            cfg, st, names=names, dep=dep)
     else:
-        cols, base_mask = _COLUMNS_OF[opts.subsys](cfg, st, names=names)
+        cols, base_mask = columns_for(cfg, st, opts.subsys, names=names,
+                                      dep=dep, svcreg=svcreg, aux=aux)
     tree = criteria.parse(opts.filter) if opts.filter else None
     mask = base_mask & criteria.evaluate(tree, cols, opts.subsys)
     idx = np.nonzero(mask)[0]
@@ -530,7 +548,7 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
 
 
 def query_json(cfg: EngineCfg, st: AggState, req: dict,
-               names=None, dep=None, svcreg=None) -> dict:
+               names=None, dep=None, svcreg=None, aux=None) -> dict:
     """JSON-envelope entry point (the NM-conn QUERY_CMD analogue)."""
     return execute(cfg, st, QueryOptions.from_json(req), names=names,
-                   dep=dep, svcreg=svcreg)
+                   dep=dep, svcreg=svcreg, aux=aux)

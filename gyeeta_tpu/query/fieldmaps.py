@@ -32,6 +32,12 @@ SUBSYS_SVCMESH = "svcmesh"          # ref svc mesh clusters (shyama)
 SUBSYS_CPUMEM = "cpumem"            # ref cpumem (2s host cpu/mem state)
 SUBSYS_TRACEREQ = "tracereq"        # ref tracereq (request tracing)
 SUBSYS_ACTIVECONN = "activeconn"    # ref activeconn (per-svc client view)
+SUBSYS_HOSTINFO = "hostinfo"        # ref hostinfo (static host inventory)
+SUBSYS_CGROUPSTATE = "cgroupstate"  # ref cgroupstate
+SUBSYS_ALERTS = "alerts"            # ref alerts (fired alert log)
+SUBSYS_ALERTDEF = "alertdef"        # ref alertdef
+SUBSYS_SILENCES = "silences"        # ref silences
+SUBSYS_INHIBITS = "inhibits"        # ref inhibits
 
 
 class FieldDef(NamedTuple):
@@ -286,6 +292,89 @@ FLOWSTATE_FIELDS = (
     num("evictedbytes", "evictedbytes", "Undercount bound (evicted mass)"),
 )
 
+# --------------------------------------------------------------- hostinfo
+# ref json_db_hostinfo_arr (HOST_INFO_NOTIFY, gy_comm_proto.h:2843):
+# static host inventory — hardware/OS/cloud metadata
+HOSTINFO_FIELDS = (
+    num("hostid", "hostid", "Host id"),
+    string("host", "host", "Hostname (interned)"),
+    num("ncpus", "ncpus", "Online CPU cores"),
+    num("nnuma", "nnuma", "NUMA nodes"),
+    num("rammb", "rammb", "RAM MB"),
+    num("swapmb", "swapmb", "Swap MB"),
+    num("boot", "boot", "Boot time (epoch sec)"),
+    string("kernverstr", "kernverstr", "Kernel version"),
+    string("dist", "dist", "OS distribution"),
+    string("cputype", "cputype", "Processor model"),
+    string("instanceid", "instanceid", "Cloud instance id"),
+    string("region", "region", "Cloud region"),
+    string("zone", "zone", "Cloud zone"),
+    string("virt", "virt", "Virtualization (none/vm/container)"),
+    string("cloud", "cloud", "Cloud provider (none/aws/gcp/azure)"),
+    boolean("isk8s", "isk8s", "Kubernetes node"),
+)
+
+# ------------------------------------------------------------ cgroupstate
+# ref cgroupstate subsystem (CGROUP_HANDLE stats, common/gy_cgroup_stat.h)
+CGROUPSTATE_FIELDS = (
+    string("cgid", "cgid", "Cgroup path hash (hex)"),
+    string("dir", "dir", "Cgroup path (interned)"),
+    num("hostid", "hostid", "Host id"),
+    num("cpupct", "cpupct", "CPU %"),
+    num("cpulimpct", "cpulimpct", "CPU limit % (<0 none)"),
+    num("throttlepct", "throttlepct", "Throttled period fraction %"),
+    num("rssmb", "rssmb", "Resident memory MB"),
+    num("memlimmb", "memlimmb", "Memory limit MB (<0 none)"),
+    num("pgmajfps", "pgmajfps", "Major page faults/sec"),
+    num("nprocs", "nprocs", "Processes in cgroup"),
+    boolean("isv2", "isv2", "cgroup v2 unified hierarchy"),
+    enum("state", "state", _state_enc, _state_dec,
+         "Cgroup pressure state"),
+)
+
+# ----------------------------------------------------------- alerts tier
+# ref shyama alert subsystems (gy_json_field_maps.h SUBSYS_ALERTS /
+# ALERTDEF / SILENCES / INHIBITS; ALERTMGR state, gy_alertmgr.h:948)
+ALERTS_FIELDS = (
+    num("tfired", "tfired", "Fire time (epoch sec)"),
+    string("alertname", "alertname", "Alert definition name"),
+    string("severity", "severity", "Severity"),
+    string("subsys", "subsys", "Subsystem evaluated"),
+    string("entity", "entity", "Entity key (svcid=… / hostid=…)"),
+    string("labels", "labels", "Labels (JSON)"),
+    string("annotations", "annotations", "Annotations (JSON)"),
+)
+
+ALERTDEF_FIELDS = (
+    string("alertname", "alertname", "Definition name"),
+    string("subsys", "subsys", "Subsystem"),
+    string("filter", "filter", "Criteria filter"),
+    string("severity", "severity", "Severity"),
+    string("mode", "mode", "realtime | db"),
+    num("numcheckfor", "numcheckfor", "Consecutive hits to fire"),
+    num("repeataftersec", "repeataftersec", "Re-notify holdoff sec"),
+    num("querysec", "querysec", "DB-mode period sec"),
+    num("groupwaitsec", "groupwaitsec", "Group-wait sec"),
+    boolean("enabled", "enabled", "Definition enabled"),
+    num("nfiring", "nfiring", "Entities currently firing"),
+)
+
+SILENCES_FIELDS = (
+    string("name", "name", "Silence name"),
+    string("filter", "filter", "Criteria filter (empty = all)"),
+    string("alertnames", "alertnames", "Alert names muted (empty = any)"),
+    num("tstart", "tstart", "Active from (epoch sec)"),
+    num("tend", "tend", "Active until (epoch sec)"),
+    boolean("active", "active", "Currently in effect"),
+)
+
+INHIBITS_FIELDS = (
+    string("name", "name", "Inhibit rule name"),
+    string("srcalerts", "srcalerts", "Source alert names"),
+    string("targetalerts", "targetalerts", "Suppressed alert names"),
+    boolean("active", "active", "A source alert is currently firing"),
+)
+
 FIELDS_OF_SUBSYS = {
     SUBSYS_SVCSTATE: SVCSTATE_FIELDS,
     SUBSYS_HOSTSTATE: HOSTSTATE_FIELDS,
@@ -301,6 +390,12 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_TRACEREQ: TRACEREQ_FIELDS,
     SUBSYS_SVCINFO: SVCINFO_FIELDS,
     SUBSYS_ACTIVECONN: ACTIVECONN_FIELDS,
+    SUBSYS_HOSTINFO: HOSTINFO_FIELDS,
+    SUBSYS_CGROUPSTATE: CGROUPSTATE_FIELDS,
+    SUBSYS_ALERTS: ALERTS_FIELDS,
+    SUBSYS_ALERTDEF: ALERTDEF_FIELDS,
+    SUBSYS_SILENCES: SILENCES_FIELDS,
+    SUBSYS_INHIBITS: INHIBITS_FIELDS,
 }
 
 

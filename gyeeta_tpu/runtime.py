@@ -80,7 +80,20 @@ class Runtime:
             donate_argnums=(0,))
         self.names = InternTable()
         from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
+        from gyeeta_tpu.utils.hostreg import CgroupRegistry, \
+            HostInfoRegistry
         self.svcreg = SvcInfoRegistry()
+        self.hostinfo = HostInfoRegistry()
+        self.cgroups = CgroupRegistry()
+        from gyeeta_tpu.alerts import columns as AC
+        self._aux = {
+            "hostinfo": lambda: self.hostinfo.columns(self.names),
+            "cgroupstate": lambda: self.cgroups.columns(self.names),
+            "alerts": lambda: AC.alerts_columns(self.alerts),
+            "alertdef": lambda: AC.alertdef_columns(self.alerts),
+            "silences": lambda: AC.silences_columns(self.alerts),
+            "inhibits": lambda: AC.inhibits_columns(self.alerts),
+        }
         self._classify = derive.jit_classify_pass(self.cfg)
         self._empty_conn = decode.conn_batch(
             np.empty(0, wire.TCP_CONN_DT), self.cfg.conn_batch)
@@ -155,6 +168,14 @@ class Runtime:
                 self.stats.bump("listener_infos",
                                 self.svcreg.update(chunks[0]))
                 n += len(chunks[0])
+            elif kind == "host_info":
+                self.stats.bump("host_infos",
+                                self.hostinfo.update(chunks[0]))
+                n += len(chunks[0])
+            elif kind == "cgroup":
+                self.stats.bump("cgroup_records",
+                                self.cgroups.update(chunks[0]))
+                n += len(chunks[0])
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
@@ -197,7 +218,8 @@ class Runtime:
         self.flush()
         report = {}
         self.state = self._classify(self.state)
-        fired = self.alerts.check(self.state)
+        fired = self.alerts.check(self.state,
+                                  columns_fn=self._alert_columns)
         # history snapshots BEFORE the window tick: the closing 5s slab is
         # still readable (tick zeroes it)
         tick = int(np.asarray(self.state.resp_win.tick)) + 1
@@ -205,6 +227,7 @@ class Runtime:
         self._tick_no = tick
         self.stats.gauge("tick", tick)
         self.dep = self._dep_age(self.dep, tick)
+        self.cgroups.age()
 
         if self.history and tick % self.opts.history_every_ticks == 0:
             now = self._clock()
@@ -231,9 +254,16 @@ class Runtime:
                 subsys="tracereq", maxrecs=self.cfg.api_capacity),
                 names=self.names)
             self.history.write("tracereq", now, trout["recs"])
+            ncg = 0
+            if len(self.cgroups):
+                cgout = api.execute(self.cfg, self.state, api.QueryOptions(
+                    subsys="cgroupstate", maxrecs=100_000),
+                    names=self.names, aux=self._aux)
+                self.history.write("cgroupstate", now, cgout["recs"])
+                ncg = cgout["nrecs"]
             report["history_rows"] = (
                 out["nrecs"] + hout["nrecs"] + tout["nrecs"]
-                + mout["nrecs"] + trout["nrecs"] + 1)
+                + mout["nrecs"] + trout["nrecs"] + ncg + 1)
 
         # db-mode alertdefs run AFTER the history write so a due def sees
         # the snapshot from this very tick (ref: MDB alerts query the DB
@@ -266,6 +296,14 @@ class Runtime:
             self.stats.bump("checkpoints")
         return report
 
+    def _alert_columns(self, subsys: str):
+        """Column source for realtime alertdef evaluation — the same
+        dispatch as api.execute so defs can target ANY live subsystem
+        (device slabs, dep graph, or host-side registries)."""
+        return api.columns_for(self.cfg, self.state, subsys,
+                               names=self.names, dep=self.dep,
+                               svcreg=self.svcreg, aux=self._aux)
+
     # -------------------------------------------------------------- query
     def query(self, req: dict) -> dict:
         """Point-in-time (live) or historical (time-ranged) JSON query."""
@@ -297,7 +335,8 @@ class Runtime:
         self.flush()                  # live queries see all staged events
         self.stats.bump("queries")
         return api.query_json(self.cfg, self.state, req, names=self.names,
-                              dep=self.dep, svcreg=self.svcreg)
+                              dep=self.dep, svcreg=self.svcreg,
+                              aux=self._aux)
 
     def restore(self, path) -> dict:
         # drop staged microbatches and partial-frame bytes from before the

@@ -357,6 +357,15 @@ class ParthaSim:
         out["host_id"] = np.arange(n, dtype=np.uint32) + self.host_base
         return out
 
+    # fixed inventory vocabulary (interned as NAME_KIND_MISC)
+    DISTROS = ("Debian 12", "Ubuntu 22.04", "AlmaLinux 9")
+    KERNELS = ("6.1.0-18-amd64", "5.15.0-105-generic")
+    CPUTYPES = ("Xeon-8481C", "EPYC-9B14")
+    REGIONS = ("us-east1", "eu-west4")
+    CGPATHS = ("/sys/fs/cgroup/system.slice", "/sys/fs/cgroup/user.slice",
+               "/sys/fs/cgroup/kubepods/burstable",
+               "/sys/fs/cgroup/kubepods/besteffort")
+
     def name_records(self) -> np.ndarray:
         """Intern announcements for every name this agent fleet uses."""
         from gyeeta_tpu.utils import hashing as HH
@@ -373,7 +382,79 @@ class ParthaSim:
                 entries.append((wire.NAME_KIND_SVC, self.glob_ids[h, s],
                                 f"svc-{s}.host-{h}"))
             entries.append((wire.NAME_KIND_HOST, h, f"host-{h}.sim"))
+        misc = list(self.DISTROS + self.KERNELS + self.CPUTYPES
+                    + self.CGPATHS)
+        for r in self.REGIONS:
+            misc += [r, f"{r}-a", f"{r}-b"]
+        for h in range(self.n_hosts):
+            misc.append(f"i-{h + self.host_base:016x}")
+        for s in misc:
+            entries.append((wire.NAME_KIND_MISC,
+                            InternTable.intern(s, wire.NAME_KIND_MISC), s))
         return InternTable.records(entries)
+
+    def host_info_records(self) -> np.ndarray:
+        """Static host inventory (HOST_INFO announce): deterministic per
+        host id so reconnect resends are idempotent."""
+        from gyeeta_tpu.utils.intern import InternTable
+
+        def mid(s):
+            return InternTable.intern(s, wire.NAME_KIND_MISC)
+
+        n = self.n_hosts
+        hs = np.arange(n) + self.host_base
+        out = np.zeros(n, wire.HOST_INFO_DT)
+        out["host_id"] = hs
+        out["ncpus"] = 8 << (hs % 3)
+        out["nnuma"] = 1 + (hs % 2)
+        out["ram_mb"] = 32768 << (hs % 3)
+        out["swap_mb"] = 2048
+        out["boot_tusec"] = self.tusec - np.uint64(86_400_000_000)
+        out["kern_ver_id"] = [mid(self.KERNELS[h % 2]) for h in hs]
+        out["distro_id"] = [mid(self.DISTROS[h % 3]) for h in hs]
+        out["cputype_id"] = [mid(self.CPUTYPES[h % 2]) for h in hs]
+        out["instance_id"] = [mid(f"i-{h:016x}") for h in hs]
+        region = [self.REGIONS[h % 2] for h in hs]
+        out["region_id"] = [mid(r) for r in region]
+        out["zone_id"] = [mid(f"{r}-{'ab'[h % 2]}")
+                          for r, h in zip(region, hs)]
+        out["virt_type"] = 1
+        out["cloud_type"] = 1 + (hs % 3)
+        out["is_k8s"] = (hs % 4) == 0
+        return out
+
+    def cgroup_records(self) -> np.ndarray:
+        """One 5s cgroup sweep: a few tracked cgroups per host with
+        utilization jitter; kubepods throttle under load."""
+        from gyeeta_tpu.utils import hashing as HH
+        from gyeeta_tpu.utils.intern import InternTable
+
+        r = self.rng
+        npaths = len(self.CGPATHS)
+        n = self.n_hosts * npaths
+        host = np.repeat(np.arange(self.n_hosts) + self.host_base, npaths)
+        path_i = np.tile(np.arange(npaths), self.n_hosts)
+        out = np.zeros(n, wire.CGROUP_DT)
+        dir_ids = np.array([InternTable.intern(p, wire.NAME_KIND_MISC)
+                            for p in self.CGPATHS], np.uint64)
+        out["dir_id"] = dir_ids[path_i]
+        out["cg_id"] = _splitmix64(
+            (host.astype(np.uint64) << np.uint64(8))
+            | path_i.astype(np.uint64))
+        out["host_id"] = host
+        out["is_v2"] = True
+        limited = path_i >= 2                 # kubepods have cpu limits
+        out["cpu_pct"] = r.random(n) * 40.0
+        out["cpu_limit_pct"] = np.where(limited, 50.0, -1.0)
+        throttled = limited & (r.random(n) < 0.1)
+        out["cpu_throttled_pct"] = np.where(throttled,
+                                            r.random(n) * 30.0, 0.0)
+        out["rss_mb"] = r.random(n) * 4096.0
+        out["memory_limit_mb"] = np.where(limited, 8192.0, -1.0)
+        out["pgmajfault_sec"] = r.random(n) * 2.0
+        out["nprocs"] = r.integers(1, 64, n)
+        out["state"] = np.where(throttled, 3, 1)   # Bad when throttled
+        return out
 
     def host_state_records(self) -> np.ndarray:
         r = self.rng
@@ -425,6 +506,20 @@ class ParthaSim:
             wire.encode_frame(wire.NOTIFY_NAME_INTERN,
                               recs[i:i + wire.MAX_NAMES_PER_BATCH])
             for i in range(0, len(recs), wire.MAX_NAMES_PER_BATCH))
+
+    def host_info_frames(self) -> bytes:
+        recs = self.host_info_records()
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_HOST_INFO,
+                              recs[i:i + wire.MAX_HOST_INFO_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_HOST_INFO_PER_BATCH))
+
+    def cgroup_frames(self) -> bytes:
+        recs = self.cgroup_records()
+        return b"".join(
+            wire.encode_frame(wire.NOTIFY_CGROUP_STATE,
+                              recs[i:i + wire.MAX_CGROUPS_PER_BATCH])
+            for i in range(0, len(recs), wire.MAX_CGROUPS_PER_BATCH))
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:

@@ -293,7 +293,16 @@ class Http2Parser:
             self._on_resp_frame(ftype, flags, sid, payload, tusec)
 
     # ------------------------------------------------------------- frames
+    def _rst(self, sid: int) -> None:
+        """RST_STREAM from either side cancels the stream: drop its
+        pending state or _open fills with cancelled calls and the
+        parser wedges at max_streams."""
+        self._open.pop(sid, None)
+        self._resp_status.pop(sid, None)
+
     def _on_req_frame(self, ftype, flags, sid, payload, tusec) -> None:
+        if ftype == FRAME_RST_STREAM:
+            return self._rst(sid)
         block = self._req.header_block(ftype, flags, sid, payload)
         if block is None:
             return
@@ -312,6 +321,8 @@ class Http2Parser:
             self._open[sid] = _Stream(api, tusec, len(fragment), is_grpc)
 
     def _on_resp_frame(self, ftype, flags, sid, payload, tusec) -> None:
+        if ftype == FRAME_RST_STREAM:
+            return self._rst(sid)
         block = self._resp.header_block(ftype, flags, sid, payload)
         if block is None:
             return

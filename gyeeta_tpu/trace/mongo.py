@@ -213,7 +213,12 @@ class MongoParser:
                         api = f"query {coll}"
             elif op == OP_COMPRESSED:
                 api = "compressed"
-            if api is not None and len(self._pending) < self._max_queue:
+            if api is not None:
+                # bounded with oldest-first eviction: orphaned requests
+                # (responses lost to capture gaps) must not wedge the
+                # queue — insertion order IS request order
+                while len(self._pending) >= self._max_queue:
+                    self._pending.pop(next(iter(self._pending)))
                 self._pending[reqid] = _Pending(api, tusec, mlen)
 
         self._req_buf, self._req_skip = self._walk(

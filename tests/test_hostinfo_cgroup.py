@@ -1,0 +1,149 @@
+"""hostinfo / cgroupstate subsystems + alerts-family query subsystems."""
+
+import numpy as np
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.utils.hostreg import CgroupRegistry, HostInfoRegistry
+from gyeeta_tpu.utils.intern import InternTable
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=64, conn_batch=64, resp_batch=64,
+                fold_k=2)
+
+
+def _rt_with_inventory():
+    rt = Runtime(CFG)
+    sim = ParthaSim(n_hosts=8, n_svcs=2, seed=3)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.host_info_frames())
+    rt.feed(sim.cgroup_frames())
+    return rt, sim
+
+
+# ------------------------------------------------------------- registries
+def test_hostinfo_registry_roundtrip():
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=1)
+    reg = HostInfoRegistry()
+    recs = sim.host_info_records()
+    # wire roundtrip: encode → decode → identical records
+    buf = sim.host_info_frames()
+    frames, consumed = wire.decode_frames(buf)
+    assert consumed == len(buf)
+    (st, got), = frames
+    assert st == wire.NOTIFY_HOST_INFO
+    assert np.array_equal(got, recs)
+    assert reg.update(got) == 4
+    assert len(reg) == 4
+    names = InternTable()
+    names.update(sim.name_records())
+    cols, mask = reg.columns(names)
+    assert mask.all() and len(cols["hostid"]) == 4
+    assert cols["dist"][0] in sim.DISTROS
+    assert cols["region"][0] in sim.REGIONS
+    assert cols["virt"][0] == "vm"
+    # idempotent re-announce
+    reg.update(got)
+    assert len(reg) == 4
+
+
+def test_cgroup_registry_ages_out():
+    sim = ParthaSim(n_hosts=2, n_svcs=2, seed=2)
+    reg = CgroupRegistry(max_age=2)
+    reg.update(sim.cgroup_records())
+    n0 = len(reg)
+    assert n0 == 2 * len(sim.CGPATHS)
+    reg.age()
+    reg.age()
+    assert len(reg) == n0          # still within max_age
+    reg.age()                      # sweep 3 > max_age 2: drop
+    assert len(reg) == 0
+
+
+def test_cgroup_columns_cache_invalidation():
+    sim = ParthaSim(n_hosts=2, n_svcs=2, seed=2)
+    reg = CgroupRegistry()
+    reg.update(sim.cgroup_records())
+    c1, _ = reg.columns()
+    c2, _ = reg.columns()
+    assert c1 is c2                # cached
+    reg.update(sim.cgroup_records())
+    c3, _ = reg.columns()
+    assert c3 is not c1            # invalidated
+
+
+# ---------------------------------------------------------------- runtime
+def test_runtime_hostinfo_query():
+    rt, sim = _rt_with_inventory()
+    q = rt.query({"subsys": "hostinfo", "maxrecs": 100})
+    assert q["nrecs"] == 8
+    r0 = q["recs"][0]
+    assert r0["dist"] in sim.DISTROS
+    assert r0["ncpus"] in (8, 16, 32)
+    assert r0["cloud"] in ("aws", "gcp", "azure")
+    # filter on a string column
+    q2 = rt.query({"subsys": "hostinfo",
+                   "filter": f"{{ hostinfo.dist = '{sim.DISTROS[0]}' }}"})
+    assert 0 < q2["nrecs"] < 8
+    assert all(r["dist"] == sim.DISTROS[0] for r in q2["recs"])
+
+
+def test_runtime_cgroupstate_query():
+    rt, sim = _rt_with_inventory()
+    q = rt.query({"subsys": "cgroupstate", "maxrecs": 200,
+                  "sortcol": "cpupct"})
+    assert q["nrecs"] == 8 * len(sim.CGPATHS)
+    dirs = {r["dir"] for r in q["recs"]}
+    assert dirs == set(sim.CGPATHS)
+    lim = [r for r in q["recs"] if r["cpulimpct"] > 0]
+    assert lim and all(r["dir"].startswith("/sys/fs/cgroup/kubepods")
+                       for r in lim)
+    # cgroups age out of the live view when a host stops reporting
+    for _ in range(rt.cgroups.max_age + 2):
+        rt.cgroups.age()
+    assert rt.query({"subsys": "cgroupstate"})["nrecs"] == 0
+
+
+# ------------------------------------------------------------ alerts tier
+def test_alert_subsystem_queries():
+    rt, sim = _rt_with_inventory()
+    rt.alerts.add_def({"alertname": "host_down", "subsys": "hoststate",
+                       "filter": "{ hoststate.state >= 4 }",
+                       "severity": "critical"})
+    rt.alerts.add_def({"alertname": "cpu_hot", "subsys": "cpumem",
+                       "filter": "{ cpumem.cpu > 90 }",
+                       "enabled": True})
+    rt.alerts.add_silence({"name": "maint", "alertnames": ["cpu_hot"],
+                           "tstart": 0, "tend": 2e9})
+    rt.alerts.add_inhibit({"name": "dep", "src_alertnames": ["host_down"],
+                           "target_alertnames": ["cpu_hot"]})
+
+    q = rt.query({"subsys": "alertdef", "sortcol": "alertname"})
+    assert q["nrecs"] == 2
+    # default sort order is descending
+    assert q["recs"][0]["alertname"] == "host_down"
+    assert q["recs"][0]["severity"] == "critical"
+    assert q["recs"][1]["alertname"] == "cpu_hot"
+
+    q = rt.query({"subsys": "silences"})
+    assert q["nrecs"] == 1 and q["recs"][0]["active"]
+
+    q = rt.query({"subsys": "inhibits"})
+    assert q["nrecs"] == 1 and not q["recs"][0]["active"]
+
+    # fire an alert: every host Severe via hot cpumem records
+    hot = sim.cpu_mem_records(hot_cpu=range(8))
+    rt.feed(wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE, hot))
+    rt.alerts.add_def({"alertname": "cpu_now", "subsys": "cpumem",
+                       "filter": "{ cpumem.cpu > 90 }"})
+    rt.run_tick()
+    q = rt.query({"subsys": "alerts", "maxrecs": 100})
+    assert q["nrecs"] > 0
+    assert {r["alertname"] for r in q["recs"]} == {"cpu_now"}
+    assert q["recs"][0]["entity"].startswith("hostid=")
+
+    # filter alerts by name
+    q2 = rt.query({"subsys": "alerts",
+                   "filter": "{ alerts.alertname = 'none' }"})
+    assert q2["nrecs"] == 0
