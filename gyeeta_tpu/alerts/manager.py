@@ -55,15 +55,22 @@ class _EntityState(NamedTuple):
     tlast_notify: float = -1e18
 
 
+# probe order matters: entity-grained ids (cgid, cliid, api…) must come
+# BEFORE hostid, or per-entity alert state collapses to per-host and
+# numcheckfor/dedup break for subsystems with many entities per host
+_ENTITY_KEYS = ("svcid", "taskid", "cgid", "cliid", "api", "flowid",
+                "alertname", "hostid")
+
+
 def _entity_key_of(subsys: str, cols: dict, i: int) -> str:
-    for k in ("svcid", "taskid", "hostid", "flowid"):
+    for k in _ENTITY_KEYS:
         if k in cols:
             return f"{k}={cols[k][i]}"
     return f"row={i}"
 
 
 def _entity_key_of_row(row: dict) -> str:
-    for k in ("svcid", "taskid", "hostid", "flowid"):
+    for k in _ENTITY_KEYS:
         if k in row and row[k] is not None:
             return f"{k}={row[k]}"
     # id-less subsystems (clusterstate): the whole subsystem is one

@@ -68,6 +68,15 @@ class EngineCfg(NamedTuple):
     topk_capacity: int = 512
     td_capacity: int = 64             # per-svc t-digest centroids
     td_route_cap: int = 64            # per-svc samples folded per step
+    # staged-digest buffer: samples accumulate here across a fold_many
+    # dispatch (K microbatches) and compress ONCE at its end — the
+    # vmapped compression sort is ~80% of the naive fold cost
+    td_stage_cap: int = 512           # per-svc staged samples (flush at
+    #                                   half-full: size ≥4× the expected
+    #                                   per-svc fill per dispatch)
+    td_sample_stride: int = 2         # digest duty-cycle: stage 1-in-N
+    #                                   resp samples (loghist folds all;
+    #                                   ref RESP_SAMPLING ~50% default)
     conn_batch: int = 2048            # static microbatch lanes
     resp_batch: int = 4096
     listener_batch: int = 512
@@ -80,6 +89,8 @@ class AggState(NamedTuple):
     ctr_win: windows.MultiWindow      # (S, NCTR) conn counters, windowed
     svc_hll: hll.HLL                  # (S, m) distinct client endpoints
     svc_td: tdigest.TDigest           # (S, C) per-svc resp digest
+    td_stage: jnp.ndarray             # (S, cap) staged raw samples
+    td_stage_n: jnp.ndarray           # (S,) int32 staged fill counts
     svc_stats: jnp.ndarray            # (S, NSTAT) last listener-state gauges
     qps_hist: jnp.ndarray             # (S, Bq) learned QPS baseline hist
     active_hist: jnp.ndarray          # (S, Ba) learned active-conn baseline
@@ -130,6 +141,7 @@ class AggState(NamedTuple):
     n_conn: jnp.ndarray               # () f32 counters
     n_resp: jnp.ndarray
     n_td_overflow: jnp.ndarray        # samples that missed the digest path
+    n_resp_unknown: jnp.ndarray       # resp samples for unannounced svcs
 
 
 def init(cfg: EngineCfg) -> AggState:
@@ -141,6 +153,8 @@ def init(cfg: EngineCfg) -> AggState:
         ctr_win=windows.init((S, NCTR), cfg.levels),
         svc_hll=hll.init(p=cfg.hll_p_svc, entities=(S,)),
         svc_td=tdigest.init(capacity=cfg.td_capacity, entities=(S,)),
+        td_stage=jnp.zeros((S, cfg.td_stage_cap), jnp.float32),
+        td_stage_n=jnp.zeros((S,), jnp.int32),
         svc_stats=jnp.zeros((S, decode.NSTAT), jnp.float32),
         qps_hist=jnp.zeros((S, cfg.qps_spec.nbuckets), jnp.float32),
         active_hist=jnp.zeros((S, cfg.active_spec.nbuckets), jnp.float32),
@@ -186,4 +200,5 @@ def init(cfg: EngineCfg) -> AggState:
         n_conn=jnp.zeros((), jnp.float32),
         n_resp=jnp.zeros((), jnp.float32),
         n_td_overflow=jnp.zeros((), jnp.float32),
+        n_resp_unknown=jnp.zeros((), jnp.float32),
     )

@@ -32,6 +32,8 @@ def _rows_leading(st: AggState) -> dict:
         "td_weights": st.svc_td.weights,
         "td_vmin": st.svc_td.vmin,
         "td_vmax": st.svc_td.vmax,
+        "td_stage": st.td_stage,
+        "td_stage_n": st.td_stage_n,
         "svc_stats": st.svc_stats,
         "qps_hist": st.qps_hist,
         "active_hist": st.active_hist,
@@ -85,6 +87,8 @@ def compact_state(cfg: EngineCfg, st: AggState) -> AggState:
         svc_td=st.svc_td._replace(
             means=new_cols["td_means"], weights=new_cols["td_weights"],
             vmin=new_cols["td_vmin"], vmax=new_cols["td_vmax"]),
+        td_stage=new_cols["td_stage"],
+        td_stage_n=new_cols["td_stage_n"],
         svc_stats=new_cols["svc_stats"],
         qps_hist=new_cols["qps_hist"],
         active_hist=new_cols["active_hist"],

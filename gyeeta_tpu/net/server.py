@@ -89,6 +89,9 @@ class GytServer:
             self.hostmap[mid] = hid
             self._save_hostmap()
             self.rt.stats.bump("agents_registered")
+            self.rt.notifylog.add(
+                f"agent registered: machine {mid:032x} -> host {hid}",
+                source="agent")
         return wire.REG_OK, hid
 
     # ------------------------------------------------------------- serving

@@ -382,16 +382,15 @@ _COLUMNS_OF = {
     fieldmaps.SUBSYS_TRACEREQ: trace_columns,
 }
 
-def activeconn_from_edges(snap: dict, names=None):
-    """Group a dep-edge column snapshot by server service (shared by the
-    single-node and sharded activeconn providers). Vectorized: one
-    np.unique over packed server ids + np.add.at segment sums."""
-    from gyeeta_tpu.ingest import wire
-
+def _group_edges(snap: dict, end: str):
+    """Group live dep edges by one endpoint (``cli`` or ``ser``) →
+    (hi, lo, inv, segsum, live_idx). One np.unique over the packed
+    64-bit ids + np.add.at segment sums — shared by the activeconn
+    (group by server) and clientconn (group by caller) views."""
     live = np.nonzero(snap["e_live"])[0]
-    ser = ((snap["e_ser_hi"][live].astype(np.uint64) << np.uint64(32))
-           | snap["e_ser_lo"][live].astype(np.uint64))
-    ids, inv = np.unique(ser, return_inverse=True)
+    ids64 = ((snap[f"e_{end}_hi"][live].astype(np.uint64) << np.uint64(32))
+             | snap[f"e_{end}_lo"][live].astype(np.uint64))
+    ids, inv = np.unique(ids64, return_inverse=True)
     n = len(ids)
 
     def segsum(vals):
@@ -401,6 +400,15 @@ def activeconn_from_edges(snap: dict, names=None):
 
     hi = (ids >> np.uint64(32)).astype(np.uint32)
     lo = (ids & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo, inv, segsum, live
+
+
+def activeconn_from_edges(snap: dict, names=None):
+    """Group a dep-edge column snapshot by server service (shared by the
+    single-node and sharded activeconn providers)."""
+    from gyeeta_tpu.ingest import wire
+
+    hi, lo, inv, segsum, live = _group_edges(snap, "ser")
     cols = {
         "svcid": _hex_id(hi, lo),
         "svcname": _names_of(names, wire.NAME_KIND_SVC, hi, lo),
@@ -409,7 +417,7 @@ def activeconn_from_edges(snap: dict, names=None):
         "bytes": segsum(snap["e_bytes"][live]),
         "nsvccli": segsum(snap["e_cli_svc"][live]),
     }
-    return cols, np.ones(n, bool)
+    return cols, np.ones(len(hi), bool)
 
 
 def activeconn_columns(cfg: EngineCfg, st: AggState, names=None,
@@ -431,16 +439,156 @@ def svcinfo_columns(cfg: EngineCfg, st: AggState, names=None,
     return svcreg.columns(names)
 
 
+def clientconn_from_edges(st: AggState, snap: dict, names=None):
+    """Group dep edges by CALLER (the clientconn view: what does this
+    process-group / service call, ref remoteconn/clientconn tables)."""
+    from gyeeta_tpu.ingest import wire
+
+    hi, lo, inv, segsum, live = _group_edges(snap, "cli")
+    is_svc = np.zeros(len(hi), bool)
+    np.maximum.at(is_svc, inv, snap["e_cli_svc"][live].astype(bool))
+    svc_names = _names_of(names, wire.NAME_KIND_SVC, hi, lo)
+    task_names = _task_comm_names(st, names, hi, lo)
+    cols = {
+        "cliid": _hex_id(hi, lo),
+        "cliname": np.where(is_svc, svc_names, task_names),
+        "clisvc": is_svc,
+        "nservers": segsum(np.ones(len(live))),
+        "nconn": segsum(snap["e_nconn"][live]),
+        "bytes": segsum(snap["e_bytes"][live]),
+    }
+    return cols, np.ones(len(hi), bool)
+
+
+def clientconn_columns(cfg: EngineCfg, st: AggState, names=None,
+                       dep=None) -> dict:
+    if dep is None:
+        raise ValueError("clientconn needs a dependency graph")
+    snap = {k: np.asarray(v)
+            for k, v in readback.dep_edges_snapshot(dep).items()}
+    return clientconn_from_edges(st, snap, names)
+
+
+def svcsumm_columns(cfg: EngineCfg, st: AggState, names=None):
+    """svcsumm subsystem: per-host service-state summary (the
+    LISTEN_SUMM_STATS rollup, ``server/gy_msocket.h:841``), built by
+    grouping the svcstate snapshot host-side."""
+    from gyeeta_tpu.semantic import states as S
+
+    cols, live = svc_columns(cfg, st, names=names)
+    idx = np.nonzero(live)[0]
+    hosts = cols["hostid"][idx].astype(np.int64)
+    ids, inv = np.unique(hosts, return_inverse=True)
+    n = len(ids)
+
+    def segsum(vals):
+        out = np.zeros(n, np.float64)
+        np.add.at(out, inv, np.asarray(vals, np.float64))
+        return out
+
+    state = cols["state"][idx]
+    hostids, hostnames = _host_name_cols(cfg.n_hosts, names)
+    out = {
+        "hostid": ids.astype(np.float64),
+        "hostname": np.asarray(hostnames, object)[ids],
+        "nsvc": segsum(np.ones(len(idx))),
+        "nidle": segsum(state == S.STATE_IDLE),
+        "ngood": segsum(state == S.STATE_GOOD),
+        "nok": segsum(state == S.STATE_OK),
+        "nbad": segsum(state == S.STATE_BAD),
+        "nsevere": segsum(state == S.STATE_SEVERE),
+        "ndown": segsum(state == S.STATE_DOWN),
+        "nissue": segsum(state >= S.STATE_BAD),
+        "totqps": segsum(cols["qps5s"][idx]),
+        "totactive": segsum(cols["nactive"][idx]),
+        "totkbin": segsum(cols["kbin15s"][idx]),
+        "totkbout": segsum(cols["kbout15s"][idx]),
+    }
+    return out, np.ones(n, bool)
+
+
+def extsvcstate_columns(cfg: EngineCfg, st: AggState, names=None,
+                        svcreg=None):
+    """extsvcstate: svcstate ⋈ svcinfo on svcid (the reference's
+    "extended" subsystems join state+info records,
+    ``server/gy_mnodehandle.cc:4657``). State rows without announced
+    metadata still appear, with empty info columns."""
+    cols, live = svc_columns(cfg, st, names=names)
+    info_cols, _ = (svcreg.columns(names) if svcreg is not None
+                    else ({}, None))
+    n = len(cols["svcid"])
+    keys = (("ip", ""), ("port", 0.0), ("comm", ""), ("cmdline", ""),
+            ("pid", 0.0), ("tstart", 0.0))
+    joined = dict(cols)
+    out = {}
+    for key, default in keys:
+        col = np.empty(n, object if isinstance(default, str)
+                       else np.float64)
+        col[:] = default
+        out[key] = col
+    if info_cols:
+        pos_of = {sid: j for j, sid in enumerate(info_cols["svcid"])}
+        for i in np.nonzero(live)[0]:      # one pass, live rows only
+            j = pos_of.get(cols["svcid"][i])
+            if j is not None:
+                for key, _ in keys:
+                    out[key][i] = info_cols[key][j]
+    joined.update(out)
+    return joined, live
+
+
+def svcprocmap_columns(cfg: EngineCfg, st: AggState, names=None,
+                       svcreg=None):
+    """svcprocmap: listener ↔ process-group mapping via the shared
+    related_listen_id (ref LISTEN_TASKMAP_NOTIFY,
+    ``gy_comm_proto.h:2813``)."""
+    from gyeeta_tpu.ingest import wire
+
+    tcols, tlive = task_columns(cfg, st, names=names)
+    info_cols, _ = (svcreg.columns(names) if svcreg is not None
+                    else (None, None))
+    rows = {"svcid": [], "svcname": [], "relsvcid": [], "taskid": [],
+            "comm": [], "hostid": []}
+    if info_cols is not None and len(tcols["taskid"]):
+        by_rel: dict[str, list[int]] = {}
+        for i in np.nonzero(tlive)[0]:
+            by_rel.setdefault(tcols["relsvcid"][i], []).append(i)
+        for j, rel in enumerate(info_cols["relsvcid"]):
+            for i in by_rel.get(rel, ()):
+                rows["svcid"].append(info_cols["svcid"][j])
+                rows["svcname"].append(info_cols["svcname"][j])
+                rows["relsvcid"].append(rel)
+                rows["taskid"].append(tcols["taskid"][i])
+                rows["comm"].append(tcols["comm"][i])
+                rows["hostid"].append(float(tcols["hostid"][i]))
+    n = len(rows["svcid"])
+    cols = {}
+    for k, vals in rows.items():
+        if k == "hostid":
+            cols[k] = np.array(vals, np.float64)
+        else:
+            col = np.empty(n, object)
+            col[:] = vals
+            cols[k] = col
+    return cols, np.ones(n, bool)
+
+
+# svcsumm derives from svc_columns (defined below the map literal)
+_COLUMNS_OF[fieldmaps.SUBSYS_SVCSUMM] = svcsumm_columns
+
 # subsystems whose columns come from the dependency graph, not AggState
 _DEP_COLUMNS_OF = {
     fieldmaps.SUBSYS_SVCDEP: dep_columns,
     fieldmaps.SUBSYS_SVCMESH: mesh_columns,
     fieldmaps.SUBSYS_ACTIVECONN: activeconn_columns,
+    fieldmaps.SUBSYS_CLIENTCONN: clientconn_columns,
 }
 
 # subsystems backed by the host-side listener-metadata registry
 _SVCREG_COLUMNS_OF = {
     fieldmaps.SUBSYS_SVCINFO: svcinfo_columns,
+    fieldmaps.SUBSYS_EXTSVCSTATE: extsvcstate_columns,
+    fieldmaps.SUBSYS_SVCPROCMAP: svcprocmap_columns,
 }
 
 # top-N views: preset sort + limit over taskstate columns

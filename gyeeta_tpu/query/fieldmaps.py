@@ -33,6 +33,13 @@ SUBSYS_CPUMEM = "cpumem"            # ref cpumem (2s host cpu/mem state)
 SUBSYS_TRACEREQ = "tracereq"        # ref tracereq (request tracing)
 SUBSYS_ACTIVECONN = "activeconn"    # ref activeconn (per-svc client view)
 SUBSYS_HOSTINFO = "hostinfo"        # ref hostinfo (static host inventory)
+SUBSYS_SVCSUMM = "svcsumm"          # ref svcsumm (per-host summary)
+SUBSYS_EXTSVCSTATE = "extsvcstate"  # ref extsvcstate (state ⋈ info)
+SUBSYS_CLIENTCONN = "clientconn"    # ref clientconn (outbound view)
+SUBSYS_SVCPROCMAP = "svcprocmap"    # ref svcprocmap (listener↔procs)
+SUBSYS_NOTIFYMSG = "notifymsg"      # ref notifymsg
+SUBSYS_HOSTLIST = "hostlist"        # ref parthalist (agents + liveness)
+SUBSYS_SERVERSTATUS = "serverstatus"  # ref madhavastatus/shyamastatus
 SUBSYS_CGROUPSTATE = "cgroupstate"  # ref cgroupstate
 SUBSYS_ALERTS = "alerts"            # ref alerts (fired alert log)
 SUBSYS_ALERTDEF = "alertdef"        # ref alertdef
@@ -292,6 +299,92 @@ FLOWSTATE_FIELDS = (
     num("evictedbytes", "evictedbytes", "Undercount bound (evicted mass)"),
 )
 
+# ---------------------------------------------------------------- svcsumm
+# ref SUBSYS_SVCSUMM (LISTEN_SUMM_STATS, server/gy_msocket.h:841):
+# per-host service summary counts
+SVCSUMM_FIELDS = (
+    num("hostid", "hostid", "Host id"),
+    string("hostname", "hostname", "Hostname (interned)"),
+    num("nsvc", "nsvc", "Services on host"),
+    num("nidle", "nidle", "Idle services"),
+    num("ngood", "ngood", "Good services"),
+    num("nok", "nok", "OK services"),
+    num("nbad", "nbad", "Bad services"),
+    num("nsevere", "nsevere", "Severe services"),
+    num("ndown", "ndown", "Down services"),
+    num("nissue", "nissue", "Services with issues (Bad+)"),
+    num("totqps", "totqps", "Total QPS across services"),
+    num("totactive", "totactive", "Total active connections"),
+    num("totkbin", "totkbin", "Total inbound KB"),
+    num("totkbout", "totkbout", "Total outbound KB"),
+)
+
+# ------------------------------------------------------------ extsvcstate
+# ref EXTSVCSTATE: svcstate joined with svcinfo (gy_mnodehandle.cc:4657)
+EXTSVCSTATE_FIELDS = SVCSTATE_FIELDS + (
+    string("ip", "ip", "Bind address"),
+    num("port", "port", "Listen port"),
+    string("comm", "comm", "Listener process comm"),
+    string("cmdline", "cmdline", "Command line (interned)"),
+    num("pid", "pid", "Listener pid"),
+    num("tstart", "tstart", "Listener start time (epoch sec)"),
+)
+
+# ------------------------------------------------------------- clientconn
+# ref SUBSYS_CLIENTCONN (remoteconn): outbound view per caller entity
+CLIENTCONN_FIELDS = (
+    string("cliid", "cliid", "Caller entity id (hex)"),
+    string("cliname", "cliname", "Caller name (interned)"),
+    boolean("clisvc", "clisvc", "Caller is itself a service"),
+    num("nservers", "nservers", "Distinct services called"),
+    num("nconn", "nconn", "Flows folded"),
+    num("bytes", "bytes", "Total bytes"),
+)
+
+# ------------------------------------------------------------- svcprocmap
+# ref LISTEN_TASKMAP_NOTIFY (gy_comm_proto.h:2813): listener ↔
+# process-group mapping
+SVCPROCMAP_FIELDS = (
+    string("svcid", "svcid", "Service glob id (hex)"),
+    string("svcname", "svcname", "Service name"),
+    string("relsvcid", "relsvcid", "Related-listener group id (hex)"),
+    string("taskid", "taskid", "Process-group id (hex)"),
+    string("comm", "comm", "Process comm"),
+    num("hostid", "hostid", "Host id"),
+)
+
+# -------------------------------------------------------------- notifymsg
+# ref SUBSYS_NOTIFYMSG (notificationtbl, gy_mdb_schema.cc:101)
+NOTIFYMSG_FIELDS = (
+    num("time", "time", "Event time (epoch sec)"),
+    string("type", "type", "info | warn | error"),
+    string("source", "source", "agent | alert | server | config"),
+    string("msg", "msg", "Message"),
+)
+
+# --------------------------------------------------------------- hostlist
+# ref SUBSYS_PARTHALIST: registered agents + liveness
+HOSTLIST_FIELDS = (
+    num("hostid", "hostid", "Assigned host id"),
+    string("hostname", "hostname", "Hostname (interned)"),
+    boolean("up", "up", "Reported within the liveness window"),
+    num("lastseen", "lastseen", "Ticks since last report (-1 never)"),
+)
+
+# ------------------------------------------------------------ serverstatus
+# ref SUBSYS_MADHAVASTATUS/SHYAMASTATUS: one-row server self status
+SERVERSTATUS_FIELDS = (
+    num("tick", "tick", "Current 5s window tick"),
+    num("nhosts", "nhosts", "Hosts that have ever reported"),
+    num("nsvc", "nsvc", "Live service rows"),
+    num("connevents", "connevents", "Flow events ingested"),
+    num("respevents", "respevents", "Response samples ingested"),
+    num("queries", "queries", "Queries served"),
+    num("alertsfired", "alertsfired", "Alerts notified"),
+    num("wirever", "wirever", "Wire protocol version"),
+    string("version", "version", "Server version"),
+)
+
 # --------------------------------------------------------------- hostinfo
 # ref json_db_hostinfo_arr (HOST_INFO_NOTIFY, gy_comm_proto.h:2843):
 # static host inventory — hardware/OS/cloud metadata
@@ -392,6 +485,13 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_ACTIVECONN: ACTIVECONN_FIELDS,
     SUBSYS_HOSTINFO: HOSTINFO_FIELDS,
     SUBSYS_CGROUPSTATE: CGROUPSTATE_FIELDS,
+    SUBSYS_SVCSUMM: SVCSUMM_FIELDS,
+    SUBSYS_EXTSVCSTATE: EXTSVCSTATE_FIELDS,
+    SUBSYS_CLIENTCONN: CLIENTCONN_FIELDS,
+    SUBSYS_SVCPROCMAP: SVCPROCMAP_FIELDS,
+    SUBSYS_NOTIFYMSG: NOTIFYMSG_FIELDS,
+    SUBSYS_HOSTLIST: HOSTLIST_FIELDS,
+    SUBSYS_SERVERSTATUS: SERVERSTATUS_FIELDS,
     SUBSYS_ALERTS: ALERTS_FIELDS,
     SUBSYS_ALERTDEF: ALERTDEF_FIELDS,
     SUBSYS_SILENCES: SILENCES_FIELDS,

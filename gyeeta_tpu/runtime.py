@@ -47,6 +47,7 @@ class Runtime:
         self._tick_no = 0             # host-side mirror of the window tick
         self._pending = b""           # partial-frame resume buffer
         self._staged = []             # decoded (cb, rb) microbatch pairs
+        self._td_dirty = False        # digest stage may be non-empty
         self._fold = step.jit_fold_step(self.cfg)
         self._fold_many = step.jit_fold_many(self.cfg)
         self._fold_lst = jax.jit(
@@ -68,6 +69,8 @@ class Runtime:
         self._compact_tasks = jax.jit(
             lambda s: step.compact_tasks(self.cfg, s))
         self._tick = jax.jit(lambda s: step.tick_5s(self.cfg, s))
+        self._td_flush = jax.jit(lambda s: step.td_flush(self.cfg, s),
+                                 donate_argnums=(0,))
         # dependency graph (single-shard slice; the sharded tier keeps its
         # own stacked DepGraph — see parallel/depgraph.py)
         self.dep = dg.init(self.opts.dep_pair_capacity,
@@ -86,6 +89,9 @@ class Runtime:
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
         from gyeeta_tpu.alerts import columns as AC
+        from gyeeta_tpu.utils.notifylog import NotifyLog
+        self.notifylog = NotifyLog(clock=clock)
+        self._t_started = self._clock()
         self._aux = {
             "hostinfo": lambda: self.hostinfo.columns(self.names),
             "cgroupstate": lambda: self.cgroups.columns(self.names),
@@ -93,6 +99,9 @@ class Runtime:
             "alertdef": lambda: AC.alertdef_columns(self.alerts),
             "silences": lambda: AC.silences_columns(self.alerts),
             "inhibits": lambda: AC.inhibits_columns(self.alerts),
+            "notifymsg": lambda: self.notifylog.columns(self.names),
+            "hostlist": self._hostlist_columns,
+            "serverstatus": self._serverstatus_columns,
         }
         self._classify = derive.jit_classify_pass(self.cfg)
         self._empty_conn = decode.conn_batch(
@@ -195,16 +204,21 @@ class Runtime:
                                    *[r for _, r in chunk])
                 self.state = self._fold_many(self.state, cbs, rbs)
                 self.dep = self._dep_many(self.dep, cbs, self._tick_no)
+            self._td_dirty = True
             self.stats.bump("slab_dispatches")
 
     def flush(self) -> int:
-        """Fold any staged partial slab (single-step path). Called at
-        every cadence/query boundary."""
+        """Fold any staged partial slab (single-step path) and compress
+        staged digest samples. Called at every cadence/query boundary —
+        after it, state is fully query-ready."""
         n = len(self._staged)
         for cb, rb in self._staged:
             self.state = self._fold(self.state, cb, rb)
             self.dep = self._dep_step(self.dep, cb, self._tick_no)
         self._staged = []
+        if self._td_dirty:     # digest stage may hold samples from
+            self.state = self._td_flush(self.state)   # fold_many runs
+            self._td_dirty = False
         return n
 
     # ------------------------------------------------------------ cadence
@@ -271,6 +285,11 @@ class Runtime:
         if self.history:
             fired += self.alerts.check_db(self.history)
         report["alerts_fired"] = len(fired)
+        for a in fired:
+            self.notifylog.add(
+                f"alert {a.alertname} [{a.severity}] {a.entity}",
+                ntype="warn" if a.severity in ("warning", "info")
+                else "error", source="alert")
 
         self.state = self._tick(self.state)
         if tick % self.opts.task_age_every_ticks == 0:
@@ -295,6 +314,44 @@ class Runtime:
             report["checkpoint"] = str(path)
             self.stats.bump("checkpoints")
         return report
+
+    def _hostlist_columns(self):
+        """hostlist subsystem (ref parthalist): hosts that have ever
+        reported, with liveness from the last-report tick."""
+        last = np.asarray(self.state.host_last_tick)
+        seen = np.nonzero(last >= 0)[0]
+        age = self._tick_no - last[seen]
+        hostids, hostnames = api._host_name_cols(self.cfg.n_hosts,
+                                                 self.names)
+        cols = {
+            "hostid": seen.astype(np.float64),
+            "hostname": np.asarray(hostnames, object)[seen],
+            "up": age <= api.DOWN_AFTER_TICKS,
+            "lastseen": age.astype(np.float64),
+        }
+        return cols, np.ones(len(seen), bool)
+
+    def _serverstatus_columns(self):
+        """serverstatus subsystem (ref madhavastatus): one-row self
+        status from the live counters."""
+        from gyeeta_tpu import version as V
+
+        c = self.stats.counters
+        obj = lambda v: np.array([v], object)  # noqa: E731
+        num = lambda v: np.array([float(v)], np.float64)  # noqa: E731
+        cols = {
+            "tick": num(self._tick_no),
+            "nhosts": num(int((np.asarray(self.state.host_last_tick)
+                               >= 0).sum())),
+            "nsvc": num(int(np.asarray(self.state.tbl.n_live))),
+            "connevents": num(c.get("conn_events", 0)),
+            "respevents": num(c.get("resp_events", 0)),
+            "queries": num(c.get("queries", 0)),
+            "alertsfired": num(self.alerts.stats.get("nfired", 0)),
+            "wirever": num(V.CURR_WIRE_VERSION),
+            "version": obj(V.__version__),
+        }
+        return cols, np.ones(1, bool)
 
     def _alert_columns(self, subsys: str):
         """Column source for realtime alertdef evaluation — the same
@@ -343,6 +400,7 @@ class Runtime:
         # restore: folding them into checkpointed state would double-count
         self._staged = []
         self._pending = b""
+        self._td_dirty = False
         self.state, extra = ckpt.restore(path, self.cfg, self.state)
         # the dep graph is not checkpointed: reset it (edges rebuild from
         # live traffic) and realign the host tick mirror so TTL deltas

@@ -93,6 +93,8 @@ class SvcInfoRegistry:
             "cmdline": resolve(wire.NAME_KIND_COMM,
                                [r["cmdline_id"] for r in rows]),
             "pid": num("pid"),
+            "relsvcid": np.array([format(r["relsvcid"], "016x")
+                                  for r in rows], object),
             "anyip": np.array([r["is_any_ip"] for r in rows], bool),
             "ishttp": np.array([r["is_http"] for r in rows], bool),
             "hostid": num("hostid"),

@@ -22,6 +22,8 @@ def cfg():
         resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
         hll_p_svc=6, hll_p_global=10, cms_depth=2, cms_width=1 << 10,
         topk_capacity=64, td_capacity=32, td_route_cap=32,
+        td_sample_stride=1,     # digest every sample: this module checks
+        #                         sketch accuracy, not sampling policy
         conn_batch=128, resp_batch=256, listener_batch=64)
 
 

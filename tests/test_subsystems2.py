@@ -1,0 +1,108 @@
+"""svcsumm / extsvcstate / clientconn / svcprocmap / notifymsg /
+hostlist / serverstatus query subsystems."""
+
+import numpy as np
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=64, conn_batch=64, resp_batch=64,
+                fold_k=2)
+
+
+def _rt():
+    rt = Runtime(CFG)
+    sim = ParthaSim(n_hosts=8, n_svcs=2, seed=4)
+    rt.feed(sim.name_frames())
+    rt.feed(wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
+                              sim.listener_info_records()))
+    rt.feed(sim.conn_frames(256) + sim.resp_frames(256)
+            + sim.listener_frames() + sim.task_frames()
+            + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                sim.host_state_records()))
+    # svc→svc halves so the dep graph has mesh edges
+    cli, ser = sim.svc_conn_records(64, split_halves=True)
+    rt.feed(wire.encode_frame(wire.NOTIFY_TCP_CONN, cli))
+    rt.feed(wire.encode_frame(wire.NOTIFY_TCP_CONN, ser))
+    rt.run_tick()
+    return rt, sim
+
+
+def test_svcsumm():
+    rt, sim = _rt()
+    q = rt.query({"subsys": "svcsumm", "sortcol": "hostid"})
+    assert q["nrecs"] == 8
+    sv = rt.query({"subsys": "svcstate", "maxrecs": 1})
+    assert sum(r["nsvc"] for r in q["recs"]) == sv["ntotal"]
+    for r in q["recs"]:
+        assert r["nsvc"] >= 2          # 2 local + any peer-reported rows
+        states = (r["nidle"] + r["ngood"] + r["nok"] + r["nbad"]
+                  + r["nsevere"] + r["ndown"])
+        assert states == r["nsvc"]
+    assert sum(r["totqps"] for r in q["recs"]) > 0
+
+
+def test_extsvcstate_join():
+    rt, sim = _rt()
+    q = rt.query({"subsys": "extsvcstate", "maxrecs": 64})
+    assert q["nrecs"] >= 16
+    named = [r for r in q["recs"] if r["port"] > 0]
+    assert named, "join produced no svcinfo columns"
+    r = named[0]
+    assert r["ip"] and r["comm"].startswith("proc-")
+    assert r["qps5s"] >= 0          # state columns present too
+
+
+def test_clientconn_view():
+    rt, sim = _rt()
+    q = rt.query({"subsys": "clientconn", "maxrecs": 100})
+    assert q["nrecs"] > 0
+    svc_callers = [r for r in q["recs"] if r["clisvc"]]
+    assert svc_callers, "svc→svc halves must yield service callers"
+    assert all(r["nservers"] >= 1 for r in q["recs"])
+
+
+def test_svcprocmap():
+    rt, sim = _rt()
+    q = rt.query({"subsys": "svcprocmap", "maxrecs": 200})
+    assert q["nrecs"] > 0
+    r = q["recs"][0]
+    assert len(r["svcid"]) == 16 and len(r["taskid"]) == 16
+    assert r["comm"].startswith("proc-")
+
+
+def test_notifymsg_and_serverstatus():
+    rt, sim = _rt()
+    rt.notifylog.add("test message", ntype="warn", source="config")
+    q = rt.query({"subsys": "notifymsg", "maxrecs": 10})
+    assert q["nrecs"] >= 1
+    assert q["recs"][0]["msg"] == "test message"   # newest first
+    s = rt.query({"subsys": "serverstatus"})
+    assert s["nrecs"] == 1
+    row = s["recs"][0]
+    assert row["nhosts"] == 8 and row["nsvc"] >= 16
+    assert row["connevents"] > 0 and row["wirever"] == 1
+
+
+def test_hostlist_liveness():
+    rt, sim = _rt()
+    q = rt.query({"subsys": "hostlist", "sortcol": "hostid"})
+    assert q["nrecs"] == 8
+    assert all(r["up"] for r in q["recs"])
+    # stop reporting: hosts age into down
+    for _ in range(8):
+        rt.run_tick()
+    q2 = rt.query({"subsys": "hostlist"})
+    assert all(not r["up"] for r in q2["recs"])
+    assert all(r["lastseen"] > 6 for r in q2["recs"])
+
+
+def test_alertdef_on_new_subsystems():
+    rt, sim = _rt()
+    rt.alerts.add_def({"alertname": "host_flood", "subsys": "svcsumm",
+                       "filter": "{ svcsumm.nsvc > 1 }"})
+    rt.run_tick()
+    q = rt.query({"subsys": "alerts", "maxrecs": 100})
+    assert {r["alertname"] for r in q["recs"]} == {"host_flood"}
