@@ -55,24 +55,26 @@ class _EntityState(NamedTuple):
     tlast_notify: float = -1e18
 
 
-# probe order matters: entity-grained ids (cgid, cliid, api…) must come
-# BEFORE hostid, or per-entity alert state collapses to per-host and
-# numcheckfor/dedup break for subsystems with many entities per host
-_ENTITY_KEYS = ("svcid", "taskid", "cgid", "cliid", "api", "flowid",
-                "alertname", "hostid")
+# The entity key is the COMPOSITE of every id-grained column present:
+# one key alone under-identifies rows on several subsystems (tracereq
+# rows are (svcid, api); svcprocmap rows are (svcid, taskid)), and a
+# coarse key collapses per-entity state — numcheckfor then advances
+# once per matching row per check and distinct entities suppress each
+# other through repeataftersec.
+_ENTITY_KEYS = ("svcid", "taskid", "cgid", "cliid", "serid", "api",
+                "flowid", "alertname", "hostid")
 
 
 def _entity_key_of(subsys: str, cols: dict, i: int) -> str:
-    for k in _ENTITY_KEYS:
-        if k in cols:
-            return f"{k}={cols[k][i]}"
-    return f"row={i}"
+    parts = [f"{k}={cols[k][i]}" for k in _ENTITY_KEYS if k in cols]
+    return ",".join(parts) if parts else f"row={i}"
 
 
 def _entity_key_of_row(row: dict) -> str:
-    for k in _ENTITY_KEYS:
-        if k in row and row[k] is not None:
-            return f"{k}={row[k]}"
+    parts = [f"{k}={row[k]}" for k in _ENTITY_KEYS
+             if k in row and row[k] is not None]
+    if parts:
+        return ",".join(parts)
     # id-less subsystems (clusterstate): the whole subsystem is one
     # entity — per-row keys would defeat dedup/numcheckfor entirely
     return "all"

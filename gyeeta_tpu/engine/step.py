@@ -46,14 +46,24 @@ _ABLATE = frozenset(
 
 
 def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
-    """Fold a ConnBatch. cb fields are (B,) device arrays."""
+    """Fold a ConnBatch. cb fields are (B,) device arrays.
+
+    Only accept-observed (server-side) lanes touch the per-service slab
+    — a client-observed record names a REMOTE service and must not
+    materialize (or re-home) its row; the reference likewise keeps
+    client-half conns in remote/unknown maps, not the listener table
+    (``server/gy_mconnhdlr.h:614-632``). Every valid lane still feeds
+    the flow-level sketches (global HLL, CMS, top-K) and the dep graph.
+    """
     valid = cb.valid
+    svc_side = valid & cb.is_accept
     if "upsert" in _ABLATE:
         tbl, rows = st.tbl, table.lookup(st.tbl, cb.svc_hi, cb.svc_lo,
-                                         valid)
+                                         svc_side)
     else:
-        tbl, rows = table.upsert_fast(st.tbl, cb.svc_hi, cb.svc_lo, valid)
-    ok = valid & (rows >= 0)
+        tbl, rows = table.upsert_fast(st.tbl, cb.svc_hi, cb.svc_lo,
+                                      svc_side)
+    ok = svc_side & (rows >= 0)
     rowz = jnp.where(ok, rows, 0)
     S = cfg.svc_capacity
 
@@ -75,11 +85,17 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
         st.svc_hll, rowz, cb.cli_hi, cb.cli_lo, valid=ok)
     glob_hll = st.glob_hll if "globhll" in _ABLATE else hll.update(
         st.glob_hll, cb.flow_hi, cb.flow_lo, valid=valid)
-    tot_bytes = cb.bytes_sent + cb.bytes_rcvd
+    # byte accounting takes the ACCEPT side only: a dual-observed flow
+    # would otherwise count twice into the additive CMS/top-K (the HLL
+    # is immune — it dedups by flow key; the dep graph dedups the same
+    # halves via scatter-max). Server-side listener accounting is also
+    # where the reference attaches traffic stats.
+    tot_bytes = jnp.where(cb.is_accept,
+                          cb.bytes_sent + cb.bytes_rcvd, 0.0)
     cms = st.cms if "cms" in _ABLATE else countmin.update(
-        st.cms, cb.flow_hi, cb.flow_lo, tot_bytes, valid=valid)
+        st.cms, cb.flow_hi, cb.flow_lo, tot_bytes, valid=svc_side)
     flow_topk = st.flow_topk if "topk" in _ABLATE else topk.update(
-        st.flow_topk, cb.flow_hi, cb.flow_lo, tot_bytes, valid=valid)
+        st.flow_topk, cb.flow_hi, cb.flow_lo, tot_bytes, valid=svc_side)
     return st._replace(
         tbl=tbl, ctr_win=ctr_win, svc_host=svc_host, svc_hll=svc_hll,
         glob_hll=glob_hll, cms=cms, flow_topk=flow_topk,
@@ -147,17 +163,22 @@ def td_maybe_flush(cfg: EngineCfg, st: AggState) -> AggState:
 
 
 def ingest_resp_bulk(cfg: EngineCfg, st: AggState, rbs) -> AggState:
-    """Process a whole dispatch's response samples in ONE vectorized
-    pass over the flattened (K*B,) lanes — the fold_many epilogue.
-
-    Replaces K in-scan ``ingest_resp`` calls: one table lookup, one
-    loghist scatter-add, one digest staging route. Unknown services
-    (never announced by conn/listener streams) drop and are counted —
-    the reference likewise only folds response stats into *known*
-    listeners (``gy_socket_stat.cc`` resp events resolve against
-    listener_tbl_).
-    """
+    """Flatten a (K, B) stacked resp batch and fold it in one pass."""
     flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), rbs)
+    return ingest_resp_flat(cfg, st, flat)
+
+
+def ingest_resp_flat(cfg: EngineCfg, st: AggState, flat) -> AggState:
+    """Process response samples in ONE vectorized pass over flat lanes
+    — the fold_many epilogue and the sharded per-shard fold.
+
+    Replaces per-microbatch ``ingest_resp`` calls: one table lookup,
+    one loghist scatter-add, one digest staging route (compression
+    amortizes via ``td_maybe_flush``). Unknown services (never
+    announced by conn/listener streams) drop and are counted — the
+    reference likewise only folds response stats into *known* listeners
+    (``gy_socket_stat.cc`` resp events resolve against listener_tbl_).
+    """
     valid = flat.valid
     rows = table.lookup(st.tbl, flat.svc_hi, flat.svc_lo, valid)
     ok = valid & (rows >= 0)

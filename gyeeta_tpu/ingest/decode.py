@@ -57,6 +57,10 @@ class ConnBatch(NamedTuple):
     duration_us: np.ndarray   # float32 (0 if still open)
     host_id: np.ndarray       # int32 source agent
     is_close: np.ndarray      # bool — close-notification record
+    is_accept: np.ndarray     # bool — server-side (accept-observed):
+    #                           only these lanes update the svc slab; a
+    #                           client-observed record references a
+    #                           REMOTE service it must not materialize
     valid: np.ndarray         # bool lane mask
 
 
@@ -272,6 +276,7 @@ def conn_batch(recs: np.ndarray, size: int = wire.MAX_CONNS_PER_BATCH
         duration_us=_pad(dur, size),
         host_id=_pad(r["host_id"].astype(np.int32), size),
         is_close=_pad(closed, size),
+        is_accept=_pad((r["flags"] & 2) != 0, size),
         valid=valid,
     )
 

@@ -75,15 +75,33 @@ def put_sharded(mesh, batch):
 
 
 def fold_step_sharded(cfg: aggstate.EngineCfg, mesh):
-    """Compiled sharded flagship step: (state, conn, resp) → state."""
+    """Compiled sharded flagship step: (state, conn, resp) → state.
+
+    Uses the same staged-digest hot path as the single-chip
+    ``fold_many``: conn fold + one flat resp pass + amortized digest
+    compression per shard. Callers must apply ``td_flush_sharded``
+    before reading digest quantiles (the sharded runtime does, at tick
+    and query boundaries)."""
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 3,
              out_specs=P(axes_of(mesh)), check_vma=False)
     def _step(st, cb, rb):
-        return _relocal(step.fold_step(cfg, _local(st), _local(cb),
-                                       _local(rb)))
+        local = step.ingest_conn(cfg, _local(st), _local(cb))
+        local = step.ingest_resp_flat(cfg, local, _local(rb))
+        return _relocal(step.td_maybe_flush(cfg, local))
 
     return jax.jit(_step, donate_argnums=(0,))
+
+
+def td_flush_sharded(cfg: aggstate.EngineCfg, mesh):
+    """Per-shard digest-stage flush (query/tick readiness)."""
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(axes_of(mesh)),
+             out_specs=P(axes_of(mesh)), check_vma=False)
+    def _flush(st):
+        return _relocal(step.td_flush(cfg, _local(st)))
+
+    return jax.jit(_flush, donate_argnums=(0,))
 
 
 def tick_5s_sharded(cfg: aggstate.EngineCfg, mesh):

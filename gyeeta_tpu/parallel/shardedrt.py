@@ -57,9 +57,16 @@ class ShardedRuntime:
         self.stats = Stats()
         self.names = InternTable()
         from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
+        from gyeeta_tpu.utils.hostreg import CgroupRegistry, \
+            HostInfoRegistry
+        from gyeeta_tpu.utils.notifylog import NotifyLog
         self.svcreg = SvcInfoRegistry()
+        self.hostinfo = HostInfoRegistry()
+        self.cgroups = CgroupRegistry()
+        self.notifylog = NotifyLog(clock=clock)
         self.alerts = AlertManager(self.cfg, clock=clock)
         self._clock = clock or time.time
+        self._t_started = self._clock()
         self._tick_no = 0
         self._pending = b""
 
@@ -73,6 +80,8 @@ class ShardedRuntime:
                         self.opts.dep_edge_capacity)), shd)
 
         self._fold = sharded.fold_step_sharded(self.cfg, self.mesh)
+        self._td_flush = sharded.td_flush_sharded(self.cfg, self.mesh)
+        self._td_dirty = False
         self._fold_lst = sharded.ingest_listener_sharded(self.cfg,
                                                          self.mesh)
         self._fold_host = sharded.ingest_host_sharded(self.cfg, self.mesh)
@@ -112,6 +121,19 @@ class ShardedRuntime:
         self._mesh_clusters = jax.jit(dg.mesh_clusters,
                                       static_argnums=(1,))
 
+        from gyeeta_tpu.alerts import columns as AC
+        self._aux = {
+            "hostinfo": lambda: self.hostinfo.columns(self.names),
+            "cgroupstate": lambda: self.cgroups.columns(self.names),
+            "alerts": lambda: AC.alerts_columns(self.alerts),
+            "alertdef": lambda: AC.alertdef_columns(self.alerts),
+            "silences": lambda: AC.silences_columns(self.alerts),
+            "inhibits": lambda: AC.inhibits_columns(self.alerts),
+            "notifymsg": lambda: self.notifylog.columns(self.names),
+            "serverstatus": self._serverstatus_columns,
+            "hostlist": self._hostlist_columns,
+        }
+
     # ------------------------------------------------------------- ingest
     def _stack(self, builder, recs, lanes):
         return sharded.put_sharded(self.mesh, sharded.shard_batches(
@@ -140,6 +162,7 @@ class ShardedRuntime:
                 rbs = self._stack(decode.resp_batch, rchunk,
                                   self.cfg.resp_batch)
                 self.state = self._fold(self.state, cbs, rbs)
+                self._td_dirty = True
                 self.dep = self._dep_step(self.dep, cbs,
                                           np.int32(self._tick_no))
                 n += len(cchunk) + len(rchunk)
@@ -172,6 +195,14 @@ class ShardedRuntime:
                 self.stats.bump("listener_infos",
                                 self.svcreg.update(chunks[0]))
                 n += len(chunks[0])
+            elif kind == "host_info":
+                self.stats.bump("host_infos",
+                                self.hostinfo.update(chunks[0]))
+                n += len(chunks[0])
+            elif kind == "cgroup":
+                self.stats.bump("cgroup_records",
+                                self.cgroups.update(chunks[0]))
+                n += len(chunks[0])
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
@@ -199,10 +230,25 @@ class ShardedRuntime:
     def _merged_columns(self, subsys: str):
         """Cluster-wide (cols, mask): per-shard provider outputs
         concatenated, or collective-rollup-backed for global subsystems."""
+        if subsys in self._aux:
+            return self._aux[subsys]()
         if subsys == fieldmaps.SUBSYS_SVCINFO:
             return self.svcreg.columns(self.names)
+        if subsys == fieldmaps.SUBSYS_SVCSUMM:
+            # group AFTER merging: one host's services span shards
+            cols, live = self._merged_columns(fieldmaps.SUBSYS_SVCSTATE)
+            return api.svcsumm_from_svc(cols, live, self.names)
+        if subsys == fieldmaps.SUBSYS_EXTSVCSTATE:
+            cols, live = self._merged_columns(fieldmaps.SUBSYS_SVCSTATE)
+            info_cols, _ = self.svcreg.columns(self.names)
+            return api.extsvc_join(cols, live, info_cols)
+        if subsys == fieldmaps.SUBSYS_SVCPROCMAP:
+            tcols, tlive = self._merged_columns(fieldmaps.SUBSYS_TASKSTATE)
+            info_cols, _ = self.svcreg.columns(self.names)
+            return api.svcprocmap_join(tcols, tlive, info_cols)
         if subsys in (fieldmaps.SUBSYS_SVCDEP, fieldmaps.SUBSYS_SVCMESH,
-                      fieldmaps.SUBSYS_ACTIVECONN):
+                      fieldmaps.SUBSYS_ACTIVECONN,
+                      fieldmaps.SUBSYS_CLIENTCONN):
             es = self._edge_roll(self.dep)
             return self._dep_cols_from_edgeset(subsys, es)
         if subsys == fieldmaps.SUBSYS_FLOWSTATE:
@@ -234,18 +280,36 @@ class ShardedRuntime:
         mask = np.concatenate([p[1] for p in parts])
         return cols, mask
 
+    def _gathered_task_names(self, hi, lo):
+        """Resolve task-group callers via the gathered task slabs."""
+        keys, comms, lives = [], [], []
+        for s in range(self.n):
+            k, c, lv = api._task_slab_arrays(self._shard_state(s))
+            keys.append(k)
+            comms.append(c)
+            lives.append(lv)
+        return api.task_comm_names_from(
+            self.names, np.concatenate(keys), np.concatenate(comms),
+            np.concatenate(lives), hi, lo)
+
     def _dep_cols_from_edgeset(self, subsys: str, es):
         from gyeeta_tpu.engine import table
 
-        if subsys == fieldmaps.SUBSYS_ACTIVECONN:
+        if subsys in (fieldmaps.SUBSYS_ACTIVECONN,
+                      fieldmaps.SUBSYS_CLIENTCONN):
             snap = {
                 "e_live": np.asarray(table.live_mask(es.tbl)),
+                "e_cli_hi": np.asarray(es.cli_hi),
+                "e_cli_lo": np.asarray(es.cli_lo),
                 "e_ser_hi": np.asarray(es.ser_hi),
                 "e_ser_lo": np.asarray(es.ser_lo),
                 "e_nconn": np.asarray(es.nconn),
                 "e_bytes": np.asarray(es.byts),
                 "e_cli_svc": np.asarray(es.cli_svc),
             }
+            if subsys == fieldmaps.SUBSYS_CLIENTCONN:
+                return api.clientconn_from_edges(
+                    snap, self.names, self._gathered_task_names)
             return api.activeconn_from_edges(snap, self.names)
         if subsys == fieldmaps.SUBSYS_SVCMESH:
             cap = 2 * es.nconn.shape[0]
@@ -266,15 +330,7 @@ class ShardedRuntime:
         svc_names = api._names_of(self.names, wire.NAME_KIND_SVC,
                                   cli_hi, cli_lo)
         # task→svc callers resolve via the gathered task slabs (comm join)
-        keys, comms, lives = [], [], []
-        for s in range(self.n):
-            k, c, lv = api._task_slab_arrays(self._shard_state(s))
-            keys.append(k)
-            comms.append(c)
-            lives.append(lv)
-        task_names = api.task_comm_names_from(
-            self.names, np.concatenate(keys), np.concatenate(comms),
-            np.concatenate(lives), cli_hi, cli_lo)
+        task_names = self._gathered_task_names(cli_hi, cli_lo)
         cols = {
             "cliid": api._hex_id(cli_hi, cli_lo),
             "cliname": np.where(cli_svc, svc_names, task_names),
@@ -287,14 +343,69 @@ class ShardedRuntime:
         }
         return cols, live
 
+    def _hostlist_columns(self):
+        """hostlist over the mesh: each shard's host panel holds only
+        its routed hosts (global ids), so concatenating the seen rows
+        of every shard yields the cluster host list."""
+        parts_id, parts_age = [], []
+        for s in range(self.n):
+            last = np.asarray(self._shard_state(s).host_last_tick)
+            seen = np.nonzero(last >= 0)[0]
+            parts_id.append(seen)
+            parts_age.append(self._tick_no - last[seen])
+        ids = np.concatenate(parts_id)
+        age = np.concatenate(parts_age)
+        order = np.argsort(ids, kind="stable")
+        ids, age = ids[order], age[order]
+        from gyeeta_tpu.ingest import wire as W
+        names = self.names.resolve_array(W.NAME_KIND_HOST,
+                                         ids.astype(np.uint64))
+        cols = {
+            "hostid": ids.astype(np.float64),
+            "hostname": names,
+            "up": age <= api.DOWN_AFTER_TICKS,
+            "lastseen": age.astype(np.float64),
+        }
+        return cols, np.ones(len(ids), bool)
+
+    def _serverstatus_columns(self):
+        from gyeeta_tpu import version as V
+
+        ru = self._rollup(self.state)
+        c = self.stats.counters
+        obj = lambda v: np.array([v], object)  # noqa: E731
+        num = lambda v: np.array([float(v)], np.float64)  # noqa: E731
+        cols = {
+            "uptime": num(self._clock() - self._t_started),
+            "tick": num(self._tick_no),
+            "nhosts": num(float(ru.n_hosts_up)),
+            "nsvc": num(float(ru.n_svc_live)),
+            "connevents": num(float(ru.n_conn)),
+            "respevents": num(float(ru.n_resp)),
+            "queries": num(c.get("queries", 0)),
+            "alertsfired": num(self.alerts.stats.get("nfired", 0)),
+            "wirever": num(V.CURR_WIRE_VERSION),
+            "version": obj(V.__version__),
+        }
+        return cols, np.ones(1, bool)
+
     # ------------------------------------------------------------ cadence
+    def _ensure_td_flushed(self) -> None:
+        """Digest stages must compress before any quantile readback."""
+        if self._td_dirty:
+            self.state = self._td_flush(self.state)
+            self._td_dirty = False
+
     def run_tick(self) -> dict:
         """Sharded 5s pass: classify → alerts on merged columns → window
         tick → ageing."""
         report = {}
+        self._ensure_td_flushed()
         self.state = self._classify(self.state)
         fired = self.alerts.check(None, columns_fn=self._merged_columns)
         report["alerts_fired"] = len(fired)
+        for a in fired:
+            self.notifylog.add_alert(a)
         self._tick_no += 1
         report["tick"] = self._tick_no
         self.state = self._tick(self.state)
@@ -310,6 +421,7 @@ class ShardedRuntime:
             from gyeeta_tpu.utils.selfstats import selfstats_response
             return selfstats_response(self.stats, self.alerts)
         self.stats.bump("queries")
+        self._ensure_td_flushed()
         with self.stats.timeit("query"):
             return api.execute(self.cfg, None, QueryOptions.from_json(req),
                                names=self.names,

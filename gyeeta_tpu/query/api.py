@@ -439,16 +439,20 @@ def svcinfo_columns(cfg: EngineCfg, st: AggState, names=None,
     return svcreg.columns(names)
 
 
-def clientconn_from_edges(st: AggState, snap: dict, names=None):
+def clientconn_from_edges(snap: dict, names=None, task_names_fn=None):
     """Group dep edges by CALLER (the clientconn view: what does this
-    process-group / service call, ref remoteconn/clientconn tables)."""
+    process-group / service call, ref remoteconn/clientconn tables).
+
+    ``task_names_fn(hi, lo) -> names`` resolves task-group callers
+    (single-node: the local task slab; sharded: gathered slabs)."""
     from gyeeta_tpu.ingest import wire
 
     hi, lo, inv, segsum, live = _group_edges(snap, "cli")
     is_svc = np.zeros(len(hi), bool)
     np.maximum.at(is_svc, inv, snap["e_cli_svc"][live].astype(bool))
     svc_names = _names_of(names, wire.NAME_KIND_SVC, hi, lo)
-    task_names = _task_comm_names(st, names, hi, lo)
+    task_names = (task_names_fn(hi, lo) if task_names_fn is not None
+                  else _hex_id(hi, lo))
     cols = {
         "cliid": _hex_id(hi, lo),
         "cliname": np.where(is_svc, svc_names, task_names),
@@ -466,18 +470,20 @@ def clientconn_columns(cfg: EngineCfg, st: AggState, names=None,
         raise ValueError("clientconn needs a dependency graph")
     snap = {k: np.asarray(v)
             for k, v in readback.dep_edges_snapshot(dep).items()}
-    return clientconn_from_edges(st, snap, names)
+    return clientconn_from_edges(
+        snap, names, lambda hi, lo: _task_comm_names(st, names, hi, lo))
 
 
-def svcsumm_columns(cfg: EngineCfg, st: AggState, names=None):
-    """svcsumm subsystem: per-host service-state summary (the
-    LISTEN_SUMM_STATS rollup, ``server/gy_msocket.h:841``), built by
-    grouping the svcstate snapshot host-side."""
+def svcsumm_from_svc(cols, live, names=None):
+    """Group svcstate columns by host → svcsumm columns. Takes the
+    ALREADY-MERGED columns so single-node and sharded paths summarize
+    identically (grouping per shard would fragment hosts whose services
+    land on several shards)."""
+    from gyeeta_tpu.ingest import wire
     from gyeeta_tpu.semantic import states as S
 
-    cols, live = svc_columns(cfg, st, names=names)
     idx = np.nonzero(live)[0]
-    hosts = cols["hostid"][idx].astype(np.int64)
+    hosts = np.asarray(cols["hostid"])[idx].astype(np.int64)
     ids, inv = np.unique(hosts, return_inverse=True)
     n = len(ids)
 
@@ -486,11 +492,15 @@ def svcsumm_columns(cfg: EngineCfg, st: AggState, names=None):
         np.add.at(out, inv, np.asarray(vals, np.float64))
         return out
 
-    state = cols["state"][idx]
-    hostids, hostnames = _host_name_cols(cfg.n_hosts, names)
+    state = np.asarray(cols["state"])[idx]
+    if names is not None:
+        hostnames = names.resolve_array(
+            wire.NAME_KIND_HOST, ids.astype(np.uint64))
+    else:
+        hostnames = np.array([str(i) for i in ids], object)
     out = {
         "hostid": ids.astype(np.float64),
-        "hostname": np.asarray(hostnames, object)[ids],
+        "hostname": hostnames,
         "nsvc": segsum(np.ones(len(idx))),
         "nidle": segsum(state == S.STATE_IDLE),
         "ngood": segsum(state == S.STATE_GOOD),
@@ -499,23 +509,24 @@ def svcsumm_columns(cfg: EngineCfg, st: AggState, names=None):
         "nsevere": segsum(state == S.STATE_SEVERE),
         "ndown": segsum(state == S.STATE_DOWN),
         "nissue": segsum(state >= S.STATE_BAD),
-        "totqps": segsum(cols["qps5s"][idx]),
-        "totactive": segsum(cols["nactive"][idx]),
-        "totkbin": segsum(cols["kbin15s"][idx]),
-        "totkbout": segsum(cols["kbout15s"][idx]),
+        "totqps": segsum(np.asarray(cols["qps5s"])[idx]),
+        "totactive": segsum(np.asarray(cols["nactive"])[idx]),
+        "totkbin": segsum(np.asarray(cols["kbin15s"])[idx]),
+        "totkbout": segsum(np.asarray(cols["kbout15s"])[idx]),
     }
     return out, np.ones(n, bool)
 
 
-def extsvcstate_columns(cfg: EngineCfg, st: AggState, names=None,
-                        svcreg=None):
-    """extsvcstate: svcstate ⋈ svcinfo on svcid (the reference's
-    "extended" subsystems join state+info records,
-    ``server/gy_mnodehandle.cc:4657``). State rows without announced
-    metadata still appear, with empty info columns."""
+def svcsumm_columns(cfg: EngineCfg, st: AggState, names=None):
+    """svcsumm subsystem: per-host service-state summary (the
+    LISTEN_SUMM_STATS rollup, ``server/gy_msocket.h:841``)."""
     cols, live = svc_columns(cfg, st, names=names)
-    info_cols, _ = (svcreg.columns(names) if svcreg is not None
-                    else ({}, None))
+    return svcsumm_from_svc(cols, live, names)
+
+
+def extsvc_join(cols, live, info_cols):
+    """Join svcstate columns with svcinfo columns on svcid (shared by
+    single-node and sharded extsvcstate providers)."""
     n = len(cols["svcid"])
     keys = (("ip", ""), ("port", 0.0), ("comm", ""), ("cmdline", ""),
             ("pid", 0.0), ("tstart", 0.0))
@@ -537,16 +548,32 @@ def extsvcstate_columns(cfg: EngineCfg, st: AggState, names=None,
     return joined, live
 
 
+def extsvcstate_columns(cfg: EngineCfg, st: AggState, names=None,
+                        svcreg=None):
+    """extsvcstate: svcstate ⋈ svcinfo on svcid (the reference's
+    "extended" subsystems join state+info records,
+    ``server/gy_mnodehandle.cc:4657``). State rows without announced
+    metadata still appear, with empty info columns."""
+    cols, live = svc_columns(cfg, st, names=names)
+    info_cols, _ = (svcreg.columns(names) if svcreg is not None
+                    else ({}, None))
+    return extsvc_join(cols, live, info_cols)
+
+
 def svcprocmap_columns(cfg: EngineCfg, st: AggState, names=None,
                        svcreg=None):
     """svcprocmap: listener ↔ process-group mapping via the shared
     related_listen_id (ref LISTEN_TASKMAP_NOTIFY,
     ``gy_comm_proto.h:2813``)."""
-    from gyeeta_tpu.ingest import wire
-
     tcols, tlive = task_columns(cfg, st, names=names)
     info_cols, _ = (svcreg.columns(names) if svcreg is not None
                     else (None, None))
+    return svcprocmap_join(tcols, tlive, info_cols)
+
+
+def svcprocmap_join(tcols, tlive, info_cols):
+    """Join task columns with svcinfo on related_listen_id (shared by
+    single-node and sharded providers — pass MERGED task columns)."""
     rows = {"svcid": [], "svcname": [], "relsvcid": [], "taskid": [],
             "comm": [], "hostid": []}
     if info_cols is not None and len(tcols["taskid"]):

@@ -374,6 +374,7 @@ HOSTLIST_FIELDS = (
 # ------------------------------------------------------------ serverstatus
 # ref SUBSYS_MADHAVASTATUS/SHYAMASTATUS: one-row server self status
 SERVERSTATUS_FIELDS = (
+    num("uptime", "uptime", "Seconds since server start"),
     num("tick", "tick", "Current 5s window tick"),
     num("nhosts", "nhosts", "Hosts that have ever reported"),
     num("nsvc", "nsvc", "Live service rows"),

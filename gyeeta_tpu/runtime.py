@@ -286,10 +286,7 @@ class Runtime:
             fired += self.alerts.check_db(self.history)
         report["alerts_fired"] = len(fired)
         for a in fired:
-            self.notifylog.add(
-                f"alert {a.alertname} [{a.severity}] {a.entity}",
-                ntype="warn" if a.severity in ("warning", "info")
-                else "error", source="alert")
+            self.notifylog.add_alert(a)
 
         self.state = self._tick(self.state)
         if tick % self.opts.task_age_every_ticks == 0:
@@ -340,6 +337,7 @@ class Runtime:
         obj = lambda v: np.array([v], object)  # noqa: E731
         num = lambda v: np.array([float(v)], np.float64)  # noqa: E731
         cols = {
+            "uptime": num(self._clock() - self._t_started),
             "tick": num(self._tick_no),
             "nhosts": num(int((np.asarray(self.state.host_last_tick)
                                >= 0).sum())),

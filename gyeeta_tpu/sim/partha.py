@@ -106,7 +106,9 @@ class ParthaSim:
         out["cli_pid"] = cli.astype(np.int32) + 1000
         out["ser_pid"] = svc.astype(np.int32) + 300
         out["host_id"] = (host + self.host_base).astype(np.uint32)
-        out["flags"] = 1  # connect-observed
+        # accept-observed: these are the service host's own close
+        # notifications (the server side owns the listener row)
+        out["flags"] = 2
         self.tusec += np.uint64(5_000_000)
         return out
 

@@ -39,8 +39,13 @@ class RuntimeOpts(NamedTuple):
     debug_level: int = 0                    # hot-reloadable
     resp_sample_pct: float = 100.0          # hot-reloadable duty cycle
     # dependency graph (parallel/depgraph.py): slab sizes + TTLs
-    dep_pair_capacity: int = 8192           # in-flight unpaired halves
-    dep_edge_capacity: int = 4096           # dependency edges tracked
+    # in-flight unpaired halves: sized so one flattened fold_k-deep
+    # dispatch of one-sided halves (fold_k × conn_batch = 32768 by
+    # default) fits at <70% load even before intra-dispatch pairing
+    # reclaims rows (ref: ~100k unresolved-conn cap per madhava,
+    # server/gy_mconnhdlr.h:94)
+    dep_pair_capacity: int = 65536
+    dep_edge_capacity: int = 16384          # dependency edges tracked
     dep_pair_ttl_ticks: int = 24            # unpaired halves expire (2 min)
     dep_edge_ttl_ticks: int = 720           # idle edges expire (1 h)
 

@@ -37,6 +37,15 @@ class NotifyLog:
             source: str = "server") -> None:
         self._ring.append(Notification(self._clock(), ntype, source, msg))
 
+    def add_alert(self, alert) -> None:
+        """One fired :class:`~gyeeta_tpu.alerts.manager.Alert` → entry
+        (shared by both runtimes so the format/severity mapping can't
+        diverge)."""
+        self.add(f"alert {alert.alertname} [{alert.severity}] "
+                 f"{alert.entity}",
+                 ntype=NOTIFY_WARN if alert.severity in ("warning", "info")
+                 else NOTIFY_ERROR, source="alert")
+
     def __len__(self) -> int:
         return len(self._ring)
 

@@ -162,3 +162,80 @@ def test_dryrun_contract_shardedrt():
     fn, args = ge.entry()
     import jax
     jax.jit(fn).lower(*args)          # single-chip compile check
+
+
+def test_new_subsystems_sharded_vs_single():
+    """svcsumm/extsvcstate/clientconn/svcprocmap/hostlist/serverstatus/
+    notifymsg/hostinfo/cgroupstate must work on the mesh and agree with
+    the single-node runtime where deterministic."""
+    from gyeeta_tpu.ingest import wire
+
+    mesh = make_mesh(8)
+    srt = ShardedRuntime(CFG, mesh, OPTS)
+    rt = Runtime(CFG, OPTS)
+    sim = ParthaSim(n_hosts=16, n_svcs=3, seed=17)
+    cli, ser = sim.svc_conn_records(128, split_halves=True)
+    bufs = [
+        sim.name_frames(),
+        wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
+                          sim.listener_info_records())
+        + sim.host_info_frames() + sim.cgroup_frames(),
+        sim.conn_frames(512) + sim.resp_frames(512)
+        + sim.listener_frames() + sim.task_frames()
+        + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                            sim.host_state_records())
+        + wire.encode_frame(wire.NOTIFY_TCP_CONN, cli)
+        + wire.encode_frame(wire.NOTIFY_TCP_CONN, ser),
+    ]
+    for buf in bufs:
+        srt.feed(buf)
+        rt.feed(buf)
+    srt.run_tick()
+    rt.run_tick()
+
+    # svcsumm: grouped after merge — totals must match single-node
+    qs = srt.query({"subsys": "svcsumm", "sortcol": "hostid",
+                    "maxrecs": 64})
+    q1 = rt.query({"subsys": "svcsumm", "sortcol": "hostid",
+                   "maxrecs": 64})
+    assert qs["nrecs"] == q1["nrecs"] == 16
+    assert (sum(r["nsvc"] for r in qs["recs"])
+            == sum(r["nsvc"] for r in q1["recs"]))
+    per_host_s = {r["hostid"]: r["nsvc"] for r in qs["recs"]}
+    per_host_1 = {r["hostid"]: r["nsvc"] for r in q1["recs"]}
+    assert per_host_s == per_host_1
+
+    # extsvcstate: join produces info columns on the mesh
+    qe = srt.query({"subsys": "extsvcstate", "maxrecs": 300})
+    assert qe["nrecs"] >= 48
+    assert any(r["port"] > 0 for r in qe["recs"])
+
+    # clientconn: svc callers resolve with names
+    qc = srt.query({"subsys": "clientconn", "maxrecs": 300})
+    assert qc["nrecs"] > 0
+    assert any(r["clisvc"] for r in qc["recs"])
+
+    # svcprocmap rows exist and carry comm names
+    qp = srt.query({"subsys": "svcprocmap", "maxrecs": 300})
+    assert qp["nrecs"] > 0
+    assert qp["recs"][0]["comm"].startswith("proc-")
+
+    # hostinfo + cgroupstate registries answer on the mesh
+    assert srt.query({"subsys": "hostinfo"})["nrecs"] == 16
+    assert srt.query({"subsys": "cgroupstate"})["nrecs"] == 16 * 4
+
+    # hostlist: all 16 hosts up
+    qh = srt.query({"subsys": "hostlist"})
+    assert qh["nrecs"] == 16 and all(r["up"] for r in qh["recs"])
+
+    # serverstatus singleton with cluster totals
+    ss = srt.query({"subsys": "serverstatus"})["recs"][0]
+    assert ss["nhosts"] == 16 and ss["nsvc"] >= 48
+    assert ss["uptime"] >= 0
+
+    # notifymsg: alert-driven entries flow on the mesh
+    srt.alerts.add_def({"alertname": "always", "subsys": "hoststate",
+                        "filter": "{ hoststate.nproc > 0 }"})
+    srt.run_tick()
+    qn = srt.query({"subsys": "notifymsg", "maxrecs": 10})
+    assert qn["nrecs"] > 0
