@@ -54,19 +54,30 @@ async def register(host: str, port: int, machine_id: int, conn_type: int,
 
 
 class NetAgent:
-    """One simulated host agent over a real socket."""
+    """One host agent over a real socket.
+
+    ``collect=True`` turns on the REAL host collectors
+    (``net/collect.py``): host inventory, 2s CPU/mem gauges, and cgroup
+    sweeps are then measured from this machine's /proc //sys instead of
+    simulated; conn/resp/listener/task streams stay simulated (their
+    kernel-side capture has no userspace equivalent — the reference
+    needs eBPF for them)."""
 
     def __init__(self, machine_id: Optional[int] = None, seed: int = 0,
                  n_svcs: int = 4, n_groups: int = 6,
-                 wire_version: int = version.CURR_WIRE_VERSION):
+                 wire_version: int = version.CURR_WIRE_VERSION,
+                 collect: bool = False):
         self.machine_id = machine_id if machine_id is not None \
             else H.hash_bytes_np(f"sim-agent-{seed}".encode())
         self.seed = seed
         self.n_svcs = n_svcs
         self.n_groups = n_groups
         self.wire_version = wire_version
+        self.collect = collect
         self.host_id: Optional[int] = None
         self.sim: Optional[ParthaSim] = None
+        self._cpumem = None
+        self._cgroups = None
         self._writer = None
 
     async def connect(self, host: str, port: int) -> int:
@@ -85,19 +96,33 @@ class NetAgent:
         self.sim = ParthaSim(
             n_hosts=1, n_svcs=self.n_svcs, n_groups=self.n_groups,
             seed=1000 + hid, host_base=hid)
+        if self.collect:
+            from gyeeta_tpu.net import collect as C
+            self._cpumem = C.CpuMemCollector(host_id=hid)
+            self._cgroups = C.CgroupCollector(host_id=hid)
+            self._cgroups.sample()        # prime the delta baseline
         await self.send_names()
         return hid
 
     async def send_names(self) -> None:
         """Announce inventory: names + listener metadata + host info
         (the reference agent resends its inventory on reconnect)."""
+        import os
+        hostname = (os.uname().nodename if self.collect
+                    else f"agent-{self.host_id}.sim")
         buf = (self.sim.name_frames() + wire.encode_frame(
             wire.NOTIFY_NAME_INTERN,
             wire_name_record(wire.NAME_KIND_HOST, self.host_id,
-                             f"agent-{self.host_id}.sim"))
+                             hostname))
             + wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
-                                self.sim.listener_info_records())
-            + self.sim.host_info_frames())
+                                self.sim.listener_info_records()))
+        if self.collect:
+            from gyeeta_tpu.net import collect as C
+            hi, names = C.collect_host_info(host_id=self.host_id)
+            buf += (wire.encode_frame(wire.NOTIFY_NAME_INTERN, names)
+                    + wire.encode_frame(wire.NOTIFY_HOST_INFO, hi))
+        else:
+            buf += self.sim.host_info_frames()
         self._writer.write(buf)
         await self._writer.drain()
 
@@ -107,11 +132,21 @@ class NetAgent:
         s = self.sim
         buf = (s.conn_frames(n_conn) + s.resp_frames(n_resp)
                + s.listener_frames() + s.task_frames()
-               + s.cgroup_frames()
                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
-                                   s.host_state_records())
-               + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
-                                   s.cpu_mem_records()))
+                                   s.host_state_records()))
+        if self.collect:
+            buf += wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
+                                     self._cpumem.sample())
+            cg, cgnames = self._cgroups.sample()
+            if len(cgnames):
+                buf += wire.encode_frame(wire.NOTIFY_NAME_INTERN,
+                                         cgnames)
+            if len(cg):
+                buf += wire.encode_frame(wire.NOTIFY_CGROUP_STATE, cg)
+        else:
+            buf += (s.cgroup_frames()
+                    + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
+                                        s.cpu_mem_records()))
         self._writer.write(buf)
         await self._writer.drain()
 
