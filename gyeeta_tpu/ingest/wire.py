@@ -40,6 +40,10 @@ COMM_QUERY_CMD = 2
 COMM_QUERY_RESP = 3
 COMM_REGISTER_REQ = 4     # agent handshake (ref PS_REGISTER_REQ_S :584)
 COMM_REGISTER_RESP = 5
+COMM_TRACE_SET = 6        # server→agent capture control (ref
+#                           REQ_TRACE_SET, gy_comm_proto.h:3295; rides
+#                           the event conn in reverse — the analogue of
+#                           the reference's CLI_TYPE_RESP_REQ direction)
 
 # NOTIFY_TYPE (EVENT_NOTIFY subtype_)
 NOTIFY_TCP_CONN = 10          # flow close/open records
@@ -407,6 +411,35 @@ CONN_QUERY = 2
 REG_OK = 0
 REG_ERR_VERSION = 1              # older than MIN_WIRE_VERSION
 REG_ERR_CAPACITY = 2             # host slots exhausted (n_hosts)
+
+# Trace capture control (server→agent): which services to capture.
+# One record per service; enable=0 stops capture (ref REQ_TRACE_SET /
+# SM_REQ_TRACE_DEF_NEW→partha distribution, gy_comm_proto.h:3295,3377).
+TRACE_SET_DT = np.dtype([
+    ("svc_glob_id", "<u8"),
+    ("enable", "u1"),
+    ("pad", "u1", (7,)),
+])
+
+MAX_TRACE_SET_PER_BATCH = 4096
+
+
+def encode_trace_set(svc_ids, enable) -> bytes:
+    """(svc_glob_ids, enable flags) → COMM_TRACE_SET frame(s); large
+    sets chunk at the batch cap like every other record stream."""
+    recs = np.zeros(len(svc_ids), TRACE_SET_DT)
+    recs["svc_glob_id"] = np.asarray(svc_ids, np.uint64)
+    recs["enable"] = np.asarray(enable, np.uint8)
+    return b"".join(
+        _frame(COMM_TRACE_SET,
+               recs[i: i + MAX_TRACE_SET_PER_BATCH].tobytes(), MAGIC_MS)
+        for i in range(0, max(len(recs), 1), MAX_TRACE_SET_PER_BATCH))
+
+
+def decode_trace_set(payload: bytes) -> np.ndarray:
+    n = len(payload) // TRACE_SET_DT.itemsize
+    return np.frombuffer(payload, TRACE_SET_DT, count=n)
+
 
 # Query multiplexing (ref QUERY_CMD/QUERY_RESPONSE, gy_comm_proto.h:502,
 # 536; ≤4K outstanding :53): seqid echoes back with the JSON response.

@@ -79,6 +79,10 @@ class NetAgent:
         self._cpumem = None
         self._cgroups = None
         self._writer = None
+        self._ctrl_task = None
+        # svc glob ids with capture enabled by the server (REQ_TRACE_SET
+        # analogue); empty = no tracing
+        self.trace_enabled: set = set()
 
     async def connect(self, host: str, port: int) -> int:
         """Register the event conn; returns assigned host_id."""
@@ -101,8 +105,26 @@ class NetAgent:
             self._cpumem = C.CpuMemCollector(host_id=hid)
             self._cgroups = C.CgroupCollector(host_id=hid)
             self._cgroups.sample()        # prime the delta baseline
+        # server→agent control frames ride the same conn in reverse
+        self._ctrl_task = asyncio.create_task(self._control_loop(reader))
         await self.send_names()
         return hid
+
+    async def _control_loop(self, reader) -> None:
+        """Apply COMM_TRACE_SET capture control from the server."""
+        while True:
+            try:
+                dtype, payload = await _read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    wire.FrameError):
+                return
+            if dtype != wire.COMM_TRACE_SET:
+                continue
+            for r in wire.decode_trace_set(payload):
+                if r["enable"]:
+                    self.trace_enabled.add(int(r["svc_glob_id"]))
+                else:
+                    self.trace_enabled.discard(int(r["svc_glob_id"]))
 
     async def send_names(self) -> None:
         """Announce inventory: names + listener metadata + host info
@@ -134,6 +156,9 @@ class NetAgent:
                + s.listener_frames() + s.task_frames()
                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
                                    s.host_state_records()))
+        if self.trace_enabled:
+            # capture is on for some services: emit their transactions
+            buf += s.trace_frames(n_resp, only_svcs=self.trace_enabled)
         if self.collect:
             buf += wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
                                      self._cpumem.sample())
@@ -151,6 +176,9 @@ class NetAgent:
         await self._writer.drain()
 
     async def close(self) -> None:
+        if self._ctrl_task:
+            self._ctrl_task.cancel()
+            self._ctrl_task = None
         if self._writer:
             self._writer.close()
             try:

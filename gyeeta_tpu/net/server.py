@@ -56,6 +56,10 @@ class GytServer:
         self._hostmap_path = pathlib.Path(hostmap_path) \
             if hostmap_path else None
         self.hostmap: dict[int, int] = self._load_hostmap()
+        # host_id → event-conn writer: the reverse-direction channel for
+        # server→agent control (trace capture enable/disable — the
+        # reference's CLI_TYPE_RESP_REQ conns carry this, gy_comm_proto.h)
+        self._event_writers: dict[int, asyncio.StreamWriter] = {}
 
     # -------------------------------------------------------- registration
     def _load_hostmap(self) -> dict:
@@ -119,8 +123,32 @@ class GytServer:
             await asyncio.sleep(self.tick_interval)
             try:
                 self.rt.run_tick()
+                await self.push_trace_control()
             except Exception:                     # pragma: no cover
                 log.exception("tick failed")
+
+    async def push_trace_control(self) -> int:
+        """Evaluate tracedefs and push enable/disable diffs to the
+        owning agents' event conns (the REQ_TRACE_SET distribution,
+        ``gy_shconnhdlr.cc:1272`` → partha). Returns records pushed."""
+        diffs = self.rt.trace_control_diff(
+            hosts=list(self._event_writers))
+        n = 0
+        for hid, (enable, disable) in diffs.items():
+            w = self._event_writers.get(hid)
+            if w is None:
+                continue
+            ids = list(enable) + list(disable)
+            flags = [1] * len(enable) + [0] * len(disable)
+            try:
+                w.write(wire.encode_trace_set(ids, flags))
+                await w.drain()
+                n += len(ids)
+            except (ConnectionError, OSError):
+                pass            # agent gone; resync on reconnect
+        if n:
+            self.rt.stats.bump("trace_sets_pushed", n)
+        return n
 
     async def _read_frame(self, reader) -> tuple[int, bytes]:
         """→ (data_type, payload_bytes). Raises IncompleteReadError at EOF."""
@@ -155,7 +183,18 @@ class GytServer:
             if status != wire.REG_OK:
                 return
             if int(req["conn_type"]) == wire.CONN_EVENT:
-                await self._event_loop(reader)
+                if host_id != 0xFFFFFFFF:
+                    self._event_writers[host_id] = writer
+                    # reconnect resync: re-push full capture state
+                    self.rt.tracedefs.forget_host(host_id)
+                try:
+                    await self._event_loop(reader)
+                finally:
+                    if self._event_writers.get(host_id) is writer:
+                        del self._event_writers[host_id]
+                        # applied capture state is unknowable once the
+                        # conn drops; rebuild it on reconnect
+                        self.rt.tracedefs.forget_host(host_id)
             else:
                 await self._query_loop(reader, writer)
         except wire.FrameError as e:

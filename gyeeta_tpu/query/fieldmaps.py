@@ -40,6 +40,9 @@ SUBSYS_SVCPROCMAP = "svcprocmap"    # ref svcprocmap (listener↔procs)
 SUBSYS_NOTIFYMSG = "notifymsg"      # ref notifymsg
 SUBSYS_HOSTLIST = "hostlist"        # ref parthalist (agents + liveness)
 SUBSYS_SERVERSTATUS = "serverstatus"  # ref madhavastatus/shyamastatus
+SUBSYS_TRACEDEF = "tracedef"        # ref tracedef (capture control)
+SUBSYS_TRACESTATUS = "tracestatus"  # ref tracestatus
+SUBSYS_TRACEUNIQ = "traceuniq"      # ref traceuniq (APIs per svc)
 SUBSYS_CGROUPSTATE = "cgroupstate"  # ref cgroupstate
 SUBSYS_ALERTS = "alerts"            # ref alerts (fired alert log)
 SUBSYS_ALERTDEF = "alertdef"        # ref alertdef
@@ -386,6 +389,29 @@ SERVERSTATUS_FIELDS = (
     string("version", "version", "Server version"),
 )
 
+# ------------------------------------------------------------ trace defs
+# ref tracedef / tracestatus subsystems (REQ_TRACE_DEF distribution,
+# common/gy_trace_def.h; tracestatustbl)
+TRACEDEF_FIELDS = (
+    string("name", "name", "Trace definition name"),
+    string("filter", "filter", "Service-selection criteria (svcinfo)"),
+    num("tend", "tend", "Capture until (epoch sec; 0 = no expiry)"),
+    boolean("active", "active", "Definition currently in effect"),
+    num("nsvc", "nsvc", "Services currently capturing"),
+)
+
+TRACESTATUS_FIELDS = TRACEDEF_FIELDS
+
+# ------------------------------------------------------------- traceuniq
+# ref traceuniqtbl: distinct API signatures per service
+TRACEUNIQ_FIELDS = (
+    string("svcid", "svcid", "Service glob id (hex)"),
+    string("svcname", "svcname", "Service name"),
+    num("napis", "napis", "Distinct API signatures"),
+    num("nreq", "nreq", "Transactions across APIs"),
+    num("nerr", "nerr", "Errored transactions"),
+)
+
 # --------------------------------------------------------------- hostinfo
 # ref json_db_hostinfo_arr (HOST_INFO_NOTIFY, gy_comm_proto.h:2843):
 # static host inventory — hardware/OS/cloud metadata
@@ -493,6 +519,9 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_NOTIFYMSG: NOTIFYMSG_FIELDS,
     SUBSYS_HOSTLIST: HOSTLIST_FIELDS,
     SUBSYS_SERVERSTATUS: SERVERSTATUS_FIELDS,
+    SUBSYS_TRACEDEF: TRACEDEF_FIELDS,
+    SUBSYS_TRACESTATUS: TRACESTATUS_FIELDS,
+    SUBSYS_TRACEUNIQ: TRACEUNIQ_FIELDS,
     SUBSYS_ALERTS: ALERTS_FIELDS,
     SUBSYS_ALERTDEF: ALERTDEF_FIELDS,
     SUBSYS_SILENCES: SILENCES_FIELDS,

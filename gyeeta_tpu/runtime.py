@@ -89,10 +89,15 @@ class Runtime:
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
         from gyeeta_tpu.alerts import columns as AC
+        from gyeeta_tpu.trace.defs import TraceDefs
         from gyeeta_tpu.utils.notifylog import NotifyLog
         self.notifylog = NotifyLog(clock=clock)
+        self.tracedefs = TraceDefs(clock=clock)
         self._t_started = self._clock()
         self._aux = {
+            "tracedef": self._tracedef_columns,
+            "tracestatus": self._tracedef_columns,
+            "traceuniq": self._traceuniq_columns,
             "hostinfo": lambda: self.hostinfo.columns(self.names),
             "cgroupstate": lambda: self.cgroups.columns(self.names),
             "alerts": lambda: AC.alerts_columns(self.alerts),
@@ -359,9 +364,100 @@ class Runtime:
                                names=self.names, dep=self.dep,
                                svcreg=self.svcreg, aux=self._aux)
 
+    def _tracedef_columns(self):
+        rows = self.tracedefs.status_rows()
+        obj = lambda k: np.array([r[k] for r in rows], object)  # noqa
+        num = lambda k: np.array([float(r[k]) for r in rows])   # noqa
+        cols = {"name": obj("name"), "filter": obj("filter"),
+                "tend": num("tend"),
+                "active": np.array([r["active"] for r in rows], bool),
+                "nsvc": num("nsvc")}
+        return cols, np.ones(len(rows), bool)
+
+    def _traceuniq_columns(self):
+        """traceuniq: distinct API signatures per service, derived by
+        grouping the per-(svc, api) slab (ref traceuniqtbl)."""
+        tcols, tlive = api.trace_columns(self.cfg, self.state,
+                                         names=self.names)
+        idx = np.nonzero(tlive)[0]
+        svc = np.asarray(tcols["svcid"])[idx]
+        ids, inv = np.unique(svc, return_inverse=True)
+        n = len(ids)
+
+        def segsum(vals):
+            out = np.zeros(n, np.float64)
+            np.add.at(out, inv, np.asarray(vals, np.float64))
+            return out
+
+        name_of = {}
+        for j, i in enumerate(idx):
+            name_of.setdefault(svc[j], tcols["svcname"][i])
+        cols = {
+            "svcid": ids.astype(object),
+            "svcname": np.array([name_of[s] for s in ids], object),
+            "napis": segsum(np.ones(len(idx))),
+            "nreq": segsum(np.asarray(tcols["nreq"])[idx]),
+            "nerr": segsum(np.asarray(tcols["nerr"])[idx]),
+        }
+        return cols, np.ones(n, bool)
+
+    # ------------------------------------------------------- trace control
+    def trace_control_diff(self, hosts=None):
+        """Evaluate tracedefs against live svcinfo → per-host
+        enable/disable diffs for the network edge to push (the
+        REQ_TRACE_SET distribution step). ``hosts`` restricts to
+        reachable agents so unreachable diffs aren't consumed."""
+        targets = self.tracedefs.target_svcids(self._alert_columns)
+        return self.tracedefs.diff_for_hosts(targets, hosts=hosts)
+
+    # ---------------------------------------------------------------- CRUD
+    _CRUD_OBJS = ("alertdef", "silence", "inhibit", "tracedef")
+
+    def crud(self, req: dict) -> dict:
+        """CRUD channel (the reference's CRUD_GENERIC/ALERT_JSON,
+        ``gy_comm_proto.h:246-258``): {"op": "add"|"delete",
+        "objtype": ..., ...payload}."""
+        op = req.get("op")
+        objtype = req.get("objtype")
+        if objtype not in self._CRUD_OBJS:
+            raise ValueError(f"objtype must be one of {self._CRUD_OBJS}")
+        if op == "add":
+            if objtype == "alertdef":
+                self.alerts.add_def(req)
+                name = req["alertname"]
+            elif objtype == "silence":
+                name = self.alerts.add_silence(req).name
+            elif objtype == "inhibit":
+                name = self.alerts.add_inhibit(req).name
+            else:
+                name = self.tracedefs.add(req).name
+            self.notifylog.add(f"{objtype} {name!r} added",
+                               source="config")
+            return {"ok": True, "objtype": objtype, "name": name}
+        if op == "delete":
+            name = req.get("name") or req.get("alertname")
+            if not name:
+                raise ValueError("delete needs a name")
+            if objtype == "alertdef":
+                found = self.alerts.delete_def(name)
+            elif objtype == "silence":
+                found = self.alerts.silences.pop(name, None) is not None
+            elif objtype == "inhibit":
+                found = self.alerts.inhibits.pop(name, None) is not None
+            else:
+                found = self.tracedefs.delete(name)
+            if found:
+                self.notifylog.add(f"{objtype} {name!r} deleted",
+                                   source="config")
+            return {"ok": found, "objtype": objtype, "name": name}
+        raise ValueError("op must be add or delete")
+
     # -------------------------------------------------------------- query
     def query(self, req: dict) -> dict:
-        """Point-in-time (live) or historical (time-ranged) JSON query."""
+        """Point-in-time (live) or historical (time-ranged) JSON query;
+        requests with an "op" field route to the CRUD channel."""
+        if req.get("op"):
+            return self.crud(req)
         if req.get("subsys") == "selfstats":
             # process self-metrics (the print_stats surface): counters +
             # per-stage latency histograms, no engine readback involved

@@ -320,8 +320,16 @@ class ParthaSim:
         out["host_id"] = (host + self.host_base).astype(np.uint32)
         return out
 
-    def trace_frames(self, n: int) -> bytes:
+    def trace_frames(self, n: int, only_svcs=None) -> bytes:
+        """``only_svcs``: an iterable of enabled svc glob ids — records
+        for other services are filtered out (the agent captures only
+        where a trace definition enabled it, ref REQ_TRACE_SET)."""
         recs = self.trace_records(n)
+        if only_svcs is not None:
+            keep = np.isin(recs["svc_glob_id"],
+                           np.fromiter(only_svcs, np.uint64,
+                                       len(only_svcs)))
+            recs = recs[keep]
         return b"".join(
             wire.encode_frame(wire.NOTIFY_REQ_TRACE,
                               recs[i:i + wire.MAX_TRACE_PER_BATCH])
