@@ -60,6 +60,8 @@ class ShardedRuntime:
         from gyeeta_tpu.utils.hostreg import CgroupRegistry, \
             HostInfoRegistry
         from gyeeta_tpu.utils.notifylog import NotifyLog
+        from gyeeta_tpu.trace.defs import TraceDefs
+        self.tracedefs = TraceDefs(clock=clock)
         self.svcreg = SvcInfoRegistry()
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
@@ -132,6 +134,14 @@ class ShardedRuntime:
             "notifymsg": lambda: self.notifylog.columns(self.names),
             "serverstatus": self._serverstatus_columns,
             "hostlist": self._hostlist_columns,
+            "shardlist": self._shardlist_columns,
+            "tracedef": lambda: self.tracedefs.columns(),
+            "tracestatus": lambda: self.tracedefs.columns(),
+            "traceuniq": self._traceuniq_columns,
+            "extactiveconn": lambda: self._ext_join("activeconn"),
+            "extclientconn": lambda: self._ext_join("clientconn",
+                                                    idcol="cliid"),
+            "exttracereq": lambda: self._ext_join("tracereq"),
         }
 
     # ------------------------------------------------------------- ingest
@@ -368,6 +378,42 @@ class ShardedRuntime:
         }
         return cols, np.ones(len(ids), bool)
 
+    def _ext_join(self, base_subsys: str, idcol: str = "svcid"):
+        cols, live = self._merged_columns(base_subsys)
+        info_cols, _ = self.svcreg.columns(self.names)
+        return api.info_join(cols, live, info_cols, idcol=idcol)
+
+    def _traceuniq_columns(self):
+        tcols, tlive = self._merged_columns(fieldmaps.SUBSYS_TRACEREQ)
+        return api.traceuniq_from_trace(tcols, tlive)
+
+    def trace_control_diff(self, hosts=None):
+        """Mesh analogue of Runtime.trace_control_diff: evaluate
+        tracedefs against the (registry-backed) svcinfo inventory."""
+        targets = self.tracedefs.target_svcids(self._merged_columns)
+        return self.tracedefs.diff_for_hosts(targets, hosts=hosts)
+
+    def _shardlist_columns(self):
+        """One row per mesh shard (the madhavalist analogue): live
+        rows, hosts, fold counters, and drop diagnostics per shard."""
+        rows = []
+        for sidx in range(self.n):
+            st = self._shard_state(sidx)
+            rows.append({
+                "shard": float(sidx),
+                "nsvc": float(np.asarray(st.tbl.n_live)),
+                "nhosts": float((np.asarray(st.host_last_tick) >= 0)
+                                .sum()),
+                "nconn": float(np.asarray(st.n_conn)),
+                "nresp": float(np.asarray(st.n_resp)),
+                "ntaskrows": float(np.asarray(st.task_tbl.n_live)),
+                "ndropped": float(np.asarray(st.tbl.n_drop)
+                                  + np.asarray(st.task_tbl.n_drop)),
+            })
+        cols = {k: np.array([r[k] for r in rows], np.float64)
+                for k in rows[0]}
+        return cols, np.ones(self.n, bool)
+
     def _serverstatus_columns(self):
         from gyeeta_tpu import version as V
 
@@ -416,7 +462,16 @@ class ShardedRuntime:
         return report
 
     # -------------------------------------------------------------- query
+    def crud(self, req: dict) -> dict:
+        from gyeeta_tpu.query import crud as CR
+        return CR.crud(self, req)
+
     def query(self, req: dict) -> dict:
+        if req.get("op"):
+            return self.crud(req)
+        if "multiquery" in req:
+            from gyeeta_tpu.query import crud as CR
+            return CR.multiquery(self.query, req)
         if req.get("subsys") == "selfstats":
             from gyeeta_tpu.utils.selfstats import selfstats_response
             return selfstats_response(self.stats, self.alerts)

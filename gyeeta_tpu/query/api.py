@@ -524,12 +524,17 @@ def svcsumm_columns(cfg: EngineCfg, st: AggState, names=None):
     return svcsumm_from_svc(cols, live, names)
 
 
-def extsvc_join(cols, live, info_cols):
-    """Join svcstate columns with svcinfo columns on svcid (shared by
-    single-node and sharded extsvcstate providers)."""
-    n = len(cols["svcid"])
-    keys = (("ip", ""), ("port", 0.0), ("comm", ""), ("cmdline", ""),
-            ("pid", 0.0), ("tstart", 0.0))
+_EXT_JOIN_KEYS = (("ip", ""), ("port", 0.0), ("comm", ""),
+                  ("cmdline", ""), ("pid", 0.0), ("tstart", 0.0))
+
+
+def info_join(cols, live, info_cols, idcol="svcid",
+              keys=_EXT_JOIN_KEYS):
+    """Left-join svcinfo metadata columns onto any column set whose
+    ``idcol`` holds service glob-id hex strings — the "extended"
+    subsystem mechanic (state ⋈ info, ``gy_mnodehandle.cc:4657``).
+    Rows without announced metadata keep defaults."""
+    n = len(cols[idcol])
     joined = dict(cols)
     out = {}
     for key, default in keys:
@@ -539,13 +544,45 @@ def extsvc_join(cols, live, info_cols):
         out[key] = col
     if info_cols:
         pos_of = {sid: j for j, sid in enumerate(info_cols["svcid"])}
-        for i in np.nonzero(live)[0]:      # one pass, live rows only
-            j = pos_of.get(cols["svcid"][i])
+        for i in np.nonzero(np.asarray(live, bool))[0]:
+            j = pos_of.get(cols[idcol][i])
             if j is not None:
                 for key, _ in keys:
                     out[key][i] = info_cols[key][j]
     joined.update(out)
     return joined, live
+
+
+def extsvc_join(cols, live, info_cols):
+    """Join svcstate columns with svcinfo columns on svcid (shared by
+    single-node and sharded extsvcstate providers)."""
+    return info_join(cols, live, info_cols)
+
+
+def traceuniq_from_trace(tcols, tlive):
+    """Group per-(svc, api) trace columns by service → traceuniq
+    columns (ref traceuniqtbl). Shared by both runtimes."""
+    idx = np.nonzero(np.asarray(tlive, bool))[0]
+    svc = np.asarray(tcols["svcid"])[idx]
+    ids, inv = np.unique(svc, return_inverse=True)
+    n = len(ids)
+
+    def segsum(vals):
+        out = np.zeros(n, np.float64)
+        np.add.at(out, inv, np.asarray(vals, np.float64))
+        return out
+
+    name_of = {}
+    for j, i in enumerate(idx):
+        name_of.setdefault(svc[j], tcols["svcname"][i])
+    cols = {
+        "svcid": ids.astype(object),
+        "svcname": np.array([name_of[s] for s in ids], object),
+        "napis": segsum(np.ones(len(idx))),
+        "nreq": segsum(np.asarray(tcols["nreq"])[idx]),
+        "nerr": segsum(np.asarray(tcols["nerr"])[idx]),
+    }
+    return cols, np.ones(n, bool)
 
 
 def extsvcstate_columns(cfg: EngineCfg, st: AggState, names=None,

@@ -1,0 +1,59 @@
+"""CRUD channel shared by both runtimes (the reference's
+CRUD_GENERIC_JSON / CRUD_ALERT_JSON query types,
+``gy_comm_proto.h:246-258``): {"op": "add"|"delete", "objtype": ...}."""
+
+from __future__ import annotations
+
+CRUD_OBJS = ("alertdef", "silence", "inhibit", "tracedef")
+
+
+def crud(rt, req: dict) -> dict:
+    """``rt`` provides .alerts, .tracedefs, .notifylog."""
+    op = req.get("op")
+    objtype = req.get("objtype")
+    if objtype not in CRUD_OBJS:
+        raise ValueError(f"objtype must be one of {CRUD_OBJS}")
+    if op == "add":
+        if objtype == "alertdef":
+            rt.alerts.add_def(req)
+            name = req["alertname"]
+        elif objtype == "silence":
+            name = rt.alerts.add_silence(req).name
+        elif objtype == "inhibit":
+            name = rt.alerts.add_inhibit(req).name
+        else:
+            name = rt.tracedefs.add(req).name
+        rt.notifylog.add(f"{objtype} {name!r} added", source="config")
+        return {"ok": True, "objtype": objtype, "name": name}
+    if op == "delete":
+        name = req.get("name") or req.get("alertname")
+        if not name:
+            raise ValueError("delete needs a name")
+        if objtype == "alertdef":
+            found = rt.alerts.delete_def(name)
+        elif objtype == "silence":
+            found = rt.alerts.silences.pop(name, None) is not None
+        elif objtype == "inhibit":
+            found = rt.alerts.inhibits.pop(name, None) is not None
+        else:
+            found = rt.tracedefs.delete(name)
+        if found:
+            rt.notifylog.add(f"{objtype} {name!r} deleted",
+                             source="config")
+        return {"ok": found, "objtype": objtype, "name": name}
+    raise ValueError("op must be add or delete")
+
+
+def multiquery(query_fn, req: dict) -> dict:
+    """Run a batch of sub-queries through ``query_fn`` (one round trip;
+    one bad sub-query doesn't fail the batch)."""
+    subs = req["multiquery"]
+    if not isinstance(subs, list) or len(subs) > 16:
+        raise ValueError("multiquery: list of <=16 queries")
+    out = []
+    for sub in subs:
+        try:
+            out.append(query_fn(sub))
+        except Exception as e:
+            out.append({"error": str(e)})
+    return {"multiquery": out, "nqueries": len(out)}

@@ -43,6 +43,12 @@ SUBSYS_SERVERSTATUS = "serverstatus"  # ref madhavastatus/shyamastatus
 SUBSYS_TRACEDEF = "tracedef"        # ref tracedef (capture control)
 SUBSYS_TRACESTATUS = "tracestatus"  # ref tracestatus
 SUBSYS_TRACEUNIQ = "traceuniq"      # ref traceuniq (APIs per svc)
+SUBSYS_EXTACTIVECONN = "extactiveconn"  # ref extactiveconn (⋈ svcinfo)
+SUBSYS_EXTCLIENTCONN = "extclientconn"  # ref extclientconn (⋈ svcinfo)
+SUBSYS_EXTTRACEREQ = "exttracereq"  # ref exttracereq (⋈ svcinfo)
+SUBSYS_SHARDLIST = "shardlist"      # mesh-native: per-shard stats (the
+#                                     madhavalist analogue — one row per
+#                                     shard instead of per madhava)
 SUBSYS_CGROUPSTATE = "cgroupstate"  # ref cgroupstate
 SUBSYS_ALERTS = "alerts"            # ref alerts (fired alert log)
 SUBSYS_ALERTDEF = "alertdef"        # ref alertdef
@@ -412,6 +418,31 @@ TRACEUNIQ_FIELDS = (
     num("nerr", "nerr", "Errored transactions"),
 )
 
+# ------------------------------------------------------------- ext* joins
+_EXTINFO_FIELDS = (
+    string("ip", "ip", "Bind address"),
+    num("port", "port", "Listen port"),
+    string("comm", "comm", "Listener process comm"),
+    string("cmdline", "cmdline", "Command line (interned)"),
+    num("pid", "pid", "Listener pid"),
+    num("tstart", "tstart", "Listener start time (epoch sec)"),
+)
+
+EXTACTIVECONN_FIELDS = ACTIVECONN_FIELDS + _EXTINFO_FIELDS
+EXTCLIENTCONN_FIELDS = CLIENTCONN_FIELDS + _EXTINFO_FIELDS
+EXTTRACEREQ_FIELDS = TRACEREQ_FIELDS + _EXTINFO_FIELDS
+
+# -------------------------------------------------------------- shardlist
+SHARDLIST_FIELDS = (
+    num("shard", "shard", "Mesh shard index"),
+    num("nsvc", "nsvc", "Live service rows on this shard"),
+    num("nhosts", "nhosts", "Hosts reporting to this shard"),
+    num("nconn", "nconn", "Flow events folded on this shard"),
+    num("nresp", "nresp", "Response samples folded on this shard"),
+    num("ntaskrows", "ntaskrows", "Live process-group rows"),
+    num("ndropped", "ndropped", "Table inserts dropped (probe exhaust)"),
+)
+
 # --------------------------------------------------------------- hostinfo
 # ref json_db_hostinfo_arr (HOST_INFO_NOTIFY, gy_comm_proto.h:2843):
 # static host inventory — hardware/OS/cloud metadata
@@ -522,6 +553,10 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_TRACEDEF: TRACEDEF_FIELDS,
     SUBSYS_TRACESTATUS: TRACESTATUS_FIELDS,
     SUBSYS_TRACEUNIQ: TRACEUNIQ_FIELDS,
+    SUBSYS_EXTACTIVECONN: EXTACTIVECONN_FIELDS,
+    SUBSYS_EXTCLIENTCONN: EXTCLIENTCONN_FIELDS,
+    SUBSYS_EXTTRACEREQ: EXTTRACEREQ_FIELDS,
+    SUBSYS_SHARDLIST: SHARDLIST_FIELDS,
     SUBSYS_ALERTS: ALERTS_FIELDS,
     SUBSYS_ALERTDEF: ALERTDEF_FIELDS,
     SUBSYS_SILENCES: SILENCES_FIELDS,

@@ -95,9 +95,13 @@ class Runtime:
         self.tracedefs = TraceDefs(clock=clock)
         self._t_started = self._clock()
         self._aux = {
-            "tracedef": self._tracedef_columns,
-            "tracestatus": self._tracedef_columns,
+            "tracedef": lambda: self.tracedefs.columns(),
+            "tracestatus": lambda: self.tracedefs.columns(),
             "traceuniq": self._traceuniq_columns,
+            "extactiveconn": lambda: self._ext_join("activeconn"),
+            "extclientconn": lambda: self._ext_join("clientconn",
+                                                    idcol="cliid"),
+            "exttracereq": lambda: self._ext_join("tracereq"),
             "hostinfo": lambda: self.hostinfo.columns(self.names),
             "cgroupstate": lambda: self.cgroups.columns(self.names),
             "alerts": lambda: AC.alerts_columns(self.alerts),
@@ -364,42 +368,18 @@ class Runtime:
                                names=self.names, dep=self.dep,
                                svcreg=self.svcreg, aux=self._aux)
 
-    def _tracedef_columns(self):
-        rows = self.tracedefs.status_rows()
-        obj = lambda k: np.array([r[k] for r in rows], object)  # noqa
-        num = lambda k: np.array([float(r[k]) for r in rows])   # noqa
-        cols = {"name": obj("name"), "filter": obj("filter"),
-                "tend": num("tend"),
-                "active": np.array([r["active"] for r in rows], bool),
-                "nsvc": num("nsvc")}
-        return cols, np.ones(len(rows), bool)
+    def _ext_join(self, base_subsys: str, idcol: str = "svcid"):
+        """ext* subsystems: base columns ⋈ svcinfo metadata."""
+        cols, live = self._alert_columns(base_subsys)
+        info_cols, _ = self.svcreg.columns(self.names)
+        return api.info_join(cols, live, info_cols, idcol=idcol)
 
     def _traceuniq_columns(self):
         """traceuniq: distinct API signatures per service, derived by
         grouping the per-(svc, api) slab (ref traceuniqtbl)."""
         tcols, tlive = api.trace_columns(self.cfg, self.state,
                                          names=self.names)
-        idx = np.nonzero(tlive)[0]
-        svc = np.asarray(tcols["svcid"])[idx]
-        ids, inv = np.unique(svc, return_inverse=True)
-        n = len(ids)
-
-        def segsum(vals):
-            out = np.zeros(n, np.float64)
-            np.add.at(out, inv, np.asarray(vals, np.float64))
-            return out
-
-        name_of = {}
-        for j, i in enumerate(idx):
-            name_of.setdefault(svc[j], tcols["svcname"][i])
-        cols = {
-            "svcid": ids.astype(object),
-            "svcname": np.array([name_of[s] for s in ids], object),
-            "napis": segsum(np.ones(len(idx))),
-            "nreq": segsum(np.asarray(tcols["nreq"])[idx]),
-            "nerr": segsum(np.asarray(tcols["nerr"])[idx]),
-        }
-        return cols, np.ones(n, bool)
+        return api.traceuniq_from_trace(tcols, tlive)
 
     # ------------------------------------------------------- trace control
     def trace_control_diff(self, hosts=None):
@@ -411,53 +391,21 @@ class Runtime:
         return self.tracedefs.diff_for_hosts(targets, hosts=hosts)
 
     # ---------------------------------------------------------------- CRUD
-    _CRUD_OBJS = ("alertdef", "silence", "inhibit", "tracedef")
-
     def crud(self, req: dict) -> dict:
-        """CRUD channel (the reference's CRUD_GENERIC/ALERT_JSON,
-        ``gy_comm_proto.h:246-258``): {"op": "add"|"delete",
-        "objtype": ..., ...payload}."""
-        op = req.get("op")
-        objtype = req.get("objtype")
-        if objtype not in self._CRUD_OBJS:
-            raise ValueError(f"objtype must be one of {self._CRUD_OBJS}")
-        if op == "add":
-            if objtype == "alertdef":
-                self.alerts.add_def(req)
-                name = req["alertname"]
-            elif objtype == "silence":
-                name = self.alerts.add_silence(req).name
-            elif objtype == "inhibit":
-                name = self.alerts.add_inhibit(req).name
-            else:
-                name = self.tracedefs.add(req).name
-            self.notifylog.add(f"{objtype} {name!r} added",
-                               source="config")
-            return {"ok": True, "objtype": objtype, "name": name}
-        if op == "delete":
-            name = req.get("name") or req.get("alertname")
-            if not name:
-                raise ValueError("delete needs a name")
-            if objtype == "alertdef":
-                found = self.alerts.delete_def(name)
-            elif objtype == "silence":
-                found = self.alerts.silences.pop(name, None) is not None
-            elif objtype == "inhibit":
-                found = self.alerts.inhibits.pop(name, None) is not None
-            else:
-                found = self.tracedefs.delete(name)
-            if found:
-                self.notifylog.add(f"{objtype} {name!r} deleted",
-                                   source="config")
-            return {"ok": found, "objtype": objtype, "name": name}
-        raise ValueError("op must be add or delete")
+        from gyeeta_tpu.query import crud as CR
+        return CR.crud(self, req)
 
     # -------------------------------------------------------------- query
     def query(self, req: dict) -> dict:
         """Point-in-time (live) or historical (time-ranged) JSON query;
-        requests with an "op" field route to the CRUD channel."""
+        requests with an "op" field route to the CRUD channel; a
+        "multiquery" list runs several queries in one round trip (the
+        reference's multiquery batches, ``gy_query_common.h:24``)."""
         if req.get("op"):
             return self.crud(req)
+        if "multiquery" in req:
+            from gyeeta_tpu.query import crud as CR
+            return CR.multiquery(self.query, req)
         if req.get("subsys") == "selfstats":
             # process self-metrics (the print_stats surface): counters +
             # per-stage latency histograms, no engine readback involved

@@ -82,6 +82,7 @@ class TraceDefs:
             return out
         cols, base = columns_fn("svcinfo")
         if not len(base):
+            self._nsvc = {d.name: 0 for d in defs}
             return out
         for d in defs:
             mask = np.asarray(base, bool)
@@ -124,6 +125,22 @@ class TraceDefs:
         """Reconnect resync: drop applied state so the next diff
         re-pushes everything (agents lose capture state on restart)."""
         self._applied.pop(host_id, None)
+
+    def columns(self):
+        """(cols, mask) for the tracedef/tracestatus subsystems —
+        shared by both runtimes so the column set cannot diverge."""
+        rows = self.status_rows()
+
+        def obj(k):
+            out = np.empty(len(rows), object)
+            out[:] = [r[k] for r in rows]
+            return out
+
+        cols = {"name": obj("name"), "filter": obj("filter"),
+                "tend": np.array([float(r["tend"]) for r in rows]),
+                "active": np.array([r["active"] for r in rows], bool),
+                "nsvc": np.array([float(r["nsvc"]) for r in rows])}
+        return cols, np.ones(len(rows), bool)
 
     def status_rows(self) -> list[dict]:
         now = self._clock()

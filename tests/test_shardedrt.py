@@ -239,3 +239,25 @@ def test_new_subsystems_sharded_vs_single():
     srt.run_tick()
     qn = srt.query({"subsys": "notifymsg", "maxrecs": 10})
     assert qn["nrecs"] > 0
+
+
+def test_shardlist_and_sharded_crud():
+    mesh = make_mesh(8)
+    srt = ShardedRuntime(CFG, mesh, OPTS)
+    sim = ParthaSim(n_hosts=16, n_svcs=3, seed=23)
+    srt.feed(sim.name_frames())
+    srt.feed(sim.conn_frames(512) + sim.resp_frames(512))
+    q = srt.query({"subsys": "shardlist", "sortcol": "shard",
+                   "sortdesc": False})
+    assert q["nrecs"] == 8
+    assert sum(r["nsvc"] for r in q["recs"]) == 16 * 3
+    assert sum(r["nconn"] for r in q["recs"]) == 512
+    # CRUD + multiquery on the mesh
+    out = srt.query({"op": "add", "objtype": "alertdef",
+                     "alertname": "x", "subsys": "svcstate",
+                     "filter": "{ svcstate.qps5s >= 0 }"})
+    assert out["ok"]
+    mq = srt.query({"multiquery": [{"subsys": "alertdef"},
+                                   {"subsys": "serverstatus"}]})
+    assert mq["multiquery"][0]["nrecs"] == 1
+    assert mq["multiquery"][1]["recs"][0]["nsvc"] == 48

@@ -106,3 +106,33 @@ def test_alertdef_on_new_subsystems():
     rt.run_tick()
     q = rt.query({"subsys": "alerts", "maxrecs": 100})
     assert {r["alertname"] for r in q["recs"]} == {"host_flood"}
+
+
+def test_multiquery_batch():
+    rt, sim = _rt()
+    out = rt.query({"multiquery": [
+        {"subsys": "svcstate", "maxrecs": 3},
+        {"subsys": "svcinfo", "maxrecs": 2},
+        {"subsys": "nonsense"},
+    ]})
+    assert out["nqueries"] == 3
+    assert out["multiquery"][0]["nrecs"] == 3
+    assert out["multiquery"][1]["nrecs"] == 2
+    assert "error" in out["multiquery"][2]
+
+
+def test_ext_join_subsystems():
+    rt, sim = _rt()
+    cli, ser = sim.svc_conn_records(64, split_halves=True)
+    rt.feed(wire.encode_frame(wire.NOTIFY_TCP_CONN, cli))
+    rt.feed(wire.encode_frame(wire.NOTIFY_TCP_CONN, ser))
+    rt.run_tick()
+    q = rt.query({"subsys": "extactiveconn", "maxrecs": 100})
+    assert q["nrecs"] > 0
+    joined = [r for r in q["recs"] if r["port"] > 0]
+    assert joined and joined[0]["comm"].startswith("proc-")
+    assert "nclients" in q["recs"][0]       # base columns intact
+    qc = rt.query({"subsys": "extclientconn", "maxrecs": 100})
+    assert qc["nrecs"] > 0
+    svc_callers = [r for r in qc["recs"] if r["clisvc"] and r["port"] > 0]
+    assert svc_callers                       # svc callers joined on cliid
