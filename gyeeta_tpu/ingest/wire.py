@@ -587,6 +587,33 @@ class FrameError(ValueError):
     pass
 
 
+def complete_prefix(buf: bytes) -> int:
+    """Length of the longest prefix of COMPLETE frames.
+
+    Per-connection reassembly helper: a server multiplexing many conns
+    into one decoder must hold back each conn's trailing partial frame
+    (another conn's bytes would otherwise splice into the middle of it).
+    Walks headers only — O(frames), no payload touched. Raises
+    FrameError on a corrupt header so the caller can drop the conn."""
+    off = 0
+    n = len(buf)
+    hsz = HEADER_DT.itemsize
+    esz = EVENT_NOTIFY_DT.itemsize
+    while off + hsz <= n:
+        hdr = np.frombuffer(buf, HEADER_DT, count=1, offset=off)[0]
+        if hdr["magic"] not in (MAGIC_PM, MAGIC_MS, MAGIC_NQ):
+            raise FrameError(f"bad magic {int(hdr['magic']):#x} at {off}")
+        total = int(hdr["total_sz"])
+        # same bound as decode_frames — a frame this walk accepts must
+        # never be one the decoders reject at the header
+        if total < hsz + esz or total >= MAX_COMM_DATA_SZ:
+            raise FrameError(f"bad total_sz {total} at {off}")
+        if off + total > n:
+            break
+        off += total
+    return off
+
+
 def decode_frames(buf: bytes):
     """Parse a byte stream of frames → list of (subtype, structured array).
 

@@ -44,11 +44,18 @@ _READ_SZ = 1 << 20
 class GytServer:
     def __init__(self, rt: Runtime, host: str = "127.0.0.1",
                  port: int = 0, tick_interval: Optional[float] = 5.0,
-                 hostmap_path: Optional[str] = None):
+                 hostmap_path: Optional[str] = None,
+                 record_path: Optional[str] = None):
         self.rt = rt
         self.host = host
         self.port = port
         self.tick_interval = tick_interval
+        # optional wire capture (utils/replay.py): every complete-frame
+        # run fed to the runtime is also appended to the capture file
+        self._recorder = None
+        if record_path:
+            from gyeeta_tpu.utils.replay import StreamRecorder
+            self._recorder = StreamRecorder(record_path)
         self._server: Optional[asyncio.AbstractServer] = None
         self._tick_task: Optional[asyncio.Task] = None
         # machine-id → host_id stickiness (the pardbmap_ placement map,
@@ -117,6 +124,9 @@ class GytServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        if self._recorder is not None:
+            rec, self._recorder = self._recorder, None
+            rec.close()      # live conns see None, never a closed file
 
     async def _tick_loop(self) -> None:
         while True:
@@ -208,18 +218,35 @@ class GytServer:
                 pass
 
     async def _event_loop(self, reader) -> None:
-        """Bulk ingest: socket bytes → Runtime.feed (framing inside)."""
+        """Bulk ingest: socket bytes → Runtime.feed.
+
+        Partial-frame reassembly happens HERE, per connection: the
+        runtime decoder is shared by every conn, so each conn's
+        trailing partial frame must be held back or another conn's
+        bytes would splice into the middle of it (the reference's
+        per-conn recv buffers give the same guarantee,
+        ``common/gy_epoll_conntrack.h`` partial-read resume)."""
+        pending = b""
         while True:
             data = await reader.read(_READ_SZ)
             if not data:
                 return
+            data = pending + data
             try:
-                self.rt.feed(data)
+                k = wire.complete_prefix(data)
             except wire.FrameError:
-                # poison frame: feed dropped its resume buffer; close the
-                # conn — the agent reconnects and resyncs (the reference
-                # closes on malformed COMM_HEADER too)
+                # poison header: close the conn — the agent reconnects
+                # and resyncs (the reference closes on bad COMM_HEADER)
                 raise
+            pending = data[k:]
+            if k:
+                # feed FIRST: a chunk that fails deep validation
+                # (nevents caps) must not poison the capture file —
+                # recorded bytes are exactly the ingested bytes
+                self.rt.feed(data[:k])
+                rec = self._recorder   # no await between check & write
+                if rec is not None:
+                    rec.write(data[:k])
 
     async def _query_loop(self, reader, writer) -> None:
         outstanding = 0

@@ -46,12 +46,19 @@ def crud(rt, req: dict) -> dict:
 
 def multiquery(query_fn, req: dict) -> dict:
     """Run a batch of sub-queries through ``query_fn`` (one round trip;
-    one bad sub-query doesn't fail the batch)."""
+    one bad sub-query doesn't fail the batch). Sub-queries must be
+    plain queries: nesting or CRUD inside a batch is rejected — a
+    16-wide batch nested N deep would fan out 16^N synchronous
+    executions on the event loop."""
     subs = req["multiquery"]
     if not isinstance(subs, list) or len(subs) > 16:
         raise ValueError("multiquery: list of <=16 queries")
     out = []
     for sub in subs:
+        if not isinstance(sub, dict) or "multiquery" in sub \
+                or sub.get("op"):
+            out.append({"error": "sub-query must be a plain query"})
+            continue
         try:
             out.append(query_fn(sub))
         except Exception as e:

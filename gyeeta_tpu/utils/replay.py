@@ -1,0 +1,129 @@
+"""Stream capture + replay — the pseudo-pcap test harness analogue.
+
+The reference replays pcap files through its live parser with IP/netns
+translation (``partha/gy_pseudo_pcap_cap.cc``, driven by runtime-config
+``pcaptrace`` blocks) as its offline integration fixture. The TPU
+framework's capture boundary is the WIRE, not packets: this module
+records timestamped event-stream chunks to a file and replays them —
+into a Runtime directly, or over a socket as a registered agent — with
+optional time compression and host-id translation (the analogue of the
+reference's IP/port translation, so one capture can simulate many
+hosts).
+
+File format (little-endian): 8-byte magic ``GYTREC01``, then chunks of
+``{t_usec u8, nbytes u4, pad u4}`` + bytes. Chunks are whatever byte
+runs the recorder saw — frame boundaries inside are the decoder's
+business, exactly like a live socket.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import struct
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+from gyeeta_tpu.ingest import wire
+
+MAGIC = b"GYTREC01"
+_CHDR = struct.Struct("<QII")
+
+
+class StreamRecorder:
+    """Append-only capture file; one ``write`` per byte run."""
+
+    def __init__(self, path, clock=None):
+        self.path = pathlib.Path(path)
+        self._clock = clock or time.time
+        self._f = open(self.path, "ab")
+        if self._f.tell() == 0:
+            self._f.write(MAGIC)
+
+    def write(self, buf: bytes) -> None:
+        if not buf:
+            return
+        self._f.write(_CHDR.pack(int(self._clock() * 1e6),
+                                 len(buf), 0))
+        self._f.write(buf)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def read_chunks(path) -> Iterator[tuple[int, bytes]]:
+    """Yield (t_usec, chunk_bytes); validates the magic."""
+    data = pathlib.Path(path).read_bytes()
+    if data[: len(MAGIC)] != MAGIC:
+        raise ValueError(f"{path}: not a GYTREC capture")
+    off = len(MAGIC)
+    while off + _CHDR.size <= len(data):
+        tus, n, _pad = _CHDR.unpack_from(data, off)
+        off += _CHDR.size
+        chunk = data[off: off + n]
+        if len(chunk) < n:
+            break                      # truncated tail (crash mid-write)
+        off += n
+        yield tus, chunk
+
+
+def remap_host_ids(buf: bytes, offset: int) -> bytes:
+    """Re-encode every known frame with host_id += offset — the
+    host-translation knob (the reference's pcap IP/port translation
+    analogue). Entity glob-ids are NOT translated: a remapped replay
+    RELOCATES the captured fleet to new host ids (service rows follow
+    their keys); true fleet multiplication uses distinct simulated
+    agents, whose ids derive from their host index. Unknown subtypes
+    and non-event frames pass through untouched."""
+    out = []
+    view = memoryview(buf)
+    off = 0
+    hsz = wire.HEADER_DT.itemsize
+    esz = wire.EVENT_NOTIFY_DT.itemsize
+    while off + hsz <= len(buf):
+        hdr = np.frombuffer(view, wire.HEADER_DT, 1, off)[0]
+        total = int(hdr["total_sz"])
+        if total < hsz or off + total > len(buf):
+            break
+        frame = bytes(view[off: off + total])
+        if int(hdr["data_type"]) == wire.COMM_EVENT_NOTIFY:
+            ev = np.frombuffer(view, wire.EVENT_NOTIFY_DT, 1, off + hsz)[0]
+            dt = wire.DTYPE_OF_SUBTYPE.get(int(ev["subtype"]))
+            if dt is not None and "host_id" in (dt.names or ()):
+                recs = np.frombuffer(
+                    view, dt, int(ev["nevents"]), off + hsz + esz).copy()
+                recs["host_id"] = recs["host_id"] + np.uint32(offset)
+                frame = (frame[: hsz + esz] + recs.tobytes()
+                         + frame[hsz + esz + recs.nbytes:])
+        out.append(frame)
+        off += total
+    out.append(bytes(view[off:]))
+    return b"".join(out)
+
+
+def play(path, feed_fn, speed: float = 0.0,
+         host_id_offset: int = 0, sleep=time.sleep) -> int:
+    """Replay a capture through ``feed_fn(bytes)``.
+
+    ``speed``: 0 = as fast as possible; N = N× recorded pace (1 = real
+    time). Returns bytes fed."""
+    n = 0
+    t0: Optional[int] = None
+    w0 = time.monotonic()
+    for tus, chunk in read_chunks(path):
+        if speed > 0:
+            if t0 is None:
+                t0 = tus
+            due = w0 + (tus - t0) / 1e6 / speed
+            delay = due - time.monotonic()
+            if delay > 0:
+                sleep(delay)
+        if host_id_offset:
+            chunk = remap_host_ids(chunk, host_id_offset)
+        feed_fn(chunk)
+        n += len(chunk)
+    return n
