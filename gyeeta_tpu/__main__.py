@@ -1,3 +1,3 @@
-from gyeeta_tpu.server_main import main
+from gyeeta_tpu.cli import main
 
 main()

@@ -1,0 +1,124 @@
+"""Command-line entry points: serve / query / agent / replay.
+
+``python -m gyeeta_tpu serve …``   — the aggregation-server daemon
+``python -m gyeeta_tpu query …``   — one-shot JSON query/CRUD client
+``python -m gyeeta_tpu agent …``   — a (sim or collecting) host agent
+``python -m gyeeta_tpu replay …``  — play a wire capture into a server
+
+The reference splits these across binaries (gymadhava/gyshyama,
+partha, node webserver clients); one Python entry point with
+subcommands covers the same operational surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _cmd_query(argv) -> None:
+    ap = argparse.ArgumentParser(prog="gyeeta_tpu query")
+    ap.add_argument("request", help="JSON query/CRUD body, or '-' for "
+                    "stdin")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10038)
+    args = ap.parse_args(argv)
+    body = sys.stdin.read() if args.request == "-" else args.request
+    req = json.loads(body)
+
+    async def run():
+        from gyeeta_tpu.net.agent import QueryClient
+        qc = QueryClient()
+        await qc.connect(args.host, args.port)
+        out = await qc.query(req)
+        await qc.close()
+        json.dump(out, sys.stdout, default=str)
+        sys.stdout.write("\n")
+
+    asyncio.run(run())
+
+
+def _cmd_agent(argv) -> None:
+    ap = argparse.ArgumentParser(prog="gyeeta_tpu agent")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10038)
+    ap.add_argument("--collect", action="store_true",
+                    help="measure THIS host's /proc //sys instead of "
+                    "simulating host/cgroup telemetry")
+    ap.add_argument("--n-agents", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--n-conn", type=int, default=256)
+    ap.add_argument("--n-resp", type=int, default=512)
+    args = ap.parse_args(argv)
+
+    async def run():
+        from gyeeta_tpu.net.agent import NetAgent
+        agents = [NetAgent(seed=args.seed + i, collect=args.collect)
+                  for i in range(args.n_agents)]
+        for a in agents:
+            hid = await a.connect(args.host, args.port)
+            print(f"agent {a.seed}: host_id {hid}", file=sys.stderr)
+        while True:
+            for a in agents:
+                await a.send_sweep(args.n_conn, args.n_resp)
+            await asyncio.sleep(args.interval)
+
+    asyncio.run(run())
+
+
+def _cmd_replay(argv) -> None:
+    ap = argparse.ArgumentParser(prog="gyeeta_tpu replay")
+    ap.add_argument("capture", help="GYTREC capture file")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10038)
+    ap.add_argument("--speed", type=float, default=0.0,
+                    help="0 = full speed; 1 = recorded pace")
+    ap.add_argument("--host-offset", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    async def run():
+        from gyeeta_tpu import version
+        from gyeeta_tpu.ingest import wire
+        from gyeeta_tpu.net.agent import register
+        from gyeeta_tpu.utils import hashing as H
+        from gyeeta_tpu.utils import replay
+        _, writer, status, _hid = await register(
+            args.host, args.port,
+            H.hash_bytes_np(b"gyt-replayer"), wire.CONN_EVENT,
+            version.CURR_WIRE_VERSION)
+        if status != wire.REG_OK:
+            raise SystemExit(f"registration failed: {status}")
+        loop = asyncio.get_running_loop()
+
+        def feed(chunk: bytes) -> None:
+            # replay.play runs in an executor thread; socket writes must
+            # hop back to the event loop
+            loop.call_soon_threadsafe(writer.write, chunk)
+
+        n = await loop.run_in_executor(
+            None, lambda: replay.play(
+                args.capture, feed, speed=args.speed,
+                host_id_offset=args.host_offset))
+        await writer.drain()
+        writer.close()
+        print(f"replayed {n} bytes", file=sys.stderr)
+
+    asyncio.run(run())
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] in ("query", "agent", "replay"):
+        return {"query": _cmd_query, "agent": _cmd_agent,
+                "replay": _cmd_replay}[argv[0]](argv[1:])
+    if argv and argv[0] == "serve":
+        argv = argv[1:]
+    from gyeeta_tpu.server_main import main as serve_main
+    serve_main(argv)
+
+
+if __name__ == "__main__":
+    main()

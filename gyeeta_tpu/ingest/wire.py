@@ -595,15 +595,17 @@ def complete_prefix(buf: bytes) -> int:
     (another conn's bytes would otherwise splice into the middle of it).
     Walks headers only — O(frames), no payload touched. Raises
     FrameError on a corrupt header so the caller can drop the conn."""
+    import struct
     off = 0
     n = len(buf)
     hsz = HEADER_DT.itemsize
     esz = EVENT_NOTIFY_DT.itemsize
+    unpack = struct.Struct("<II").unpack_from   # magic, total_sz — cheap
+    magics = (MAGIC_PM, MAGIC_MS, MAGIC_NQ)
     while off + hsz <= n:
-        hdr = np.frombuffer(buf, HEADER_DT, count=1, offset=off)[0]
-        if hdr["magic"] not in (MAGIC_PM, MAGIC_MS, MAGIC_NQ):
-            raise FrameError(f"bad magic {int(hdr['magic']):#x} at {off}")
-        total = int(hdr["total_sz"])
+        magic, total = unpack(buf, off)
+        if magic not in magics:
+            raise FrameError(f"bad magic {magic:#x} at {off}")
         # same bound as decode_frames — a frame this walk accepts must
         # never be one the decoders reject at the header
         if total < hsz + esz or total >= MAX_COMM_DATA_SZ:

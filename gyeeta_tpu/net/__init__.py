@@ -8,4 +8,12 @@ client: registers, then streams simulator telemetry. ``agent.QueryClient``
 """
 
 from gyeeta_tpu.net.agent import NetAgent, QueryClient  # noqa: F401
-from gyeeta_tpu.net.server import GytServer  # noqa: F401
+
+
+def __getattr__(name):
+    # GytServer pulls in the Runtime (and with it jax); thin clients
+    # importing this package must stay jax-free, so load it lazily
+    if name == "GytServer":
+        from gyeeta_tpu.net.server import GytServer
+        return GytServer
+    raise AttributeError(name)

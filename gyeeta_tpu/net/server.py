@@ -237,6 +237,7 @@ class GytServer:
             except wire.FrameError:
                 # poison header: close the conn — the agent reconnects
                 # and resyncs (the reference closes on bad COMM_HEADER)
+                self.rt.stats.bump("frames_bad")
                 raise
             pending = data[k:]
             if k:

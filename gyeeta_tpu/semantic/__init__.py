@@ -9,13 +9,14 @@ percentile baselines. Here the whole fleet classifies in one jitted
 first-match-wins rule cascade over (S,) columns.
 """
 
+import importlib
+
 from gyeeta_tpu.semantic.states import (
     STATE_IDLE, STATE_GOOD, STATE_OK, STATE_BAD, STATE_SEVERE, STATE_DOWN,
     ISSUE_NONE, ISSUE_TASKS, ISSUE_QPS_HIGH, ISSUE_ACTIVE_CONN_HIGH,
     ISSUE_SERVER_ERRORS, ISSUE_OS_CPU, ISSUE_OS_MEMORY, STATE_NAMES,
     ISSUE_NAMES,
 )
-from gyeeta_tpu.semantic import svcstate, hoststate, derive
 
 __all__ = [
     "STATE_IDLE", "STATE_GOOD", "STATE_OK", "STATE_BAD", "STATE_SEVERE",
@@ -24,3 +25,12 @@ __all__ = [
     "ISSUE_OS_MEMORY", "STATE_NAMES", "ISSUE_NAMES", "svcstate", "hoststate",
     "derive",
 ]
+
+
+def __getattr__(name):
+    # the classifier modules import jax; agents only need the state
+    # constants above, so keep the jax side lazy (thin clients must
+    # never initialize an accelerator backend)
+    if name in ("svcstate", "hoststate", "derive", "cpumem"):
+        return importlib.import_module(f"gyeeta_tpu.semantic.{name}")
+    raise AttributeError(name)

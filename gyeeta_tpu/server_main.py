@@ -44,7 +44,8 @@ class Daemon:
                      extra.get("tick"))
         self.srv = GytServer(self.rt, host=args.host, port=args.port,
                              tick_interval=args.tick_interval,
-                             hostmap_path=args.hostmap)
+                             hostmap_path=args.hostmap,
+                             record_path=args.record)
         self._hot = C.HotReload(args.config, opts) if args.config else None
         self.stop_event = asyncio.Event()
 
@@ -114,6 +115,8 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--checkpoint-dir")
     ap.add_argument("--restore", help="checkpoint .npz to restore")
     ap.add_argument("--hostmap", help="machine-id→host-id placement file")
+    ap.add_argument("--record", help="tee ingested wire bytes to this "
+                    "capture file (replay with `gyeeta_tpu replay`)")
     ap.add_argument("--tick-interval", type=float, default=5.0)
     ap.add_argument("--stats-interval", type=float, default=60.0)
     ap.add_argument("--log-level", default="INFO")

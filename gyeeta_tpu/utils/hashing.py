@@ -14,8 +14,23 @@ host-side decoders and tests can reproduce device indices bit-exactly.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 import numpy as np
+
+
+class _LazyJnp:
+    """Deferred ``jax.numpy`` import: this module is on the import path
+    of thin clients (query CLI, agents) that never touch a device —
+    pulling in jax (and its backend init) there costs seconds and can
+    block on an unreachable accelerator. First device-path use swaps
+    the real module into the global."""
+
+    def __getattr__(self, name):
+        import jax.numpy as jnp
+        globals()["jnp"] = jnp
+        return getattr(jnp, name)
+
+
+jnp = _LazyJnp()
 
 # Murmur3 / splitmix constants.
 _C1 = 0x85EBCA6B

@@ -47,6 +47,10 @@ class StreamRecorder:
         self._f.write(_CHDR.pack(int(self._clock() * 1e6),
                                  len(buf), 0))
         self._f.write(buf)
+        # writes are already batched (one per complete-frame run): flush
+        # each so a server crash loses at most the OS buffer, and never
+        # a chunk header without its payload
+        self._f.flush()
 
     def flush(self) -> None:
         self._f.flush()
@@ -56,19 +60,20 @@ class StreamRecorder:
 
 
 def read_chunks(path) -> Iterator[tuple[int, bytes]]:
-    """Yield (t_usec, chunk_bytes); validates the magic."""
-    data = pathlib.Path(path).read_bytes()
-    if data[: len(MAGIC)] != MAGIC:
-        raise ValueError(f"{path}: not a GYTREC capture")
-    off = len(MAGIC)
-    while off + _CHDR.size <= len(data):
-        tus, n, _pad = _CHDR.unpack_from(data, off)
-        off += _CHDR.size
-        chunk = data[off: off + n]
-        if len(chunk) < n:
-            break                      # truncated tail (crash mid-write)
-        off += n
-        yield tus, chunk
+    """Yield (t_usec, chunk_bytes); validates the magic. Streams —
+    captures can reach many GB at product ingest rates."""
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not a GYTREC capture")
+        while True:
+            hdr = f.read(_CHDR.size)
+            if len(hdr) < _CHDR.size:
+                return
+            tus, n, _pad = _CHDR.unpack(hdr)
+            chunk = f.read(n)
+            if len(chunk) < n:
+                return                 # truncated tail (crash mid-write)
+            yield tus, chunk
 
 
 def remap_host_ids(buf: bytes, offset: int) -> bytes:
@@ -93,10 +98,16 @@ def remap_host_ids(buf: bytes, offset: int) -> bytes:
         if int(hdr["data_type"]) == wire.COMM_EVENT_NOTIFY:
             ev = np.frombuffer(view, wire.EVENT_NOTIFY_DT, 1, off + hsz)[0]
             dt = wire.DTYPE_OF_SUBTYPE.get(int(ev["subtype"]))
+            nev = int(ev["nevents"])
             if dt is not None and "host_id" in (dt.names or ()):
-                recs = np.frombuffer(
-                    view, dt, int(ev["nevents"]), off + hsz + esz).copy()
-                recs["host_id"] = recs["host_id"] + np.uint32(offset)
+                if hsz + esz + nev * dt.itemsize > total:
+                    raise wire.FrameError(
+                        f"nevents {nev} overflows frame at {off}")
+                recs = np.frombuffer(view, dt, nev, off + hsz + esz).copy()
+                with np.errstate(over="ignore"):
+                    recs["host_id"] = (
+                        recs["host_id"].astype(np.int64)
+                        + np.int64(offset)).astype(np.uint32)
                 frame = (frame[: hsz + esz] + recs.tobytes()
                          + frame[hsz + esz + recs.nbytes:])
         out.append(frame)
@@ -110,10 +121,14 @@ def play(path, feed_fn, speed: float = 0.0,
     """Replay a capture through ``feed_fn(bytes)``.
 
     ``speed``: 0 = as fast as possible; N = N× recorded pace (1 = real
-    time). Returns bytes fed."""
+    time). Returns bytes fed. With ``host_id_offset``, frames that span
+    chunk boundaries reassemble before remapping (the file format
+    permits arbitrary chunking even though the server records
+    complete-frame runs)."""
     n = 0
     t0: Optional[int] = None
     w0 = time.monotonic()
+    pending = b""
     for tus, chunk in read_chunks(path):
         if speed > 0:
             if t0 is None:
@@ -123,7 +138,13 @@ def play(path, feed_fn, speed: float = 0.0,
             if delay > 0:
                 sleep(delay)
         if host_id_offset:
-            chunk = remap_host_ids(chunk, host_id_offset)
+            data = pending + chunk
+            k = wire.complete_prefix(data)
+            pending = data[k:]
+            chunk = remap_host_ids(data[:k], host_id_offset)
         feed_fn(chunk)
         n += len(chunk)
+    if pending:
+        feed_fn(pending)               # trailing partial, unremappable
+        n += len(pending)
     return n

@@ -118,3 +118,21 @@ def test_replay_host_remap(tmp_path):
     rt.flush()
     last = np.asarray(rt.state.host_last_tick)
     assert set(np.nonzero(last >= 0)[0]) == {0, 1, 4, 5}
+
+
+def test_thin_client_imports_are_jax_free():
+    """Query/agent/replay clients must not pull in jax (CLI latency;
+    they must work even when the accelerator backend is unreachable)."""
+    import subprocess
+    import sys
+    code = (
+        "import sys; sys.modules['jax'] = None\n"
+        "from gyeeta_tpu.net.agent import QueryClient, NetAgent\n"
+        "from gyeeta_tpu.utils import replay\n"
+        "from gyeeta_tpu.cli import main\n"
+        "print('ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
