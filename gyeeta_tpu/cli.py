@@ -80,6 +80,8 @@ def _cmd_replay(argv) -> None:
     args = ap.parse_args(argv)
 
     async def run():
+        import time as _time
+
         from gyeeta_tpu import version
         from gyeeta_tpu.ingest import wire
         from gyeeta_tpu.net.agent import register
@@ -91,18 +93,37 @@ def _cmd_replay(argv) -> None:
             version.CURR_WIRE_VERSION)
         if status != wire.REG_OK:
             raise SystemExit(f"registration failed: {status}")
-        loop = asyncio.get_running_loop()
-
-        def feed(chunk: bytes) -> None:
-            # replay.play runs in an executor thread; socket writes must
-            # hop back to the event loop
-            loop.call_soon_threadsafe(writer.write, chunk)
-
-        n = await loop.run_in_executor(
-            None, lambda: replay.play(
-                args.capture, feed, speed=args.speed,
-                host_id_offset=args.host_offset))
-        await writer.drain()
+        # stream on the event loop with a drain per chunk: captures can
+        # be many GB, so transport backpressure must gate the file read,
+        # and a dropped conn must fail loudly, not buffer into the void
+        n = 0
+        t0 = None
+        w0 = _time.monotonic()
+        pending = b""
+        try:
+            for tus, chunk in replay.read_chunks(args.capture):
+                if args.speed > 0:
+                    t0 = tus if t0 is None else t0
+                    delay = (w0 + (tus - t0) / 1e6 / args.speed
+                             - _time.monotonic())
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                if args.host_offset:
+                    data = pending + chunk
+                    k = wire.complete_prefix(data)
+                    pending = data[k:]
+                    chunk = replay.remap_host_ids(data[:k],
+                                                  args.host_offset)
+                writer.write(chunk)
+                await writer.drain()
+                n += len(chunk)
+            if pending:
+                writer.write(pending)
+                await writer.drain()
+                n += len(pending)
+        except (ConnectionError, OSError) as e:
+            raise SystemExit(f"server dropped the conn after {n} bytes: "
+                             f"{e}")
         writer.close()
         print(f"replayed {n} bytes", file=sys.stderr)
 

@@ -587,6 +587,11 @@ class FrameError(ValueError):
     pass
 
 
+import struct as _struct  # noqa: E402
+# (magic, total_sz) — the per-read hot-path header peek
+_HDR_PREFIX_UNPACK = _struct.Struct("<II").unpack_from
+
+
 def complete_prefix(buf: bytes) -> int:
     """Length of the longest prefix of COMPLETE frames.
 
@@ -595,12 +600,11 @@ def complete_prefix(buf: bytes) -> int:
     (another conn's bytes would otherwise splice into the middle of it).
     Walks headers only — O(frames), no payload touched. Raises
     FrameError on a corrupt header so the caller can drop the conn."""
-    import struct
     off = 0
     n = len(buf)
     hsz = HEADER_DT.itemsize
     esz = EVENT_NOTIFY_DT.itemsize
-    unpack = struct.Struct("<II").unpack_from   # magic, total_sz — cheap
+    unpack = _HDR_PREFIX_UNPACK
     magics = (MAGIC_PM, MAGIC_MS, MAGIC_NQ)
     while off + hsz <= n:
         magic, total = unpack(buf, off)

@@ -287,8 +287,15 @@ def dep_fold_many(dep: DepGraph, cbs, tick) -> DepGraph:
     microbatches keep intra-dispatch recycling (worst case 8192 new
     halves per step vs the 64k-row default table) at 1/4 the step count
     of the old per-microbatch scan."""
-    K = cbs.valid.shape[0]
-    chunk = 4
+    K, B = cbs.valid.shape[:2]
+    # bound each step's worst-case one-sided inserts to a QUARTER of
+    # the pair table: even on top of a steady-state unpaired backlog
+    # (bounded by pair_ttl eviction, typically ≤25-40%) an
+    # all-one-sided burst stays under the ~78% probe-exhaustion load
+    # documented in engine/table.py. Default 64k table, 16×2048
+    # dispatches → chunks of 8 (two steps per dispatch).
+    capacity = dep.h_last_tick.shape[0]
+    chunk = max(1, min(K, (capacity // 4) // max(B, 1)))
 
     def body(carry, cbn):
         flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]),
@@ -296,6 +303,10 @@ def dep_fold_many(dep: DepGraph, cbs, tick) -> DepGraph:
         return dep_step(carry, flat, tick), None
 
     nfull = K // chunk
+    if nfull == 1 and K % chunk == 0:
+        flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]),
+                            cbs)
+        return dep_step(dep, flat, tick)
     if nfull:
         grouped = jax.tree.map(
             lambda x: x[: nfull * chunk].reshape(

@@ -637,8 +637,41 @@ def svcprocmap_join(tcols, tlive, info_cols):
     return cols, np.ones(n, bool)
 
 
+def procinfo_columns(cfg: EngineCfg, st: AggState, names=None):
+    """procinfo: the static face of the process-group slab (identity,
+    placement, service linkage — ref aggrtaskinfotbl). Built straight
+    from the task snapshot: the related-listener ids exist as (hi, lo)
+    arrays there — no hex round trip."""
+    from gyeeta_tpu.ingest import decode as D
+    from gyeeta_tpu.ingest import wire
+
+    snap = {k: np.asarray(v)
+            for k, v in readback.task_snapshot(cfg, st).items()}
+    rel_hi, rel_lo = snap["rel_hi"], snap["rel_lo"]
+    rel_ids = ((rel_hi.astype(np.uint64) << np.uint64(32))
+               | rel_lo.astype(np.uint64))
+    if names is not None:
+        svcnames = names.resolve_array(wire.NAME_KIND_SVC, rel_ids,
+                                       fallback_hex=False)
+    else:
+        svcnames = np.full(len(rel_ids), "", object)
+    svcnames = np.where(rel_ids == 0, "", svcnames)
+    cols = {
+        "taskid": _hex_id(snap["key_hi"], snap["key_lo"]),
+        "comm": _names_of(names, wire.NAME_KIND_COMM,
+                          snap["comm_hi"], snap["comm_lo"]),
+        "relsvcid": _hex_id(rel_hi, rel_lo),
+        "svcname": svcnames,
+        "ntasks": snap["stats"][:, D.TASK_NTASKS],
+        "hostid": snap["hostid"],
+    }
+    return cols, snap["live"]
+
+
 # svcsumm derives from svc_columns (defined below the map literal)
 _COLUMNS_OF[fieldmaps.SUBSYS_SVCSUMM] = svcsumm_columns
+_COLUMNS_OF[fieldmaps.SUBSYS_PROCINFO] = procinfo_columns
+_COLUMNS_OF[fieldmaps.SUBSYS_TOPPGCPU] = task_columns
 
 # subsystems whose columns come from the dependency graph, not AggState
 _DEP_COLUMNS_OF = {
@@ -659,6 +692,7 @@ _SVCREG_COLUMNS_OF = {
 # (ref TASK_TOP_PROCS top-15 CPU / top-8 RSS, gy_comm_proto.h:1415)
 _TOP_PRESETS = {
     fieldmaps.SUBSYS_TOPCPU: ("cpu", 15),
+    fieldmaps.SUBSYS_TOPPGCPU: ("cpu", 10),   # ref top-10 PG CPU
     fieldmaps.SUBSYS_TOPRSS: ("rssmb", 8),
     fieldmaps.SUBSYS_TOPDELAY: ("cpudelms", 15),
 }

@@ -25,6 +25,9 @@ SUBSYS_TASKSTATE = "taskstate"      # ref aggrtaskstate
 # top-N process-group views (ref TASK_TOP_PROCS, gy_comm_proto.h:1415:
 # top CPU / PG CPU / RSS / forks — here: preset-sorted taskstate views)
 SUBSYS_TOPCPU = "topcpu"
+SUBSYS_TOPPGCPU = "toppgcpu"        # ref toppgcpu (groups ARE our unit;
+#                                     alias preset of topcpu)
+SUBSYS_PROCINFO = "procinfo"        # ref procinfo (static group info)
 SUBSYS_TOPRSS = "toprss"
 SUBSYS_TOPDELAY = "topdelay"
 SUBSYS_SVCDEP = "svcdependency"     # ref DEPENDS_LISTENER / svcprocmap
@@ -191,6 +194,18 @@ TASKSTATE_FIELDS = (
     num("nissue", "nissue", "Processes with issues"),
     enum("state", "state", _state_enc, _state_dec, "Group state"),
     enum("issue", "issue", _tissue_enc, _tissue_dec, "Issue source"),
+    num("hostid", "hostid", "Owning host id"),
+)
+
+# --------------------------------------------------------------- procinfo
+# ref SUBSYS_PROCINFO (aggrtaskinfotbl): the static face of a process
+# group — identity, placement, service linkage
+PROCINFO_FIELDS = (
+    string("taskid", "taskid", "Process-group id (hex)"),
+    string("comm", "comm", "Process command name"),
+    string("relsvcid", "relsvcid", "Related listener (service) id (hex)"),
+    string("svcname", "svcname", "Linked service name ('' if none)"),
+    num("ntasks", "ntasks", "Processes in the group"),
     num("hostid", "hostid", "Owning host id"),
 )
 
@@ -533,6 +548,8 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_FLOWSTATE: FLOWSTATE_FIELDS,
     SUBSYS_TASKSTATE: TASKSTATE_FIELDS,
     SUBSYS_TOPCPU: TASKSTATE_FIELDS,
+    SUBSYS_TOPPGCPU: TASKSTATE_FIELDS,
+    SUBSYS_PROCINFO: PROCINFO_FIELDS,
     SUBSYS_TOPRSS: TASKSTATE_FIELDS,
     SUBSYS_TOPDELAY: TASKSTATE_FIELDS,
     SUBSYS_SVCDEP: SVCDEP_FIELDS,
