@@ -80,8 +80,6 @@ def _cmd_replay(argv) -> None:
     args = ap.parse_args(argv)
 
     async def run():
-        import time as _time
-
         from gyeeta_tpu import version
         from gyeeta_tpu.ingest import wire
         from gyeeta_tpu.net.agent import register
@@ -97,30 +95,14 @@ def _cmd_replay(argv) -> None:
         # be many GB, so transport backpressure must gate the file read,
         # and a dropped conn must fail loudly, not buffer into the void
         n = 0
-        t0 = None
-        w0 = _time.monotonic()
-        pending = b""
         try:
-            for tus, chunk in replay.read_chunks(args.capture):
-                if args.speed > 0:
-                    t0 = tus if t0 is None else t0
-                    delay = (w0 + (tus - t0) / 1e6 / args.speed
-                             - _time.monotonic())
-                    if delay > 0:
-                        await asyncio.sleep(delay)
-                if args.host_offset:
-                    data = pending + chunk
-                    k = wire.complete_prefix(data)
-                    pending = data[k:]
-                    chunk = replay.remap_host_ids(data[:k],
-                                                  args.host_offset)
+            for delay, chunk in replay.paced_chunks(
+                    args.capture, args.speed, args.host_offset):
+                if delay > 0:
+                    await asyncio.sleep(delay)
                 writer.write(chunk)
                 await writer.drain()
                 n += len(chunk)
-            if pending:
-                writer.write(pending)
-                await writer.drain()
-                n += len(pending)
         except (ConnectionError, OSError) as e:
             raise SystemExit(f"server dropped the conn after {n} bytes: "
                              f"{e}")

@@ -101,8 +101,76 @@ def _bind_and_handshake(lib):
     if native != expect:
         raise RuntimeError(
             f"native deframer layout mismatch: {native} != {expect}")
+    # columnar conn-decode layout push (same single-source discipline)
+    lib.gyt_set_conn_layout.restype = ctypes.c_int32
+    lib.gyt_set_conn_layout.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                        ctypes.c_int32]
+    lib.gyt_decode_conn.restype = ctypes.c_int32
+    lib.gyt_decode_conn.argtypes = [ctypes.c_void_p, ctypes.c_int64] + \
+        [ctypes.c_void_p] * 16
+    dt = wire.TCP_CONN_DT
+    off = {name: dt.fields[name][1] for name in dt.names}
+    fields = [dt.itemsize,
+              off["cli"], off["ser"], off["nat_cli"], off["nat_ser"],
+              off["tusec_start"], off["tusec_close"],
+              off["cli_task_aggr_id"], off["cli_related_listen_id"],
+              off["ser_glob_id"], off["bytes_sent"], off["bytes_rcvd"],
+              off["host_id"], off["flags"],
+              wire.IP_PORT_DT.fields["port"][1]]
+    arr = (ctypes.c_int64 * len(fields))(*fields)
+    rc = lib.gyt_set_conn_layout(arr, len(fields))
+    if rc != 0:
+        raise RuntimeError(f"gyt_set_conn_layout: "
+                           f"{_ERRNAMES.get(rc, rc)}")
     _lib = lib
     return _lib
+
+
+def decode_conn(recs, size: int):
+    """Native columnar TCP_CONN decode → ConnBatch (or None when the
+    native library is unavailable — callers fall back to
+    decode.conn_batch). Semantics bit-identical to the Python decoder;
+    tests/test_native_ingest.py diffs them on random records."""
+    lib = _load()
+    if lib is None:
+        return None
+    from gyeeta_tpu.ingest import decode as D
+
+    if len(recs) > size:
+        raise ValueError(f"{len(recs)} records exceed batch size {size};"
+                         f" split upstream")
+    n = len(recs)
+    recs = np.ascontiguousarray(recs)
+    u32 = lambda: np.zeros(size, np.uint32)     # noqa: E731
+    f32 = lambda: np.zeros(size, np.float32)    # noqa: E731
+    cols = dict(
+        svc_hi=u32(), svc_lo=u32(), flow_hi=u32(), flow_lo=u32(),
+        cli_hi=u32(), cli_lo=u32(), cli_task_hi=u32(),
+        cli_task_lo=u32(), cli_rel_hi=u32(), cli_rel_lo=u32(),
+        bytes_sent=f32(), bytes_rcvd=f32(), duration_us=f32(),
+        host_id=np.zeros(size, np.int32),
+        is_close=np.zeros(size, np.uint8),
+        is_accept=np.zeros(size, np.uint8))
+    ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
+    rc = lib.gyt_decode_conn(
+        recs.ctypes.data_as(ctypes.c_void_p), n,
+        ptr(cols["svc_hi"]), ptr(cols["svc_lo"]),
+        ptr(cols["flow_hi"]), ptr(cols["flow_lo"]),
+        ptr(cols["cli_hi"]), ptr(cols["cli_lo"]),
+        ptr(cols["cli_task_hi"]), ptr(cols["cli_task_lo"]),
+        ptr(cols["cli_rel_hi"]), ptr(cols["cli_rel_lo"]),
+        ptr(cols["bytes_sent"]), ptr(cols["bytes_rcvd"]),
+        ptr(cols["duration_us"]), ptr(cols["host_id"]),
+        ptr(cols["is_close"]), ptr(cols["is_accept"]))
+    if rc != 0:
+        raise RuntimeError(f"gyt_decode_conn: {_ERRNAMES.get(rc, rc)}")
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return D.ConnBatch(
+        valid=valid,
+        is_close=cols.pop("is_close").astype(bool),
+        is_accept=cols.pop("is_accept").astype(bool),
+        **cols)
 
 
 def available() -> bool:

@@ -171,6 +171,158 @@ int32_t gyt_extract(const uint8_t* buf, int64_t len, uint32_t subtype,
   return GYT_OK;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Columnar TCP_CONN decode: raw records → the ConnBatch column arrays.
+// The hashing (murmur3 finalizer chains, xor-folded IPv6 words, the
+// 5-tuple flow key) is bit-identical to utils/hashing.py's numpy path —
+// a parity test diffs the two on random records. Field offsets are NOT
+// compiled in: the Python loader pushes them from wire.TCP_CONN_DT
+// (gyt_set_conn_layout), same discipline as the subtype table.
+
+namespace {
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+inline uint32_t mix64(uint32_t hi, uint32_t lo, uint32_t salt) {
+  const uint32_t s = (salt + 1u) * 0x9E3779B9u;
+  uint32_t h = fmix32(lo ^ s);
+  return fmix32(hi ^ h ^ salt);
+}
+
+// offsets into one TCP_CONN record, pushed from Python
+struct ConnLayout {
+  int64_t itemsize;
+  int64_t cli, ser, nat_cli, nat_ser;  // IP_PORT offsets (16B ip first)
+  int64_t tusec_start, tusec_close;
+  int64_t cli_task, cli_rel, ser_glob;
+  int64_t bytes_sent, bytes_rcvd;
+  int64_t host_id, flags;
+  int64_t port_off;  // offset of port WITHIN an IP_PORT sub-record
+};
+
+ConnLayout g_conn{};
+bool g_conn_set = false;
+
+inline void fold_ip(const uint8_t* p, uint32_t* hi, uint32_t* lo) {
+  uint32_t w[4];
+  std::memcpy(w, p, 16);
+  *hi = w[0] ^ w[2];
+  *lo = w[1] ^ w[3];
+}
+
+inline bool ip_nonzero(const uint8_t* p) {
+  uint64_t a, b;
+  std::memcpy(&a, p, 8);
+  std::memcpy(&b, p + 8, 8);
+  return (a | b) != 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// fields: itemsize then the 14 offsets in ConnLayout order.
+int32_t gyt_set_conn_layout(const int64_t* fields, int32_t n) {
+  if (n != 15) return GYT_BAD_TABLE;
+  int64_t* dst = &g_conn.itemsize;
+  for (int32_t i = 0; i < 15; i++) dst[i] = fields[i];
+  if (g_conn.itemsize <= 0 || g_conn.itemsize % 8 != 0)
+    return GYT_BAD_TABLE;
+  g_conn_set = true;
+  return GYT_OK;
+}
+
+// Decode n records at `recs` into pre-allocated column arrays (each of
+// length >= n). Semantics identical to decode.conn_batch's per-record
+// math; Python pads/validates lanes.
+int32_t gyt_decode_conn(
+    const uint8_t* recs, int64_t n, uint32_t* svc_hi, uint32_t* svc_lo,
+    uint32_t* flow_hi, uint32_t* flow_lo, uint32_t* cli_hi,
+    uint32_t* cli_lo, uint32_t* task_hi, uint32_t* task_lo,
+    uint32_t* rel_hi, uint32_t* rel_lo, float* bytes_sent,
+    float* bytes_rcvd, float* duration_us, int32_t* host_id,
+    uint8_t* is_close, uint8_t* is_accept) {
+  if (!g_conn_set) return GYT_BAD_TABLE;
+  const ConnLayout& L = g_conn;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* r = recs + i * L.itemsize;
+    uint64_t u64;
+
+    std::memcpy(&u64, r + L.ser_glob, 8);
+    svc_hi[i] = static_cast<uint32_t>(u64 >> 32);
+    svc_lo[i] = static_cast<uint32_t>(u64);
+    std::memcpy(&u64, r + L.cli_task, 8);
+    task_hi[i] = static_cast<uint32_t>(u64 >> 32);
+    task_lo[i] = static_cast<uint32_t>(u64);
+    std::memcpy(&u64, r + L.cli_rel, 8);
+    rel_hi[i] = static_cast<uint32_t>(u64 >> 32);
+    rel_lo[i] = static_cast<uint32_t>(u64);
+
+    // NAT-aware effective tuple (post-NAT view when conntrack resolved)
+    const uint8_t* cli = r + L.cli;
+    const uint8_t* ser = r + L.ser;
+    const uint8_t* ncli = r + L.nat_cli;
+    const uint8_t* nser = r + L.nat_ser;
+    const bool nat_c = ip_nonzero(ncli);
+    const bool nat_s = ip_nonzero(nser);
+    const uint8_t* eff_cli = nat_c ? ncli : cli;
+    const uint8_t* eff_ser = nat_s ? nser : ser;
+    uint16_t cport, sport;
+    std::memcpy(&cport, (nat_c ? ncli : cli) + L.port_off, 2);
+    std::memcpy(&sport, (nat_s ? nser : ser) + L.port_off, 2);
+
+    uint32_t cip_hi, cip_lo, sip_hi, sip_lo;
+    fold_ip(eff_cli, &cip_hi, &cip_lo);
+    fold_ip(eff_ser, &sip_hi, &sip_lo);
+
+    // flow_key (utils/hashing.py): ports word, two mix64 streams, chain
+    const uint32_t ports =
+        (static_cast<uint32_t>(cport) << 16) | sport;
+    const uint32_t a = mix64(cip_hi, cip_lo, 1);
+    const uint32_t b = mix64(sip_hi, sip_lo, 2);
+    const uint32_t f_lo = fmix32(a ^ (ports * 0x85EBCA6Bu));
+    const uint32_t f_hi = fmix32(b ^ (6u * 0xC2B2AE35u) ^ f_lo);
+    flow_hi[i] = f_hi;
+    flow_lo[i] = f_lo;
+
+    // client endpoint identity: address-only hash
+    const uint32_t c_hi = fmix32(cip_hi ^ 0xC11E57u);
+    cli_hi[i] = c_hi;
+    cli_lo[i] = fmix32(cip_lo ^ c_hi);
+
+    uint64_t bs, br, t0, t1;
+    std::memcpy(&bs, r + L.bytes_sent, 8);
+    std::memcpy(&br, r + L.bytes_rcvd, 8);
+    std::memcpy(&t0, r + L.tusec_start, 8);
+    std::memcpy(&t1, r + L.tusec_close, 8);
+    bytes_sent[i] = static_cast<float>(bs);
+    bytes_rcvd[i] = static_cast<float>(br);
+    const bool closed = t1 > 0;
+    duration_us[i] = closed ? static_cast<float>(t1 - t0) : 0.0f;
+    is_close[i] = closed ? 1 : 0;
+
+    uint32_t hid, flags;
+    std::memcpy(&hid, r + L.host_id, 4);
+    std::memcpy(&flags, r + L.flags, 4);
+    host_id[i] = static_cast<int32_t>(hid);
+    is_accept[i] = (flags & 2u) ? 1 : 0;
+  }
+  return GYT_OK;
+}
+
+}  // extern "C"
+
+extern "C" {
+
 // Count frames + records per subtype without copying (sizing pass).
 // counts: array of g_ntypes int64, in gyt_set_table order.
 int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,

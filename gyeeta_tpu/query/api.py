@@ -163,16 +163,11 @@ def host_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
 
 def task_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
     """taskstate subsystem columns (ref MAGGR_TASK / aggrtaskstate)."""
-    from gyeeta_tpu.ingest import wire
-
     snap = {k: np.asarray(v)
             for k, v in readback.task_snapshot(cfg, st).items()}
     g = snap["stats"]
-    cols = {
-        "taskid": _hex_id(snap["key_hi"], snap["key_lo"]),
-        "comm": _names_of(names, wire.NAME_KIND_COMM,
-                          snap["comm_hi"], snap["comm_lo"]),
-        "relsvcid": _hex_id(snap["rel_hi"], snap["rel_lo"]),
+    cols = _task_identity_cols(snap, names)
+    cols |= {
         "tcpkb": g[:, D.TASK_TCP_KB],
         "tcpconns": g[:, D.TASK_TCP_CONNS],
         "cpu": g[:, D.TASK_CPU_PCT],
@@ -637,34 +632,41 @@ def svcprocmap_join(tcols, tlive, info_cols):
     return cols, np.ones(n, bool)
 
 
+def _task_identity_cols(snap, names):
+    """Shared identity columns over a task snapshot (taskid/comm/
+    relsvcid rendering in ONE place for taskstate + procinfo)."""
+    from gyeeta_tpu.ingest import wire
+
+    return {
+        "taskid": _hex_id(snap["key_hi"], snap["key_lo"]),
+        "comm": _names_of(names, wire.NAME_KIND_COMM,
+                          snap["comm_hi"], snap["comm_lo"]),
+        "relsvcid": _hex_id(snap["rel_hi"], snap["rel_lo"]),
+    }
+
+
 def procinfo_columns(cfg: EngineCfg, st: AggState, names=None):
     """procinfo: the static face of the process-group slab (identity,
     placement, service linkage — ref aggrtaskinfotbl). Built straight
     from the task snapshot: the related-listener ids exist as (hi, lo)
     arrays there — no hex round trip."""
-    from gyeeta_tpu.ingest import decode as D
     from gyeeta_tpu.ingest import wire
 
     snap = {k: np.asarray(v)
             for k, v in readback.task_snapshot(cfg, st).items()}
-    rel_hi, rel_lo = snap["rel_hi"], snap["rel_lo"]
-    rel_ids = ((rel_hi.astype(np.uint64) << np.uint64(32))
-               | rel_lo.astype(np.uint64))
+    rel_ids = ((snap["rel_hi"].astype(np.uint64) << np.uint64(32))
+               | snap["rel_lo"].astype(np.uint64))
     if names is not None:
         svcnames = names.resolve_array(wire.NAME_KIND_SVC, rel_ids,
                                        fallback_hex=False)
     else:
         svcnames = np.full(len(rel_ids), "", object)
-    svcnames = np.where(rel_ids == 0, "", svcnames)
-    cols = {
-        "taskid": _hex_id(snap["key_hi"], snap["key_lo"]),
-        "comm": _names_of(names, wire.NAME_KIND_COMM,
-                          snap["comm_hi"], snap["comm_lo"]),
-        "relsvcid": _hex_id(rel_hi, rel_lo),
-        "svcname": svcnames,
+    cols = _task_identity_cols(snap, names)
+    cols.update({
+        "svcname": np.where(rel_ids == 0, "", svcnames),
         "ntasks": snap["stats"][:, D.TASK_NTASKS],
         "hostid": snap["hostid"],
-    }
+    })
     return cols, snap["live"]
 
 

@@ -116,6 +116,34 @@ def remap_host_ids(buf: bytes, offset: int) -> bytes:
     return b"".join(out)
 
 
+def paced_chunks(path, speed: float = 0.0,
+                 host_id_offset: int = 0) -> Iterator[tuple[float, bytes]]:
+    """Yield (delay_seconds, ready-to-feed bytes) for a capture — the
+    ONE implementation of pacing, partial-frame reassembly, and host-id
+    remapping, shared by the sync :func:`play` and the async CLI (which
+    must interleave awaits). ``delay`` is how long the consumer should
+    sleep before feeding this chunk (0 when running flat out)."""
+    t0: Optional[int] = None
+    w0 = time.monotonic()
+    pending = b""
+    for tus, chunk in read_chunks(path):
+        delay = 0.0
+        if speed > 0:
+            if t0 is None:
+                t0 = tus
+            delay = max(0.0, w0 + (tus - t0) / 1e6 / speed
+                        - time.monotonic())
+        if host_id_offset:
+            data = pending + chunk
+            k = wire.complete_prefix(data)
+            pending = data[k:]
+            chunk = remap_host_ids(data[:k], host_id_offset)
+        if chunk or delay:
+            yield delay, chunk
+    if pending:
+        yield 0.0, pending             # trailing partial, unremappable
+
+
 def play(path, feed_fn, speed: float = 0.0,
          host_id_offset: int = 0, sleep=time.sleep) -> int:
     """Replay a capture through ``feed_fn(bytes)``.
@@ -126,25 +154,9 @@ def play(path, feed_fn, speed: float = 0.0,
     permits arbitrary chunking even though the server records
     complete-frame runs)."""
     n = 0
-    t0: Optional[int] = None
-    w0 = time.monotonic()
-    pending = b""
-    for tus, chunk in read_chunks(path):
-        if speed > 0:
-            if t0 is None:
-                t0 = tus
-            due = w0 + (tus - t0) / 1e6 / speed
-            delay = due - time.monotonic()
-            if delay > 0:
-                sleep(delay)
-        if host_id_offset:
-            data = pending + chunk
-            k = wire.complete_prefix(data)
-            pending = data[k:]
-            chunk = remap_host_ids(data[:k], host_id_offset)
+    for delay, chunk in paced_chunks(path, speed, host_id_offset):
+        if delay > 0:
+            sleep(delay)
         feed_fn(chunk)
         n += len(chunk)
-    if pending:
-        feed_fn(pending)               # trailing partial, unremappable
-        n += len(pending)
     return n

@@ -105,3 +105,36 @@ def test_all_subtypes_covered_by_native_table():
     assert set(nat) == set(py) == set(wire.DTYPE_OF_SUBTYPE)
     for st in nat:
         assert np.array_equal(nat[st], py[st]), st
+
+
+def test_native_conn_decode_parity():
+    """gyt_decode_conn must be bit-identical to decode.conn_batch on
+    random records, including NAT-translated tuples and accept flags."""
+    import numpy as np
+    import pytest
+
+    from gyeeta_tpu.ingest import decode, native, wire
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    if not native.available():
+        pytest.skip("native deframer not built")
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=77)
+    recs = sim.conn_records(512)
+    # exercise the NAT path: give some records translated tuples
+    cli, ser = sim.svc_conn_records(64, split_halves=True)
+    recs = np.concatenate([recs, cli, ser])
+    rng = np.random.default_rng(5)
+    nat_rows = rng.choice(len(recs), 100, replace=False)
+    recs["nat_cli"]["ip"][nat_rows, :4] = rng.integers(
+        1, 255, (100, 4), dtype=np.uint8)
+    recs["nat_cli"]["port"][nat_rows] = rng.integers(
+        1024, 65535, 100, dtype=np.uint16)
+
+    size = 1024
+    a = native.decode_conn(recs, size)
+    b = decode.conn_batch(recs, size)
+    assert a is not None
+    for field in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=field)
