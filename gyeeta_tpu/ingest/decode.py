@@ -281,6 +281,15 @@ def conn_batch(recs: np.ndarray, size: int = wire.MAX_CONNS_PER_BATCH
     )
 
 
+def conn_batch_fast(recs: np.ndarray,
+                    size: int = wire.MAX_CONNS_PER_BATCH) -> ConnBatch:
+    """Columnar conn decode via the native C++ path when built
+    (bit-identical; ~4x faster), else :func:`conn_batch`."""
+    from gyeeta_tpu.ingest import native
+    cb = native.decode_conn(recs, size)
+    return cb if cb is not None else conn_batch(recs, size)
+
+
 def resp_batch(recs: np.ndarray, size: int = wire.MAX_RESP_PER_BATCH
                ) -> RespBatch:
     n = _check_fit(recs, size)

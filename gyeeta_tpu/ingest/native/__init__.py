@@ -136,6 +136,9 @@ def decode_conn(recs, size: int):
         return None
     from gyeeta_tpu.ingest import decode as D
 
+    if recs.dtype != wire.TCP_CONN_DT:
+        raise TypeError(f"decode_conn needs TCP_CONN_DT records, got "
+                        f"{recs.dtype}")   # C++ walks layout offsets
     if len(recs) > size:
         raise ValueError(f"{len(recs)} records exceed batch size {size};"
                          f" split upstream")

@@ -167,7 +167,7 @@ class ShardedRuntime:
                 self.cfg.listener_batch):
             if kind == "connresp":
                 cchunk, rchunk = chunks
-                cbs = self._stack(decode.conn_batch, cchunk,
+                cbs = self._stack(decode.conn_batch_fast, cchunk,
                                   self.cfg.conn_batch)
                 rbs = self._stack(decode.resp_batch, rchunk,
                                   self.cfg.resp_batch)

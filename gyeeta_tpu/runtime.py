@@ -118,13 +118,6 @@ class Runtime:
         self._empty_resp = decode.resp_batch(
             np.empty(0, wire.RESP_SAMPLE_DT), self.cfg.resp_batch)
 
-    @staticmethod
-    def _decode_conn(recs, size):
-        """Columnar conn decode: native C++ fast path (hashing + IP
-        folds per record), Python fallback."""
-        cb = native.decode_conn(recs, size)
-        return cb if cb is not None else decode.conn_batch(recs, size)
-
     # ------------------------------------------------------------- ingest
     def feed(self, buf: bytes) -> int:
         """Ingest a byte stream (any number of frames, any mix of types).
@@ -155,7 +148,7 @@ class Runtime:
                 self.cfg.listener_batch):
             if kind == "connresp":
                 cchunk, rchunk = chunks
-                cb = (self._decode_conn(cchunk, self.cfg.conn_batch)
+                cb = (decode.conn_batch_fast(cchunk, self.cfg.conn_batch)
                       if len(cchunk) else self._empty_conn)
                 rb = (decode.resp_batch(rchunk, self.cfg.resp_batch)
                       if len(rchunk) else self._empty_resp)

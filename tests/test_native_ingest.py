@@ -129,6 +129,13 @@ def test_native_conn_decode_parity():
         1, 255, (100, 4), dtype=np.uint8)
     recs["nat_cli"]["port"][nat_rows] = rng.integers(
         1024, 65535, 100, dtype=np.uint16)
+    # ...and the server-side DNAT branch (nat_ser), on overlapping and
+    # disjoint rows so all four nat_c/nat_s combinations occur
+    nat_s_rows = rng.choice(len(recs), 100, replace=False)
+    recs["nat_ser"]["ip"][nat_s_rows, :4] = rng.integers(
+        1, 255, (100, 4), dtype=np.uint8)
+    recs["nat_ser"]["port"][nat_s_rows] = rng.integers(
+        1024, 65535, 100, dtype=np.uint16)
 
     size = 1024
     a = native.decode_conn(recs, size)
