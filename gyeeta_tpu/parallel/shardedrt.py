@@ -62,9 +62,11 @@ class ShardedRuntime:
         from gyeeta_tpu.utils.notifylog import NotifyLog
         from gyeeta_tpu.trace.defs import TraceDefs
         self.tracedefs = TraceDefs(clock=clock)
+        from gyeeta_tpu.utils.natreg import NatClusterRegistry
         self.svcreg = SvcInfoRegistry()
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
+        self.natclusters = NatClusterRegistry()
         self.notifylog = NotifyLog(clock=clock)
         self.alerts = AlertManager(self.cfg, clock=clock)
         self._clock = clock or time.time
@@ -135,6 +137,7 @@ class ShardedRuntime:
             "serverstatus": self._serverstatus_columns,
             "hostlist": self._hostlist_columns,
             "shardlist": self._shardlist_columns,
+            "svcipclust": lambda: self.natclusters.columns(self.names),
             "tracedef": lambda: self.tracedefs.columns(),
             "tracestatus": lambda: self.tracedefs.columns(),
             "traceuniq": self._traceuniq_columns,
@@ -167,6 +170,8 @@ class ShardedRuntime:
                 self.cfg.listener_batch):
             if kind == "connresp":
                 cchunk, rchunk = chunks
+                if len(cchunk):
+                    self.natclusters.observe_conns(cchunk)
                 cbs = self._stack(decode.conn_batch_fast, cchunk,
                                   self.cfg.conn_batch)
                 rbs = self._stack(decode.resp_batch, rchunk,
@@ -459,6 +464,8 @@ class ShardedRuntime:
             self.state = self._age_tasks(self.state)
             self.state = self._age_apis(self.state)
         self.dep = self._dep_age(self.dep, np.int32(self._tick_no))
+        self.cgroups.age()
+        self.natclusters.age()
         return report
 
     # -------------------------------------------------------------- query

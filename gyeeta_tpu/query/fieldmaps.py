@@ -53,6 +53,7 @@ SUBSYS_SHARDLIST = "shardlist"      # mesh-native: per-shard stats (the
 #                                     madhavalist analogue — one row per
 #                                     shard instead of per madhava)
 SUBSYS_CGROUPSTATE = "cgroupstate"  # ref cgroupstate
+SUBSYS_SVCIPCLUST = "svcipclust"    # ref NAT-IP / VIP clusters
 SUBSYS_ALERTS = "alerts"            # ref alerts (fired alert log)
 SUBSYS_ALERTDEF = "alertdef"        # ref alertdef
 SUBSYS_SILENCES = "silences"        # ref silences
@@ -447,6 +448,16 @@ EXTACTIVECONN_FIELDS = ACTIVECONN_FIELDS + _EXTINFO_FIELDS
 EXTCLIENTCONN_FIELDS = CLIENTCONN_FIELDS + _EXTINFO_FIELDS
 EXTTRACEREQ_FIELDS = TRACEREQ_FIELDS + _EXTINFO_FIELDS
 
+# ------------------------------------------------------------- svcipclust
+# ref check_svc_nat_ip_clusters (server/gy_shconnhdlr.h:1301): services
+# reached through one virtual IP = a load-balancer cluster
+SVCIPCLUST_FIELDS = (
+    string("vip", "vip", "Virtual (pre-NAT) ip:port dialed by clients"),
+    string("svcid", "svcid", "Backend service glob id (hex)"),
+    string("svcname", "svcname", "Backend service name"),
+    num("nsvc", "nsvc", "Backends behind this VIP"),
+)
+
 # -------------------------------------------------------------- shardlist
 SHARDLIST_FIELDS = (
     num("shard", "shard", "Mesh shard index"),
@@ -574,6 +585,7 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_EXTCLIENTCONN: EXTCLIENTCONN_FIELDS,
     SUBSYS_EXTTRACEREQ: EXTTRACEREQ_FIELDS,
     SUBSYS_SHARDLIST: SHARDLIST_FIELDS,
+    SUBSYS_SVCIPCLUST: SVCIPCLUST_FIELDS,
     SUBSYS_ALERTS: ALERTS_FIELDS,
     SUBSYS_ALERTDEF: ALERTDEF_FIELDS,
     SUBSYS_SILENCES: SILENCES_FIELDS,

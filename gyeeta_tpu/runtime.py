@@ -85,9 +85,11 @@ class Runtime:
         from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
         from gyeeta_tpu.utils.hostreg import CgroupRegistry, \
             HostInfoRegistry
+        from gyeeta_tpu.utils.natreg import NatClusterRegistry
         self.svcreg = SvcInfoRegistry()
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
+        self.natclusters = NatClusterRegistry()
         from gyeeta_tpu.alerts import columns as AC
         from gyeeta_tpu.trace.defs import TraceDefs
         from gyeeta_tpu.utils.notifylog import NotifyLog
@@ -111,6 +113,7 @@ class Runtime:
             "notifymsg": lambda: self.notifylog.columns(self.names),
             "hostlist": self._hostlist_columns,
             "serverstatus": self._serverstatus_columns,
+            "svcipclust": lambda: self.natclusters.columns(self.names),
         }
         self._classify = derive.jit_classify_pass(self.cfg)
         self._empty_conn = decode.conn_batch(
@@ -148,6 +151,8 @@ class Runtime:
                 self.cfg.listener_batch):
             if kind == "connresp":
                 cchunk, rchunk = chunks
+                if len(cchunk):
+                    self.natclusters.observe_conns(cchunk)
                 cb = (decode.conn_batch_fast(cchunk, self.cfg.conn_batch)
                       if len(cchunk) else self._empty_conn)
                 rb = (decode.resp_batch(rchunk, self.cfg.resp_batch)
@@ -251,6 +256,7 @@ class Runtime:
         self.stats.gauge("tick", tick)
         self.dep = self._dep_age(self.dep, tick)
         self.cgroups.age()
+        self.natclusters.age()
 
         if self.history and tick % self.opts.history_every_ticks == 0:
             now = self._clock()

@@ -129,3 +129,63 @@ def test_daemon_config_and_graceful_stop(tmp_path):
     ckpts = list(tmp_path.glob("gyt_final_*.npz"))
     assert len(ckpts) == 1
     assert float(np.asarray(d.rt.state.n_conn)) == 64.0
+
+
+def test_svcipclust_subsystem():
+    """Services dialed through one VIP group into a cluster (ref
+    check_svc_nat_ip_clusters)."""
+    import numpy as np
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire as W
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    rt = Runtime(EngineCfg(n_hosts=8, svc_capacity=64, conn_batch=64,
+                           resp_batch=64, fold_k=2))
+    sim = ParthaSim(n_hosts=8, n_svcs=2, seed=12)
+    rt.feed(sim.name_frames())
+    recs = sim.svc_conn_records(128, nat=True)
+    # force several backends behind ONE vip: same dialed ser tuple
+    vip_rows = np.arange(32)
+    recs["ser"]["ip"][vip_rows] = recs["ser"]["ip"][vip_rows[0]]
+    recs["ser"]["port"][vip_rows] = recs["ser"]["port"][vip_rows[0]]
+    rt.feed(W.encode_frame(W.NOTIFY_TCP_CONN, recs))
+    rt.run_tick()
+    q = rt.query({"subsys": "svcipclust", "maxrecs": 500,
+                  "sortcol": "nsvc"})
+    assert q["nrecs"] > 0
+    top = q["recs"][0]
+    assert top["nsvc"] > 1                 # a real multi-backend cluster
+    assert ":" in top["vip"]
+    assert top["svcname"].startswith("svc-")
+    # clusters age out when the VIP stops being observed
+    for _ in range(rt.natclusters.max_age + 2):
+        rt.natclusters.age()
+    assert rt.query({"subsys": "svcipclust"})["nrecs"] == 0
+
+
+def test_svcipclust_split_halves():
+    """Cross-host NAT flows: the client half knows the VIP, the accept
+    half knows the backend id — the registry joins them."""
+    import numpy as np
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire as W
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    rt = Runtime(EngineCfg(n_hosts=8, svc_capacity=64, conn_batch=64,
+                           resp_batch=64, fold_k=2))
+    sim = ParthaSim(n_hosts=8, n_svcs=2, seed=15)
+    rt.feed(sim.name_frames())
+    cli, ser = sim.svc_conn_records(96, split_halves=True, nat=True)
+    cli["ser"]["ip"][:] = cli["ser"]["ip"][0]      # one VIP
+    cli["ser"]["port"][:] = cli["ser"]["port"][0]
+    assert (cli["ser_glob_id"] == 0).all()         # callee unknown
+    rt.feed(W.encode_frame(W.NOTIFY_TCP_CONN, cli))
+    rt.feed(W.encode_frame(W.NOTIFY_TCP_CONN, ser))
+    rt.run_tick()
+    q = rt.query({"subsys": "svcipclust", "maxrecs": 100})
+    assert q["nrecs"] > 1, q
+    assert all(r["nsvc"] == q["nrecs"] for r in q["recs"])
