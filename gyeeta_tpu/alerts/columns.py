@@ -78,6 +78,20 @@ def silences_columns(mgr, names=None, now=None):
     return cols, np.ones(len(sils), bool)
 
 
+def actions_columns(mgr, names=None):
+    """Registered alert actions + how many defs route to each
+    (SUBSYS_ACTIONS; ref actionstbl + NODE_ACTION_SOCK routing)."""
+    acts = sorted(mgr.actions)
+    ndefs = {a: 0 for a in acts}
+    for d in mgr.defs.values():
+        for a in d.actions:
+            if a in ndefs:
+                ndefs[a] += 1
+    cols = {"name": _obj(acts),
+            "ndefs": np.array([float(ndefs[a]) for a in acts])}
+    return cols, np.ones(len(acts), bool)
+
+
 def inhibits_columns(mgr, names=None):
     inhs = sorted(mgr.inhibits.values(), key=lambda i: i.name)
     firing_names = {k[0] for k in mgr.firing()}

@@ -133,6 +133,7 @@ class ShardedRuntime:
             "alertdef": lambda: AC.alertdef_columns(self.alerts),
             "silences": lambda: AC.silences_columns(self.alerts),
             "inhibits": lambda: AC.inhibits_columns(self.alerts),
+            "actions": lambda: AC.actions_columns(self.alerts),
             "notifymsg": lambda: self.notifylog.columns(self.names),
             "serverstatus": self._serverstatus_columns,
             "hostlist": self._hostlist_columns,

@@ -58,6 +58,7 @@ SUBSYS_ALERTS = "alerts"            # ref alerts (fired alert log)
 SUBSYS_ALERTDEF = "alertdef"        # ref alertdef
 SUBSYS_SILENCES = "silences"        # ref silences
 SUBSYS_INHIBITS = "inhibits"        # ref inhibits
+SUBSYS_ACTIONS = "actions"          # ref actions (alert routing targets)
 
 
 class FieldDef(NamedTuple):
@@ -552,6 +553,11 @@ INHIBITS_FIELDS = (
     boolean("active", "active", "A source alert is currently firing"),
 )
 
+ACTIONS_FIELDS = (
+    string("name", "name", "Action name (alertdef routing target)"),
+    num("ndefs", "ndefs", "Alert definitions routing to this action"),
+)
+
 FIELDS_OF_SUBSYS = {
     SUBSYS_SVCSTATE: SVCSTATE_FIELDS,
     SUBSYS_HOSTSTATE: HOSTSTATE_FIELDS,
@@ -590,6 +596,7 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_ALERTDEF: ALERTDEF_FIELDS,
     SUBSYS_SILENCES: SILENCES_FIELDS,
     SUBSYS_INHIBITS: INHIBITS_FIELDS,
+    SUBSYS_ACTIONS: ACTIONS_FIELDS,
 }
 
 

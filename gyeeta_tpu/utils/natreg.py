@@ -31,6 +31,7 @@ class NatClusterRegistry:
         # split-half resolution: backend tuple → (vip_key, last_sweep),
         # learned from client halves whose callee id is still unknown
         self._pending: dict[tuple, tuple] = {}
+        self._version = 0           # bumped ONLY on membership change
         self._sweep = 0
         self.max_vips = max_vips
         self.max_age = max_age      # sweeps (ticks) without observation
@@ -98,8 +99,6 @@ class NatClusterRegistry:
                     vkey, vip_ip, vip_port, _ = hit
                     n += self._register(vkey, vip_ip, vip_port,
                                         int(recs["ser_glob_id"][i]))
-        if n:
-            self._cache = None
         return n
 
     def _register(self, key, ip16, port: int, svc: int) -> int:
@@ -109,6 +108,8 @@ class NatClusterRegistry:
                 return 0
             ent = self._vips[key] = {}
             self._vip_disp[key] = f"{format_ip(ip16)}:{port}"
+        if svc not in ent:
+            self._version += 1      # refreshes don't invalidate caches
         ent[svc] = self._sweep
         return 1
 
@@ -131,7 +132,7 @@ class NatClusterRegistry:
                     if self._sweep - v[3] > 2]:
             del self._pending[key]
         if dropped:
-            self._cache = None
+            self._version += 1
         return dropped
 
     def __len__(self) -> int:
@@ -140,8 +141,7 @@ class NatClusterRegistry:
     def columns(self, names=None):
         """One row per (vip, service) pairing; nsvc = replicas behind
         the VIP (rows with nsvc > 1 are the actual clusters)."""
-        ver = (getattr(names, "version", None), self._sweep,
-               sum(len(v) for v in self._vips.values()))
+        ver = (getattr(names, "version", None), self._version)
         if self._cache is not None and self._cache[0] == ver:
             return self._cache[1]
         vips, svcids, svcnames, nsvc = [], [], [], []
