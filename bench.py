@@ -21,6 +21,23 @@ import time
 PER_CHIP_TARGET = 12.5e6  # BASELINE.md north star / 8 chips
 
 
+def _probe_accelerator(timeout_s: float = 120.0) -> bool:
+    """True when the default backend initializes in a subprocess within
+    the timeout. A wedged device tunnel blocks jax.devices() FOREVER
+    with no way to interrupt it in-process — observed with the axon
+    TPU tunnel — and a bench that hangs produces no artifact at all;
+    probing in a killable child lets the parent fall back to CPU and
+    still report a (clearly labelled) number."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     import jax
 
@@ -28,8 +45,14 @@ def main() -> None:
     # platform (the axon sitecustomize pins jax_platforms, so an env-var
     # JAX_PLATFORMS override alone does not take effect)
     plat = os.environ.get("GYT_BENCH_PLATFORM")
+    degraded = False
     if plat:
         jax.config.update("jax_platforms", plat)
+    elif not _probe_accelerator():
+        print("bench: accelerator backend unreachable — CPU fallback",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        degraded = True
 
     from gyeeta_tpu.engine import aggstate, step
     from gyeeta_tpu.engine.aggstate import EngineCfg
@@ -96,7 +119,9 @@ def main() -> None:
         print(json.dumps({
             "metric": "flow_events_per_sec_per_chip",
             "value": round(value, 1), "unit": "events/sec",
-            "vs_baseline": round(value / PER_CHIP_TARGET, 4)}))
+            "vs_baseline": round(value / PER_CHIP_TARGET, 4),
+            **({"tpu_unreachable_cpu_fallback": True} if degraded
+               else {})}))
         return
 
     # feed-path throughput: the PRODUCT ingest loop (bytes → native deframe
@@ -128,6 +153,7 @@ def main() -> None:
         "unit": "events/sec",
         "vs_baseline": round(value / PER_CHIP_TARGET, 4),
         "feed_path_events_per_sec": round(feed_rate, 1),
+        **({"tpu_unreachable_cpu_fallback": True} if degraded else {}),
     }))
 
 
