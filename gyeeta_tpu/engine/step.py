@@ -52,8 +52,11 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
     — a client-observed record names a REMOTE service and must not
     materialize (or re-home) its row; the reference likewise keeps
     client-half conns in remote/unknown maps, not the listener table
-    (``server/gy_mconnhdlr.h:614-632``). Every valid lane still feeds
-    the flow-level sketches (global HLL, CMS, top-K) and the dep graph.
+    (``server/gy_mconnhdlr.h:614-632``). The global HLL sees every
+    valid lane (it dedups by flow key, so dual observation is safe);
+    the additive CMS / flow top-K fold accept-observed lanes only, so a
+    dual-observed flow's bytes are never counted twice. The dep graph
+    dedups its halves via scatter-max.
     """
     valid = cb.valid
     svc_side = valid & cb.is_accept
@@ -85,13 +88,12 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
         st.svc_hll, rowz, cb.cli_hi, cb.cli_lo, valid=ok)
     glob_hll = st.glob_hll if "globhll" in _ABLATE else hll.update(
         st.glob_hll, cb.flow_hi, cb.flow_lo, valid=valid)
-    # byte accounting takes the ACCEPT side only: a dual-observed flow
-    # would otherwise count twice into the additive CMS/top-K (the HLL
-    # is immune — it dedups by flow key; the dep graph dedups the same
-    # halves via scatter-max). Server-side listener accounting is also
-    # where the reference attaches traffic stats.
-    tot_bytes = jnp.where(cb.is_accept,
-                          cb.bytes_sent + cb.bytes_rcvd, 0.0)
+    # byte accounting takes the ACCEPT side only (valid=svc_side below
+    # already masks client-observed lanes): a dual-observed flow would
+    # otherwise count twice into the additive CMS/top-K. Server-side
+    # listener accounting is also where the reference attaches traffic
+    # stats.
+    tot_bytes = cb.bytes_sent + cb.bytes_rcvd
     cms = st.cms if "cms" in _ABLATE else countmin.update(
         st.cms, cb.flow_hi, cb.flow_lo, tot_bytes, valid=svc_side)
     flow_topk = st.flow_topk if "topk" in _ABLATE else topk.update(

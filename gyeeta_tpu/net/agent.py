@@ -86,6 +86,15 @@ class NetAgent:
 
     async def connect(self, host: str, port: int) -> int:
         """Register the event conn; returns assigned host_id."""
+        # the server re-applies capture state from scratch on reconnect
+        # (forget_host → full re-push of current targets only); stale
+        # local enables from before the drop must not survive it — and
+        # neither may a still-draining old control loop, which could
+        # decode a buffered TRACE_SET and re-add them after the clear
+        if self._ctrl_task:
+            self._ctrl_task.cancel()
+            self._ctrl_task = None
+        self.trace_enabled.clear()
         hostname_id = self.machine_id & 0xFFFFFFFF
         reader, writer, status, hid = await register(
             host, port, self.machine_id, wire.CONN_EVENT,

@@ -155,7 +155,12 @@ class GytServer:
                 await w.drain()
                 n += len(ids)
             except (ConnectionError, OSError):
-                pass            # agent gone; resync on reconnect
+                # the diff was already committed to the applied state;
+                # a failed push that does NOT tear down the reader path
+                # would leave the host silently out of sync. Restore the
+                # pre-diff state so next tick re-emits the SAME diff
+                # (forget_host would lose pending disables forever).
+                self.rt.tracedefs.unapply(hid, enable, disable)
         if n:
             self.rt.stats.bump("trace_sets_pushed", n)
         return n

@@ -181,6 +181,8 @@ class ShardedRuntime:
                 self._td_dirty = True
                 self.dep = self._dep_step(self.dep, cbs,
                                           np.int32(self._tick_no))
+                self.stats.bump("conn_events", len(cchunk))
+                self.stats.bump("resp_events", len(rchunk))
                 n += len(cchunk) + len(rchunk)
             elif kind == "listener":
                 self.state = self._fold_lst(self.state, self._stack(
@@ -225,23 +227,31 @@ class ShardedRuntime:
         return n
 
     # ---------------------------------------------------- merged columns
-    def _shard_state(self, s: int):
-        """Shard s's state slice, read from its addressable buffer
-        directly — no cross-device XLA gather on the query path, and no
-        host transfer: leaves stay device arrays (the provider's jitted
-        snapshot consumes them; only its outputs come to host)."""
-        def take(x):
-            if hasattr(x, "addressable_shards"):
-                for sh in x.addressable_shards:
-                    idx = sh.index[0] if sh.index else None
-                    if (isinstance(idx, slice) and idx.start is not None
-                            and idx.stop is not None
-                            and idx.start <= s < idx.stop):
-                        # sh.data is single-device: slicing it is local
-                        return sh.data[s - idx.start]
-            return np.asarray(x)[s]
+    @staticmethod
+    def _shard_leaf(x, s: int):
+        """Leaf slice for shard s, read from its addressable buffer
+        directly — no cross-device XLA gather, no host transfer."""
+        if hasattr(x, "addressable_shards"):
+            for sh in x.addressable_shards:
+                idx = sh.index[0] if sh.index else None
+                if (isinstance(idx, slice) and idx.start is not None
+                        and idx.stop is not None
+                        and idx.start <= s < idx.stop):
+                    # sh.data is single-device: slicing it is local
+                    return sh.data[s - idx.start]
+        return np.asarray(x)[s]
 
-        return jax.tree.map(take, self.state)
+    def _shard_state(self, s: int):
+        """Shard s's full state slice (leaves stay device arrays; the
+        provider's jitted snapshot consumes them and only its outputs
+        come to host)."""
+        return jax.tree.map(lambda x: self._shard_leaf(x, s), self.state)
+
+    def _hosts_ever_reported(self, s: int) -> np.ndarray:
+        """Shard s's ``host_last_tick`` as a host array — the single
+        definition of "has ever reported" (last tick >= 0), shared by
+        hostlist and serverstatus so the two can't diverge."""
+        return np.asarray(self._shard_leaf(self.state.host_last_tick, s))
 
     def _merged_columns(self, subsys: str):
         """Cluster-wide (cols, mask): per-shard provider outputs
@@ -365,7 +375,7 @@ class ShardedRuntime:
         of every shard yields the cluster host list."""
         parts_id, parts_age = [], []
         for s in range(self.n):
-            last = np.asarray(self._shard_state(s).host_last_tick)
+            last = self._hosts_ever_reported(s)
             seen = np.nonzero(last >= 0)[0]
             parts_id.append(seen)
             parts_age.append(self._tick_no - last[seen])
@@ -427,13 +437,19 @@ class ShardedRuntime:
         c = self.stats.counters
         obj = lambda v: np.array([v], object)  # noqa: E731
         num = lambda v: np.array([float(v)], np.float64)  # noqa: E731
+        # "hosts that have EVER reported" (same quantity the single-node
+        # runtime reports) — each shard's host panel holds only its own
+        # routed hosts, so the per-shard counts are disjoint and sum
+        nhosts = sum(int((self._hosts_ever_reported(s) >= 0).sum())
+                     for s in range(self.n))
         cols = {
             "uptime": num(self._clock() - self._t_started),
             "tick": num(self._tick_no),
-            "nhosts": num(float(ru.n_hosts_up)),
+            "nhosts": num(float(nhosts)),
             "nsvc": num(float(ru.n_svc_live)),
-            "connevents": num(float(ru.n_conn)),
-            "respevents": num(float(ru.n_resp)),
+            # exact host-side int counters, same as the single-node path
+            "connevents": num(c.get("conn_events", 0)),
+            "respevents": num(c.get("resp_events", 0)),
             "queries": num(c.get("queries", 0)),
             "alertsfired": num(self.alerts.stats.get("nfired", 0)),
             "wirever": num(V.CURR_WIRE_VERSION),

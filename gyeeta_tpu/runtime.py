@@ -358,6 +358,9 @@ class Runtime:
             "nhosts": num(int((np.asarray(self.state.host_last_tick)
                                >= 0).sum())),
             "nsvc": num(int(np.asarray(self.state.tbl.n_live))),
+            # exact host-side int counters (the () f32 device scalars
+            # lose increments past ~2^24 events); the sharded runtime
+            # bumps the same counters in its feed path
             "connevents": num(c.get("conn_events", 0)),
             "respevents": num(c.get("resp_events", 0)),
             "queries": num(c.get("queries", 0)),

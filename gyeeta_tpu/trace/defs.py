@@ -126,6 +126,20 @@ class TraceDefs:
         re-pushes everything (agents lose capture state on restart)."""
         self._applied.pop(host_id, None)
 
+    def unapply(self, host_id: int, enable, disable) -> None:
+        """Reverse a committed diff after its push FAILED: the agent
+        never saw it, so its state is still the pre-diff one. Restoring
+        that (applied − enables + disables) makes the next tick re-emit
+        the same diff — including disables, which ``forget_host`` alone
+        can never re-send (a host absent from both targets and applied
+        produces no diff at all)."""
+        have = (self._applied.get(host_id, set())
+                - set(enable)) | set(disable)
+        if have:
+            self._applied[host_id] = have
+        else:
+            self._applied.pop(host_id, None)
+
     def columns(self):
         """(cols, mask) for the tracedef/tracestatus subsystems —
         shared by both runtimes so the column set cannot diverge."""
