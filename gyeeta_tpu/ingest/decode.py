@@ -290,6 +290,25 @@ def conn_batch_fast(recs: np.ndarray,
     return cb if cb is not None else conn_batch(recs, size)
 
 
+def conn_slab(recs: np.ndarray, k: int, b: int) -> ConnBatch:
+    """TCP_CONN records (n ≤ k·b) → ConnBatch with (k, b) stacked
+    columns: ONE flat columnar decode + a free reshape, replacing k
+    per-chunk decodes plus a tree-wide ``np.stack`` (the r3 feed-path
+    hot spot). Record i lands in flattened lane i; padding collects at
+    the slab tail — lane placement is only ever consumed through the
+    ``valid`` mask, so tail-padding and per-chunk padding are
+    equivalent to the fold."""
+    cb = conn_batch_fast(recs, k * b)
+    return ConnBatch(*(x.reshape(k, b) for x in cb))
+
+
+def resp_slab(recs: np.ndarray, k: int, b: int) -> RespBatch:
+    """RESP_SAMPLE records (n ≤ k·b) → RespBatch with (k, b) stacked
+    columns (see :func:`conn_slab`)."""
+    rb = resp_batch(recs, k * b)
+    return RespBatch(*(x.reshape(k, b) for x in rb))
+
+
 def resp_batch(recs: np.ndarray, size: int = wire.MAX_RESP_PER_BATCH
                ) -> RespBatch:
     n = _check_fit(recs, size)

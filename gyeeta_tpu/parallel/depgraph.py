@@ -114,9 +114,13 @@ def edge_key(cli_hi, cli_lo, ser_hi, ser_lo):
 
 def fold_edges(dep: DepGraph, cli_hi, cli_lo, cli_svc, ser_hi, ser_lo,
                byts, valid, tick) -> DepGraph:
-    """Accumulate (cli→ser) flows into the edge slab (batched upsert)."""
+    """Accumulate (cli→ser) flows into the edge slab (batched upsert).
+
+    ``upsert_fast``: the edge working set is small and long-lived (one
+    row per cli→ser dependency), so after warmup every batch is all-hit
+    and the 8 insert rounds are skipped entirely (``lax.cond``)."""
     khi, klo = edge_key(cli_hi, cli_lo, ser_hi, ser_lo)
-    tbl, rows = table.upsert(dep.edge_tbl, khi, klo, valid=valid)
+    tbl, rows = table.upsert_fast(dep.edge_tbl, khi, klo, valid=valid)
     ok = valid & (rows >= 0)
     E = dep.e_nconn.shape[0]
     lanes = jnp.where(ok, rows, E)
@@ -216,10 +220,32 @@ def pair_halves(dep: DepGraph, hv: Halves, tick) -> DepGraph:
         + jnp.sum(hv.valid & (rows < 0)).astype(jnp.float32),
     )
     # fold the completed rows' edges, then tombstone + clear them (drain —
-    # the table only ever holds in-flight halves)
-    dep = fold_edges(dep, dep.h_cli_hi, dep.h_cli_lo, dep.h_cli_svc,
-                     dep.h_ser_hi, dep.h_ser_lo, dep.h_bytes, done, tick)
+    # the table only ever holds in-flight halves). A row can only become
+    # done when a lane of THIS batch landed its second half, and every
+    # done row is cleared the same step, so newly-done ≤ B — a bounded
+    # nonzero gather covers all of them. (Folding edges with a P-lane
+    # valid mask over the whole table was the dominant dep-fold cost:
+    # an 8-round upsert at 65k lanes per step at the default capacity.)
+    D = hv.valid.shape[0]
+    idx = jnp.nonzero(done, size=D, fill_value=Pc)[0]
+    get = lambda col: col.at[idx].get(mode="fill", fill_value=0)  # noqa: E731
+    dep = fold_edges(dep, get(dep.h_cli_hi), get(dep.h_cli_lo),
+                     get(dep.h_cli_svc), get(dep.h_ser_hi),
+                     get(dep.h_ser_lo), get(dep.h_bytes),
+                     idx < Pc, tick)
     return _clear_half_rows(dep, done)
+
+
+def pair_halves_cond(dep: DepGraph, hv: Halves, tick) -> DepGraph:
+    """``pair_halves`` skipped entirely (``lax.cond``) when the batch
+    carries no one-sided halves — local/two-sided traffic (every flow
+    whose agent observed both ends, the reference's non-shyama path)
+    pays zero pairing cost. Identical semantics: with no valid lanes
+    pair_halves inserts nothing and completes no rows, and done rows
+    never persist across steps (drained the same step they complete)."""
+    return lax.cond(jnp.any(hv.valid),
+                    lambda d: pair_halves(d, hv, tick),
+                    lambda d: d, dep)
 
 
 def _clear_half_rows(dep: DepGraph, kill) -> DepGraph:
@@ -273,51 +299,46 @@ def dep_step(dep: DepGraph, cb, tick) -> DepGraph:
     locally — the n_shards=1 degenerate of the sharded step)."""
     direct, hv = halves_from_conn(cb)
     dep = fold_edges(dep, *direct, tick)
-    return pair_halves(dep, hv, tick)
+    return pair_halves_cond(dep, hv, tick)
 
 
 def dep_fold_many(dep: DepGraph, cbs, tick) -> DepGraph:
-    """K stacked conn batches flattened into few large steps.
+    """K stacked conn batches → one flat direct-edge fold + chunked
+    pairing.
 
-    Like the engine's ``fold_many``: dep ops are shape-generic, so the
-    K-microbatch framing flattens. Unlike the engine's, pairing RECYCLES
-    rows (a matched half frees its slot for the next insert), so fully
-    flattening K×B one-sided lanes into one upsert would need the whole
-    dispatch to fit the pair table simultaneously. Chunks of 4
-    microbatches keep intra-dispatch recycling (worst case 8192 new
-    halves per step vs the 64k-row default table) at 1/4 the step count
-    of the old per-microbatch scan."""
+    Direct (both-sides-known) lanes don't recycle table rows, so the
+    whole K×B slab folds in ONE ``upsert_fast`` — all-hit in steady
+    state, a single probe-match pass. Pairing DOES recycle rows (a
+    matched half frees its slot for the next insert), so its one-sided
+    lanes run in bounded chunks: each chunk's worst-case inserts stay
+    under a quarter of the pair table (even on top of a steady-state
+    unpaired backlog, an all-one-sided burst stays under the ~78%
+    probe-exhaustion load documented in engine/table.py). Each chunk
+    cond-skips entirely when it carries no one-sided lanes — the
+    common case for local/two-sided traffic."""
     K, B = cbs.valid.shape[:2]
-    # bound each step's worst-case one-sided inserts to a QUARTER of
-    # the pair table: even on top of a steady-state unpaired backlog
-    # (bounded by pair_ttl eviction, typically ≤25-40%) an
-    # all-one-sided burst stays under the ~78% probe-exhaustion load
-    # documented in engine/table.py. Default 64k table, 16×2048
-    # dispatches → chunks of 8 (two steps per dispatch).
+    n = K * B
+    flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), cbs)
+    direct, hv = halves_from_conn(flat)
+    dep = fold_edges(dep, *direct, tick)
     capacity = dep.h_last_tick.shape[0]
-    chunk = max(1, min(K, (capacity // 4) // max(B, 1)))
+    chunk = max(1, min(n, capacity // 4))
 
-    def body(carry, cbn):
-        flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]),
-                            cbn)
-        return dep_step(carry, flat, tick), None
+    def body(carry, hvn):
+        return pair_halves_cond(carry, hvn, tick), None
 
-    nfull = K // chunk
-    if nfull == 1 and K % chunk == 0:
-        flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]),
-                            cbs)
-        return dep_step(dep, flat, tick)
+    nfull = n // chunk
+    if nfull == 1 and n % chunk == 0:
+        return pair_halves_cond(dep, hv, tick)
     if nfull:
         grouped = jax.tree.map(
             lambda x: x[: nfull * chunk].reshape(
-                (nfull, chunk) + x.shape[1:]), cbs)
+                (nfull, chunk) + x.shape[1:]), hv)
         dep, _ = lax.scan(body, dep, grouped)
-    rem = K % chunk
-    if rem:      # remainder microbatches get their own bounded step
-        tail = jax.tree.map(
-            lambda x: x[nfull * chunk:].reshape((-1,) + x.shape[2:]),
-            cbs)
-        dep = dep_step(dep, tail, tick)
+    rem = n % chunk
+    if rem:      # remainder lanes get their own bounded chunk
+        tail = jax.tree.map(lambda x: x[nfull * chunk:], hv)
+        dep = pair_halves_cond(dep, tail, tick)
     return dep
 
 
@@ -347,7 +368,7 @@ def dep_step_fn(mesh, cap_per_dest: int):
         routed, o_drop = _dispatch_halves(hv, axes, sizes, n,
                                           cap_per_dest)
         local = local._replace(n_dropped=local.n_dropped + o_drop)
-        local = pair_halves(local, routed, tick)
+        local = pair_halves_cond(local, routed, tick)
         return jax.tree.map(lambda x: x[None], local)
 
     return jax.jit(_step, donate_argnums=(0,))
