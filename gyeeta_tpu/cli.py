@@ -47,6 +47,10 @@ def _cmd_agent(argv) -> None:
     ap.add_argument("--collect", action="store_true",
                     help="measure THIS host's /proc //sys instead of "
                     "simulating host/cgroup telemetry")
+    ap.add_argument("--real", action="store_true",
+                    help="observe THIS host's real TCP connections and "
+                    "listeners (sock_diag sweep) instead of simulated "
+                    "flows; implies --collect semantics for flows only")
     ap.add_argument("--n-agents", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--interval", type=float, default=5.0)
@@ -56,7 +60,8 @@ def _cmd_agent(argv) -> None:
 
     async def run():
         from gyeeta_tpu.net.agent import NetAgent
-        agents = [NetAgent(seed=args.seed + i, collect=args.collect)
+        agents = [NetAgent(seed=args.seed + i, collect=args.collect,
+                           real=args.real)
                   for i in range(args.n_agents)]
         for a in agents:
             hid = await a.connect(args.host, args.port)

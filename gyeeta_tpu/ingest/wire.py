@@ -583,6 +583,16 @@ def encode_frame(subtype: int, records: np.ndarray,
     return hdr.tobytes() + ev.tobytes() + payload
 
 
+def encode_frames_chunked(subtype: int, records: np.ndarray,
+                          magic: int = MAGIC_PM) -> bytes:
+    """Frame a record array of ANY length: split at the subtype's batch
+    cap (``MAX_OF_SUBTYPE``) into as many frames as needed. The one
+    cap-split loop for every producer (sim, real collectors, replay)."""
+    cap = MAX_OF_SUBTYPE.get(subtype, len(records) or 1)
+    return b"".join(encode_frame(subtype, records[i:i + cap], magic)
+                    for i in range(0, len(records), cap))
+
+
 class FrameError(ValueError):
     pass
 

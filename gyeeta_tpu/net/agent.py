@@ -66,7 +66,7 @@ class NetAgent:
     def __init__(self, machine_id: Optional[int] = None, seed: int = 0,
                  n_svcs: int = 4, n_groups: int = 6,
                  wire_version: int = version.CURR_WIRE_VERSION,
-                 collect: bool = False):
+                 collect: bool = False, real: bool = False):
         self.machine_id = machine_id if machine_id is not None \
             else H.hash_bytes_np(f"sim-agent-{seed}".encode())
         self.seed = seed
@@ -74,8 +74,16 @@ class NetAgent:
         self.n_groups = n_groups
         self.wire_version = wire_version
         self.collect = collect
+        # real=True: flows/listeners come from THIS host's kernel via
+        # the sock_diag sweep (net/tcpconn.py) instead of the simulator
+        # — the inet_diag path of the reference
+        # (``common/gy_socket_stat.cc:8598``). resp/trace streams stay
+        # absent in real mode (they need eBPF the reference has and
+        # userspace does not).
+        self.real = real
         self.host_id: Optional[int] = None
         self.sim: Optional[ParthaSim] = None
+        self._tcpconn = None
         self._cpumem = None
         self._cgroups = None
         self._writer = None
@@ -114,6 +122,10 @@ class NetAgent:
             self._cpumem = C.CpuMemCollector(host_id=hid)
             self._cgroups = C.CgroupCollector(host_id=hid)
             self._cgroups.sample()        # prime the delta baseline
+        if self.real:
+            from gyeeta_tpu.net.tcpconn import TcpConnCollector
+            self._tcpconn = TcpConnCollector(
+                host_id=hid, machine_id=self.machine_id)
         # server→agent control frames ride the same conn in reverse
         self._ctrl_task = asyncio.create_task(self._control_loop(reader))
         await self.send_names()
@@ -139,14 +151,18 @@ class NetAgent:
         """Announce inventory: names + listener metadata + host info
         (the reference agent resends its inventory on reconnect)."""
         import os
-        hostname = (os.uname().nodename if self.collect
+        hostname = (os.uname().nodename if (self.collect or self.real)
                     else f"agent-{self.host_id}.sim")
-        buf = (self.sim.name_frames() + wire.encode_frame(
+        buf = wire.encode_frame(
             wire.NOTIFY_NAME_INTERN,
             wire_name_record(wire.NAME_KIND_HOST, self.host_id,
                              hostname))
-            + wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
-                                self.sim.listener_info_records()))
+        if not self.real:
+            # sim inventory; real listeners announce themselves on the
+            # first sweep (the collector emits LISTENER_INFO on sight)
+            buf += (self.sim.name_frames()
+                    + wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
+                                        self.sim.listener_info_records()))
         if self.collect:
             from gyeeta_tpu.net import collect as C
             hi, names = C.collect_host_info(host_id=self.host_id)
@@ -161,13 +177,17 @@ class NetAgent:
                          ) -> None:
         """One 5s-equivalent sweep: flows, resp samples, state records."""
         s = self.sim
-        buf = (s.conn_frames(n_conn) + s.resp_frames(n_resp)
-               + s.listener_frames() + s.task_frames()
-               + wire.encode_frame(wire.NOTIFY_HOST_STATE,
-                                   s.host_state_records()))
-        if self.trace_enabled:
-            # capture is on for some services: emit their transactions
-            buf += s.trace_frames(n_resp, only_svcs=self.trace_enabled)
+        if self.real:
+            buf = self._real_sweep_frames()
+        else:
+            buf = (s.conn_frames(n_conn) + s.resp_frames(n_resp)
+                   + s.listener_frames() + s.task_frames()
+                   + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                       s.host_state_records()))
+            if self.trace_enabled:
+                # capture on for some services: emit their transactions
+                buf += s.trace_frames(n_resp,
+                                      only_svcs=self.trace_enabled)
         if self.collect:
             buf += wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
                                      self._cpumem.sample())
@@ -183,6 +203,26 @@ class NetAgent:
                                         s.cpu_mem_records()))
         self._writer.write(buf)
         await self._writer.drain()
+
+    def _real_sweep_frames(self) -> bytes:
+        """One real sock_diag sweep → wire frames (cap-split per type)."""
+        import time as _time
+
+        d = self._tcpconn.sweep()
+        buf = (wire.encode_frames_chunked(wire.NOTIFY_NAME_INTERN,
+                                          d["names"])
+               + wire.encode_frames_chunked(wire.NOTIFY_LISTENER_INFO,
+                                            d["listener_info"])
+               + wire.encode_frames_chunked(wire.NOTIFY_TCP_CONN,
+                                            d["conns"])
+               + wire.encode_frames_chunked(wire.NOTIFY_LISTENER_STATE,
+                                            d["listeners"]))
+        hs = np.zeros(1, wire.HOST_STATE_DT)
+        hs[0]["curr_time_usec"] = int(_time.time() * 1e6)
+        hs[0]["nlisten"] = len(d["listeners"])
+        hs[0]["curr_state"] = 1               # OK; issues come from the
+        hs[0]["host_id"] = self.host_id       # server-side classifiers
+        return buf + wire.encode_frame(wire.NOTIFY_HOST_STATE, hs)
 
     async def close(self) -> None:
         if self._ctrl_task:

@@ -483,53 +483,32 @@ class ParthaSim:
     # --------------------------------------------------------------- wire
     def conn_frames(self, n_events: int) -> bytes:
         """n_events conn records framed into ≤2048-record messages."""
-        recs = self.conn_records(n_events)
-        return b"".join(
-            wire.encode_frame(wire.NOTIFY_TCP_CONN,
-                              recs[i:i + wire.MAX_CONNS_PER_BATCH])
-            for i in range(0, len(recs), wire.MAX_CONNS_PER_BATCH))
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_TCP_CONN, self.conn_records(n_events))
 
     def resp_frames(self, n_events: int) -> bytes:
-        recs = self.resp_records(n_events)
-        return b"".join(
-            wire.encode_frame(wire.NOTIFY_RESP_SAMPLE,
-                              recs[i:i + wire.MAX_RESP_PER_BATCH])
-            for i in range(0, len(recs), wire.MAX_RESP_PER_BATCH))
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_RESP_SAMPLE, self.resp_records(n_events))
 
     def listener_frames(self) -> bytes:
-        recs = self.listener_state_records()
-        return b"".join(
-            wire.encode_frame(wire.NOTIFY_LISTENER_STATE,
-                              recs[i:i + wire.MAX_LISTENERS_PER_BATCH])
-            for i in range(0, len(recs), wire.MAX_LISTENERS_PER_BATCH))
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_LISTENER_STATE, self.listener_state_records())
 
     def task_frames(self) -> bytes:
-        recs = self.aggr_task_records()
-        return b"".join(
-            wire.encode_frame(wire.NOTIFY_AGGR_TASK_STATE,
-                              recs[i:i + wire.MAX_TASKS_PER_BATCH])
-            for i in range(0, len(recs), wire.MAX_TASKS_PER_BATCH))
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_AGGR_TASK_STATE, self.aggr_task_records())
 
     def name_frames(self) -> bytes:
-        recs = self.name_records()
-        return b"".join(
-            wire.encode_frame(wire.NOTIFY_NAME_INTERN,
-                              recs[i:i + wire.MAX_NAMES_PER_BATCH])
-            for i in range(0, len(recs), wire.MAX_NAMES_PER_BATCH))
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_NAME_INTERN, self.name_records())
 
     def host_info_frames(self) -> bytes:
-        recs = self.host_info_records()
-        return b"".join(
-            wire.encode_frame(wire.NOTIFY_HOST_INFO,
-                              recs[i:i + wire.MAX_HOST_INFO_PER_BATCH])
-            for i in range(0, len(recs), wire.MAX_HOST_INFO_PER_BATCH))
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_HOST_INFO, self.host_info_records())
 
     def cgroup_frames(self) -> bytes:
-        recs = self.cgroup_records()
-        return b"".join(
-            wire.encode_frame(wire.NOTIFY_CGROUP_STATE,
-                              recs[i:i + wire.MAX_CGROUPS_PER_BATCH])
-            for i in range(0, len(recs), wire.MAX_CGROUPS_PER_BATCH))
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_CGROUP_STATE, self.cgroup_records())
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:

@@ -1,0 +1,211 @@
+"""Real-traffic end-to-end: sock_diag collector → agent → server → query.
+
+VERDICT r3 task 3's done-criterion: run the agent on THIS box in real
+mode, generate actual TCP traffic with a local client/server pair, and
+watch svcstate/activeconn report the real connections (not simulated
+ones). Also unit-level checks of the collector's classification, delta
+and close semantics against live sockets.
+
+Ref: the inet_diag sweep ``common/gy_socket_stat.cc:8598`` (15s full
+connection sweep) and listener inventory ``gy_socket_stat.h:996``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.net import GytServer, NetAgent, QueryClient
+from gyeeta_tpu.net.tcpconn import (TcpConnCollector, aggr_task_id_of,
+                                    list_tcp_netlink, list_tcp_proc,
+                                    listener_glob_id)
+from gyeeta_tpu.runtime import Runtime
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                conn_batch=256, resp_batch=512, listener_batch=64,
+                fold_k=2)
+
+ECHO_PORT = 45913
+
+
+class _EchoServer:
+    """Tiny local TCP service generating REAL kernel socket state."""
+
+    def __init__(self, port: int = ECHO_PORT):
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", port))
+        self.srv.listen(16)
+        self.port = port
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                c, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(c,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _handle(c):
+        try:
+            while True:
+                d = c.recv(4096)
+                if not d:
+                    return
+                c.sendall(d)
+        except OSError:
+            pass
+        finally:
+            c.close()
+
+    def close(self):
+        self.srv.close()
+
+
+def _socket_source_available() -> bool:
+    return list_tcp_netlink() is not None or bool(list_tcp_proc())
+
+
+pytestmark = pytest.mark.skipif(
+    not _socket_source_available(),
+    reason="no sock_diag or /proc/net/tcp on this host")
+
+
+def test_snapshot_sources_agree_on_tuples():
+    """netlink and /proc/net enumerate the same established tuples."""
+    nl = list_tcp_netlink()
+    if nl is None:
+        pytest.skip("netlink denied")
+    pr = list_tcp_proc()
+    nk = {s.key for s in nl if s.state == 1}
+    pk = {s.key for s in pr if s.state == 1}
+    # sampling race tolerance: the overlap must dominate both sets
+    assert len(nk & pk) >= max(1, int(0.7 * min(len(nk), len(pk) or 1)))
+
+
+def test_collector_observes_real_traffic():
+    echo = _EchoServer()
+    try:
+        col = TcpConnCollector(host_id=3, machine_id=0x1234)
+        col.sweep()                       # baseline (pre-existing flag)
+        clis = []
+        for _ in range(3):
+            c = socket.create_connection(("127.0.0.1", echo.port))
+            c.sendall(b"x" * 500)
+            c.recv(4096)
+            clis.append(c)
+        time.sleep(0.2)
+        d = col.sweep()
+        gid = listener_glob_id(0x1234,
+                               b"\x00" * 10 + b"\xff\xff" + bytes(
+                                   [127, 0, 0, 1]), echo.port)
+        ls = d["listeners"]
+        row = ls[ls["glob_id"] == gid]
+        assert len(row) == 1 and int(row[0]["nconns"]) == 3
+        inb = d["conns"][(d["conns"]["flags"] & 2) != 0]
+        mine = inb[inb["ser_glob_id"] == gid]
+        assert len(mine) == 3
+        # byte DELTAS: exactly what the clients wrote since baseline
+        assert int(mine["bytes_sent"].sum()) == 1500
+        # outbound halves carry the owning process group
+        outb = d["conns"][(d["conns"]["flags"] & 1) != 0]
+        me = outb[outb["ser"]["port"] == echo.port]
+        assert len(me) == 3
+        assert (me["cli_task_aggr_id"] != 0).all()
+        # closes are detected by disappearance
+        for c in clis:
+            c.close()
+        time.sleep(0.3)
+        d2 = col.sweep()
+        closes = d2["conns"][d2["conns"]["tusec_close"] > 0]
+        assert len(closes) >= 3
+    finally:
+        echo.close()
+
+
+def test_idle_conns_emit_nothing_new():
+    echo = _EchoServer(port=ECHO_PORT + 1)
+    try:
+        col = TcpConnCollector(host_id=3, machine_id=0x99)
+        c = socket.create_connection(("127.0.0.1", echo.port))
+        c.sendall(b"y" * 100)
+        c.recv(4096)
+        time.sleep(0.2)
+        col.sweep()
+        d2 = col.sweep()                  # no traffic since
+        est_port = d2["conns"][
+            (d2["conns"]["ser"]["port"] == echo.port)
+            | (d2["conns"]["cli"]["port"] == echo.port)]
+        assert len(est_port) == 0
+        c.close()
+    finally:
+        echo.close()
+
+
+def test_aggr_task_id_stable():
+    assert aggr_task_id_of(1, "nginx") == aggr_task_id_of(1, "nginx")
+    assert aggr_task_id_of(1, "nginx") != aggr_task_id_of(2, "nginx")
+    assert aggr_task_id_of(1, "nginx") != aggr_task_id_of(1, "redis")
+
+
+async def _real_session():
+    rt = Runtime(CFG)
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    echo = _EchoServer(port=ECHO_PORT + 2)
+    agent = NetAgent(collect=False, real=True)
+    try:
+        await agent.connect(host, port)
+        await agent.send_sweep()          # baseline sweep
+        await asyncio.sleep(0.1)
+        clis = []
+        for _ in range(4):
+            c = socket.create_connection(("127.0.0.1", echo.port))
+            c.sendall(b"z" * 256)
+            c.recv(4096)
+            clis.append(c)
+        await asyncio.sleep(0.2)
+        await agent.send_sweep()
+        await asyncio.sleep(0.1)
+        rt.flush()
+        rt.run_tick()
+        qc = QueryClient()
+        await qc.connect(host, port)
+        svc = await qc.query({"subsys": "svcstate"})
+        info = await qc.query({"subsys": "svcinfo"})
+        await qc.close()
+        for c in clis:
+            c.close()
+        return svc, info, echo.port
+    finally:
+        echo.close()
+        await agent.close()
+        await srv.stop()
+
+
+def test_real_agent_end_to_end():
+    """The whole chain on live kernel state: svcstate rows are THIS
+    box's actual listeners, including the test's own echo service with
+    its real connection count."""
+    svc, info, port = asyncio.run(_real_session())
+    assert svc["nrecs"] >= 1
+    names = [r["svcname"] for r in svc["recs"]]
+    echo_rows = [r for r in svc["recs"]
+                 if r["svcname"].endswith(f":{port}")]
+    assert echo_rows, f"echo listener not in svcstate: {names}"
+    assert echo_rows[0]["nconns"] >= 4
+    # svcinfo join: the listener's real metadata travelled as
+    # LISTENER_INFO (port + comm-derived name)
+    irows = [r for r in info["recs"]
+             if r.get("svcname", "").endswith(f":{port}")]
+    assert irows and int(irows[0]["port"]) == port
