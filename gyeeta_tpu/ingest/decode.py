@@ -157,11 +157,13 @@ TASK_VM_DELAY_MS = 5
 TASK_BLKIO_DELAY_MS = 6
 TASK_NTASKS = 7
 TASK_NTASKS_ISSUE = 8
-NTASKSTAT = 9
+TASK_FORKS_SEC = 9
+NTASKSTAT = 10
 
 _TASK_STAT_FIELDS = (
     "tcp_kbytes", "tcp_conns", "total_cpu_pct", "rss_mb", "cpu_delay_msec",
     "vm_delay_msec", "blkio_delay_msec", "ntasks_total", "ntasks_issue",
+    "forks_sec",
 )
 
 # host panel column indices of HostBatch.panel (and AggState.host_panel)

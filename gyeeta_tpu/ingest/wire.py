@@ -184,12 +184,15 @@ AGGR_TASK_DT = np.dtype([
     ("cpu_delay_msec", "<u4"),
     ("vm_delay_msec", "<u4"),
     ("blkio_delay_msec", "<u4"),
+    ("forks_sec", "<f4"),          # group fork rate (TOPFORK source;
+    #                                ref TASK_TOP_PROCS fork view)
     ("ntasks_total", "<u2"),
     ("ntasks_issue", "<u2"),
     ("curr_state", "u1"),
     ("curr_issue", "u1"),
     ("pad", "u1", (2,)),
     ("host_id", "<u4"),
+    ("pad2", "u1", (4,)),
 ])
 
 MAX_TASKS_PER_BATCH = 1200     # gy_comm_proto.h:2139 MAX_NUM_TASKS

@@ -84,6 +84,7 @@ class NetAgent:
         self.host_id: Optional[int] = None
         self.sim: Optional[ParthaSim] = None
         self._tcpconn = None
+        self._taskproc = None
         self._cpumem = None
         self._cgroups = None
         self._writer = None
@@ -123,8 +124,11 @@ class NetAgent:
             self._cgroups = C.CgroupCollector(host_id=hid)
             self._cgroups.sample()        # prime the delta baseline
         if self.real:
+            from gyeeta_tpu.net.taskproc import ProcTaskCollector
             from gyeeta_tpu.net.tcpconn import TcpConnCollector
             self._tcpconn = TcpConnCollector(
+                host_id=hid, machine_id=self.machine_id)
+            self._taskproc = ProcTaskCollector(
                 host_id=hid, machine_id=self.machine_id)
         # server→agent control frames ride the same conn in reverse
         self._ctrl_task = asyncio.create_task(self._control_loop(reader))
@@ -153,16 +157,19 @@ class NetAgent:
         import os
         hostname = (os.uname().nodename if (self.collect or self.real)
                     else f"agent-{self.host_id}.sim")
-        buf = wire.encode_frame(
-            wire.NOTIFY_NAME_INTERN,
-            wire_name_record(wire.NAME_KIND_HOST, self.host_id,
-                             hostname))
+        buf = b""
         if not self.real:
             # sim inventory; real listeners announce themselves on the
             # first sweep (the collector emits LISTENER_INFO on sight)
             buf += (self.sim.name_frames()
                     + wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
                                         self.sim.listener_info_records()))
+        # hostname AFTER sim names: the sim announces a placeholder
+        # host name and the intern table is last-write-wins
+        buf += wire.encode_frame(
+            wire.NOTIFY_NAME_INTERN,
+            wire_name_record(wire.NAME_KIND_HOST, self.host_id,
+                             hostname))
         if self.collect:
             from gyeeta_tpu.net import collect as C
             hi, names = C.collect_host_info(host_id=self.host_id)
@@ -209,17 +216,26 @@ class NetAgent:
         import time as _time
 
         d = self._tcpconn.sweep()
+        trecs, tnames = self._taskproc.sweep(
+            task_net=d["task_net"],
+            listener_of_comm=d["listener_of_comm"])
         buf = (wire.encode_frames_chunked(wire.NOTIFY_NAME_INTERN,
                                           d["names"])
+               + wire.encode_frames_chunked(wire.NOTIFY_NAME_INTERN,
+                                            tnames)
                + wire.encode_frames_chunked(wire.NOTIFY_LISTENER_INFO,
                                             d["listener_info"])
                + wire.encode_frames_chunked(wire.NOTIFY_TCP_CONN,
                                             d["conns"])
                + wire.encode_frames_chunked(wire.NOTIFY_LISTENER_STATE,
-                                            d["listeners"]))
+                                            d["listeners"])
+               + wire.encode_frames_chunked(
+                   wire.NOTIFY_AGGR_TASK_STATE, trecs))
         hs = np.zeros(1, wire.HOST_STATE_DT)
         hs[0]["curr_time_usec"] = int(_time.time() * 1e6)
         hs[0]["nlisten"] = len(d["listeners"])
+        hs[0]["ntasks"] = int(trecs["ntasks_total"].sum())
+        hs[0]["ntasks_issue"] = int(trecs["ntasks_issue"].sum())
         hs[0]["curr_state"] = 1               # OK; issues come from the
         hs[0]["host_id"] = self.host_id       # server-side classifiers
         return buf + wire.encode_frame(wire.NOTIFY_HOST_STATE, hs)

@@ -348,7 +348,7 @@ class TcpConnCollector:
         self.machine_id = machine_id
         self.use_netlink = use_netlink
         self.conntrack = conntrack
-        self._known_listeners: dict = {}   # (addr,port) -> glob_id
+        self._known_listeners: dict = {}   # (addr,port) -> (glob_id, comm)
         self._conn_prev: dict = {}         # key -> [acked, recvd, t0us, pre]
         self._first_sweep = True
 
@@ -384,19 +384,21 @@ class TcpConnCollector:
         need_inodes = set()
         for s in listeners:
             k = (s.saddr, s.sport)
-            gid = self._known_listeners.get(k)
-            if gid is None:
+            known = self._known_listeners.get(k)
+            if known is None:
                 gid = listener_glob_id(self.machine_id, s.saddr, s.sport)
                 new_listeners.append((s, gid))
                 need_inodes.add(s.inode)
+            else:
+                gid = known[0]
             lmap.setdefault(s.sport, []).append((s.saddr, gid))
         owners = inode_owners(need_inodes) if need_inodes else {}
 
         names: list = []
         li_recs = np.zeros(len(new_listeners), wire.LISTENER_INFO_DT)
         for i, (s, gid) in enumerate(new_listeners):
-            self._known_listeners[(s.saddr, s.sport)] = gid
             pid, comm = owners.get(s.inode, (0, "?"))
+            self._known_listeners[(s.saddr, s.sport)] = (gid, comm)
             comm_id = InternTable.intern(comm, wire.NAME_KIND_COMM)
             # service display name: comm:port — unique per listener and
             # human-readable (the reference uses comm + resolved domain)
@@ -426,6 +428,7 @@ class TcpConnCollector:
         # cached (pid, comm) in the prev entry.
         conn_rows = []
         per_listener: dict = {}      # gid -> [nconn, active, kin, kout]
+        task_net: dict = {}          # aggr_task_id -> [kbytes, nconns]
         seen_keys = set()
         new_out_inodes = {
             s.inode for s in estab
@@ -460,6 +463,13 @@ class TcpConnCollector:
                     st[1] += 1
                 st[2] += d_recvd / 1024.0
                 st[3] += d_acked / 1024.0
+            elif prev[5]:
+                # outbound with a known owner: per-process-group traffic
+                # (feeds AGGR_TASK tcp_kbytes/tcp_conns via taskproc)
+                tn = task_net.setdefault(
+                    aggr_task_id_of(self.machine_id, prev[5]), [0.0, 0])
+                tn[0] += (d_acked + d_recvd) / 1024.0
+                tn[1] += 1
             if not (new or d_acked or d_recvd):
                 continue                  # idle known conn: nothing new
             conn_rows.append(self._conn_record(
@@ -481,7 +491,7 @@ class TcpConnCollector:
 
         # per-listener 5s-equivalent state
         ls = np.zeros(len(self._known_listeners), wire.LISTENER_STATE_DT)
-        for i, ((addr, port), gid) in enumerate(
+        for i, ((addr, port), (gid, _comm)) in enumerate(
                 self._known_listeners.items()):
             r = ls[i]
             st = per_listener.get(gid, [0, 0, 0.0, 0.0])
@@ -500,6 +510,12 @@ class TcpConnCollector:
             "listener_info": li_recs,
             "names": InternTable.records(names) if names
             else np.empty(0, wire.NAME_INTERN_DT),
+            # joins for the /proc task collector (same sweep cadence)
+            "task_net": task_net,
+            "listener_of_comm": {
+                comm: gid for (gid, comm)
+                in self._known_listeners.values()
+                if comm and comm != "?"},
         }
 
     def _conn_record(self, s: SockEntry, gid: int, d_acked: int,

@@ -178,6 +178,7 @@ def task_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
         "iodelms": g[:, D.TASK_BLKIO_DELAY_MS],
         "ntasks": g[:, D.TASK_NTASKS],
         "nissue": g[:, D.TASK_NTASKS_ISSUE],
+        "forks": g[:, D.TASK_FORKS_SEC],
         "state": snap["state"],
         "issue": snap["issue"],
         "hostid": snap["hostid"],
@@ -373,6 +374,7 @@ _COLUMNS_OF = {
     fieldmaps.SUBSYS_TOPCPU: task_columns,
     fieldmaps.SUBSYS_TOPRSS: task_columns,
     fieldmaps.SUBSYS_TOPDELAY: task_columns,
+    fieldmaps.SUBSYS_TOPFORK: task_columns,
     fieldmaps.SUBSYS_CPUMEM: cpumem_columns,
     fieldmaps.SUBSYS_TRACEREQ: trace_columns,
 }
@@ -697,6 +699,7 @@ _TOP_PRESETS = {
     fieldmaps.SUBSYS_TOPPGCPU: ("cpu", 10),   # ref top-10 PG CPU
     fieldmaps.SUBSYS_TOPRSS: ("rssmb", 8),
     fieldmaps.SUBSYS_TOPDELAY: ("cpudelms", 15),
+    fieldmaps.SUBSYS_TOPFORK: ("forks", 15),
 }
 
 

@@ -30,6 +30,7 @@ SUBSYS_TOPPGCPU = "toppgcpu"        # ref toppgcpu (groups ARE our unit;
 SUBSYS_PROCINFO = "procinfo"        # ref procinfo (static group info)
 SUBSYS_TOPRSS = "toprss"
 SUBSYS_TOPDELAY = "topdelay"
+SUBSYS_TOPFORK = "topfork"          # ref TOPFORK (top fork-rate groups)
 SUBSYS_SVCDEP = "svcdependency"     # ref DEPENDS_LISTENER / svcprocmap
 SUBSYS_SVCMESH = "svcmesh"          # ref svc mesh clusters (shyama)
 SUBSYS_CPUMEM = "cpumem"            # ref cpumem (2s host cpu/mem state)
@@ -194,6 +195,7 @@ TASKSTATE_FIELDS = (
     num("iodelms", "iodelms", "Block IO delay msec"),
     num("ntasks", "ntasks", "Processes in the group"),
     num("nissue", "nissue", "Processes with issues"),
+    num("forks", "forks", "Process forks/sec in the group"),
     enum("state", "state", _state_enc, _state_dec, "Group state"),
     enum("issue", "issue", _tissue_enc, _tissue_dec, "Issue source"),
     num("hostid", "hostid", "Owning host id"),
@@ -569,6 +571,7 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_PROCINFO: PROCINFO_FIELDS,
     SUBSYS_TOPRSS: TASKSTATE_FIELDS,
     SUBSYS_TOPDELAY: TASKSTATE_FIELDS,
+    SUBSYS_TOPFORK: TASKSTATE_FIELDS,
     SUBSYS_SVCDEP: SVCDEP_FIELDS,
     SUBSYS_SVCMESH: SVCMESH_FIELDS,
     SUBSYS_CPUMEM: CPUMEM_FIELDS,

@@ -269,6 +269,11 @@ class ParthaSim:
         out["blkio_delay_msec"] = io_delay
         out["vm_delay_msec"] = (r.random(n) < 0.01) * r.integers(10, 500, n)
         out["ntasks_total"] = 1 + r.integers(0, 16, n)
+        # fork churn: mostly quiet groups, a heavy-tailed few (the
+        # TOPFORK signal — shell/cron-style groups fork constantly)
+        out["forks_sec"] = np.where(
+            r.random(n) < 0.15, r.pareto(1.5, n) * 5.0, 0.0
+        ).astype(np.float32)
         issue = (cpu_delay > 500) | (io_delay > 300)
         out["ntasks_issue"] = issue * (1 + r.integers(
             0, out["ntasks_total"].astype(np.int64), n))

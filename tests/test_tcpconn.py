@@ -115,6 +115,10 @@ def test_collector_observes_real_traffic():
         inb = d["conns"][(d["conns"]["flags"] & 2) != 0]
         mine = inb[inb["ser_glob_id"] == gid]
         assert len(mine) == 3
+        # loopback traffic carries the loopback flag (127/8 both ends)
+        assert ((mine["flags"] & 4) != 0).all()
+        # the listener→comm join map names this (python) listener
+        assert gid in d["listener_of_comm"].values()
         # byte DELTAS: exactly what the clients wrote since baseline
         assert int(mine["bytes_sent"].sum()) == 1500
         # outbound halves carry the owning process group
