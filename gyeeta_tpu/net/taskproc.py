@@ -127,15 +127,27 @@ class ProcTaskCollector:
             g[5] += runq
         self._prev_pids = cur_pids
 
-        comms = sorted(groups, key=lambda c: -groups[c][2])
+        # truncation order: fork churn first (the TOPFORK signal a
+        # plain by-ntasks sort would drop for single-pid respawners),
+        # then group size
+        comms = sorted(groups,
+                       key=lambda c: (-groups[c][3], -groups[c][2]))
         if len(comms) > self.max_groups:
             comms = comms[: self.max_groups]
+        # baselines advance for EVERY group each sweep — a group capped
+        # out of the report must not accumulate multi-sweep deltas that
+        # later get divided by a single dt
+        prev_of = {c: self._prev_group.get(
+            c, [groups[c][0], groups[c][4], groups[c][5]])
+            for c in comms}
+        self._prev_group = {c: [g[0], g[4], g[5]]
+                            for c, g in groups.items()}
         out = np.zeros(len(comms), wire.AGGR_TASK_DT)
         names = []
+        from gyeeta_tpu.semantic import states as S
         for i, comm in enumerate(comms):
             cpu, rss, n, forks, blkio, runq = groups[comm]
-            pg = self._prev_group.get(comm, [cpu, blkio, runq])
-            self._prev_group[comm] = [cpu, blkio, runq]
+            pg = prev_of[comm]
             aggr_id = aggr_task_id_of(self.machine_id, comm)
             comm_id = InternTable.intern(comm, wire.NAME_KIND_COMM)
             if comm_id not in self._announced:
@@ -164,7 +176,6 @@ class ProcTaskCollector:
             io_d = float(r["blkio_delay_msec"])
             issue = cpu_d > 500 or io_d > 300
             r["ntasks_issue"] = min(n, 2**16 - 1) if issue else 0
-            from gyeeta_tpu.semantic import states as S
             r["curr_state"] = (
                 S.STATE_SEVERE if cpu_d > 1200 else
                 S.STATE_BAD if issue else
@@ -174,8 +185,5 @@ class ProcTaskCollector:
                 S.TISSUE_CPU_DELAY if cpu_d > 500 else
                 S.TISSUE_BLKIO_DELAY if io_d > 300 else S.TISSUE_NONE)
             r["host_id"] = self.host_id
-        # drop baselines for vanished groups
-        for comm in [c for c in self._prev_group if c not in groups]:
-            del self._prev_group[comm]
         return out, (InternTable.records(names) if names
                      else np.empty(0, wire.NAME_INTERN_DT))

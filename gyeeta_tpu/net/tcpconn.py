@@ -510,13 +510,22 @@ class TcpConnCollector:
             "listener_info": li_recs,
             "names": InternTable.records(names) if names
             else np.empty(0, wire.NAME_INTERN_DT),
-            # joins for the /proc task collector (same sweep cadence)
+            # joins for the /proc task collector (same sweep cadence);
+            # a comm owning several listeners joins to the SMALLEST gid
+            # — deterministic across sweeps and agent restarts (gids
+            # are stable hashes)
             "task_net": task_net,
-            "listener_of_comm": {
-                comm: gid for (gid, comm)
-                in self._known_listeners.values()
-                if comm and comm != "?"},
+            "listener_of_comm": self._listener_of_comm(),
         }
+
+    def _listener_of_comm(self) -> dict:
+        out: dict = {}
+        for gid, comm in self._known_listeners.values():
+            if comm and comm != "?":
+                cur = out.get(comm)
+                if cur is None or gid < cur:
+                    out[comm] = gid
+        return out
 
     def _conn_record(self, s: SockEntry, gid: int, d_acked: int,
                      d_recvd: int, prev: list, nat: dict,
