@@ -1,0 +1,338 @@
+"""gy_comm_proto ingest adapter: stock-partha frames → GYT records.
+
+SURVEY M1's loose end (VERDICT r3 #5): the reference wire format is the
+serialization boundary — a stock partha agent should be able to connect
+later unmodified. GYT's own frames are fixed-width and interned
+(``wire.py``); the reference's are C++ structs with TRAILING
+VARIABLE-LENGTH STRINGS (cmdline / issue strings) and per-record
+padding. This module decodes the reference layouts into GYT record
+arrays (+ NAME_INTERN announcements for every string), so reference
+traffic folds through the exact same ``Runtime.feed`` path.
+
+Layouts transcribed as numpy dtypes from the reference ABI (protocol
+contract, little-endian throughout ``gy_comm_proto.h:43``):
+
+- ``COMM_HEADER``           — gy_comm_proto.h:336 (magic/total/type/pad)
+- ``EVENT_NOTIFY``          — gy_comm_proto.h:486 (subtype/nevents)
+- ``TCP_CONN_NOTIFY``       — gy_comm_proto.h:1665 (+ trailing cmdline)
+- ``LISTENER_STATE_NOTIFY`` — gy_comm_proto.h:2183 (+ issue string)
+- ``AGGR_TASK_STATE_NOTIFY``— gy_comm_proto.h:2114 (+ issue string)
+- ``IP_PORT``/``GY_IP_ADDR``— gy_common_inc.h:11162 / :10492 (packed
+  u128 address + u32 v4 + af/flags, 8-aligned, port + tail pad)
+
+Only the partha→madhava event subtypes the engine folds are adapted;
+unknown subtypes are skipped frame-whole (forward compatibility — the
+reference's recv loop does the same for unhandled events).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.utils.intern import InternTable
+
+# ----------------------------------------------------- reference constants
+REF_MAGIC_PM = 0x05666605        # PM_HDR_MAGIC (partha → madhava)
+REF_MAGICS = {0x05555505, 0x05666605, 0x05777705, 0x05888805}
+
+REF_COMM_EVENT_NOTIFY = 14       # COMM_TYPE_E
+
+REF_NOTIFY_LISTENER_STATE = 0x309
+REF_NOTIFY_TCP_CONN = 0x30C
+REF_NOTIFY_AGGR_TASK_STATE = 0x310
+
+AF_INET, AF_INET6 = 2, 10
+
+REF_HEADER_DT = np.dtype([       # COMM_HEADER, gy_comm_proto.h:336
+    ("magic", "<u4"), ("total_sz", "<u4"),
+    ("data_type", "<u4"), ("padding_sz", "<u4"),
+])
+
+REF_EVENT_NOTIFY_DT = np.dtype([  # EVENT_NOTIFY, gy_comm_proto.h:486
+    ("subtype", "<u4"), ("nevents", "<u4"),
+])
+
+# GY_IP_ADDR (gy_common_inc.h:10492, packed+aligned(8)) inside IP_PORT
+# (gy_common_inc.h:11162): 16B raw v6 address + embedded v4 + af/flags,
+# then the port and 8-align tail padding
+REF_IP_PORT_DT = np.dtype([
+    ("ip128", "u1", (16,)),      # in6_addr raw bytes (network order)
+    ("ip32_be", "<u4"),          # v4 address, network byte order
+    ("aftype", "<i2"), ("ipflags", "<u2"),
+    ("port", "<u2"), ("pad", "u1", (6,)),
+])
+assert REF_IP_PORT_DT.itemsize == 32
+
+# TCP_CONN_NOTIFY fixed part (gy_comm_proto.h:1665); cli_cmdline_len_
+# bytes of cmdline + padding_len_ bytes follow each record
+REF_TCP_CONN_DT = np.dtype([
+    ("cli", REF_IP_PORT_DT), ("ser", REF_IP_PORT_DT),
+    ("nat_cli", REF_IP_PORT_DT), ("nat_ser", REF_IP_PORT_DT),
+    ("tusec_start", "<u8"), ("tusec_close", "<u8"),
+    ("cli_task_aggr_id", "<u8"), ("cli_related_listen_id", "<u8"),
+    ("cli_madhava_id", "<u8"),
+    ("machid_hi", "<u8"), ("machid_lo", "<u8"),   # GY_MACHINE_ID pair
+    ("ser_related_listen_id", "<u8"), ("ser_glob_id", "<u8"),
+    ("ser_madhava_id", "<u8"),
+    ("bytes_sent", "<u8"), ("bytes_rcvd", "<u8"),
+    ("cli_pid", "<i4"), ("ser_pid", "<i4"),
+    ("ser_conn_hash", "<u4"), ("ser_sock_inode", "<u4"),
+    ("cli_comm", "S16"), ("ser_comm", "S16"),
+    ("cli_cmdline_len", "<u2"),
+    ("is_connect", "u1"), ("is_accept", "u1"), ("is_loopback", "u1"),
+    ("is_pre_existing", "u1"), ("notified_before", "u1"),
+    ("padding_len", "u1"),
+])
+assert REF_TCP_CONN_DT.itemsize == 280
+
+# LISTENER_STATE_NOTIFY fixed part (gy_comm_proto.h:2183)
+REF_LISTENER_STATE_DT = np.dtype([
+    ("glob_id", "<u8"),
+    ("nqrys_5s", "<u4"), ("total_resp_5sec", "<u4"), ("nconns", "<u4"),
+    ("nconns_active", "<u4"), ("ntasks", "<u4"),
+    ("p95_5s_resp_ms", "<u4"), ("p95_5min_resp_ms", "<u4"),
+    ("curr_kbytes_inbound", "<u4"), ("curr_kbytes_outbound", "<u4"),
+    ("ser_errors", "<u4"), ("cli_errors", "<u4"),
+    ("tasks_delay_usec", "<u4"), ("tasks_cpudelay_usec", "<u4"),
+    ("tasks_blkiodelay_usec", "<u4"), ("tasks_user_cpu", "<u4"),
+    ("tasks_sys_cpu", "<u4"), ("tasks_rss_mb", "<u4"),
+    ("ntasks_issue", "<u2"),
+    ("is_http_svc", "u1"), ("curr_state", "u1"), ("curr_issue", "u1"),
+    ("issue_bit_hist", "u1"), ("high_resp_bit_hist", "u1"),
+    ("last_issue_subsrc", "u1"), ("query_flags", "u1"),
+    ("issue_string_len", "u1"), ("padding_len", "u1"),
+    ("tailpad", "u1", (1,)),
+])
+assert REF_LISTENER_STATE_DT.itemsize == 88
+
+# AGGR_TASK_STATE_NOTIFY fixed part (gy_comm_proto.h:2114)
+REF_AGGR_TASK_DT = np.dtype([
+    ("aggr_task_id", "<u8"), ("onecomm", "S16"),
+    ("pid_arr", "<i4", (2,)),
+    ("tcp_kbytes", "<u4"), ("tcp_conns", "<u4"),
+    ("total_cpu_pct", "<f4"), ("rss_mb", "<u4"),
+    ("cpu_delay_msec", "<u4"), ("vm_delay_msec", "<u4"),
+    ("blkio_delay_msec", "<u4"),
+    ("ntasks_total", "<u2"), ("ntasks_issue", "<u2"),
+    ("curr_state", "u1"), ("curr_issue", "u1"),
+    ("issue_bit_hist", "u1"), ("severe_issue_bit_hist", "u1"),
+    ("issue_string_len", "u1"), ("padding_len", "u1"),
+    ("tailpad", "u1", (2,)),
+])
+assert REF_AGGR_TASK_DT.itemsize == 72
+
+_HSZ = REF_HEADER_DT.itemsize
+_ESZ = REF_EVENT_NOTIFY_DT.itemsize
+
+
+class RefFrameError(wire.FrameError):
+    pass
+
+
+def _check_nevents(nevents: int, payload: bytes, fsz: int, cap: int,
+                   what: str) -> None:
+    """The wire's u4 nevents is attacker-controlled: bound it by the
+    reference batch cap AND by what the payload could possibly hold
+    (each record is ≥ fsz bytes) BEFORE allocating output — the GYT
+    decoder enforces the same caps in ``wire.decode_frames``."""
+    if nevents > cap or nevents * fsz > len(payload):
+        raise RefFrameError(
+            f"{what}: nevents {nevents} exceeds cap {cap} or "
+            f"payload {len(payload)}B")
+
+
+def _ip16(rec) -> bytes:
+    """One REF_IP_PORT → the wire's 16-byte (v4-mapped) address."""
+    if int(rec["aftype"]) == AF_INET:
+        return (b"\x00" * 10 + b"\xff\xff"
+                + int(rec["ip32_be"]).to_bytes(4, "little"))
+        # ip32_be_ holds network-order bytes; little-endian re-pack of
+        # the u32 value restores the original byte sequence
+    return rec["ip128"].tobytes()
+
+
+def _copy_ip_port(dst, src) -> None:
+    dst["ip"] = np.frombuffer(_ip16(src), np.uint8)
+    dst["port"] = src["port"]
+
+
+def decode_tcp_conn(payload: bytes, nevents: int, host_id: int
+                    ) -> tuple[np.ndarray, list]:
+    """Variable-length TCP_CONN_NOTIFY walk → GYT TCP_CONN records +
+    intern entries for comm/cmdline strings."""
+    fsz = REF_TCP_CONN_DT.itemsize
+    _check_nevents(nevents, payload, fsz, wire.MAX_CONNS_PER_BATCH,
+                   "tcp_conn")
+    out = np.zeros(nevents, wire.TCP_CONN_DT)
+    names: list = []
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"tcp_conn record {i} truncated")
+        rec = np.frombuffer(payload, REF_TCP_CONN_DT, count=1,
+                            offset=off)[0]
+        cmdlen = int(rec["cli_cmdline_len"])
+        end = off + fsz + cmdlen + int(rec["padding_len"])
+        if end > len(payload):
+            raise RefFrameError(f"tcp_conn record {i} overflows frame")
+        r = out[i]
+        for f in ("cli", "ser", "nat_cli", "nat_ser"):
+            _copy_ip_port(r[f], rec[f])
+        for f in ("tusec_start", "tusec_close", "cli_task_aggr_id",
+                  "cli_related_listen_id", "cli_madhava_id",
+                  "ser_related_listen_id", "ser_glob_id",
+                  "ser_madhava_id", "bytes_sent", "bytes_rcvd",
+                  "cli_pid", "ser_pid", "ser_conn_hash",
+                  "ser_sock_inode"):
+            r[f] = rec[f]
+        r["peer_machine_id_hi"] = rec["machid_hi"]
+        r["peer_machine_id_lo"] = rec["machid_lo"]
+        for src_f, dst_f in (("cli_comm", "cli_comm_id"),
+                             ("ser_comm", "ser_comm_id")):
+            s = rec[src_f].tobytes().split(b"\x00", 1)[0].decode(
+                "utf-8", "replace")
+            if s:
+                nid = InternTable.intern(s, wire.NAME_KIND_COMM)
+                r[dst_f] = nid
+                names.append((wire.NAME_KIND_COMM, nid, s))
+        if cmdlen:
+            cmdline = payload[off + fsz: off + fsz + cmdlen].split(
+                b"\x00", 1)[0].decode("utf-8", "replace")
+            nid = InternTable.intern(cmdline, wire.NAME_KIND_MISC)
+            r["cli_cmdline_id"] = nid
+            names.append((wire.NAME_KIND_MISC, nid, cmdline))
+        r["host_id"] = host_id
+        r["flags"] = (int(rec["is_connect"]) * 1
+                      | int(rec["is_accept"]) * 2
+                      | int(rec["is_loopback"]) * 4
+                      | int(rec["is_pre_existing"]) * 8
+                      | int(rec["notified_before"]) * 16)
+        off = end
+    return out, names
+
+
+def decode_listener_state(payload: bytes, nevents: int, host_id: int
+                          ) -> tuple[np.ndarray, list]:
+    fsz = REF_LISTENER_STATE_DT.itemsize
+    _check_nevents(nevents, payload, fsz, wire.MAX_LISTENERS_PER_BATCH,
+                   "listener_state")
+    out = np.zeros(nevents, wire.LISTENER_STATE_DT)
+    names: list = []
+    off = 0
+    shared = set(wire.LISTENER_STATE_DT.names) \
+        & set(REF_LISTENER_STATE_DT.names)
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"listener_state record {i} truncated")
+        rec = np.frombuffer(payload, REF_LISTENER_STATE_DT, count=1,
+                            offset=off)[0]
+        ilen = int(rec["issue_string_len"])
+        end = off + fsz + ilen + int(rec["padding_len"])
+        if end > len(payload):
+            raise RefFrameError(
+                f"listener_state record {i} overflows frame")
+        r = out[i]
+        for f in shared:
+            if f != "pad":
+                r[f] = rec[f]
+        if ilen:
+            s = payload[off + fsz: off + fsz + ilen].split(
+                b"\x00", 1)[0].decode("utf-8", "replace")
+            nid = InternTable.intern(s, wire.NAME_KIND_MISC)
+            r["issue_string_id"] = nid
+            names.append((wire.NAME_KIND_MISC, nid, s))
+        r["host_id"] = host_id
+        off = end
+    return out, names
+
+
+def decode_aggr_task(payload: bytes, nevents: int, host_id: int
+                     ) -> tuple[np.ndarray, list]:
+    fsz = REF_AGGR_TASK_DT.itemsize
+    _check_nevents(nevents, payload, fsz, wire.MAX_TASKS_PER_BATCH,
+                   "aggr_task")
+    out = np.zeros(nevents, wire.AGGR_TASK_DT)
+    names: list = []
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"aggr_task record {i} truncated")
+        rec = np.frombuffer(payload, REF_AGGR_TASK_DT, count=1,
+                            offset=off)[0]
+        ilen = int(rec["issue_string_len"])
+        end = off + fsz + ilen + int(rec["padding_len"])
+        if end > len(payload):
+            raise RefFrameError(f"aggr_task record {i} overflows frame")
+        r = out[i]
+        for f in ("aggr_task_id", "tcp_kbytes", "tcp_conns",
+                  "total_cpu_pct", "rss_mb", "cpu_delay_msec",
+                  "vm_delay_msec", "blkio_delay_msec", "ntasks_total",
+                  "ntasks_issue", "curr_state", "curr_issue"):
+            r[f] = rec[f]
+        comm = rec["onecomm"].tobytes().split(b"\x00", 1)[0].decode(
+            "utf-8", "replace")
+        if comm:
+            nid = InternTable.intern(comm, wire.NAME_KIND_COMM)
+            r["comm_id"] = nid
+            names.append((wire.NAME_KIND_COMM, nid, comm))
+        # the reference resolves task→listener linkage server-side via
+        # its listen-taskmap events; absent here → 0 (unlinked)
+        r["host_id"] = host_id
+        off = end
+    return out, names
+
+
+_DECODER_OF = {
+    REF_NOTIFY_TCP_CONN: (decode_tcp_conn, wire.NOTIFY_TCP_CONN),
+    REF_NOTIFY_LISTENER_STATE: (decode_listener_state,
+                                wire.NOTIFY_LISTENER_STATE),
+    REF_NOTIFY_AGGR_TASK_STATE: (decode_aggr_task,
+                                 wire.NOTIFY_AGGR_TASK_STATE),
+}
+
+
+def adapt(buf: bytes, host_id: int) -> tuple[bytes, int]:
+    """Reference byte stream → GYT wire frames, ready for
+    ``Runtime.feed``.
+
+    Walks COMM_HEADER frames (trailing partial frame left for the
+    caller, epoll-resume semantics like ``wire.decode_frames``);
+    adapts known partha→madhava event subtypes, emits NAME_INTERN
+    frames for every trailing string, and skips unknown subtypes
+    frame-whole. Returns ``(gyt_bytes, consumed)``.
+    """
+    out: list[bytes] = []
+    off = 0
+    n = len(buf)
+    while off + _HSZ <= n:
+        hdr = np.frombuffer(buf, REF_HEADER_DT, count=1, offset=off)[0]
+        if int(hdr["magic"]) not in REF_MAGICS:
+            raise RefFrameError(f"bad reference magic "
+                                f"0x{int(hdr['magic']):08x}")
+        total = int(hdr["total_sz"])
+        if total < _HSZ or total >= wire.MAX_COMM_DATA_SZ:
+            raise RefFrameError(f"bad total_sz {total}")
+        if off + total > n:
+            break                         # partial frame: resume later
+        pad = int(hdr["padding_sz"])
+        if pad > total - _HSZ:            # unvalidated pad would slice
+            raise RefFrameError(          # outside the declared frame
+                f"bad padding_sz {pad} for total_sz {total}")
+        if int(hdr["data_type"]) == REF_COMM_EVENT_NOTIFY \
+                and total - pad >= _HSZ + _ESZ:
+            ev = np.frombuffer(buf, REF_EVENT_NOTIFY_DT, count=1,
+                               offset=off + _HSZ)[0]
+            dec = _DECODER_OF.get(int(ev["subtype"]))
+            if dec is not None:
+                fn, gyt_subtype = dec
+                payload = buf[off + _HSZ + _ESZ: off + total - pad]
+                recs, names = fn(payload, int(ev["nevents"]), host_id)
+                if names:
+                    out.append(wire.encode_frames_chunked(
+                        wire.NOTIFY_NAME_INTERN,
+                        InternTable.records(names)))
+                out.append(wire.encode_frames_chunked(gyt_subtype,
+                                                      recs))
+        off += total
+    return b"".join(out), off

@@ -32,7 +32,7 @@ from typing import Optional
 import numpy as np
 
 from gyeeta_tpu import version
-from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.ingest import refproto, wire
 from gyeeta_tpu.runtime import Runtime
 
 log = logging.getLogger("gyeeta_tpu.net")
@@ -203,7 +203,7 @@ class GytServer:
                     # reconnect resync: re-push full capture state
                     self.rt.tracedefs.forget_host(host_id)
                 try:
-                    await self._event_loop(reader)
+                    await self._event_loop(reader, host_id)
                 finally:
                     if self._event_writers.get(host_id) is writer:
                         del self._event_writers[host_id]
@@ -222,7 +222,7 @@ class GytServer:
             except (ConnectionError, OSError):   # pragma: no cover
                 pass
 
-    async def _event_loop(self, reader) -> None:
+    async def _event_loop(self, reader, host_id: int = 0) -> None:
         """Bulk ingest: socket bytes → Runtime.feed.
 
         Partial-frame reassembly happens HERE, per connection: the
@@ -230,13 +230,38 @@ class GytServer:
         trailing partial frame must be held back or another conn's
         bytes would splice into the middle of it (the reference's
         per-conn recv buffers give the same guarantee,
-        ``common/gy_epoll_conntrack.h`` partial-read resume)."""
+        ``common/gy_epoll_conntrack.h`` partial-read resume).
+
+        A conn whose frames carry the REFERENCE's COMM_HEADER magics
+        (a stock partha / gy_comm_proto producer) is detected by its
+        first complete header and routed through the ingest adapter
+        (``ingest/refproto.py``) — adapted GYT frames feed the same
+        runtime path, and the capture recorder sees the ADAPTED bytes
+        (recorded bytes are always replayable GYT frames)."""
         pending = b""
+        ref_mode = False
         while True:
             data = await reader.read(_READ_SZ)
             if not data:
                 return
             data = pending + data
+            if not ref_mode and len(data) >= 4 and int.from_bytes(
+                    data[:4], "little") in refproto.REF_MAGICS:
+                ref_mode = True
+                self.rt.stats.bump("conns_ref_adapted")
+            if ref_mode:
+                try:
+                    gyt, k = refproto.adapt(data, host_id)
+                except wire.FrameError:
+                    self.rt.stats.bump("frames_bad")
+                    raise
+                pending = data[k:]
+                if gyt:
+                    self.rt.feed(gyt)
+                    rec = self._recorder
+                    if rec is not None:
+                        rec.write(gyt)
+                continue
             try:
                 k = wire.complete_prefix(data)
             except wire.FrameError:

@@ -127,13 +127,19 @@ class ProcTaskCollector:
             g[5] += runq
         self._prev_pids = cur_pids
 
-        # truncation order: fork churn first (the TOPFORK signal a
-        # plain by-ntasks sort would drop for single-pid respawners),
-        # then group size
-        comms = sorted(groups,
-                       key=lambda c: (-groups[c][3], -groups[c][2]))
+        # truncation: primary order is group size (the taskstate /
+        # topcpu signal), with a BOUNDED reserve of slots for the top
+        # fork-churners a by-size sort would drop (single-pid
+        # respawners, the TOPFORK signal) — neither signal can evict
+        # the other wholesale
+        comms = sorted(groups, key=lambda c: -groups[c][2])
         if len(comms) > self.max_groups:
-            comms = comms[: self.max_groups]
+            kept = set(comms[: self.max_groups])
+            forkers = [c for c in sorted(
+                groups, key=lambda c: -groups[c][3])
+                if groups[c][3] > 0 and c not in kept]
+            reserve = forkers[: max(self.max_groups // 8, 1)]
+            comms = comms[: self.max_groups - len(reserve)] + reserve
         # baselines advance for EVERY group each sweep — a group capped
         # out of the report must not accumulate multi-sweep deltas that
         # later get divided by a single dt

@@ -1,0 +1,229 @@
+"""gy_comm_proto ingest adapter: synthesized reference-layout frames →
+GYT records → Runtime.feed → queries (VERDICT r3 #5 done-criterion).
+
+Fixtures are built from the adapter's own layout dtypes plus manual
+trailing-string/padding assembly, mirroring how the reference's
+``set_padding_len`` producers lay records out
+(``gy_comm_proto.h:1665,2183,2114``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import refproto as RP
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
+                conn_batch=64, resp_batch=64, fold_k=2)
+
+
+def _ref_frame(subtype: int, nevents: int, payload: bytes) -> bytes:
+    body_len = RP._HSZ + RP._ESZ + len(payload)
+    total = (body_len + 7) & ~7
+    hdr = np.zeros((), RP.REF_HEADER_DT)
+    hdr["magic"] = RP.REF_MAGIC_PM
+    hdr["total_sz"] = total
+    hdr["data_type"] = RP.REF_COMM_EVENT_NOTIFY
+    hdr["padding_sz"] = total - body_len
+    ev = np.zeros((), RP.REF_EVENT_NOTIFY_DT)
+    ev["subtype"] = subtype
+    ev["nevents"] = nevents
+    return (hdr.tobytes() + ev.tobytes() + payload
+            + b"\x00" * (total - body_len))
+
+
+def _v4(a, b, c, d):
+    ip = np.zeros((), RP.REF_IP_PORT_DT)
+    ip["aftype"] = RP.AF_INET
+    ip["ip32_be"] = int.from_bytes(bytes([a, b, c, d]), "little")
+    return ip
+
+
+def _conn_record(ser_glob: int, sport: int, nbytes: int,
+                 cmdline: bytes = b"", accept: bool = True) -> bytes:
+    rec = np.zeros((), RP.REF_TCP_CONN_DT)
+    rec["cli"] = _v4(10, 0, 0, 9)
+    rec["cli"]["port"] = 40001
+    rec["ser"] = _v4(10, 0, 0, 7)
+    rec["ser"]["port"] = sport
+    rec["tusec_start"] = 1_700_000_000_000_000
+    rec["cli_task_aggr_id"] = 0xDEAD
+    rec["ser_glob_id"] = ser_glob
+    rec["ser_related_listen_id"] = ser_glob
+    rec["bytes_sent"] = nbytes
+    rec["bytes_rcvd"] = nbytes // 2
+    rec["cli_comm"] = b"refclient"
+    rec["ser_comm"] = b"refserver"
+    rec["is_accept"] = accept
+    rec["is_connect"] = not accept
+    rec["cli_cmdline_len"] = len(cmdline)
+    act = RP.REF_TCP_CONN_DT.itemsize + len(cmdline)
+    pad = (-act) % 8
+    rec["padding_len"] = pad
+    return rec.tobytes() + cmdline + b"\x00" * pad
+
+
+def _listener_record(glob_id: int, nconns: int, issue: bytes = b""
+                     ) -> bytes:
+    rec = np.zeros((), RP.REF_LISTENER_STATE_DT)
+    rec["glob_id"] = glob_id
+    rec["nqrys_5s"] = 120
+    rec["nconns"] = nconns
+    rec["nconns_active"] = max(nconns - 1, 0)
+    rec["curr_kbytes_inbound"] = 64
+    rec["curr_state"] = 2
+    rec["issue_string_len"] = len(issue)
+    act = RP.REF_LISTENER_STATE_DT.itemsize + len(issue)
+    pad = (-act) % 8
+    rec["padding_len"] = pad
+    return rec.tobytes() + issue + b"\x00" * pad
+
+
+def _task_record(aggr_id: int, comm: bytes, cpu: float,
+                 issue: bytes = b"") -> bytes:
+    rec = np.zeros((), RP.REF_AGGR_TASK_DT)
+    rec["aggr_task_id"] = aggr_id
+    rec["onecomm"] = comm
+    rec["total_cpu_pct"] = cpu
+    rec["rss_mb"] = 256
+    rec["ntasks_total"] = 3
+    rec["curr_state"] = 2
+    rec["issue_string_len"] = len(issue)
+    act = RP.REF_AGGR_TASK_DT.itemsize + len(issue)
+    pad = (-act) % 8
+    rec["padding_len"] = pad
+    return rec.tobytes() + issue + b"\x00" * pad
+
+
+def test_layout_sizes_match_reference_abi():
+    """sizeof contracts from gy_comm_proto.h (compile-time constants
+    in the reference; decode breaks silently if these drift)."""
+    assert RP.REF_IP_PORT_DT.itemsize == 32
+    assert RP.REF_TCP_CONN_DT.itemsize == 280
+    assert RP.REF_LISTENER_STATE_DT.itemsize == 88
+    assert RP.REF_AGGR_TASK_DT.itemsize == 72
+
+
+def test_adapt_conn_with_trailing_cmdline():
+    payload = (_conn_record(0xAA01, 8080, 4096, b"/usr/bin/client --x")
+               + _conn_record(0xAA01, 8080, 2048))
+    buf = _ref_frame(RP.REF_NOTIFY_TCP_CONN, 2, payload)
+    gyt, consumed = RP.adapt(buf, host_id=3)
+    assert consumed == len(buf)
+    recs, c2 = wire.decode_frames(gyt)
+    by_type = {st: r for st, r in recs}
+    conns = by_type[wire.NOTIFY_TCP_CONN]
+    assert len(conns) == 2
+    assert int(conns[0]["ser_glob_id"]) == 0xAA01
+    assert int(conns[0]["bytes_sent"]) == 4096
+    assert (conns["host_id"] == 3).all()
+    assert int(conns[0]["flags"]) & 2           # accept flag mapped
+    names = by_type[wire.NOTIFY_NAME_INTERN]
+    strs = {bytes(n["name"]).split(b"\x00")[0].decode()
+            for n in names}
+    assert {"refclient", "refserver"} <= strs
+    assert "/usr/bin/client --x" in strs        # trailing cmdline
+
+
+def test_adapt_partial_frame_resume():
+    payload = _conn_record(0xBB02, 9090, 100)
+    buf = _ref_frame(RP.REF_NOTIFY_TCP_CONN, 1, payload)
+    gyt, consumed = RP.adapt(buf + buf[:20], host_id=1)
+    assert consumed == len(buf)                 # partial held back
+    assert len(gyt) > 0
+
+
+def test_adapt_unknown_subtype_skipped():
+    inner = np.zeros(4, "<u8").tobytes()
+    buf = (_ref_frame(0x30F, 1, inner)          # CPU_MEM: not adapted
+           + _ref_frame(RP.REF_NOTIFY_TCP_CONN, 1,
+                        _conn_record(0xCC03, 80, 10)))
+    gyt, consumed = RP.adapt(buf, host_id=2)
+    assert consumed == len(buf)
+    recs, _ = wire.decode_frames(gyt)
+    assert any(st == wire.NOTIFY_TCP_CONN and len(r) == 1
+               for st, r in recs)
+
+
+def test_adapt_bad_magic_raises():
+    with pytest.raises(RP.RefFrameError):
+        RP.adapt(b"\x00" * 32, host_id=0)
+
+
+async def _ref_conn_session():
+    import asyncio
+
+    from gyeeta_tpu.net import GytServer, QueryClient
+    from gyeeta_tpu.net.agent import register
+
+    rt = Runtime(CFG)
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    try:
+        _r, w, status, hid = await register(host, port, 0xFACE,
+                                            wire.CONN_EVENT)
+        assert status == wire.REG_OK
+        # after registration the conn speaks STOCK gy_comm_proto
+        glob_id = 0x0DD0_5511
+        w.write(_ref_frame(RP.REF_NOTIFY_TCP_CONN, 4,
+                           b"".join(_conn_record(glob_id, 7443, 500)
+                                    for _ in range(4))))
+        await w.drain()
+        await asyncio.sleep(0.2)
+        rt.flush()
+        rt.run_tick()
+        qc = QueryClient()
+        await qc.connect(host, port)
+        out = await qc.query({"subsys": "svcstate",
+                              "filter": f"{{ svcstate.svcid = "
+                                        f"'{glob_id:016x}' }}"})
+        await qc.close()
+        w.close()
+        return out, hid, rt
+    finally:
+        await srv.stop()
+
+
+def test_ref_magic_conn_adapted_at_server_edge():
+    """A registered event conn that switches to reference-magic frames
+    (stock partha producer) is adapted transparently by the server."""
+    import asyncio
+
+    out, hid, rt = asyncio.run(_ref_conn_session())
+    assert out["nrecs"] == 1
+    assert out["recs"][0]["hostid"] == hid
+    assert rt.stats.snapshot().get("conns_ref_adapted") == 1
+
+
+def test_ref_stream_folds_through_runtime():
+    """The VERDICT done-criterion: ref-layout fixtures → adapt →
+    Runtime.feed → svcstate/taskstate queries see the traffic."""
+    rt = Runtime(CFG)
+    glob_id = 0x51C7_0001
+    conns = b"".join(_conn_record(glob_id, 8443, 1000)
+                     for _ in range(8))
+    buf = (_ref_frame(RP.REF_NOTIFY_TCP_CONN, 8, conns)
+           + _ref_frame(RP.REF_NOTIFY_LISTENER_STATE, 1,
+                        _listener_record(glob_id, 7, b"high resp"))
+           + _ref_frame(RP.REF_NOTIFY_AGGR_TASK_STATE, 2,
+                        _task_record(0xD00D, b"ref-worker", 42.5)
+                        + _task_record(0xD00E, b"ref-batch", 7.25,
+                                       b"cpu delay")))
+    gyt, consumed = RP.adapt(buf, host_id=2)
+    assert consumed == len(buf)
+    rt.feed(gyt)
+    rt.run_tick()
+    svc = rt.query({"subsys": "svcstate",
+                    "filter": f"{{ svcstate.svcid = "
+                              f"'{glob_id:016x}' }}"})
+    assert svc["nrecs"] == 1
+    assert svc["recs"][0]["nconns"] == 7        # listener state row
+    task = rt.query({"subsys": "taskstate", "sortcol": "cpu"})
+    comms = {r["comm"] for r in task["recs"]}
+    assert {"ref-worker", "ref-batch"} <= comms
+    top = rt.query({"subsys": "topcpu"})
+    assert top["recs"][0]["comm"] == "ref-worker"
