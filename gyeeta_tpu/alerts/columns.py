@@ -87,7 +87,12 @@ def actions_columns(mgr, names=None):
         for a in d.actions:
             if a in ndefs:
                 ndefs[a] += 1
+    cfgs = mgr.action_cfgs
     cols = {"name": _obj(acts),
+            "type": _obj(["builtin" if a not in cfgs
+                          else cfgs[a].atype for a in acts]),
+            "target": _obj(["" if a not in cfgs
+                            else cfgs[a].url for a in acts]),
             "ndefs": np.array([float(ndefs[a]) for a in acts])}
     return cols, np.ones(len(acts), bool)
 

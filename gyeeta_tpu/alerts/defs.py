@@ -56,13 +56,18 @@ class AlertDef(NamedTuple):
         tree = criteria.parse(d["filter"])     # validate at definition time
         if tree is None:
             raise ValueError("alertdef filter must be non-empty")
+        # 'action'/'actions', string or list — a bare string must wrap,
+        # never iterate into per-character "names"
+
+        def _actions_of(dd):
+            acts = dd.get("action", dd.get("actions", ("log",)))
+            return (acts,) if isinstance(acts, str) else tuple(acts)
         return cls(
             name=d["alertname"], subsys=d["subsys"], filter=d["filter"],
             severity=sev,
             numcheckfor=max(1, int(d.get("numcheckfor", 1))),
             repeataftersec=float(d.get("repeataftersec", 300.0)),
-            actions=tuple(d.get("action", ("log",)))
-            if not isinstance(d.get("action"), str) else (d["action"],),
+            actions=_actions_of(d),
             labels=tuple(sorted(dict(d.get("labels", {})).items())),
             annotations=tuple(sorted(dict(d.get("annotations", {}))
                                      .items())),

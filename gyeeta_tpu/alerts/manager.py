@@ -93,6 +93,12 @@ class AlertManager:
         self.actions: dict[str, Callable[[list], None]] = {
             "log": self.alert_log.extend,
         }
+        # configured delivery actions (webhook/slack/email/pagerduty):
+        # configs live here for CRUD + the actions subsystem; execution
+        # runs on the dispatcher's worker thread (ref alert_act_thread,
+        # gy_alertmgr.cc:3465) so evaluation never blocks on HTTP
+        self.action_cfgs: dict[str, "deliver.ActionConfig"] = {}
+        self._dispatcher = None
         self._state: dict[tuple, _EntityState] = {}
         self._trees: dict[str, object] = {}     # parsed filter cache
         self._groups: dict[str, list] = {}      # name → [deadline, alerts]
@@ -130,6 +136,37 @@ class AlertManager:
 
     def register_action(self, name: str, fn: Callable[[list], None]):
         self.actions[name] = fn
+
+    @property
+    def dispatcher(self):
+        if self._dispatcher is None:
+            from gyeeta_tpu.alerts.deliver import ActionDispatcher
+            self._dispatcher = ActionDispatcher()
+        return self._dispatcher
+
+    def add_action(self, d: dict):
+        """CRUD: configure a delivery action (ref actiondef CRUD →
+        routed by alertdef.actions names)."""
+        from gyeeta_tpu.alerts import deliver
+        cfg = d if isinstance(d, deliver.ActionConfig) \
+            else deliver.ActionConfig.from_json(d)
+        if cfg.name == "log":
+            raise ValueError("'log' is built in")
+        self.action_cfgs[cfg.name] = cfg
+        self.actions[cfg.name] = \
+            lambda group, _c=cfg: self.dispatcher.enqueue(_c, group)
+        return cfg
+
+    def delete_action(self, name: str) -> bool:
+        if name == "log":
+            return False
+        self.action_cfgs.pop(name, None)
+        return self.actions.pop(name, None) is not None
+
+    def close(self) -> None:
+        if self._dispatcher is not None:
+            self._dispatcher.close()
+            self._dispatcher = None
 
     # ------------------------------------------------------------ check
     def firing(self) -> list[tuple]:

@@ -127,6 +127,7 @@ class GytServer:
         if self._recorder is not None:
             rec, self._recorder = self._recorder, None
             rec.close()      # live conns see None, never a closed file
+        self.rt.close()      # alert delivery worker + history handle
 
     async def _tick_loop(self) -> None:
         while True:

@@ -134,12 +134,16 @@ class ProcTaskCollector:
         # the other wholesale
         comms = sorted(groups, key=lambda c: -groups[c][2])
         if len(comms) > self.max_groups:
-            kept = set(comms[: self.max_groups])
+            nres = max(self.max_groups // 8, 1)
+            base = comms[: self.max_groups - nres]
+            kept = set(base)
             forkers = [c for c in sorted(
                 groups, key=lambda c: -groups[c][3])
-                if groups[c][3] > 0 and c not in kept]
-            reserve = forkers[: max(self.max_groups // 8, 1)]
-            comms = comms[: self.max_groups - len(reserve)] + reserve
+                if groups[c][3] > 0 and c not in kept][:nres]
+            # unused reserve slots go back to the by-size order
+            fill = [c for c in comms[len(base):]
+                    if c not in forkers][: nres - len(forkers)]
+            comms = base + forkers + fill
         # baselines advance for EVERY group each sweep — a group capped
         # out of the report must not accumulate multi-sweep deltas that
         # later get divided by a single dt

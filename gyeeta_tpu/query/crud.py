@@ -4,7 +4,7 @@ CRUD_GENERIC_JSON / CRUD_ALERT_JSON query types,
 
 from __future__ import annotations
 
-CRUD_OBJS = ("alertdef", "silence", "inhibit", "tracedef")
+CRUD_OBJS = ("alertdef", "silence", "inhibit", "tracedef", "action")
 
 
 def crud(rt, req: dict) -> dict:
@@ -21,6 +21,8 @@ def crud(rt, req: dict) -> dict:
             name = rt.alerts.add_silence(req).name
         elif objtype == "inhibit":
             name = rt.alerts.add_inhibit(req).name
+        elif objtype == "action":
+            name = rt.alerts.add_action(req).name
         else:
             name = rt.tracedefs.add(req).name
         rt.notifylog.add(f"{objtype} {name!r} added", source="config")
@@ -35,6 +37,8 @@ def crud(rt, req: dict) -> dict:
             found = rt.alerts.silences.pop(name, None) is not None
         elif objtype == "inhibit":
             found = rt.alerts.inhibits.pop(name, None) is not None
+        elif objtype == "action":
+            found = rt.alerts.delete_action(name)
         else:
             found = rt.tracedefs.delete(name)
         if found:

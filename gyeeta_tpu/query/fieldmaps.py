@@ -557,6 +557,9 @@ INHIBITS_FIELDS = (
 
 ACTIONS_FIELDS = (
     string("name", "name", "Action name (alertdef routing target)"),
+    string("type", "type", "Delivery type (builtin/webhook/slack/"
+                           "email/pagerduty)"),
+    string("target", "target", "Delivery URL ('' for builtins)"),
     num("ndefs", "ndefs", "Alert definitions routing to this action"),
 )
 

@@ -504,6 +504,16 @@ class Runtime:
                               dep=self.dep, svcreg=self.svcreg,
                               aux=self._aux)
 
+    def close(self) -> None:
+        """Release background resources (alert delivery worker,
+        history db handle). Idempotent; the server calls it on stop."""
+        self.alerts.close()
+        if self.history is not None:
+            try:
+                self.history.db.close()
+            except Exception:  # noqa: BLE001 — already closed is fine
+                pass
+
     def restore(self, path) -> dict:
         # drop staged records and partial-frame bytes from before the
         # restore: folding them into checkpointed state would double-count
