@@ -88,11 +88,23 @@ def actions_columns(mgr, names=None):
             if a in ndefs:
                 ndefs[a] += 1
     cfgs = mgr.action_cfgs
+
+    def redact(url: str) -> str:
+        """scheme+host only: webhook paths ARE bearer secrets (Slack /
+        PagerDuty incoming-webhook URLs) and the actions subsystem is
+        readable by any query client."""
+        from urllib.parse import urlsplit
+        try:
+            p = urlsplit(url)
+            return f"{p.scheme}://{p.netloc}/…" if p.netloc else url
+        except ValueError:
+            return ""
+
     cols = {"name": _obj(acts),
             "type": _obj(["builtin" if a not in cfgs
                           else cfgs[a].atype for a in acts]),
             "target": _obj(["" if a not in cfgs
-                            else cfgs[a].url for a in acts]),
+                            else redact(cfgs[a].url) for a in acts]),
             "ndefs": np.array([float(ndefs[a]) for a in acts])}
     return cols, np.ones(len(acts), bool)
 

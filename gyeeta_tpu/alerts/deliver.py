@@ -225,7 +225,12 @@ class ActionDispatcher:
                 self._cv.wait(left)
         return True
 
-    def close(self) -> None:
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain queued deliveries (bounded), then stop the worker —
+        SIGTERM with alerts in flight must not silently lose them (the
+        compose stop_grace_period exists for exactly this drain)."""
+        if self._thread is not None and self._thread.is_alive():
+            self.drain(timeout)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)

@@ -67,6 +67,7 @@ class GytServer:
         # server→agent control (trace capture enable/disable — the
         # reference's CLI_TYPE_RESP_REQ conns carry this, gy_comm_proto.h)
         self._event_writers: dict[int, asyncio.StreamWriter] = {}
+        self._open_conns: set = set()      # every live conn's writer
 
     # -------------------------------------------------------- registration
     def _load_hostmap(self) -> dict:
@@ -122,6 +123,12 @@ class GytServer:
             self._tick_task = None
         if self._server:
             self._server.close()
+            # force-close live conns BEFORE wait_closed: since 3.12.1
+            # Server.wait_closed waits for every active handler, and a
+            # stopping server must not wait on agents that never hang
+            # up (the crash/restart path drops them; they reconnect)
+            for w in list(self._open_conns):
+                w.close()
             await self._server.wait_closed()
             self._server = None
         if self._recorder is not None:
@@ -182,6 +189,7 @@ class GytServer:
 
     async def _handle_conn(self, reader, writer) -> None:
         peer = writer.get_extra_info("peername")
+        self._open_conns.add(writer)
         try:
             # every conn opens with one REGISTER_REQ declaring its role
             try:
@@ -217,6 +225,7 @@ class GytServer:
             log.warning("conn %s: %s — closing", peer, e)
             self.rt.stats.bump("conns_framing_errors")
         finally:
+            self._open_conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()

@@ -42,6 +42,22 @@ class Daemon:
             extra = self.rt.restore(args.restore)
             log.info("restored checkpoint %s (tick %s)", args.restore,
                      extra.get("tick"))
+        elif getattr(args, "restore_latest", False):
+            # the respawn path must NEVER crash-loop on a bad
+            # checkpoint: walk newest→oldest, fall back to cold start
+            cands = checkpoint_candidates(opts.checkpoint_dir)
+            for cand in cands:
+                try:
+                    extra = self.rt.restore(cand)
+                    log.info("restored checkpoint %s (tick %s)", cand,
+                             extra.get("tick"))
+                    break
+                except Exception as e:  # noqa: BLE001 — corrupt /
+                    # cfg-mismatched file: try the next-older one
+                    log.warning("checkpoint %s unusable (%s) — "
+                                "trying older", cand, e)
+            else:
+                log.info("no usable checkpoint (cold start)")
         self.srv = GytServer(self.rt, host=args.host, port=args.port,
                              tick_interval=args.tick_interval,
                              hostmap_path=args.hostmap,
@@ -104,6 +120,28 @@ class Daemon:
         self.stop_event.set()
 
 
+def checkpoint_candidates(ckpt_dir: Optional[str]) -> list:
+    """Complete checkpoint files, newest first. Excludes the .tmp.npz
+    a crash mid-``ckpt.save`` leaves behind (atomic-rename staging) —
+    restoring one would crash-loop a supervised restart forever."""
+    import pathlib
+    if not ckpt_dir:
+        return []
+    d = pathlib.Path(ckpt_dir)
+    if not d.is_dir():
+        return []
+    cands = [p for p in d.glob("gyt_*.npz")
+             if not p.name.endswith(".tmp.npz")]
+    return [str(p) for p in sorted(
+        cands, key=lambda p: p.stat().st_mtime, reverse=True)]
+
+
+def latest_checkpoint(ckpt_dir: Optional[str]):
+    """Newest complete checkpoint file in the dir, or None."""
+    cands = checkpoint_candidates(ckpt_dir)
+    return cands[0] if cands else None
+
+
 def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         prog="gyeeta_tpu",
@@ -114,6 +152,10 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--history-db", help="sqlite history path")
     ap.add_argument("--checkpoint-dir")
     ap.add_argument("--restore", help="checkpoint .npz to restore")
+    ap.add_argument("--restore-latest", action="store_true",
+                    help="restore the newest checkpoint in "
+                    "--checkpoint-dir when one exists (the respawn "
+                    "path: a supervised restart resumes state)")
     ap.add_argument("--hostmap", help="machine-id→host-id placement file")
     ap.add_argument("--record", help="tee ingested wire bytes to this "
                     "capture file (replay with `gyeeta_tpu replay`)")
