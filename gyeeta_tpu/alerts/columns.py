@@ -96,7 +96,9 @@ def actions_columns(mgr, names=None):
         from urllib.parse import urlsplit
         try:
             p = urlsplit(url)
-            return f"{p.scheme}://{p.netloc}/…" if p.netloc else url
+            # no parseable host ⇒ show NOTHING (a schemeless
+            # "host/path-secret" string would leak whole)
+            return f"{p.scheme}://{p.netloc}/…" if p.netloc else ""
         except ValueError:
             return ""
 

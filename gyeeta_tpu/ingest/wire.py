@@ -233,6 +233,11 @@ MAX_CPUMEM_PER_BATCH = 4096
 REQ_TRACE_DT = np.dtype([
     ("svc_glob_id", "<u8"),
     ("api_id", "<u8"),            # interned normalized signature
+    ("conn_id", "<u8"),           # traced connection identity (wire v3;
+    #                               TRACECONN grouping, ref
+    #                               json_db_traceconn_arr)
+    ("cli_task_aggr_id", "<u8"),  # requesting process group (cprocid)
+    ("cli_comm_id", "<u8"),       # interned client comm (cname)
     ("tusec", "<u8"),             # request first-byte time
     ("resp_usec", "<u4"),
     ("bytes_in", "<u4"),

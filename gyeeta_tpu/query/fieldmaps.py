@@ -47,6 +47,7 @@ SUBSYS_SERVERSTATUS = "serverstatus"  # ref madhavastatus/shyamastatus
 SUBSYS_TRACEDEF = "tracedef"        # ref tracedef (capture control)
 SUBSYS_TRACESTATUS = "tracestatus"  # ref tracestatus
 SUBSYS_TRACEUNIQ = "traceuniq"      # ref traceuniq (APIs per svc)
+SUBSYS_TRACECONN = "traceconn"      # ref traceconn (traced conns)
 SUBSYS_EXTACTIVECONN = "extactiveconn"  # ref extactiveconn (⋈ svcinfo)
 SUBSYS_EXTCLIENTCONN = "extclientconn"  # ref extclientconn (⋈ svcinfo)
 SUBSYS_EXTTRACEREQ = "exttracereq"  # ref exttracereq (⋈ svcinfo)
@@ -437,6 +438,22 @@ TRACEUNIQ_FIELDS = (
     num("nerr", "nerr", "Errored transactions"),
 )
 
+# -------------------------------------------------------------- traceconn
+# ref SUBSYS_TRACECONN (json_db_traceconn_arr, gy_json_field_maps.h:2670):
+# the per-CONNECTION face of request tracing — who talks to the traced
+# service over which connection
+TRACECONN_FIELDS = (
+    string("svcid", "svcid", "Traced service glob id (hex)"),
+    string("name", "name", "Traced service name"),
+    string("connid", "connid", "Traced connection id (hex)"),
+    string("cprocid", "cprocid", "Client process-group id (hex)"),
+    string("cname", "cname", "Client process comm"),
+    boolean("csvc", "csvc", "Client is itself a service"),
+    num("nreq", "nreq", "Requests seen on this connection"),
+    num("hostid", "hostid", "Reporting host id"),
+    num("idleticks", "idleticks", "Ticks since last request"),
+)
+
 # ------------------------------------------------------------- ext* joins
 _EXTINFO_FIELDS = (
     string("ip", "ip", "Bind address"),
@@ -593,6 +610,7 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_TRACEDEF: TRACEDEF_FIELDS,
     SUBSYS_TRACESTATUS: TRACESTATUS_FIELDS,
     SUBSYS_TRACEUNIQ: TRACEUNIQ_FIELDS,
+    SUBSYS_TRACECONN: TRACECONN_FIELDS,
     SUBSYS_EXTACTIVECONN: EXTACTIVECONN_FIELDS,
     SUBSYS_EXTCLIENTCONN: EXTCLIENTCONN_FIELDS,
     SUBSYS_EXTTRACEREQ: EXTTRACEREQ_FIELDS,

@@ -54,8 +54,8 @@ class Runtime:
         self._n_conn_raw = 0
         self._n_resp_raw = 0
         self._td_dirty = False        # digest stage may be non-empty
-        self._state_version = 0       # bumped whenever views may change
-        self._col_cache: dict = {}    # subsys → (version, (cols, mask))
+        from gyeeta_tpu.utils.colcache import ColumnCache
+        self._cols = ColumnCache()    # version-keyed snapshot memo
         self._fold = step.jit_fold_step(self.cfg)
         self._fold_lst = jax.jit(
             lambda s, b: step.ingest_listener(self.cfg, s, b))
@@ -104,6 +104,8 @@ class Runtime:
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
         self.natclusters = NatClusterRegistry()
+        from gyeeta_tpu.utils.traceconnreg import TraceConnRegistry
+        self.traceconns = TraceConnRegistry()
         from gyeeta_tpu.alerts import columns as AC
         from gyeeta_tpu.trace.defs import TraceDefs
         from gyeeta_tpu.utils.notifylog import NotifyLog
@@ -114,6 +116,8 @@ class Runtime:
             "tracedef": lambda: self.tracedefs.columns(),
             "tracestatus": lambda: self.tracedefs.columns(),
             "traceuniq": self._traceuniq_columns,
+            "traceconn": lambda: self.traceconns.columns(
+                self.names, svc_task_ids=self._svc_task_ids()),
             "extactiveconn": lambda: self._ext_join("activeconn"),
             "extclientconn": lambda: self._ext_join("clientconn",
                                                     idcol="cliid"),
@@ -199,6 +203,7 @@ class Runtime:
                 n += len(chunks[0])
                 self.stats.bump("cpumem_records", len(chunks[0]))
             elif kind == "trace":
+                self.traceconns.observe(chunks[0])
                 trb = decode.trace_batch(chunks[0])
                 self.state = self._fold_trace(self.state, trb)
                 n += len(chunks[0])
@@ -221,10 +226,10 @@ class Runtime:
                 # are part of every snapshot view
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
-                self._state_version += 1
+                self._cols.bump()
         self._dispatch_full_slabs()
         if n:
-            self._state_version += 1
+            self._cols.bump()
         return n
 
     def _dispatch_full_slabs(self) -> None:
@@ -299,9 +304,9 @@ class Runtime:
         if self._td_dirty:     # digest stage may hold samples from
             self.state = self._td_flush(self.state)   # fold_many runs
             self._td_dirty = False
-            self._state_version += 1
+            self._cols.bump()
         if n:
-            self._state_version += 1
+            self._cols.bump()
         return n
 
     # ------------------------------------------------------------ cadence
@@ -315,7 +320,7 @@ class Runtime:
         self.flush()
         report = {}
         self.state = self._classify(self.state)
-        self._state_version += 1      # classify + tick mutate views
+        self._cols.bump()             # classify + tick mutate views
         fired = self.alerts.check(self.state,
                                   columns_fn=self._alert_columns)
         # history snapshots BEFORE the window tick: the closing 5s slab is
@@ -327,6 +332,7 @@ class Runtime:
         self.dep = self._dep_age(self.dep, tick)
         self.cgroups.age()
         self.natclusters.age()
+        self.traceconns.age()
 
         if self.history and tick % self.opts.history_every_ticks == 0:
             now = self._clock()
@@ -396,7 +402,7 @@ class Runtime:
             report["checkpoint"] = str(path)
             self.stats.bump("checkpoints")
         # the window tick / aging / compaction above changed every view
-        self._state_version += 1
+        self._cols.bump()
         return report
 
     def _hostlist_columns(self):
@@ -452,33 +458,40 @@ class Runtime:
     def _cached_columns(self, subsys: str):
         """Version-keyed snapshot cache (query freshness, VERDICT r3
         weak #4): device readbacks recompute only after state actually
-        changed (feed/tick/flush/restore bump ``_state_version``);
+        changed (feed/tick/flush/restore bump the cache version);
         between ticks every query serves from the cached columns — the
         reference likewise queries incrementally-maintained in-memory
         tables, not per-request recomputation. Registry/CRUD-backed aux
         views are NEVER cached (they mutate without a version bump)."""
         if subsys in self._aux:
             return self._aux[subsys]()
-        ent = self._col_cache.get(subsys)
-        if ent is not None and ent[0] == self._state_version:
-            return ent[1]
-        try:
-            out = api.columns_for(self.cfg, self.state, subsys,
-                                  names=self.names, dep=self.dep,
-                                  svcreg=self.svcreg, aux=self._aux)
-        except KeyError:
-            # a subsystem with fields but no single-node provider
-            # (e.g. shardlist) must fail like execute() without a
-            # columns_fn would — a clean error, not a bare KeyError
-            raise ValueError(f"unknown subsystem {subsys!r}") from None
-        self._col_cache[subsys] = (self._state_version, out)
-        return out
+        def compute():
+            try:
+                return api.columns_for(self.cfg, self.state, subsys,
+                                       names=self.names, dep=self.dep,
+                                       svcreg=self.svcreg,
+                                       aux=self._aux)
+            except KeyError:
+                # a subsystem with fields but no single-node provider
+                # (e.g. shardlist) must fail like execute() without a
+                # columns_fn would — clean error, not a bare KeyError
+                raise ValueError(
+                    f"unknown subsystem {subsys!r}") from None
+        return self._cols.get(subsys, compute)
 
     def _ext_join(self, base_subsys: str, idcol: str = "svcid"):
         """ext* subsystems: base columns ⋈ svcinfo metadata."""
         cols, live = self._alert_columns(base_subsys)
         info_cols, _ = self.svcreg.columns(self.names)
         return api.info_join(cols, live, info_cols, idcol=idcol)
+
+    def _svc_task_ids(self):
+        """Hex process-group ids that serve a listener (taskstate rows
+        with a nonzero relsvcid) — the traceconn ``csvc`` source."""
+        cols, live = self._cached_columns("taskstate")
+        zero = "0" * 16
+        return {t for t, r, ok in zip(cols["taskid"], cols["relsvcid"],
+                                      live) if ok and r != zero}
 
     def _traceuniq_columns(self):
         """traceuniq: distinct API signatures per service, derived by
@@ -560,8 +573,8 @@ class Runtime:
         self._conn_raw, self._resp_raw = [], []
         self._n_conn_raw = self._n_resp_raw = 0
         self._pending = b""
-        self._state_version += 1
-        self._col_cache.clear()
+        self._cols.bump()
+        self._cols.clear()
         self._td_dirty = False
         self.state, extra = ckpt.restore(path, self.cfg, self.state)
         # the dep graph is not checkpointed: reset it (edges rebuild from

@@ -322,6 +322,21 @@ class ParthaSim:
         out["is_error"] = err
         out["bytes_in"] = r.integers(100, 2000, n)
         out["bytes_out"] = r.integers(200, 50_000, n)
+        # traced-connection identity: a handful of persistent client
+        # conns per (client group, service) pair — the TRACECONN axis
+        ch = r.integers(0, self.n_hosts, n)
+        cg = r.integers(0, self.n_groups, n)
+        cli_task = self.task_ids[ch, cg]
+        out["cli_task_aggr_id"] = cli_task
+        out["cli_comm_id"] = self.comm_ids[cg]
+        conn_no = r.integers(0, 4, n).astype(np.uint64)
+        khi = (cli_task >> np.uint64(32)).astype(np.uint32)
+        klo = cli_task.astype(np.uint32) \
+            ^ out["svc_glob_id"].astype(np.uint32)
+        chi = HH.mix64(khi, klo, 0xC0)
+        clo = HH.mix64(khi, klo, 0xC1)
+        out["conn_id"] = ((chi.astype(np.uint64) << np.uint64(32))
+                          | clo.astype(np.uint64)) ^ conn_no
         out["host_id"] = (host + self.host_base).astype(np.uint32)
         return out
 

@@ -20,10 +20,11 @@ whole trace batches: windowed counters + per-API response-time loghist
 
 from gyeeta_tpu.trace.proto import (  # noqa: F401
     PROTO_UNKNOWN, PROTO_HTTP1, PROTO_POSTGRES, PROTO_MONGO,
-    PROTO_HTTP2, PROTO_TLS, PROTO_NAMES,
+    PROTO_HTTP2, PROTO_TLS, PROTO_SYBASE, PROTO_NAMES,
     HttpParser, PostgresParser, detect_protocol, normalize_http,
     normalize_sql, Transaction, transactions_to_records,
 )
+from gyeeta_tpu.trace.tds import SybaseParser  # noqa: F401
 from gyeeta_tpu.trace.http2 import (  # noqa: F401
     HpackDecoder, Http2Parser, huffman_decode,
 )
@@ -38,4 +39,5 @@ PARSER_OF_PROTO = {
     PROTO_MONGO: MongoParser,
     PROTO_HTTP2: Http2Parser,
     PROTO_TLS: TlsParser,
+    PROTO_SYBASE: SybaseParser,
 }

@@ -33,7 +33,9 @@ PROTO_POSTGRES = 2
 PROTO_MONGO = 3
 PROTO_HTTP2 = 4
 PROTO_TLS = 5
-PROTO_NAMES = ("unknown", "http1", "postgres", "mongo", "http2", "tls")
+PROTO_SYBASE = 6
+PROTO_NAMES = ("unknown", "http1", "postgres", "mongo", "http2", "tls",
+               "sybase")
 
 _HTTP_METHODS = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ",
                  b"OPTIONS ", b"PATCH ", b"TRACE ", b"CONNECT ")
@@ -79,6 +81,13 @@ def detect_protocol(first_bytes: bytes) -> int:
         op = int.from_bytes(first_bytes[12:16], "little")
         if 16 <= ln <= 48_000_000 and op in _MONGO_OPS:
             return PROTO_MONGO
+    if len(first_bytes) >= 8:
+        # TDS: a conn opens with a LOGIN (0x02) buffer — 8-byte packet
+        # header with a sane big-endian length (gy_sybase_proto.h:20)
+        ptype, status = first_bytes[0], first_bytes[1]
+        ln = (first_bytes[2] << 8) | first_bytes[3]
+        if ptype == 0x02 and status in (0x00, 0x01) and 8 <= ln <= 4096:
+            return PROTO_SYBASE
     return PROTO_UNKNOWN
 
 

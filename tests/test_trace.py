@@ -237,3 +237,38 @@ def test_sharded_trace_matches_single():
     a = {(r["svcid"], r["api"]): r["nreq"] for r in rt.query(q)["recs"]}
     b = {(r["svcid"], r["api"]): r["nreq"] for r in srt.query(q)["recs"]}
     assert a == b and sum(a.values()) == 512
+
+
+def test_traceconn_subsystem():
+    """TRACECONN (ref json_db_traceconn_arr): traced requests group by
+    connection with client process identity; both runtimes serve it."""
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=64, conn_batch=64,
+                    resp_batch=64, fold_k=2)
+    rt = Runtime(cfg)
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=12)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.trace_frames(256) + sim.task_frames())
+    rt.run_tick()
+    q = rt.query({"subsys": "traceconn", "sortcol": "nreq",
+                  "maxrecs": 500})
+    assert q["nrecs"] > 0
+    r = q["recs"][0]
+    assert len(r["connid"]) == 16 and len(r["cprocid"]) == 16
+    assert r["cname"].startswith("proc-")      # client comm resolved
+    assert r["nreq"] >= 1
+    # requests on one connection tally; total nreq == records fed
+    assert sum(x["nreq"] for x in q["recs"]) == 256
+    # exttracereq still joins svcinfo (unchanged contract)
+    rt.feed(wire.encode_frame(wire.NOTIFY_LISTENER_INFO,
+                              sim.listener_info_records()))
+    q2 = rt.query({"subsys": "traceconn",
+                   "filter": "{ traceconn.nreq > 0 }"})
+    assert q2["nrecs"] == q["ntotal"]
+    # csvc: client groups that serve a listener (sim groups < n_svcs
+    # carry related_listen_id) are flagged as service callers
+    flags = {r["csvc"] for r in q["recs"]}
+    assert flags == {True, False}
