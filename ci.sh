@@ -1,6 +1,15 @@
 #!/bin/sh
-# CI entry: full test suite on the 8-device virtual CPU platform.
+# CI entry: test suite on the 8-device virtual CPU platform.
 # (tests/conftest.py forces JAX_PLATFORMS=cpu + the device count itself.)
+#
+#   ./ci.sh            full suite (slow: ~15 min on a 1-core box)
+#   ./ci.sh fast       unit tier only (-m "not slow", a few minutes) —
+#                      run this on every change; the full suite at least
+#                      once before shipping
 set -e
 cd "$(dirname "$0")"
+if [ "$1" = "fast" ]; then
+    shift
+    exec python -m pytest tests/ -q -m "not slow" "$@"
+fi
 python -m pytest tests/ -q "$@"

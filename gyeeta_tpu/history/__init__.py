@@ -6,12 +6,14 @@ listenstatetbl, hoststatetbl, ... + partition create/cleanup functions).
 Same design here: the live path is the device sketch readback; the
 historical path is SQL over day-partitioned tables written on a cadence.
 
-Backend: sqlite3 (stdlib) with day partitioning via table suffixes —
-identical schema/semantics to the reference's approach; swapping the
-connection for libpq gives the Postgres deployment (same SQL dialect for
-everything used here).
+Backends behind one seam (``open_store``): sqlite3 (stdlib, default —
+tests and single-box runs) and Postgres
+(``--history-db postgresql://…`` → ``pgstore.PgHistoryStore``, the
+reference's durable tier; day-table partition maintenance mirrors its
+add/drop partition jobs).
 """
 
 from gyeeta_tpu.history.store import HistoryStore, to_sql
+from gyeeta_tpu.history.pgstore import PgHistoryStore, open_store
 
-__all__ = ["HistoryStore", "to_sql"]
+__all__ = ["HistoryStore", "PgHistoryStore", "open_store", "to_sql"]

@@ -103,6 +103,10 @@ def to_sql(tree, subsys: str):
 class HistoryStore:
     """sqlite-backed day-partitioned snapshot store."""
 
+    # floor-division time bucket (positive time): CAST truncates here;
+    # backends where CAST rounds (Postgres) override with FLOOR
+    TIME_BUCKET_SQL = "CAST(time/{step} AS INTEGER)*{step}"
+
     def __init__(self, path: str = ":memory:"):
         self.db = sqlite3.connect(path)
         self.db.execute("PRAGMA journal_mode=WAL")
@@ -231,7 +235,9 @@ class HistoryStore:
             raise ValueError("groupby 'time' needs 'step' seconds")
         tree = C.parse(filter) if filter else None
         where, params, exact = to_sql(tree, subsys)
-        push = A.sql_pushdown(specs, gb, step) if exact else None
+        push = A.sql_pushdown(specs, gb, step,
+                              bucket_expr=self.TIME_BUCKET_SQL) \
+            if exact else None
         if push is not None:
             # avg is rewritten sum+count inside, so every SQL-native op
             # merges across partitions; only percentiles force numpy

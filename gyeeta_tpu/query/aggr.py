@@ -167,15 +167,21 @@ def aggregate_columns(cols: dict, idx: np.ndarray, specs: list,
     return out
 
 
-def sql_pushdown(specs: list, groupby: tuple, step: Optional[float]):
+def sql_pushdown(specs: list, groupby: tuple, step: Optional[float],
+                 bucket_expr: Optional[str] = None):
     """(select_exprs, group_exprs) for the exact-SQL fast path, or None
-    when any op needs numpy (percentiles)."""
+    when any op needs numpy (percentiles). ``bucket_expr`` is the
+    backend's floor-division time-bucket SQL (CAST truncates in sqlite
+    but ROUNDS in Postgres — each store supplies the form that floors,
+    matching the numpy path's ``time // step * step``)."""
     sel, grp = [], []
     for g in groupby:
         if g == "time":
             if not step:
                 raise ValueError("groupby 'time' needs a 'step' seconds")
-            expr = f"CAST(time/{float(step)} AS INTEGER)*{float(step)}"
+            expr = (bucket_expr or
+                    "CAST(time/{step} AS INTEGER)*{step}").format(
+                step=float(step))
             sel.append(f"{expr} AS time")
             grp.append(expr)
         else:

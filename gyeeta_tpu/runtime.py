@@ -22,7 +22,6 @@ from gyeeta_tpu.alerts import AlertManager
 from gyeeta_tpu.engine import aggstate, compact, step
 from gyeeta_tpu.engine.aggstate import EngineCfg
 from gyeeta_tpu.parallel import depgraph as dg
-from gyeeta_tpu.history import HistoryStore
 from gyeeta_tpu.ingest import decode, native, wire
 from gyeeta_tpu.query import api
 from gyeeta_tpu.semantic import derive
@@ -41,7 +40,8 @@ class Runtime:
         self.state = aggstate.init(self.cfg)
         self.stats = Stats()
         self.alerts = AlertManager(self.cfg, clock=clock)
-        self.history = (HistoryStore(self.opts.history_db)
+        from gyeeta_tpu.history import open_store
+        self.history = (open_store(self.opts.history_db)
                         if self.opts.history_db else None)
         self._clock = clock or time.time
         self._tick_no = 0             # host-side mirror of the window tick

@@ -149,7 +149,10 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--config", help="JSON config ({engine:…, runtime:…})")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=10038)
-    ap.add_argument("--history-db", help="sqlite history path")
+    ap.add_argument("--history-db",
+                    help="history store: a sqlite path, or a "
+                    "postgresql:// DSN for the durable Postgres tier "
+                    "(needs psycopg in the image)")
     ap.add_argument("--checkpoint-dir")
     ap.add_argument("--restore", help="checkpoint .npz to restore")
     ap.add_argument("--restore-latest", action="store_true",
