@@ -44,10 +44,16 @@ def _pg_type(fd) -> str:
 
 class _PgDb:
     """sqlite-shaped facade over a psycopg connection: qmark→format
-    paramstyle, commit-on-with (psycopg's own ``with conn`` CLOSES the
-    connection — not what the store's transaction blocks mean)."""
+    paramstyle; AUTOCOMMIT with explicit BEGIN/COMMIT only inside
+    ``with`` blocks. Bare reads must not open transactions (a server
+    answering historical queries would sit idle-in-transaction for
+    hours, blocking vacuum, and one failed statement would poison the
+    connection with 'current transaction is aborted' forever) —
+    psycopg's own ``with conn`` also CLOSES the connection, which is
+    not what the store's transaction blocks mean."""
 
     def __init__(self, conn):
+        conn.autocommit = True
         self._conn = conn
 
     def execute(self, q: str, params=()):
@@ -65,19 +71,17 @@ class _PgDb:
         cur.executemany(q.replace("?", "%s"), [list(p) for p in seq])
 
     def commit(self) -> None:
-        self._conn.commit()
+        pass                      # autocommit: nothing pending
 
     def close(self) -> None:
         self._conn.close()
 
     def __enter__(self):
+        self.execute("BEGIN")
         return self
 
     def __exit__(self, et, ev, tb):
-        if et is None:
-            self._conn.commit()
-        else:
-            self._conn.rollback()
+        self.execute("COMMIT" if et is None else "ROLLBACK")
 
 
 def _connect(dsn: str):
@@ -101,6 +105,9 @@ class PgHistoryStore(HistoryStore):
     # CAST rounds in Postgres; FLOOR matches the numpy path's
     # ``time // step * step`` (and sqlite's truncating CAST)
     TIME_BUCKET_SQL = "FLOOR(time/{step})*{step}"
+    # case-sensitive containment, same semantics as sqlite instr and
+    # the live numpy path's `in`
+    SUBSTR_SQL = "strpos({col}, ?) > 0"
 
     def __init__(self, dsn: str):
         # deliberately NOT calling super().__init__ (it opens sqlite)

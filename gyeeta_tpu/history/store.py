@@ -40,9 +40,24 @@ def _table(subsys: str, day: str) -> str:
     return f"{subsys}tbl_{day}"
 
 
-def to_sql(tree, subsys: str):
+# case-SENSITIVE containment, matching the live numpy path's `in`
+# (criteria.py): sqlite instr / Postgres strpos — sqlite LIKE is
+# ASCII case-insensitive and would diverge between backends
+_SUBSTR_SQLITE = "instr({col}, ?) > 0"
+
+
+def _bool_literal(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def to_sql(tree, subsys: str, substr_fmt: str = _SUBSTR_SQLITE):
     """Expression tree → (where_sql, params, exact) — exact=False when a
-    post-filter pass is still required (regex comparators)."""
+    post-filter pass is still required (regex comparators).
+    ``substr_fmt`` is the backend's case-sensitive containment SQL."""
     if tree is None:
         return "1=1", [], True
     if isinstance(tree, C.Criterion):
@@ -51,6 +66,11 @@ def to_sql(tree, subsys: str):
         fd = fieldmaps.field_map(subsys)[tree.field]
         col = fd.json
         vals = list(tree.values)
+        if fd.kind == "bool":
+            # sqlite stores bools as 0/1 and compares loosely; Postgres
+            # boolean columns reject integer literals — normalize to
+            # real bools so both backends see the same typed parameter
+            vals = [_bool_literal(v) for v in vals]
         if fd.kind == "enum":
             # history rows store presentation strings (row_to_json);
             # normalize query literals (numeric or string) through the
@@ -73,23 +93,24 @@ def to_sql(tree, subsys: str):
             q = ",".join("?" * len(vals))
             return f"{col} NOT IN ({q})", vals, True
         if tree.op in ("substr", "notsubstr"):
-            esc = (str(vals[0]).replace("\\", "\\\\")
-                   .replace("%", "\\%").replace("_", "\\_"))
-            neg = "NOT " if tree.op == "notsubstr" else ""
-            return (f"{col} {neg}LIKE ? ESCAPE '\\'", [f"%{esc}%"], True)
+            expr = substr_fmt.format(col=col)
+            if tree.op == "notsubstr":
+                expr = f"NOT ({expr})"
+            return expr, [str(vals[0])], True
         if tree.op in ("like", "notlike", "bit2", "bit3"):
             # no portable SQL form → select broadly, post-filter in python
             return "1=1", [], False
         raise ValueError(f"comparator {tree.op} not translatable")
     if tree.op == "not":
-        inner, params, exact = to_sql(tree.children[0], subsys)
+        inner, params, exact = to_sql(tree.children[0], subsys,
+                                      substr_fmt)
         if not exact:
             # NOT over an approximated clause must not prune in SQL
             return "1=1", [], False
         return f"NOT ({inner})", params, True
     parts, params, exact = [], [], True
     for ch in tree.children:
-        s, p, e = to_sql(ch, subsys)
+        s, p, e = to_sql(ch, subsys, substr_fmt)
         parts.append(f"({s})")
         params.extend(p)
         exact = exact and e
@@ -106,6 +127,8 @@ class HistoryStore:
     # floor-division time bucket (positive time): CAST truncates here;
     # backends where CAST rounds (Postgres) override with FLOOR
     TIME_BUCKET_SQL = "CAST(time/{step} AS INTEGER)*{step}"
+    # case-sensitive containment (live-path semantics); PG overrides
+    SUBSTR_SQL = _SUBSTR_SQLITE
 
     def __init__(self, path: str = ":memory:"):
         self.db = sqlite3.connect(path)
@@ -166,7 +189,8 @@ class HistoryStore:
         """Historical query: criteria → SQL across day partitions, with
         python post-filter for regex comparators (dual execution)."""
         tree = C.parse(filter) if filter else None
-        where, params, exact = to_sql(tree, subsys)
+        where, params, exact = to_sql(tree, subsys,
+                                      substr_fmt=self.SUBSTR_SQL)
         cols = ["time"] + _TABLES[subsys]
         out = []
         for day in self._days_between(tstart, tend):
@@ -234,7 +258,8 @@ class HistoryStore:
         if "time" in gb and not step:
             raise ValueError("groupby 'time' needs 'step' seconds")
         tree = C.parse(filter) if filter else None
-        where, params, exact = to_sql(tree, subsys)
+        where, params, exact = to_sql(tree, subsys,
+                                      substr_fmt=self.SUBSTR_SQL)
         push = A.sql_pushdown(specs, gb, step,
                               bucket_expr=self.TIME_BUCKET_SQL) \
             if exact else None

@@ -21,6 +21,7 @@ import numpy as np
 from gyeeta_tpu.alerts import AlertManager
 from gyeeta_tpu.engine import aggstate, compact, step
 from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.history import open_store
 from gyeeta_tpu.parallel import depgraph as dg
 from gyeeta_tpu.ingest import decode, native, wire
 from gyeeta_tpu.query import api
@@ -40,7 +41,6 @@ class Runtime:
         self.state = aggstate.init(self.cfg)
         self.stats = Stats()
         self.alerts = AlertManager(self.cfg, clock=clock)
-        from gyeeta_tpu.history import open_store
         self.history = (open_store(self.opts.history_db)
                         if self.opts.history_db else None)
         self._clock = clock or time.time

@@ -50,35 +50,38 @@ def test_pgdb_facade_translates_paramstyle():
     calls = []
 
     class FakeCur:
-        def execute(self, q, p):
+        def execute(self, q, p=None):
             calls.append((q, p))
 
         def executemany(self, q, seq):
             calls.append((q, list(seq)))
 
     class FakeConn:
+        autocommit = False
+
         def cursor(self):
             return FakeCur()
 
-        def commit(self):
-            calls.append(("commit",))
-
-        def rollback(self):
-            calls.append(("rollback",))
-
-    db = _PgDb(FakeConn())
+    conn = FakeConn()
+    db = _PgDb(conn)
+    # bare reads run in AUTOCOMMIT (no idle-in-transaction poisoning)
+    assert conn.autocommit is True
     db.execute("SELECT x FROM t WHERE a = ? AND b IN (?,?)", (1, 2, 3))
     assert calls[0] == ("SELECT x FROM t WHERE a = %s "
-                       "AND b IN (%s,%s)", [1, 2, 3])
+                        "AND b IN (%s,%s)", [1, 2, 3])
+    # literal '%' (LIKE patterns) passes through when unparameterized
+    db.execute("SELECT 1 FROM t WHERE n LIKE '%x%'")
+    assert calls[-1] == ("SELECT 1 FROM t WHERE n LIKE '%x%'", None)
+    # with-blocks are explicit BEGIN/COMMIT (ROLLBACK on error)
     with db:
         pass
-    assert calls[-1] == ("commit",)
+    assert [c[0] for c in calls[-2:]] == ["BEGIN", "COMMIT"]
     try:
         with db:
             raise RuntimeError("boom")
     except RuntimeError:
         pass
-    assert calls[-1] == ("rollback",)
+    assert calls[-1][0] == "ROLLBACK"
 
 
 needs_pg = pytest.mark.skipif(
