@@ -76,6 +76,8 @@ def main() -> None:
                   file=sys.stderr)
             jax.config.update("jax_platforms", "cpu")
             degraded = True
+        elif len(probe_log) == 1:
+            probe_log = None    # clean first-try probe: nothing to log
 
     from gyeeta_tpu.engine import aggstate, step
     from gyeeta_tpu.engine.aggstate import EngineCfg
@@ -143,9 +145,9 @@ def main() -> None:
             "metric": "flow_events_per_sec_per_chip",
             "value": round(value, 1), "unit": "events/sec",
             "vs_baseline": round(value / PER_CHIP_TARGET, 4),
-            **({"tpu_unreachable_cpu_fallback": True,
-                "probe_attempts": probe_log} if degraded
-               else {})}))
+            **({"tpu_unreachable_cpu_fallback": True} if degraded
+               else {}),
+            **({"probe_attempts": probe_log} if probe_log else {})}))
         return
 
     # feed-path throughput: the PRODUCT ingest loop (bytes → native deframe
@@ -177,8 +179,9 @@ def main() -> None:
         "unit": "events/sec",
         "vs_baseline": round(value / PER_CHIP_TARGET, 4),
         "feed_path_events_per_sec": round(feed_rate, 1),
-        **({"tpu_unreachable_cpu_fallback": True,
-            "probe_attempts": probe_log} if degraded else {}),
+        **({"tpu_unreachable_cpu_fallback": True} if degraded
+           else {}),
+        **({"probe_attempts": probe_log} if probe_log else {}),
     }))
 
 

@@ -117,11 +117,36 @@ def _cmd_replay(argv) -> None:
     asyncio.run(run())
 
 
+def _cmd_web(argv) -> None:
+    ap = argparse.ArgumentParser(prog="gyeeta_tpu web")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="upstream gyt-server address")
+    ap.add_argument("--port", type=int, default=10038)
+    # loopback by default: the gateway is UNAUTHENTICATED query + CRUD
+    # — exposing it wider is an explicit operator decision (put auth in
+    # front, like the reference's Node tier expects)
+    ap.add_argument("--listen-host", default="127.0.0.1")
+    ap.add_argument("--listen-port", type=int, default=10080)
+    args = ap.parse_args(argv)
+
+    async def run():
+        from gyeeta_tpu.net.webgw import WebGateway
+        gw = WebGateway(args.host, args.port, host=args.listen_host,
+                        port=args.listen_port)
+        h, p = await gw.start()
+        print(f"web gateway on http://{h}:{p} -> gyt "
+              f"{args.host}:{args.port}", file=sys.stderr)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] in ("query", "agent", "replay"):
+    if argv and argv[0] in ("query", "agent", "replay", "web"):
         return {"query": _cmd_query, "agent": _cmd_agent,
-                "replay": _cmd_replay}[argv[0]](argv[1:])
+                "replay": _cmd_replay, "web": _cmd_web}[argv[0]](
+            argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]
     from gyeeta_tpu.server_main import main as serve_main

@@ -1,0 +1,183 @@
+"""Web gateway: HTTP/JSON face over the query protocol (L7 tier).
+
+The reference fronts madhava/shyama with a Node.js webserver speaking
+its JSON envelope over NM conns (the repo's out-of-tree web tier; the
+server side is the NM handshake in ``server/gy_mnodehandle.cc``).
+Here the same tier is one asyncio process bridging REST to the GYT
+query conn:
+
+- ``POST /query``            — raw JSON query/CRUD/multiquery envelope
+- ``GET  /v1/<subsys>``      — convenience: query params ``filter``,
+  ``maxrecs``, ``sortcol``, ``sortdesc``, ``tstart``, ``tend``
+- ``GET  /healthz``          — gateway + upstream liveness
+
+One upstream :class:`~gyeeta_tpu.net.agent.QueryClient` serialized by
+a lock (the query conn multiplexes by seqid, but the client helper
+reads responses inline); dropped upstream conns reconnect per request.
+Stdlib-only HTTP/1.1 (Content-Length framing, keep-alive) — the
+gateway carries operator queries, not ingest traffic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.parse
+from typing import Optional
+
+from gyeeta_tpu.net.agent import QueryClient
+
+_MAX_BODY = 8 << 20
+_MAX_HDR = 64 << 10
+
+
+class WebGateway:
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.upstream = (upstream_host, upstream_port)
+        self.host, self.port = host, port
+        self._server = None
+        self._qc: Optional[QueryClient] = None
+        self._lock = asyncio.Lock()
+
+    async def start(self) -> tuple:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if self._qc is not None:
+            await self._qc.close()
+            self._qc = None
+
+    # -------------------------------------------------------- upstream
+    async def _query(self, req: dict) -> dict:
+        async with self._lock:
+            for attempt in (0, 1):      # one reconnect on a dead conn
+                if self._qc is None:
+                    qc = QueryClient()
+                    await qc.connect(*self.upstream)
+                    self._qc = qc
+                try:
+                    return await self._qc.query(req)
+                except (ConnectionError, OSError,
+                        asyncio.IncompleteReadError):
+                    await self._qc.close()
+                    self._qc = None
+                    if attempt:
+                        raise
+        raise ConnectionError("upstream unreachable")
+
+    # ------------------------------------------------------------ http
+    async def _handle(self, reader, writer) -> None:
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                except asyncio.LimitOverrunError:
+                    await self._respond(writer, 431, {"error":
+                                                      "headers too large"})
+                    return
+                if len(head) > _MAX_HDR:
+                    await self._respond(writer, 431, {"error":
+                                                      "headers too large"})
+                    return
+                lines = head.decode("latin1").split("\r\n")
+                parts = lines[0].split()
+                if len(parts) != 3:
+                    await self._respond(writer, 400,
+                                        {"error": "bad request line"})
+                    return
+                method, target, _ = parts
+                headers = {}
+                for ln in lines[1:]:
+                    if ":" in ln:
+                        k, v = ln.split(":", 1)
+                        headers[k.strip().lower()] = v.strip()
+                try:
+                    clen = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    clen = -1
+                if clen < 0:
+                    await self._respond(writer, 400,
+                                        {"error": "bad content-length"})
+                    return
+                if clen > _MAX_BODY:
+                    await self._respond(writer, 413,
+                                        {"error": "body too large"})
+                    return
+                body = await reader.readexactly(clen) if clen else b""
+                keep = headers.get("connection", "keep-alive") \
+                    .lower() != "close"
+                await self._route(writer, method, target, body)
+                if not keep:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):  # pragma: no cover
+                pass
+
+    async def _route(self, writer, method: str, target: str,
+                     body: bytes) -> None:
+        path, _, qs = target.partition("?")
+        try:
+            if method == "GET" and path == "/healthz":
+                out = await self._query({"subsys": "serverstatus"})
+                up = out.get("nrecs", 0) == 1
+                await self._respond(writer, 200 if up else 503,
+                                    {"ok": up})
+                return
+            if method == "POST" and path == "/query":
+                req = json.loads(body or b"{}")
+                await self._respond(writer, 200, await self._query(req))
+                return
+            if method == "GET" and path.startswith("/v1/"):
+                req = {"subsys": path[4:].strip("/")}
+                q = urllib.parse.parse_qs(qs)
+                for k in ("filter", "sortcol"):
+                    if k in q:
+                        req[k] = q[k][0]
+                for k in ("maxrecs",):
+                    if k in q:
+                        req[k] = int(q[k][0])
+                for k in ("tstart", "tend"):
+                    if k in q:
+                        req[k] = float(q[k][0])
+                if "sortdesc" in q:
+                    req["sortdesc"] = q["sortdesc"][0].lower() in (
+                        "1", "true")
+                await self._respond(writer, 200, await self._query(req))
+                return
+            await self._respond(writer, 404, {"error": "not found"})
+        except (ValueError, KeyError, RuntimeError) as e:
+            # RuntimeError carries the server's own error envelope
+            # (unknown subsystem, bad filter, …) — a CLIENT error here
+            await self._respond(writer, 400, {"error": str(e)})
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            await self._respond(writer, 502,
+                                {"error": "upstream unreachable"})
+
+    @staticmethod
+    async def _respond(writer, status: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  413: "Payload Too Large", 431: "Headers Too Large",
+                  502: "Bad Gateway", 503: "Service Unavailable"}.get(
+            status, "Error")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        await writer.drain()

@@ -40,6 +40,7 @@ from gyeeta_tpu.parallel.mesh import leading_sharding, shard_of_host
 from gyeeta_tpu.query import api, fieldmaps, readback
 from gyeeta_tpu.query.api import QueryOptions
 from gyeeta_tpu.sketch import topk
+from gyeeta_tpu.utils import dnsmap as _dnsmap
 from gyeeta_tpu.utils.config import RuntimeOpts
 from gyeeta_tpu.utils.intern import InternTable
 from gyeeta_tpu.utils.selfstats import Stats
@@ -71,6 +72,10 @@ class ShardedRuntime:
         self.natclusters = NatClusterRegistry()
         from gyeeta_tpu.utils.traceconnreg import TraceConnRegistry
         self.traceconns = TraceConnRegistry()
+        from gyeeta_tpu.utils.tagreg import TagRegistry
+        self.tags = TagRegistry()
+        from gyeeta_tpu.utils.dnsmap import DnsCache
+        self.dns = DnsCache()
         self.notifylog = NotifyLog(clock=clock)
         self.alerts = AlertManager(self.cfg, clock=clock)
         self._clock = clock or time.time
@@ -142,7 +147,9 @@ class ShardedRuntime:
             "serverstatus": self._serverstatus_columns,
             "hostlist": self._hostlist_columns,
             "shardlist": self._shardlist_columns,
-            "svcipclust": lambda: self.natclusters.columns(self.names),
+            "svcipclust": lambda: _dnsmap.annotate_vip_cols(
+                self.natclusters.columns(self.names), self.dns),
+            "tags": lambda: self.tags.columns(),
             "tracedef": lambda: self.tracedefs.columns(),
             "tracestatus": lambda: self.tracedefs.columns(),
             "traceuniq": self._traceuniq_columns,
@@ -270,8 +277,13 @@ class ShardedRuntime:
         (they mutate without a version bump)."""
         if subsys in self._aux:
             return self._aux[subsys]()
-        return self._cols.get(
+        out = self._cols.get(
             subsys, lambda: self._merged_columns_uncached(subsys))
+        if subsys == fieldmaps.SUBSYS_PROCINFO:
+            # joined OUTSIDE the cache: tags mutate via CRUD without a
+            # state version bump
+            out = self.tags.with_tags(out)
+        return out
 
     def _merged_columns_uncached(self, subsys: str):
         """Per-shard provider outputs concatenated, or collective-

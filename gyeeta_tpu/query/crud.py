@@ -4,7 +4,8 @@ CRUD_GENERIC_JSON / CRUD_ALERT_JSON query types,
 
 from __future__ import annotations
 
-CRUD_OBJS = ("alertdef", "silence", "inhibit", "tracedef", "action")
+CRUD_OBJS = ("alertdef", "silence", "inhibit", "tracedef",
+             "action", "tag")
 
 
 def crud(rt, req: dict) -> dict:
@@ -23,12 +24,16 @@ def crud(rt, req: dict) -> dict:
             name = rt.alerts.add_inhibit(req).name
         elif objtype == "action":
             name = rt.alerts.add_action(req).name
+        elif objtype == "tag":
+            rt.tags.set(req["taskid"], req.get("tag", ""))
+            name = req["taskid"]
         else:
             name = rt.tracedefs.add(req).name
         rt.notifylog.add(f"{objtype} {name!r} added", source="config")
         return {"ok": True, "objtype": objtype, "name": name}
     if op == "delete":
-        name = req.get("name") or req.get("alertname")
+        name = req.get("name") or req.get("alertname") \
+            or req.get("taskid")
         if not name:
             raise ValueError("delete needs a name")
         if objtype == "alertdef":
@@ -39,6 +44,8 @@ def crud(rt, req: dict) -> dict:
             found = rt.alerts.inhibits.pop(name, None) is not None
         elif objtype == "action":
             found = rt.alerts.delete_action(name)
+        elif objtype == "tag":
+            found = rt.tags.delete(req.get("taskid") or name)
         else:
             found = rt.tracedefs.delete(name)
         if found:

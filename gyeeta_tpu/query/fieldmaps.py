@@ -48,6 +48,7 @@ SUBSYS_TRACEDEF = "tracedef"        # ref tracedef (capture control)
 SUBSYS_TRACESTATUS = "tracestatus"  # ref tracestatus
 SUBSYS_TRACEUNIQ = "traceuniq"      # ref traceuniq (APIs per svc)
 SUBSYS_TRACECONN = "traceconn"      # ref traceconn (traced conns)
+SUBSYS_TAGS = "tags"                # ref tags (user process-group tags)
 SUBSYS_EXTACTIVECONN = "extactiveconn"  # ref extactiveconn (⋈ svcinfo)
 SUBSYS_EXTCLIENTCONN = "extclientconn"  # ref extclientconn (⋈ svcinfo)
 SUBSYS_EXTTRACEREQ = "exttracereq"  # ref exttracereq (⋈ svcinfo)
@@ -212,6 +213,17 @@ PROCINFO_FIELDS = (
     string("svcname", "svcname", "Linked service name ('' if none)"),
     num("ntasks", "ntasks", "Processes in the group"),
     num("hostid", "hostid", "Owning host id"),
+    string("tag", "tag", "User tag (CRUD objtype 'tag'; ref "
+                         "MAGGR_TASK tagbuf_, gy_msocket.h:960)"),
+)
+
+# ------------------------------------------------------------------- tags
+# ref SUBSYS_TAGS (gy_json_field_maps.h:55 — a bare enum there; the
+# working feature is the per-group tag buffer): the tag registry as its
+# own listing
+TAGS_FIELDS = (
+    string("taskid", "taskid", "Tagged process-group id (hex)"),
+    string("tag", "tag", "User tag text"),
 )
 
 # ---------------------------------------------------------- svcdependency
@@ -473,6 +485,8 @@ EXTTRACEREQ_FIELDS = TRACEREQ_FIELDS + _EXTINFO_FIELDS
 # reached through one virtual IP = a load-balancer cluster
 SVCIPCLUST_FIELDS = (
     string("vip", "vip", "Virtual (pre-NAT) ip:port dialed by clients"),
+    string("dns", "dns", "Reverse-resolved VIP domain ('' pending/"
+                         "unresolvable; ref gy_dns_mapping.h:46)"),
     string("svcid", "svcid", "Backend service glob id (hex)"),
     string("svcname", "svcname", "Backend service name"),
     num("nsvc", "nsvc", "Backends behind this VIP"),
@@ -611,6 +625,7 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_TRACESTATUS: TRACESTATUS_FIELDS,
     SUBSYS_TRACEUNIQ: TRACEUNIQ_FIELDS,
     SUBSYS_TRACECONN: TRACECONN_FIELDS,
+    SUBSYS_TAGS: TAGS_FIELDS,
     SUBSYS_EXTACTIVECONN: EXTACTIVECONN_FIELDS,
     SUBSYS_EXTCLIENTCONN: EXTCLIENTCONN_FIELDS,
     SUBSYS_EXTTRACEREQ: EXTTRACEREQ_FIELDS,

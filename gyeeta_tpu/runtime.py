@@ -27,6 +27,7 @@ from gyeeta_tpu.ingest import decode, native, wire
 from gyeeta_tpu.query import api
 from gyeeta_tpu.semantic import derive
 from gyeeta_tpu.utils import checkpoint as ckpt
+from gyeeta_tpu.utils import dnsmap as _dnsmap
 from gyeeta_tpu.utils.config import RuntimeOpts
 from gyeeta_tpu.utils.intern import InternTable
 from gyeeta_tpu.utils.selfstats import Stats
@@ -106,6 +107,10 @@ class Runtime:
         self.natclusters = NatClusterRegistry()
         from gyeeta_tpu.utils.traceconnreg import TraceConnRegistry
         self.traceconns = TraceConnRegistry()
+        from gyeeta_tpu.utils.tagreg import TagRegistry
+        self.tags = TagRegistry()
+        from gyeeta_tpu.utils.dnsmap import DnsCache
+        self.dns = DnsCache()
         from gyeeta_tpu.alerts import columns as AC
         from gyeeta_tpu.trace.defs import TraceDefs
         from gyeeta_tpu.utils.notifylog import NotifyLog
@@ -132,7 +137,9 @@ class Runtime:
             "notifymsg": lambda: self.notifylog.columns(self.names),
             "hostlist": self._hostlist_columns,
             "serverstatus": self._serverstatus_columns,
-            "svcipclust": lambda: self.natclusters.columns(self.names),
+            "svcipclust": lambda: _dnsmap.annotate_vip_cols(
+                self.natclusters.columns(self.names), self.dns),
+            "tags": lambda: self.tags.columns(),
         }
         self._classify = derive.jit_classify_pass(self.cfg)
 
@@ -477,7 +484,12 @@ class Runtime:
                 # columns_fn would — clean error, not a bare KeyError
                 raise ValueError(
                     f"unknown subsystem {subsys!r}") from None
-        return self._cols.get(subsys, compute)
+        out = self._cols.get(subsys, compute)
+        if subsys == "procinfo":
+            # joined OUTSIDE the cache: tags mutate via CRUD without a
+            # state version bump
+            out = self.tags.with_tags(out)
+        return out
 
     def _ext_join(self, base_subsys: str, idcol: str = "svcid"):
         """ext* subsystems: base columns ⋈ svcinfo metadata."""
@@ -558,9 +570,11 @@ class Runtime:
                            columns_fn=self._cached_columns)
 
     def close(self) -> None:
-        """Release background resources (alert delivery worker,
-        history db handle). Idempotent; the server calls it on stop."""
+        """Release background resources (alert delivery worker, DNS
+        resolver, history db handle). Idempotent; the server calls it
+        on stop."""
         self.alerts.close()
+        self.dns.close()
         if self.history is not None:
             try:
                 self.history.db.close()

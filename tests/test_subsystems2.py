@@ -138,3 +138,33 @@ def test_ext_join_subsystems():
     assert qc["nrecs"] > 0
     svc_callers = [r for r in qc["recs"] if r["clisvc"] and r["port"] > 0]
     assert svc_callers                       # svc callers joined on cliid
+
+
+def test_tags_crud_and_procinfo_join():
+    """User tags (ref MAGGR_TASK tagbuf_, procinfo FIELD_TAG): CRUD
+    sets a tag on a process group; procinfo rows carry it; the tags
+    subsystem lists the registry; untagged rows stay ''."""
+    rt, sim = _rt()
+    pi = rt.query({"subsys": "procinfo", "maxrecs": 4})
+    assert pi["nrecs"] >= 2
+    tid = pi["recs"][0]["taskid"]
+    out = rt.query({"op": "add", "objtype": "tag", "taskid": tid,
+                    "tag": "tier:frontend"})
+    assert out["ok"]
+    pi2 = rt.query({"subsys": "procinfo",
+                    "filter": "{ procinfo.tag substr 'frontend' }"})
+    assert pi2["nrecs"] == 1 and pi2["recs"][0]["taskid"] == tid
+    assert pi2["recs"][0]["tag"] == "tier:frontend"
+    lst = rt.query({"subsys": "tags"})
+    assert lst["nrecs"] == 1 and lst["recs"][0]["taskid"] == tid
+    # untagged rows have '' and CRUD delete clears
+    untagged = [r for r in rt.query({"subsys": "procinfo",
+                                     "maxrecs": 100})["recs"]
+                if r["taskid"] != tid]
+    assert all(r["tag"] == "" for r in untagged)
+    assert rt.query({"op": "delete", "objtype": "tag",
+                     "taskid": tid})["ok"]
+    assert rt.query({"subsys": "tags"})["nrecs"] == 0
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        rt.tags.set("nothex", "x")

