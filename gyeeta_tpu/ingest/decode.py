@@ -422,6 +422,12 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
     cg = recs.get(wire.NOTIFY_CGROUP_STATE)
     if cg is not None:
         yield ("cgroup", cg)
+    mnt = recs.get(wire.NOTIFY_MOUNT_STATE)
+    if mnt is not None:
+        yield ("mount", mnt)
+    nif = recs.get(wire.NOTIFY_NETIF_STATE)
+    if nif is not None:
+        yield ("netif", nif)
     nm = recs.get(wire.NOTIFY_NAME_INTERN)
     if nm is not None:
         yield ("names", nm)

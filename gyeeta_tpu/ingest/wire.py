@@ -57,6 +57,8 @@ NOTIFY_REQ_TRACE = 17         # request-trace transactions (per-API)
 NOTIFY_LISTENER_INFO = 18     # listener static metadata (ip/port/cmdline)
 NOTIFY_HOST_INFO = 19         # static host inventory (hw/os/cloud)
 NOTIFY_CGROUP_STATE = 20      # 5s per-cgroup stats
+NOTIFY_MOUNT_STATE = 21       # mount/filesystem inventory + freespace
+NOTIFY_NETIF_STATE = 22       # net interface inventory + traffic rates
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -326,6 +328,44 @@ CGROUP_DT = np.dtype([
 
 MAX_CGROUPS_PER_BATCH = 2048
 
+# MOUNT_STATE record — mount/filesystem inventory with freespace
+# tracking (the capability of the reference's MOUNT_HDLR,
+# ``common/gy_mount_disk.h:233``: per-mount fstype + freespace updated
+# on a cadence; pseudo-filesystems excluded agent-side).
+MOUNT_DT = np.dtype([
+    ("mnt_id", "<u8"),             # hash of (device, mountpoint)
+    ("dir_id", "<u8"),             # interned mountpoint path
+    ("fstype_id", "<u8"),          # interned filesystem type
+    ("size_mb", "<f4"),
+    ("free_mb", "<f4"),
+    ("used_pct", "<f4"),
+    ("inodes_used_pct", "<f4"),
+    ("is_network_fs", "u1"),       # nfs/cifs/… (gy_mount_disk.h:512)
+    ("pad", "u1", (3,)),
+    ("host_id", "<u4"),
+])
+
+MAX_MOUNTS_PER_BATCH = 1024
+
+# NETIF_STATE record — interface inventory + rate deltas (the
+# capability of the reference's NET_IF_HDLR, ``common/gy_netif.h:708``:
+# speed, observed traffic, error rates per interface).
+NETIF_DT = np.dtype([
+    ("if_id", "<u8"),              # hash of interface name
+    ("name_id", "<u8"),            # interned interface name
+    ("speed_mbps", "<f4"),         # link speed (-1 unknown)
+    ("rx_mb_sec", "<f4"),
+    ("tx_mb_sec", "<f4"),
+    ("rx_errs_sec", "<f4"),
+    ("tx_errs_sec", "<f4"),
+    ("is_up", "u1"),
+    ("pad", "u1", (3,)),
+    ("host_id", "<u4"),
+    ("pad2", "u1", (4,)),     # 8-byte itemsize alignment
+])
+
+MAX_NETIF_PER_BATCH = 1024
+
 # NAME_INTERN — the host-side half of the fixed-width record contract: the
 # reference carries comm[16]/cmdline/issue strings inline in every record
 # (e.g. gy_comm_proto.h:1708 trailing cmdline); we instead intern strings
@@ -359,6 +399,8 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_LISTENER_INFO: LISTENER_INFO_DT,
     NOTIFY_HOST_INFO: HOST_INFO_DT,
     NOTIFY_CGROUP_STATE: CGROUP_DT,
+    NOTIFY_MOUNT_STATE: MOUNT_DT,
+    NOTIFY_NETIF_STATE: NETIF_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -375,6 +417,8 @@ MAX_OF_SUBTYPE = {
     NOTIFY_LISTENER_INFO: MAX_LISTENER_INFO_PER_BATCH,
     NOTIFY_HOST_INFO: MAX_HOST_INFO_PER_BATCH,
     NOTIFY_CGROUP_STATE: MAX_CGROUPS_PER_BATCH,
+    NOTIFY_MOUNT_STATE: MAX_MOUNTS_PER_BATCH,
+    NOTIFY_NETIF_STATE: MAX_NETIF_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),

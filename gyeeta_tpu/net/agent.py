@@ -87,6 +87,8 @@ class NetAgent:
         self._taskproc = None
         self._cpumem = None
         self._cgroups = None
+        self._mounts = None
+        self._netifs = None
         self._writer = None
         self._ctrl_task = None
         # svc glob ids with capture enabled by the server (REQ_TRACE_SET
@@ -123,6 +125,9 @@ class NetAgent:
             self._cpumem = C.CpuMemCollector(host_id=hid)
             self._cgroups = C.CgroupCollector(host_id=hid)
             self._cgroups.sample()        # prime the delta baseline
+            self._mounts = C.MountCollector(host_id=hid)
+            self._netifs = C.NetIfCollector(host_id=hid)
+            self._netifs.sample()         # prime the rate baseline
         if self.real:
             from gyeeta_tpu.net.taskproc import ProcTaskCollector
             from gyeeta_tpu.net.tcpconn import TcpConnCollector
@@ -204,6 +209,12 @@ class NetAgent:
                                          cgnames)
             if len(cg):
                 buf += wire.encode_frame(wire.NOTIFY_CGROUP_STATE, cg)
+            for sub, (recs, names) in (
+                    (wire.NOTIFY_MOUNT_STATE, self._mounts.sample()),
+                    (wire.NOTIFY_NETIF_STATE, self._netifs.sample())):
+                buf += wire.encode_frames_chunked(
+                    wire.NOTIFY_NAME_INTERN, names)
+                buf += wire.encode_frames_chunked(sub, recs)
         else:
             buf += (s.cgroup_frames()
                     + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,

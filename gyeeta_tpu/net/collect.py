@@ -357,3 +357,147 @@ class CgroupCollector:
         rec_arr = (np.array(recs, dtype=wire.CGROUP_DT)
                    if recs else np.empty(0, wire.CGROUP_DT))
         return rec_arr, InternTable.records(names)
+
+
+# ----------------------------------------------------------------- mounts
+_NETWORK_FS = {"nfs", "nfs4", "cifs", "smbfs", "glusterfs", "cephfs",
+               "ocfs2", "afs", "9p", "fuse.sshfs"}
+_SKIP_FS = {"proc", "sysfs", "devtmpfs", "devpts", "tmpfs", "cgroup",
+            "cgroup2", "securityfs", "debugfs", "tracefs", "configfs",
+            "pstore", "bpf", "mqueue", "hugetlbfs", "autofs", "ramfs",
+            "binfmt_misc", "fusectl", "rpc_pipefs", "overlay",
+            "squashfs", "nsfs", "efivarfs"}
+
+
+class MountCollector:
+    """Mount/filesystem inventory with freespace (the MOUNT_HDLR
+    capability, ``common/gy_mount_disk.h:233``): /proc/self/mounts +
+    statvfs per real filesystem; pseudo-filesystems are skipped the
+    way the reference's fscategory filter does."""
+
+    def __init__(self, host_id: int = 0, max_mounts: int = 256):
+        self.host_id = host_id
+        self.max_mounts = max_mounts
+
+    def sample(self) -> tuple[np.ndarray, np.ndarray]:
+        rows, names = [], []
+        seen = set()
+        for line in _read("/proc/self/mounts").splitlines():
+            p = line.split()
+            if len(p) < 3:
+                continue
+            dev, mnt, fstype = p[0], p[1], p[2]
+            base_fs = fstype.split(".", 1)[0]
+            if fstype in _SKIP_FS or base_fs in _SKIP_FS:
+                continue
+            mnt = mnt.replace("\\040", " ")
+            if mnt in seen or len(rows) >= self.max_mounts:
+                continue
+            seen.add(mnt)
+            is_netfs = (base_fs in _NETWORK_FS or fstype in _NETWORK_FS)
+            if is_netfs and not os.environ.get("GYT_STAT_NETFS"):
+                # a hung NFS/CIFS server turns statvfs into an
+                # UNINTERRUPTIBLE sleep that would freeze the agent's
+                # whole event loop — inventory network mounts without
+                # touching them (GYT_STAT_NETFS=1 opts in)
+                size_mb = free_mb = 0.0
+                st = None
+            else:
+                try:
+                    st = os.statvfs(mnt)
+                except OSError:
+                    continue
+                size_mb = st.f_blocks * st.f_frsize / (1 << 20)
+                if size_mb <= 0:
+                    continue
+                free_mb = st.f_bavail * st.f_frsize / (1 << 20)
+            r = np.zeros((), wire.MOUNT_DT)
+            dir_id = InternTable.intern(mnt, wire.NAME_KIND_MISC)
+            fs_id = InternTable.intern(fstype, wire.NAME_KIND_MISC)
+            from gyeeta_tpu.utils import hashing as H
+            r["mnt_id"] = H.hash_bytes_np(
+                f"{dev}:{mnt}".encode()) or 1
+            r["dir_id"], r["fstype_id"] = dir_id, fs_id
+            r["size_mb"] = size_mb
+            r["free_mb"] = free_mb
+            r["used_pct"] = (100.0 * (1.0 - free_mb / size_mb)
+                             if size_mb else 0.0)
+            tot_i = st.f_files if st is not None else 0
+            r["inodes_used_pct"] = (
+                100.0 * (tot_i - st.f_favail) / tot_i if tot_i else 0.0)
+            r["is_network_fs"] = is_netfs
+            r["host_id"] = self.host_id
+            rows.append(r)
+            names += [(wire.NAME_KIND_MISC, dir_id, mnt),
+                      (wire.NAME_KIND_MISC, fs_id, fstype)]
+        recs = (np.stack(rows) if rows
+                else np.empty(0, wire.MOUNT_DT))
+        return recs, InternTable.records(names) if names \
+            else np.empty(0, wire.NAME_INTERN_DT)
+
+
+# ------------------------------------------------------------ interfaces
+class NetIfCollector:
+    """Interface inventory + rate deltas (the NET_IF_HDLR capability,
+    ``common/gy_netif.h:708``): /sys/class/net statistics swept on the
+    agent cadence; loopback included (it carries real traffic in
+    single-box deployments)."""
+
+    def __init__(self, host_id: int = 0, max_ifs: int = 64):
+        self.host_id = host_id
+        self.max_ifs = max_ifs
+        self._prev: dict[str, tuple] = {}
+        self._t_prev = 0.0
+
+    @staticmethod
+    def _stat(ifname: str, stat: str) -> int:
+        try:
+            return int(_read(
+                f"/sys/class/net/{ifname}/statistics/{stat}") or 0)
+        except ValueError:
+            return 0
+
+    def sample(self) -> tuple[np.ndarray, np.ndarray]:
+        now = time.monotonic()
+        dt = max(now - self._t_prev, 1e-3) if self._t_prev else 0.0
+        self._t_prev = now
+        try:
+            ifs = sorted(os.listdir("/sys/class/net"))[: self.max_ifs]
+        except OSError:
+            ifs = []
+        rows, names = [], []
+        from gyeeta_tpu.utils import hashing as H
+        for ifname in ifs:
+            cur = (self._stat(ifname, "rx_bytes"),
+                   self._stat(ifname, "tx_bytes"),
+                   self._stat(ifname, "rx_errors"),
+                   self._stat(ifname, "tx_errors"))
+            prev = self._prev.get(ifname)
+            self._prev[ifname] = cur
+            if prev is None or not dt:
+                continue                  # need a delta baseline
+            r = np.zeros((), wire.NETIF_DT)
+            name_id = InternTable.intern(ifname, wire.NAME_KIND_MISC)
+            r["if_id"] = H.hash_bytes_np(b"IF" + ifname.encode()) or 1
+            r["name_id"] = name_id
+            try:
+                r["speed_mbps"] = float(
+                    _read(f"/sys/class/net/{ifname}/speed") or -1)
+            except ValueError:
+                r["speed_mbps"] = -1.0
+            r["rx_mb_sec"] = max(cur[0] - prev[0], 0) / dt / (1 << 20)
+            r["tx_mb_sec"] = max(cur[1] - prev[1], 0) / dt / (1 << 20)
+            r["rx_errs_sec"] = max(cur[2] - prev[2], 0) / dt
+            r["tx_errs_sec"] = max(cur[3] - prev[3], 0) / dt
+            oper = _read(f"/sys/class/net/{ifname}/operstate").strip()
+            r["is_up"] = oper in ("up", "unknown")   # lo says unknown
+            r["host_id"] = self.host_id
+            rows.append(r)
+            names.append((wire.NAME_KIND_MISC, name_id, ifname))
+        # forget vanished interfaces
+        for k in [k for k in self._prev if k not in set(ifs)]:
+            del self._prev[k]
+        recs = (np.stack(rows) if rows
+                else np.empty(0, wire.NETIF_DT))
+        return recs, InternTable.records(names) if names \
+            else np.empty(0, wire.NAME_INTERN_DT)

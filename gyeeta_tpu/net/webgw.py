@@ -58,6 +58,8 @@ class WebGateway:
 
     # -------------------------------------------------------- upstream
     async def _query(self, req: dict) -> dict:
+        from gyeeta_tpu.ingest import wire
+
         async with self._lock:
             for attempt in (0, 1):      # one reconnect on a dead conn
                 if self._qc is None:
@@ -67,7 +69,11 @@ class WebGateway:
                 try:
                     return await self._qc.query(req)
                 except (ConnectionError, OSError,
-                        asyncio.IncompleteReadError):
+                        asyncio.IncompleteReadError,
+                        wire.FrameError):
+                    # FrameError = DESYNCED stream (aborted QS_PARTIAL,
+                    # seqid mismatch): the conn must not be reused or
+                    # every later request reads the stale tail forever
                     await self._qc.close()
                     self._qc = None
                     if attempt:

@@ -61,7 +61,7 @@ class ShardedRuntime:
         self.names = InternTable()
         from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
         from gyeeta_tpu.utils.hostreg import CgroupRegistry, \
-            HostInfoRegistry
+            HostInfoRegistry, MountRegistry, NetIfRegistry
         from gyeeta_tpu.utils.notifylog import NotifyLog
         from gyeeta_tpu.trace.defs import TraceDefs
         self.tracedefs = TraceDefs(clock=clock)
@@ -69,6 +69,8 @@ class ShardedRuntime:
         self.svcreg = SvcInfoRegistry()
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
+        self.mounts = MountRegistry()
+        self.netifs = NetIfRegistry()
         self.natclusters = NatClusterRegistry()
         from gyeeta_tpu.utils.traceconnreg import TraceConnRegistry
         self.traceconns = TraceConnRegistry()
@@ -138,6 +140,8 @@ class ShardedRuntime:
         self._aux = {
             "hostinfo": lambda: self.hostinfo.columns(self.names),
             "cgroupstate": lambda: self.cgroups.columns(self.names),
+            "mountstate": lambda: self.mounts.columns(self.names),
+            "netif": lambda: self.netifs.columns(self.names),
             "alerts": lambda: AC.alerts_columns(self.alerts),
             "alertdef": lambda: AC.alertdef_columns(self.alerts),
             "silences": lambda: AC.silences_columns(self.alerts),
@@ -231,6 +235,14 @@ class ShardedRuntime:
             elif kind == "host_info":
                 self.stats.bump("host_infos",
                                 self.hostinfo.update(chunks[0]))
+                n += len(chunks[0])
+            elif kind == "mount":
+                self.stats.bump("mount_records",
+                                self.mounts.update(chunks[0]))
+                n += len(chunks[0])
+            elif kind == "netif":
+                self.stats.bump("netif_records",
+                                self.netifs.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "cgroup":
                 self.stats.bump("cgroup_records",
@@ -521,6 +533,8 @@ class ShardedRuntime:
             self.state = self._age_apis(self.state)
         self.dep = self._dep_age(self.dep, np.int32(self._tick_no))
         self.cgroups.age()
+        self.mounts.age()
+        self.netifs.age()
         self.natclusters.age()
         self.traceconns.age()
         # the window tick / ageing above changed every view
@@ -547,6 +561,12 @@ class ShardedRuntime:
             return api.execute(self.cfg, None, QueryOptions.from_json(req),
                                names=self.names,
                                columns_fn=self._merged_columns)
+
+    def close(self) -> None:
+        """Release background workers (alert delivery, DNS resolver).
+        Idempotent — mirrors Runtime.close()."""
+        self.alerts.close()
+        self.dns.close()
 
     def rollup_stats(self) -> dict:
         """Replicated cluster totals (the MS_CLUSTER_STATE analogue)."""

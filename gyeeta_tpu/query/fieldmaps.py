@@ -49,6 +49,8 @@ SUBSYS_TRACESTATUS = "tracestatus"  # ref tracestatus
 SUBSYS_TRACEUNIQ = "traceuniq"      # ref traceuniq (APIs per svc)
 SUBSYS_TRACECONN = "traceconn"      # ref traceconn (traced conns)
 SUBSYS_TAGS = "tags"                # ref tags (user process-group tags)
+SUBSYS_MOUNTSTATE = "mountstate"    # ref MOUNT_HDLR (mount/freespace)
+SUBSYS_NETIF = "netif"              # ref NET_IF_HDLR (interfaces)
 SUBSYS_EXTACTIVECONN = "extactiveconn"  # ref extactiveconn (⋈ svcinfo)
 SUBSYS_EXTCLIENTCONN = "extclientconn"  # ref extclientconn (⋈ svcinfo)
 SUBSYS_EXTTRACEREQ = "exttracereq"  # ref exttracereq (⋈ svcinfo)
@@ -224,6 +226,33 @@ PROCINFO_FIELDS = (
 TAGS_FIELDS = (
     string("taskid", "taskid", "Tagged process-group id (hex)"),
     string("tag", "tag", "User tag text"),
+)
+
+# ------------------------------------------------------------- mountstate
+# ref MOUNT_HDLR inventory (gy_mount_disk.h:233): per-mount filesystem
+# + freespace, pseudo-fs excluded agent-side
+MOUNTSTATE_FIELDS = (
+    num("hostid", "hostid", "Reporting host id"),
+    string("mnt", "mnt", "Mount point path"),
+    string("fstype", "fstype", "Filesystem type"),
+    num("sizemb", "sizemb", "Filesystem size MB"),
+    num("freemb", "freemb", "Free space MB (unprivileged avail)"),
+    num("usedpct", "usedpct", "Space used %%"),
+    num("inodepct", "inodepct", "Inodes used %%"),
+    boolean("netfs", "netfs", "Network filesystem (nfs/cifs/…)"),
+)
+
+# ------------------------------------------------------------------ netif
+# ref NET_IF_HDLR (gy_netif.h:708): interface inventory + rates
+NETIF_FIELDS = (
+    num("hostid", "hostid", "Reporting host id"),
+    string("name", "name", "Interface name"),
+    num("speedmbps", "speedmbps", "Link speed Mbps (-1 unknown)"),
+    num("rxmbsec", "rxmbsec", "Receive MB/s"),
+    num("txmbsec", "txmbsec", "Transmit MB/s"),
+    num("rxerrsec", "rxerrsec", "Receive errors/s"),
+    num("txerrsec", "txerrsec", "Transmit errors/s"),
+    boolean("up", "up", "Operationally up"),
 )
 
 # ---------------------------------------------------------- svcdependency
@@ -626,6 +655,8 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_TRACEUNIQ: TRACEUNIQ_FIELDS,
     SUBSYS_TRACECONN: TRACECONN_FIELDS,
     SUBSYS_TAGS: TAGS_FIELDS,
+    SUBSYS_MOUNTSTATE: MOUNTSTATE_FIELDS,
+    SUBSYS_NETIF: NETIF_FIELDS,
     SUBSYS_EXTACTIVECONN: EXTACTIVECONN_FIELDS,
     SUBSYS_EXTCLIENTCONN: EXTCLIENTCONN_FIELDS,
     SUBSYS_EXTTRACEREQ: EXTTRACEREQ_FIELDS,

@@ -99,11 +99,13 @@ class Runtime:
         self.names = InternTable()
         from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
         from gyeeta_tpu.utils.hostreg import CgroupRegistry, \
-            HostInfoRegistry
+            HostInfoRegistry, MountRegistry, NetIfRegistry
         from gyeeta_tpu.utils.natreg import NatClusterRegistry
         self.svcreg = SvcInfoRegistry()
         self.hostinfo = HostInfoRegistry()
         self.cgroups = CgroupRegistry()
+        self.mounts = MountRegistry()
+        self.netifs = NetIfRegistry()
         self.natclusters = NatClusterRegistry()
         from gyeeta_tpu.utils.traceconnreg import TraceConnRegistry
         self.traceconns = TraceConnRegistry()
@@ -129,6 +131,8 @@ class Runtime:
             "exttracereq": lambda: self._ext_join("tracereq"),
             "hostinfo": lambda: self.hostinfo.columns(self.names),
             "cgroupstate": lambda: self.cgroups.columns(self.names),
+            "mountstate": lambda: self.mounts.columns(self.names),
+            "netif": lambda: self.netifs.columns(self.names),
             "alerts": lambda: AC.alerts_columns(self.alerts),
             "alertdef": lambda: AC.alertdef_columns(self.alerts),
             "silences": lambda: AC.silences_columns(self.alerts),
@@ -226,6 +230,14 @@ class Runtime:
             elif kind == "cgroup":
                 self.stats.bump("cgroup_records",
                                 self.cgroups.update(chunks[0]))
+                n += len(chunks[0])
+            elif kind == "mount":
+                self.stats.bump("mount_records",
+                                self.mounts.update(chunks[0]))
+                n += len(chunks[0])
+            elif kind == "netif":
+                self.stats.bump("netif_records",
+                                self.netifs.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "names":
                 # names don't count into n (not telemetry events) but
@@ -338,6 +350,8 @@ class Runtime:
         self.stats.gauge("tick", tick)
         self.dep = self._dep_age(self.dep, tick)
         self.cgroups.age()
+        self.mounts.age()
+        self.netifs.age()
         self.natclusters.age()
         self.traceconns.age()
 

@@ -198,3 +198,151 @@ class CgroupRegistry:
         out = (cols, np.ones(n, bool))
         self._cache = ((ver, self._sweep), out)
         return out
+
+
+class MountRegistry:
+    """Keyed by (host_id, mnt_id); same sweep-ageing discipline as
+    :class:`CgroupRegistry` (MOUNT_HDLR capability server-side)."""
+
+    def __init__(self, max_age: int = 24):
+        self._by_key: dict[tuple[int, int], dict] = {}
+        self._cache = None
+        self._sweep = 0
+        self.max_age = max_age
+
+    def update(self, recs: np.ndarray) -> int:
+        if len(recs):
+            self._cache = None
+        for r in recs:
+            self._by_key[(int(r["host_id"]), int(r["mnt_id"]))] = {
+                "dir_id": int(r["dir_id"]),
+                "fstype_id": int(r["fstype_id"]),
+                "size_mb": float(r["size_mb"]),
+                "free_mb": float(r["free_mb"]),
+                "used_pct": float(r["used_pct"]),
+                "inodes_used_pct": float(r["inodes_used_pct"]),
+                "is_network_fs": bool(r["is_network_fs"]),
+                "sweep": self._sweep,
+            }
+        return len(recs)
+
+    def age(self) -> int:
+        self._sweep += 1
+        dead = [k for k, v in self._by_key.items()
+                if self._sweep - v["sweep"] > self.max_age]
+        for k in dead:
+            del self._by_key[k]
+        if dead:
+            self._cache = None
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def columns(self, names=None):
+        from gyeeta_tpu.ingest import wire
+
+        ver = getattr(names, "version", None)
+        if self._cache is not None and self._cache[0] == (ver,
+                                                          self._sweep):
+            return self._cache[1]
+        keys = sorted(self._by_key)
+        rows = [self._by_key[k] for k in keys]
+        n = len(keys)
+
+        def num(key):
+            return np.array([r[key] for r in rows], np.float64)
+
+        def resolve(idkey):
+            ids = np.array([r[idkey] for r in rows], np.uint64)
+            if names is None:
+                return np.array([format(i, "016x") for i in ids],
+                                object)
+            return names.resolve_array(wire.NAME_KIND_MISC, ids)
+
+        cols = {
+            "hostid": np.array([h for h, _ in keys], np.float64),
+            "mnt": resolve("dir_id"),
+            "fstype": resolve("fstype_id"),
+            "sizemb": num("size_mb"),
+            "freemb": num("free_mb"),
+            "usedpct": num("used_pct"),
+            "inodepct": num("inodes_used_pct"),
+            "netfs": np.array([r["is_network_fs"] for r in rows],
+                              bool),
+        }
+        out = (cols, np.ones(n, bool))
+        self._cache = ((ver, self._sweep), out)
+        return out
+
+
+class NetIfRegistry:
+    """Keyed by (host_id, if_id); NET_IF_HDLR capability server-side."""
+
+    def __init__(self, max_age: int = 24):
+        self._by_key: dict[tuple[int, int], dict] = {}
+        self._cache = None
+        self._sweep = 0
+        self.max_age = max_age
+
+    def update(self, recs: np.ndarray) -> int:
+        if len(recs):
+            self._cache = None
+        for r in recs:
+            self._by_key[(int(r["host_id"]), int(r["if_id"]))] = {
+                "name_id": int(r["name_id"]),
+                "speed_mbps": float(r["speed_mbps"]),
+                "rx_mb_sec": float(r["rx_mb_sec"]),
+                "tx_mb_sec": float(r["tx_mb_sec"]),
+                "rx_errs_sec": float(r["rx_errs_sec"]),
+                "tx_errs_sec": float(r["tx_errs_sec"]),
+                "is_up": bool(r["is_up"]),
+                "sweep": self._sweep,
+            }
+        return len(recs)
+
+    def age(self) -> int:
+        self._sweep += 1
+        dead = [k for k, v in self._by_key.items()
+                if self._sweep - v["sweep"] > self.max_age]
+        for k in dead:
+            del self._by_key[k]
+        if dead:
+            self._cache = None
+        return len(dead)
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def columns(self, names=None):
+        from gyeeta_tpu.ingest import wire
+
+        ver = getattr(names, "version", None)
+        if self._cache is not None and self._cache[0] == (ver,
+                                                          self._sweep):
+            return self._cache[1]
+        keys = sorted(self._by_key)
+        rows = [self._by_key[k] for k in keys]
+        n = len(keys)
+
+        def num(key):
+            return np.array([r[key] for r in rows], np.float64)
+
+        ids = np.array([r["name_id"] for r in rows], np.uint64)
+        if names is None:
+            ifnames = np.array([format(i, "016x") for i in ids], object)
+        else:
+            ifnames = names.resolve_array(wire.NAME_KIND_MISC, ids)
+        cols = {
+            "hostid": np.array([h for h, _ in keys], np.float64),
+            "name": ifnames,
+            "speedmbps": num("speed_mbps"),
+            "rxmbsec": num("rx_mb_sec"),
+            "txmbsec": num("tx_mb_sec"),
+            "rxerrsec": num("rx_errs_sec"),
+            "txerrsec": num("tx_errs_sec"),
+            "up": np.array([r["is_up"] for r in rows], bool),
+        }
+        out = (cols, np.ones(n, bool))
+        self._cache = ((ver, self._sweep), out)
+        return out

@@ -104,3 +104,71 @@ def test_collect_agent_end_to_end():
         await srv.stop()
 
     asyncio.run(main())
+
+
+def test_mount_and_netif_collectors_real():
+    """Mount + interface collectors read THIS box (ref MOUNT_HDLR /
+    NET_IF_HDLR capabilities, gy_mount_disk.h:233 / gy_netif.h:708)."""
+    import time as _time
+
+    from gyeeta_tpu.net.collect import MountCollector, NetIfCollector
+
+    m = MountCollector(host_id=2)
+    recs, names = m.sample()
+    assert len(recs) >= 1                  # at least the root fs
+    assert (recs["size_mb"] > 0).all()
+    assert ((recs["used_pct"] >= 0) & (recs["used_pct"] <= 100)).all()
+    n = NetIfCollector(host_id=2)
+    n.sample()                             # baseline
+    _time.sleep(0.2)
+    nrecs, nnames = n.sample()
+    assert len(nrecs) >= 1                 # at least lo
+    assert (nrecs["rx_mb_sec"] >= 0).all()
+    assert len(nnames) >= 1
+
+
+def test_mount_netif_end_to_end():
+    """collect-mode agent streams mount/netif sweeps; mountstate and
+    netif subsystems answer over the wire with this box's real data."""
+    import asyncio
+
+    from gyeeta_tpu.net import GytServer, NetAgent, QueryClient
+    from gyeeta_tpu.runtime import Runtime
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=128, task_capacity=128,
+                    conn_batch=64, resp_batch=64, listener_batch=64,
+                    fold_k=2)
+
+    async def run():
+        rt = Runtime(cfg)
+        srv = GytServer(rt, tick_interval=None)
+        host, port = await srv.start()
+        agent = NetAgent(collect=True, n_svcs=2, n_groups=2)
+        try:
+            await agent.connect(host, port)
+            await agent.send_sweep(n_conn=64, n_resp=64)
+            await asyncio.sleep(0.3)
+            await agent.send_sweep(n_conn=64, n_resp=64)
+            await asyncio.sleep(0.1)
+            rt.flush()
+            qc = QueryClient()
+            await qc.connect(host, port)
+            mnt = await qc.query({"subsys": "mountstate",
+                                  "sortcol": "usedpct"})
+            nif = await qc.query({"subsys": "netif", "sortcol": "name",
+                                  "sortdesc": False})
+            await qc.close()
+            return mnt, nif
+        finally:
+            await agent.close()
+            await srv.stop()
+
+    mnt, nif = asyncio.run(run())
+    assert mnt["nrecs"] >= 1
+    r = mnt["recs"][0]
+    assert r["mnt"].startswith("/") and r["fstype"]
+    assert 0 <= r["usedpct"] <= 100
+    assert nif["nrecs"] >= 1
+    assert any(x["name"] == "lo" for x in nif["recs"])
