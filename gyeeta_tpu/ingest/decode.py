@@ -221,6 +221,25 @@ _LISTENER_STAT_FIELDS = (
 )
 
 
+def take_raw(lst: list, want: int, dtype) -> np.ndarray:
+    """Pop up to ``want`` records off a raw-record-array backlog (the
+    slab staging discipline shared by both runtimes)."""
+    out, got = [], 0
+    while lst and got < want:
+        a = lst[0]
+        take = min(len(a), want - got)
+        if take == len(a):
+            lst.pop(0)
+        else:
+            lst[0] = a[take:]
+            a = a[:take]
+        out.append(a)
+        got += take
+    if not out:
+        return np.empty(0, dtype)
+    return out[0] if len(out) == 1 else np.concatenate(out)
+
+
 def _pad(a: np.ndarray, size: int, fill=0):
     out = np.full((size,) + a.shape[1:], fill, a.dtype)
     out[: len(a)] = a[:size]

@@ -365,8 +365,11 @@ _NETWORK_FS = {"nfs", "nfs4", "cifs", "smbfs", "glusterfs", "cephfs",
 _SKIP_FS = {"proc", "sysfs", "devtmpfs", "devpts", "tmpfs", "cgroup",
             "cgroup2", "securityfs", "debugfs", "tracefs", "configfs",
             "pstore", "bpf", "mqueue", "hugetlbfs", "autofs", "ramfs",
-            "binfmt_misc", "fusectl", "rpc_pipefs", "overlay",
+            "binfmt_misc", "fusectl", "rpc_pipefs",
             "squashfs", "nsfs", "efivarfs"}
+# NOTE: overlay is NOT skipped — a containerized agent's rootfs is
+# overlayfs and filling its writable layer is exactly the disk-full
+# signal mount monitoring exists for
 
 
 class MountCollector:
@@ -380,6 +383,8 @@ class MountCollector:
         self.max_mounts = max_mounts
 
     def sample(self) -> tuple[np.ndarray, np.ndarray]:
+        from gyeeta_tpu.utils import hashing as H
+
         rows, names = [], []
         seen = set()
         for line in _read("/proc/self/mounts").splitlines():
@@ -414,7 +419,6 @@ class MountCollector:
             r = np.zeros((), wire.MOUNT_DT)
             dir_id = InternTable.intern(mnt, wire.NAME_KIND_MISC)
             fs_id = InternTable.intern(fstype, wire.NAME_KIND_MISC)
-            from gyeeta_tpu.utils import hashing as H
             r["mnt_id"] = H.hash_bytes_np(
                 f"{dev}:{mnt}".encode()) or 1
             r["dir_id"], r["fstype_id"] = dir_id, fs_id
@@ -462,9 +466,15 @@ class NetIfCollector:
         dt = max(now - self._t_prev, 1e-3) if self._t_prev else 0.0
         self._t_prev = now
         try:
-            ifs = sorted(os.listdir("/sys/class/net"))[: self.max_ifs]
+            allifs = sorted(os.listdir("/sys/class/net"))
         except OSError:
-            ifs = []
+            allifs = []
+        # physical interfaces FIRST under the cap: a k8s node's 100+
+        # veth/cali* names must never crowd out the real uplink
+        phys = [i for i in allifs
+                if os.path.exists(f"/sys/class/net/{i}/device")]
+        rest = [i for i in allifs if i not in set(phys)]
+        ifs = (phys + rest)[: self.max_ifs]
         rows, names = [], []
         from gyeeta_tpu.utils import hashing as H
         for ifname in ifs:

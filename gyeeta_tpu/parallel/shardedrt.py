@@ -84,6 +84,13 @@ class ShardedRuntime:
         self._t_started = self._clock()
         self._tick_no = 0
         self._pending = b""
+        # conn/resp slab staging (same discipline as the single-node
+        # runtime): raw record arrays accumulate and route+decode+fold
+        # as ONE wide per-shard dispatch per fold_k·B records
+        self._conn_raw: list = []
+        self._resp_raw: list = []
+        self._n_conn_raw = 0
+        self._n_resp_raw = 0
 
         self.state = sharded.init_sharded(self.cfg, self.mesh)
         shd = leading_sharding(self.mesh)
@@ -112,6 +119,11 @@ class ShardedRuntime:
             self.cfg, self.mesh, self.opts.api_max_age_ticks)
         self._dep_step = dg.dep_step_fn(
             self.mesh, cap_per_dest=self.cfg.conn_batch)
+        # slab-width dep step: the a2a capacity scales with the wider
+        # dispatch so a burst of one-sided halves isn't dropped
+        self._dep_slab = dg.dep_step_fn(
+            self.mesh,
+            cap_per_dest=self.cfg.conn_batch * self.cfg.fold_k)
         self._rollup = rollup.rollup_fn(self.cfg, self.mesh)
         self._edge_roll = dg.edge_rollup_fn(
             self.mesh, out_capacity=self.opts.dep_edge_capacity)
@@ -182,27 +194,31 @@ class ShardedRuntime:
         self._pending = data[consumed:]
         n = 0
         self._cols.bump()
-        # a chunk of B global records may route up to B lanes onto one
-        # shard, so the shared plan's global lane-size chunking is safe
+        # conn/resp hot path: stage RAW record arrays; a full slab
+        # (fold_k microbatches' worth) routes + decodes + folds as ONE
+        # wide per-shard dispatch (the single-node slab discipline)
+        conn = recs.pop(wire.NOTIFY_TCP_CONN, None)
+        if conn is not None and len(conn):
+            self.natclusters.observe_conns(conn)
+            self._conn_raw.append(conn)
+            self._n_conn_raw += len(conn)
+            self.stats.bump("conn_events", len(conn))
+            n += len(conn)
+        resp = recs.pop(wire.NOTIFY_RESP_SAMPLE, None)
+        if resp is not None and len(resp):
+            self._resp_raw.append(resp)
+            self._n_resp_raw += len(resp)
+            self.stats.bump("resp_events", len(resp))
+            n += len(resp)
+        slab_c = self.cfg.fold_k * self.cfg.conn_batch
+        slab_r = self.cfg.fold_k * self.cfg.resp_batch
+        while (self._n_conn_raw >= slab_c
+               or self._n_resp_raw >= slab_r):
+            self._dispatch_slab(slab_c, slab_r)
         for kind, *chunks in decode.drain_chunks(
                 recs, self.cfg.conn_batch, self.cfg.resp_batch,
                 self.cfg.listener_batch):
-            if kind == "connresp":
-                cchunk, rchunk = chunks
-                if len(cchunk):
-                    self.natclusters.observe_conns(cchunk)
-                cbs = self._stack(decode.conn_batch_fast, cchunk,
-                                  self.cfg.conn_batch)
-                rbs = self._stack(decode.resp_batch, rchunk,
-                                  self.cfg.resp_batch)
-                self.state = self._fold(self.state, cbs, rbs)
-                self._td_dirty = True
-                self.dep = self._dep_step(self.dep, cbs,
-                                          np.int32(self._tick_no))
-                self.stats.bump("conn_events", len(cchunk))
-                self.stats.bump("resp_events", len(rchunk))
-                n += len(cchunk) + len(rchunk)
-            elif kind == "listener":
+            if kind == "listener":
                 self.state = self._fold_lst(self.state, self._stack(
                     decode.listener_batch, chunks[0],
                     self.cfg.listener_batch))
@@ -252,6 +268,34 @@ class ShardedRuntime:
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
         return n
+
+    def _dispatch_slab(self, lanes_c: int, lanes_r: int) -> None:
+        """Route + decode + fold up to a slab of staged raw records in
+        one wide per-shard dispatch (worst-case routing skew means the
+        per-shard lane count equals the whole take)."""
+        crecs = decode.take_raw(self._conn_raw, lanes_c,
+                                wire.TCP_CONN_DT)
+        rrecs = decode.take_raw(self._resp_raw, lanes_r,
+                                wire.RESP_SAMPLE_DT)
+        self._n_conn_raw -= len(crecs)
+        self._n_resp_raw -= len(rrecs)
+        cbs = self._stack(decode.conn_batch_fast, crecs, lanes_c)
+        rbs = self._stack(decode.resp_batch, rrecs, lanes_r)
+        self.state = self._fold(self.state, cbs, rbs)
+        self._td_dirty = True
+        dep_fn = self._dep_slab if lanes_c > self.cfg.conn_batch \
+            else self._dep_step
+        self.dep = dep_fn(self.dep, cbs, np.int32(self._tick_no))
+
+    def flush(self) -> int:
+        """Fold staged raw leftovers (chunk-width dispatches) — state
+        is fully query-ready afterwards. Called at every tick/query
+        boundary."""
+        folded = self._n_conn_raw + self._n_resp_raw
+        while self._n_conn_raw or self._n_resp_raw:
+            self._dispatch_slab(self.cfg.conn_batch,
+                                self.cfg.resp_batch)
+        return folded
 
     # ---------------------------------------------------- merged columns
     @staticmethod
@@ -508,7 +552,10 @@ class ShardedRuntime:
 
     # ------------------------------------------------------------ cadence
     def _ensure_td_flushed(self) -> None:
-        """Digest stages must compress before any quantile readback."""
+        """Digest stages must compress before any quantile readback
+        (and staged raw records must fold first — they're invisible to
+        queries otherwise)."""
+        self.flush()
         if self._td_dirty:
             self.state = self._td_flush(self.state)
             self._td_dirty = False
@@ -570,6 +617,7 @@ class ShardedRuntime:
 
     def rollup_stats(self) -> dict:
         """Replicated cluster totals (the MS_CLUSTER_STATE analogue)."""
+        self.flush()          # staged slab records must count
         ru = self._rollup(self.state)
         return {
             "n_conn": float(ru.n_conn), "n_resp": float(ru.n_resp),
