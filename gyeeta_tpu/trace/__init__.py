@@ -41,3 +41,8 @@ PARSER_OF_PROTO = {
     PROTO_TLS: TlsParser,
     PROTO_SYBASE: SybaseParser,
 }
+
+# AFTER the registry: pcapfile consumes PARSER_OF_PROTO at import
+from gyeeta_tpu.trace.pcapfile import (  # noqa: E402,F401
+    FlowConversation, PcapError, parse_pcap,
+)

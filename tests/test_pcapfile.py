@@ -1,0 +1,194 @@
+"""pcap-file ingestion: crafted captures → flows → parsed transactions.
+
+Fixtures build classic-pcap bytes in-test (global header + Ethernet/
+IPv4/TCP frames) carrying real HTTP and Postgres conversations, with
+retransmits, VLAN tags and out-of-order delivery — the offline face of
+the reference's pcap engine."""
+
+from __future__ import annotations
+
+import struct
+
+import pytest
+
+from gyeeta_tpu.trace import PROTO_HTTP1, PROTO_POSTGRES
+from gyeeta_tpu.trace.pcapfile import PcapError, parse_pcap
+
+
+def _pcap_header(nsec=False, linktype=1):
+    magic = 0xA1B23C4D if nsec else 0xA1B2C3D4
+    return struct.pack("<IHHiIII", magic, 2, 4, 0, 0, 65535, linktype)
+
+
+def _eth_ip_tcp(src, sport, dst, dport, seq, payload=b"", flags=0x18,
+                vlan=False):
+    eth = b"\xaa" * 6 + b"\xbb" * 6
+    if vlan:
+        eth += struct.pack(">HH", 0x8100, 42)
+    eth += struct.pack(">H", 0x0800)
+    tcp = struct.pack(">HHIIBBHHH", sport, dport, seq, 0, 5 << 4,
+                      flags, 65535, 0, 0) + payload
+    ip = struct.pack(">BBHHHBBH4s4s", 0x45, 0, 20 + len(tcp), 1, 0,
+                     64, 6, 0, src, dst)
+    return eth + ip + tcp
+
+
+def _rec(t_us, frame):
+    return struct.pack("<IIII", t_us // 1_000_000, t_us % 1_000_000,
+                       len(frame), len(frame)) + frame
+
+
+CLI, SER = b"\x0a\x00\x00\x01", b"\x0a\x00\x00\x02"
+
+
+def _http_capture(vlan=False, with_syn=True, retransmit=False):
+    req = (b"GET /api/users/123 HTTP/1.1\r\nHost: x\r\n"
+           b"Content-Length: 0\r\n\r\n")
+    resp = (b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok")
+    frames = []
+    t = 1_700_000_000_000_000
+    if with_syn:
+        frames.append(_rec(t, _eth_ip_tcp(CLI, 40000, SER, 80, 100,
+                                          flags=0x02, vlan=vlan)))
+    # request split across two segments, delivered OUT OF ORDER
+    frames.append(_rec(t + 2000, _eth_ip_tcp(
+        CLI, 40000, SER, 80, 101 + 10, req[10:], vlan=vlan)))
+    frames.append(_rec(t + 1000, _eth_ip_tcp(
+        CLI, 40000, SER, 80, 101, req[:10], vlan=vlan)))
+    if retransmit:
+        frames.append(_rec(t + 2500, _eth_ip_tcp(
+            CLI, 40000, SER, 80, 101, req[:10], vlan=vlan)))
+    frames.append(_rec(t + 50_000, _eth_ip_tcp(
+        SER, 80, CLI, 40000, 500, resp, vlan=vlan)))
+    return _pcap_header() + b"".join(frames)
+
+
+def test_http_conversation_parsed():
+    flows = parse_pcap(_http_capture())
+    assert len(flows) == 1
+    f = flows[0]
+    assert f.proto == PROTO_HTTP1
+    assert f.cli == (CLI, 40000) and f.ser == (SER, 80)
+    (t,) = f.transactions
+    assert t.api == "GET /api/users/{}"
+    assert t.status == 200 and not t.is_error
+    assert t.resp_usec == 48_000          # response ts - request ts
+
+
+def test_retransmit_and_vlan_and_synless():
+    # retransmitted segment must not duplicate bytes into the parser
+    (f,) = parse_pcap(_http_capture(retransmit=True))
+    assert f.transactions[0].api == "GET /api/users/{}"
+    # VLAN-tagged frames parse
+    (fv,) = parse_pcap(_http_capture(vlan=True))
+    assert fv.transactions[0].status == 200
+    # capture started mid-conversation (no SYN): direction falls back
+    # to ports + protocol detection
+    (fs,) = parse_pcap(_http_capture(with_syn=False))
+    assert fs.cli == (CLI, 40000)
+    assert fs.transactions[0].api == "GET /api/users/{}"
+
+
+def test_postgres_conversation_parsed():
+    startup = struct.pack(">II", 8, 196608)
+    sql = b"select * from foo;\x00"
+    q = b"Q" + struct.pack(">I", 4 + len(sql)) + sql
+    rfq = b"Z" + struct.pack(">I", 5) + b"I"
+    t = 1_700_000_000_000_000
+    frames = [
+        _rec(t, _eth_ip_tcp(CLI, 51000, SER, 5432, 1, startup)),
+        _rec(t + 10, _eth_ip_tcp(CLI, 51000, SER, 5432,
+                                 1 + len(startup), q)),
+        _rec(t + 30_000, _eth_ip_tcp(SER, 5432, CLI, 51000, 900, rfq)),
+    ]
+    (f,) = parse_pcap(_pcap_header() + b"".join(frames))
+    assert f.proto == PROTO_POSTGRES
+    (txn,) = f.transactions
+    assert txn.api.startswith("select * from foo")
+    assert txn.resp_usec == 29_990
+
+
+def test_bad_magic_and_truncation():
+    with pytest.raises(PcapError):
+        parse_pcap(b"\x00" * 64)
+    # a truncated final record is ignored without crashing (here it
+    # holds the only response, so the flow legitimately yields no
+    # completed transactions)
+    buf = _http_capture()
+    assert parse_pcap(buf[:-5]) == []
+    # truncating INSIDE the stream after the response keeps the flow
+    assert parse_pcap(buf + b"\x01\x02\x03")  # garbage tail record hdr
+
+
+def test_transactions_feed_runtime():
+    """pcap → transactions → REQ_TRACE records → tracereq query."""
+    import numpy as np
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.trace.proto import transactions_to_records
+
+    (f,) = parse_pcap(_http_capture())
+    recs, name_recs = transactions_to_records(
+        f.transactions, svc_glob_id=0xABC123, host_id=1)
+    rt = Runtime(EngineCfg(n_hosts=4, svc_capacity=64, conn_batch=64,
+                           resp_batch=64, fold_k=2))
+    rt.feed(wire.encode_frames_chunked(wire.NOTIFY_NAME_INTERN,
+                                       name_recs)
+            + wire.encode_frames_chunked(wire.NOTIFY_REQ_TRACE, recs))
+    rt.run_tick()
+    out = rt.query({"subsys": "tracereq"})
+    assert out["nrecs"] == 1
+    assert out["recs"][0]["api"] == "GET /api/users/{}"
+
+
+def test_true_network_reorder_and_seq_wrap():
+    """Later-seq bytes captured EARLIER still reassemble (monotonized
+    time merge can't undo seq order), and a flow whose sequence space
+    wraps 2^32 mid-request survives unwrapping."""
+    req = (b"GET /api/users/123 HTTP/1.1\r\nHost: x\r\n"
+           b"Content-Length: 0\r\n\r\n")
+    resp = b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"
+    t = 1_700_000_000_000_000
+    # true reorder: tail segment captured BEFORE the head segment
+    frames = [
+        _rec(t + 1000, _eth_ip_tcp(CLI, 40000, SER, 80, 101 + 10,
+                                   req[10:])),
+        _rec(t + 2000, _eth_ip_tcp(CLI, 40000, SER, 80, 101,
+                                   req[:10])),
+        _rec(t + 9000, _eth_ip_tcp(SER, 80, CLI, 40000, 500, resp)),
+    ]
+    (f,) = parse_pcap(_pcap_header() + b"".join(frames))
+    assert f.transactions[0].api == "GET /api/users/{}"
+    # seq wrap: ISN near 2^32, second half wraps past zero
+    isn = 0xFFFFFFF0
+    frames = [
+        _rec(t, _eth_ip_tcp(CLI, 40001, SER, 80, isn, req[:20])),
+        _rec(t + 10, _eth_ip_tcp(CLI, 40001, SER, 80,
+                                 (isn + 20) & 0xFFFFFFFF, req[20:])),
+        _rec(t + 9000, _eth_ip_tcp(SER, 80, CLI, 40001, 500, resp)),
+    ]
+    (f2,) = parse_pcap(_pcap_header() + b"".join(frames))
+    assert f2.transactions[0].api == "GET /api/users/{}"
+
+
+def test_tiny_segment_protocol_detection():
+    """Detection accumulates past 4 segments — a startup message in
+    2-byte segments still classifies as Postgres."""
+    startup = struct.pack(">II", 8, 196608)
+    sql = b"select 1;\x00"
+    q = b"Q" + struct.pack(">I", 4 + len(sql)) + sql
+    rfq = b"Z" + struct.pack(">I", 5) + b"I"
+    t = 1_700_000_000_000_000
+    stream = startup + q
+    frames = [
+        _rec(t + i, _eth_ip_tcp(CLI, 52000, SER, 5432, 1 + i,
+                                stream[i:i + 2]))
+        for i in range(0, len(stream), 2)
+    ]
+    frames.append(_rec(t + 50_000, _eth_ip_tcp(SER, 5432, CLI, 52000,
+                                               900, rfq)))
+    (f,) = parse_pcap(_pcap_header() + b"".join(frames))
+    assert f.proto == PROTO_POSTGRES
+    assert f.transactions[0].api.startswith("select $")
