@@ -39,11 +39,18 @@ from gyeeta_tpu.utils import hashing as H
 EMPTY = np.uint32(0xFFFFFFFF)
 TOMB = np.uint32(0xFFFFFFFE)
 
-PROBES = 8  # unrolled double-hash probe rounds
-# Load guidance: with 8 probe positions, inserts start exhausting the
-# chain as occupancy grows — measured ~1.5% dropped at 78% load (see
-# tests/test_scale.py). Size slabs for ≤70% steady-state occupancy;
-# drops are counted in ``n_drop`` and re-sent keys retry next sweep.
+PROBES = 16  # unrolled double-hash probe rounds
+# Load guidance: a key whose probe positions are ALL occupied can never
+# insert — it drops on every retry and permanently defeats the
+# ``upsert_fast`` all-hit fast path (one such key forces the 16-round
+# insert machinery on every dispatch). The permanent-failure odds are
+# ~load^PROBES per key: at 8 probes, 0.5^8 ≈ 0.4% of keys at 50% load
+# (observed in the bench: a stuck key cost ~2.5ms/µbatch forever);
+# at 16 probes it is 0.0015% at 50% and 0.3% at 70%. The lookup cost
+# is one (B, PROBES) gather — doubling probes costs ~1.5% of the fold,
+# the cheapest insurance available. Size slabs for ≤70% steady-state
+# occupancy; drops are counted in ``n_drop`` and re-sent keys retry
+# next sweep.
 
 
 class Table(NamedTuple):
@@ -152,7 +159,7 @@ def upsert_fast(tbl: Table, khi, klo, valid=None):
     resolves — the steady state of the ingest hot loop (service keys
     are long-lived; inserts happen at announce/churn rate, not event
     rate). One probe-match pass decides; ``lax.cond`` executes only the
-    taken branch on TPU, so the 8 unrolled claim rounds (gather +
+    taken branch on TPU, so the PROBES unrolled claim rounds (gather +
     scatter-min winner election per round) cost nothing once the
     working set is resident — the moral equivalent of the reference's
     RCU read-mostly fast path vs its insert slow path

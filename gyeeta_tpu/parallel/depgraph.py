@@ -118,7 +118,7 @@ def fold_edges(dep: DepGraph, cli_hi, cli_lo, cli_svc, ser_hi, ser_lo,
 
     ``upsert_fast``: the edge working set is small and long-lived (one
     row per cli→ser dependency), so after warmup every batch is all-hit
-    and the 8 insert rounds are skipped entirely (``lax.cond``)."""
+    and the insert rounds are skipped entirely (``lax.cond``)."""
     khi, klo = edge_key(cli_hi, cli_lo, ser_hi, ser_lo)
     tbl, rows = table.upsert_fast(dep.edge_tbl, khi, klo, valid=valid)
     ok = valid & (rows >= 0)
@@ -225,7 +225,7 @@ def pair_halves(dep: DepGraph, hv: Halves, tick) -> DepGraph:
     # done row is cleared the same step, so newly-done ≤ B — a bounded
     # nonzero gather covers all of them. (Folding edges with a P-lane
     # valid mask over the whole table was the dominant dep-fold cost:
-    # an 8-round upsert at 65k lanes per step at the default capacity.)
+    # a PROBES-round upsert at 65k lanes per step at the default capacity.)
     D = hv.valid.shape[0]
     idx = jnp.nonzero(done, size=D, fill_value=Pc)[0]
     get = lambda col: col.at[idx].get(mode="fill", fill_value=0)  # noqa: E731

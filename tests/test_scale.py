@@ -91,10 +91,11 @@ def test_northstar_geometry_fits_and_runs():
     print(f"scale: {n_live} live services after full sweep "
           f"({time.perf_counter() - t0:.1f} s), "
           f"{int(np.asarray(st.tbl.n_drop))} dropped", file=sys.stderr)
-    # at 78% load the 8-round double-hash probe drops ~1.5% of inserts
-    # (open-addressing tail; dropped keys are counted, and real
-    # deployments size the slab for ≤70% occupancy — table.py guidance).
-    # conn keys are a subset of the sweep, so the target is 400×128.
+    # at 78% load the 16-round double-hash probe drops ~0.1% of
+    # inserts (was ~1.5% at 8 probes; open-addressing tail — dropped
+    # keys are counted, and real deployments size the slab for ≤70%
+    # occupancy, table.py guidance). conn keys are a subset of the
+    # sweep, so the target is 400×128.
     assert n_live >= int(400 * 128 * 0.98)
     assert n_live + int(np.asarray(st.tbl.n_drop)) >= 400 * 128
 
