@@ -6,8 +6,9 @@ combos = ["", "topk", "tdigest", "topk,tdigest", "upsert",
 for ab in combos:
     env = dict(os.environ, GYT_BENCH_ABLATE=ab, GYT_BENCH_NO_FEED="1")
     p = subprocess.run([sys.executable, "bench.py"], env=env,
-                       capture_output=True, text=True, timeout=900)
-    ms = [l for l in p.stderr.splitlines() if "ms/microbatch" in l]
+                       capture_output=True, text=True, timeout=1800)
+    ms = [l.split("]: ", 1)[-1] for l in p.stderr.splitlines()
+          if "ms/dispatch" in l]
     print(f"{ab or 'FULL':44s} "
-          f"{ms[0].split('(')[-1] if ms else p.stderr[-200:]}",
+          f"{' | '.join(ms) if ms else p.stderr[-200:]}",
           flush=True)

@@ -1,0 +1,22 @@
+"""Scale-sweep wrapper: runs the opt-in north-star geometry test.
+
+Thin driver so `_tpu_watch.py` (and humans) can produce a SCALE artifact
+with one command on whatever platform JAX resolves to. Equivalent to:
+  GYT_SCALE_TEST=1 python -m pytest tests/test_scale.py -x -q -s
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+if __name__ == "__main__":
+    env = dict(os.environ)
+    env["GYT_SCALE_TEST"] = "1"
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_scale.py",
+         "-x", "-q", "-s", "-p", "no:cacheprovider"],
+        cwd=HERE, env=env)
+    sys.exit(r.returncode)

@@ -1,16 +1,19 @@
-"""Round-4 hardened TPU watcher.
+"""Round-5 hardened TPU watcher.
 
 The axon TPU tunnel can wedge so that ``jax.devices()`` blocks forever
-(observed round 3, 7+ hours). VERDICT r3 task 1: probe in a killable
+(observed rounds 3-4, 75+ probes over ~10h all timing out). VERDICT r4
+task 2: keep the watcher armed from minute zero, probe in a killable
 subprocess with retries spread over the whole round, record every
 attempt into an artifact even on failure, and the moment the tunnel
-answers run the bench + ablation on the real chip.
+answers run bench + ablation + SCALE + QUERYLAT on the real chip.
 
 Runs as a single background process (the only TPU-touching process —
-concurrent TPU users are what wedged the tunnel last round). Artifacts:
-  TPU_PROBE_r04.json   — every probe attempt (always written)
-  BENCH_TPU_r04.json   — bench.py JSON line from the real chip
-  ABLATION_r04_tpu.txt — _ablate.py table on the real chip
+concurrent TPU users are what wedged the tunnel in round 3). Artifacts:
+  TPU_PROBE_r05.json   — every probe attempt (always written)
+  BENCH_TPU_r05.json   — bench.py JSON line from the real chip
+  ABLATION_r05_tpu.txt — _ablate.py table on the real chip
+  SCALE_r05_tpu.txt    — scale sweep on the real chip
+  QUERYLAT_r05_tpu.json— query-latency run on the real chip
 """
 from __future__ import annotations
 
@@ -21,13 +24,15 @@ import sys
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-PROBE_ART = os.path.join(HERE, "TPU_PROBE_r04.json")
-BENCH_ART = os.path.join(HERE, "BENCH_TPU_r04.json")
-ABL_ART = os.path.join(HERE, "ABLATION_r04_tpu.txt")
+PROBE_ART = os.path.join(HERE, "TPU_PROBE_r05.json")
+BENCH_ART = os.path.join(HERE, "BENCH_TPU_r05.json")
+ABL_ART = os.path.join(HERE, "ABLATION_r05_tpu.txt")
+SCALE_ART = os.path.join(HERE, "SCALE_r05_tpu.txt")
+QLAT_ART = os.path.join(HERE, "QUERYLAT_r05_tpu.json")
 
 PROBE_TIMEOUT = 150.0
 SLEEP_BETWEEN = 240.0
-MAX_HOURS = float(os.environ.get("GYT_TPU_WATCH_HOURS", "10"))
+MAX_HOURS = float(os.environ.get("GYT_TPU_WATCH_HOURS", "11"))
 
 
 def _write_json(path: str, obj) -> None:
@@ -60,7 +65,7 @@ def run_bench() -> dict | None:
     env.pop("GYT_BENCH_PLATFORM", None)
     try:
         r = subprocess.run([sys.executable, "bench.py"], cwd=HERE, env=env,
-                           capture_output=True, text=True, timeout=1800)
+                           capture_output=True, text=True, timeout=2400)
     except subprocess.TimeoutExpired:
         return None
     line = None
@@ -76,6 +81,26 @@ def run_bench() -> dict | None:
         return {"rc": r.returncode, "raw": line[:2000]}
     obj["bench_stderr"] = (r.stderr or "")[-2000:]
     return obj
+
+
+def _run_to_file(script: str, art: str, timeout: float,
+                 extra_env: dict | None = None) -> None:
+    """Run a python script on the chip, capturing stdout into ``art``."""
+    env = dict(os.environ)
+    env.pop("GYT_BENCH_PLATFORM", None)
+    if extra_env:
+        env.update(extra_env)
+    try:
+        p = subprocess.run([sys.executable, script], cwd=HERE, env=env,
+                           capture_output=True, text=True, timeout=timeout)
+        with open(art, "w") as f:
+            f.write(p.stdout)
+            if p.returncode != 0:
+                f.write("\n--- rc=%d stderr ---\n" % p.returncode)
+                f.write(p.stderr[-4000:])
+    except Exception as e:  # noqa: BLE001
+        with open(art, "w") as f:
+            f.write(f"{script} failed: {e}\n")
 
 
 def main() -> None:
@@ -96,17 +121,15 @@ def main() -> None:
                 print(f"bench done: {res.get('value')} ev/s "
                       f"(vs_baseline {res.get('vs_baseline')})", flush=True)
                 print("running ablation on the chip", flush=True)
-                try:
-                    p = subprocess.run([sys.executable, "_ablate.py"],
-                                       cwd=HERE, capture_output=True,
-                                       text=True, timeout=3600)
-                    with open(ABL_ART, "w") as f:
-                        f.write(p.stdout)
-                        if p.returncode != 0:
-                            f.write("\n" + p.stderr[-2000:])
-                except Exception as e:  # noqa: BLE001
-                    with open(ABL_ART, "w") as f:
-                        f.write(f"ablation failed: {e}\n")
+                _run_to_file("_ablate.py", ABL_ART, 3600)
+                print("running scale sweep on the chip", flush=True)
+                _run_to_file("_scale.py", SCALE_ART, 3600,
+                             extra_env={"GYT_TEST_PLATFORM": "tpu"})
+                print("running query-latency on the chip", flush=True)
+                _run_to_file("_querylat.py", QLAT_ART + ".log", 3600,
+                             extra_env={"GYT_QUERYLAT_PLATFORM": "tpu",
+                                        "GYT_QUERYLAT_ART": QLAT_ART})
+                print("watcher: all on-chip artifacts captured", flush=True)
                 return
             print(f"bench failed despite probe ok: {res}", flush=True)
             _write_json(BENCH_ART, {"bench_failed": True, "detail": res})

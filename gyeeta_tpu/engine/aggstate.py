@@ -67,7 +67,6 @@ class EngineCfg(NamedTuple):
     cms_width: int = 1 << 16
     topk_capacity: int = 512
     td_capacity: int = 64             # per-svc t-digest centroids
-    td_route_cap: int = 64            # per-svc samples folded per step
     # staged-digest buffer: samples accumulate here across a fold_many
     # dispatch (K microbatches) and compress ONCE at its end — the
     # vmapped compression sort is ~80% of the naive fold cost
@@ -77,6 +76,10 @@ class EngineCfg(NamedTuple):
     td_sample_stride: int = 2         # digest duty-cycle: stage 1-in-N
     #                                   resp samples (loghist folds all;
     #                                   ref RESP_SAMPLING ~50% default)
+    td_flush_m: int = 4096            # entities compressed per partial
+    #                                   flush — flush cost is O(m), not
+    #                                   O(capacity); the runtime drains
+    #                                   iteratively under pressure
     conn_batch: int = 2048            # static microbatch lanes
     resp_batch: int = 4096
     listener_batch: int = 512

@@ -108,40 +108,20 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
 def ingest_resp(cfg: EngineCfg, st: AggState, rb) -> AggState:
     """Fold one RespBatch of raw (glob_id, resp_us) samples — the
     single-microbatch path (partial slabs at cadence/query boundaries,
-    sharded per-batch folds). The hot loop uses ``ingest_resp_bulk``.
+    sharded per-batch folds). Identical semantics to the hot loop
+    (``ingest_resp_bulk``): digest samples STAGE; compression happens
+    via the pressure-triggered ``td_flush_partial``/``td_drain``. An
+    earlier inline route-and-compress here vmapped the compression
+    sort over every entity per call — O(capacity), 1.1 s per
+    microbatch at the 65k north-star geometry (the r4 fold collapse).
 
-    Lookup-only, like the bulk path: a response sample never CREATES a
-    service row — services enter the table via conn/listener streams
-    (the reference resolves resp events against listener_tbl_ and drops
-    misses, ``gy_socket_stat.cc`` handle_tcp_resp_event). Unknowns are
-    counted, not folded, so both paths agree regardless of batching.
+    Lookup-only: a response sample never CREATES a service row —
+    services enter the table via conn/listener streams (the reference
+    resolves resp events against listener_tbl_ and drops misses,
+    ``gy_socket_stat.cc`` handle_tcp_resp_event). Unknowns are counted,
+    not folded, so all paths agree regardless of batching.
     """
-    valid = rb.valid
-    rows = table.lookup(st.tbl, rb.svc_hi, rb.svc_lo, valid)
-    ok = valid & (rows >= 0)
-    n_unknown = jnp.sum(valid & (rows < 0)).astype(jnp.float32)
-    rowz = jnp.where(ok, rows, 0)
-    resp_win = st.resp_win
-    if "loghist" not in _ABLATE:
-        cur = loghist.update_entities(
-            st.resp_win.cur, cfg.resp_spec, rowz, rb.resp_us, valid=ok)
-        resp_win = st.resp_win._replace(cur=cur)
-    if "tdigest" in _ABLATE:
-        svc_td, n_over = st.svc_td, jnp.int32(0)
-    else:
-        # same duty-cycle stride as the bulk path — otherwise samples
-        # arriving via partial slabs at cadence/query boundaries carry
-        # stride× the digest weight of hot-loop samples
-        k = max(1, cfg.td_sample_stride)
-        svc_td, n_over = tdigest.update_routed(
-            st.svc_td, jnp.where(ok, rows, -1)[::k], rb.resp_us[::k],
-            route_cap=cfg.td_route_cap)
-    return st._replace(
-        resp_win=resp_win, svc_td=svc_td,
-        n_resp=st.n_resp + jnp.sum(valid).astype(jnp.float32),
-        n_resp_unknown=st.n_resp_unknown + n_unknown,
-        n_td_overflow=st.n_td_overflow + n_over.astype(jnp.float32),
-    )
+    return ingest_resp_flat(cfg, st, rb)
 
 
 def td_flush(cfg: EngineCfg, st: AggState) -> AggState:
@@ -154,14 +134,24 @@ def td_flush(cfg: EngineCfg, st: AggState) -> AggState:
     return st._replace(svc_td=svc_td, td_stage=stage, td_stage_n=stage_n)
 
 
-def td_maybe_flush(cfg: EngineCfg, st: AggState) -> AggState:
-    """Flush the digest stage only when it is running out of headroom
-    (any entity above half capacity) — compression cost amortizes over
-    multiple dispatches; ``lax.cond`` executes one branch on TPU."""
+def td_flush_partial(cfg: EngineCfg, st: AggState) -> AggState:
+    """Compress the ``cfg.td_flush_m`` fullest digest stages and clear
+    them — the hot-loop flush. O(m) per call regardless of capacity;
+    the runtime triggers it from a host-side pressure check instead of
+    an in-graph ``lax.cond`` (a cond carrying the 128 MB stage forced
+    whole-buffer copies every dispatch — measured 110 ms/dispatch at
+    65k capacity even when the branch was NOT taken)."""
     if "tdigest" in _ABLATE:
         return st
-    need = jnp.max(st.td_stage_n) > (cfg.td_stage_cap // 2)
-    return jax.lax.cond(need, lambda s: td_flush(cfg, s), lambda s: s, st)
+    svc_td, stage, stage_n = tdigest.flush_staged_topm(
+        st.svc_td, st.td_stage, st.td_stage_n, cfg.td_flush_m)
+    return st._replace(svc_td=svc_td, td_stage=stage, td_stage_n=stage_n)
+
+
+def stage_pressure(st: AggState):
+    """Max staged-sample count over entities — the host-side flush
+    trigger signal (a () int32; readback is one scalar)."""
+    return jnp.max(st.td_stage_n)
 
 
 def ingest_resp_bulk(cfg: EngineCfg, st: AggState, rbs) -> AggState:
@@ -176,7 +166,8 @@ def ingest_resp_flat(cfg: EngineCfg, st: AggState, flat) -> AggState:
 
     Replaces per-microbatch ``ingest_resp`` calls: one table lookup,
     one loghist scatter-add, one digest staging route (compression
-    amortizes via ``td_maybe_flush``). Unknown services (never
+    amortizes via pressure-triggered ``td_flush_partial``). Unknown
+    services (never
     announced by conn/listener streams) drop and are counted — the
     reference likewise only folds response stats into *known* listeners
     (``gy_socket_stat.cc`` resp events resolve against listener_tbl_).
@@ -442,14 +433,16 @@ def fold_many(cfg: EngineCfg, st: AggState, cbs, rbs) -> AggState:
 
     Response-side work (lookup + loghist + digest staging) is likewise
     one vectorized pass (``ingest_resp_bulk``); digest compression
-    amortizes across dispatches via the persistent stage
-    (``td_maybe_flush``) — the per-microbatch recompression this
-    replaces measured ~80% of the whole fold.
+    amortizes across dispatches via the persistent stage. The flush
+    itself is NOT in this graph: the runtime watches ``stage_pressure``
+    host-side and dispatches ``td_flush_partial`` when the stage runs
+    out of headroom — an in-graph ``lax.cond`` here cost 110 ms per
+    dispatch at 65k capacity (untaken!) from whole-buffer copies at the
+    cond boundary.
     """
     flatc = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), cbs)
     st = ingest_conn(cfg, st, flatc)
-    st = ingest_resp_bulk(cfg, st, rbs)
-    return td_maybe_flush(cfg, st)
+    return ingest_resp_bulk(cfg, st, rbs)
 
 
 def jit_fold_many(cfg: EngineCfg):

@@ -103,7 +103,9 @@ class ShardedRuntime:
 
         self._fold = sharded.fold_step_sharded(self.cfg, self.mesh)
         self._td_flush = sharded.td_flush_sharded(self.cfg, self.mesh)
+        self._td_pressure = sharded.td_pressure_sharded(self.mesh)
         self._td_dirty = False
+        self._pressure = None         # device scalar from last dispatch
         self._fold_lst = sharded.ingest_listener_sharded(self.cfg,
                                                          self.mesh)
         self._fold_host = sharded.ingest_host_sharded(self.cfg, self.mesh)
@@ -281,7 +283,14 @@ class ShardedRuntime:
         self._n_resp_raw -= len(rrecs)
         cbs = self._stack(decode.conn_batch_fast, crecs, lanes_c)
         rbs = self._stack(decode.resp_batch, rrecs, lanes_r)
+        # previous dispatch's pressure scalar is ready by now: flush the
+        # fullest per-shard stages before folding if headroom is low
+        if (self._pressure is not None
+                and int(self._pressure) > self.cfg.td_stage_cap // 2):
+            self.state = self._td_flush(self.state)
+            self.stats.bump("td_partial_flushes")
         self.state = self._fold(self.state, cbs, rbs)
+        self._pressure = self._td_pressure(self.state)
         self._td_dirty = True
         dep_fn = self._dep_slab if lanes_c > self.cfg.conn_batch \
             else self._dep_step
@@ -551,21 +560,33 @@ class ShardedRuntime:
         return cols, np.ones(1, bool)
 
     # ------------------------------------------------------------ cadence
-    def _ensure_td_flushed(self) -> None:
-        """Digest stages must compress before any quantile readback
-        (and staged raw records must fold first — they're invisible to
-        queries otherwise)."""
+    def td_drain(self, max_iters: int | None = None) -> int:
+        """Drain per-shard digest stages with O(m) partial flushes
+        against the global pressure scalar — same host-trigger design
+        as the single-chip runtime (no in-graph cond; see
+        ``Runtime.td_drain``). Unbounded by default; ``run_tick``
+        bounds it to amortize a fully-active slab across ticks. No
+        query subsystem reads the digest, so this is off the <1s
+        query path."""
         self.flush()
-        if self._td_dirty:
+        i = 0
+        while max_iters is None or i < max_iters:
+            if int(self._td_pressure(self.state)) <= 0:
+                self._td_dirty = False
+                self._pressure = None
+                break
             self.state = self._td_flush(self.state)
-            self._td_dirty = False
-            self._cols.bump()
+            self.stats.bump("td_partial_flushes")
+            i += 1
+        return i
 
     def run_tick(self) -> dict:
         """Sharded 5s pass: classify → alerts on merged columns → window
         tick → ageing."""
         report = {}
-        self._ensure_td_flushed()
+        self.flush()
+        if self._td_dirty:    # tick-cadence digest compression (bounded)
+            self.td_drain(max_iters=self.opts.td_drain_iters_per_tick)
         self.state = self._classify(self.state)
         self._cols.bump()
         fired = self.alerts.check(None, columns_fn=self._merged_columns)
@@ -603,7 +624,7 @@ class ShardedRuntime:
             from gyeeta_tpu.utils.selfstats import selfstats_response
             return selfstats_response(self.stats, self.alerts)
         self.stats.bump("queries")
-        self._ensure_td_flushed()
+        self.flush()          # live queries see all staged records
         with self.stats.timeit("query"):
             return api.execute(self.cfg, None, QueryOptions.from_json(req),
                                names=self.names,

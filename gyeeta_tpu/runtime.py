@@ -57,28 +57,55 @@ class Runtime:
         self._td_dirty = False        # digest stage may be non-empty
         from gyeeta_tpu.utils.colcache import ColumnCache
         self._cols = ColumnCache()    # version-keyed snapshot memo
+        # every state→state jit donates its input: without donation XLA
+        # copies the whole AggState per call — 3 GiB ≈ 2 s/dispatch at
+        # north-star geometry (the r4 listener-sweep cost was exactly
+        # this). self.state is always rebound to the result, so the
+        # donated buffers are never read again.
         self._fold = step.jit_fold_step(self.cfg)
         self._fold_lst = jax.jit(
-            lambda s, b: step.ingest_listener(self.cfg, s, b))
+            lambda s, b: step.ingest_listener(self.cfg, s, b),
+            donate_argnums=(0,))
         self._fold_host = jax.jit(
-            lambda s, b: step.ingest_host(self.cfg, s, b))
+            lambda s, b: step.ingest_host(self.cfg, s, b),
+            donate_argnums=(0,))
         self._fold_task = jax.jit(
-            lambda s, b: step.ingest_task(self.cfg, s, b))
+            lambda s, b: step.ingest_task(self.cfg, s, b),
+            donate_argnums=(0,))
         self._fold_cm = jax.jit(
-            lambda s, b: step.ingest_cpumem(self.cfg, s, b))
+            lambda s, b: step.ingest_cpumem(self.cfg, s, b),
+            donate_argnums=(0,))
         self._fold_trace = jax.jit(
-            lambda s, b: step.ingest_trace(self.cfg, s, b))
+            lambda s, b: step.ingest_trace(self.cfg, s, b),
+            donate_argnums=(0,))
         self._age_apis = jax.jit(
             lambda s: step.age_apis(self.cfg, s,
-                                    self.opts.api_max_age_ticks))
+                                    self.opts.api_max_age_ticks),
+            donate_argnums=(0,))
         self._age_tasks = jax.jit(
             lambda s: step.age_tasks(self.cfg, s,
-                                     self.opts.task_max_age_ticks))
+                                     self.opts.task_max_age_ticks),
+            donate_argnums=(0,))
         self._compact_tasks = jax.jit(
-            lambda s: step.compact_tasks(self.cfg, s))
-        self._tick = jax.jit(lambda s: step.tick_5s(self.cfg, s))
-        self._td_flush = jax.jit(lambda s: step.td_flush(self.cfg, s),
-                                 donate_argnums=(0,))
+            lambda s: step.compact_tasks(self.cfg, s),
+            donate_argnums=(0,))
+        self._tick = jax.jit(lambda s: step.tick_5s(self.cfg, s),
+                             donate_argnums=(0,))
+        # digest flush: host-side pressure trigger + O(m) partial flush.
+        # An in-graph lax.cond flush cost 110 ms/dispatch UNTAKEN at 65k
+        # capacity (whole-stage copies at the cond boundary); the full
+        # O(capacity) flush cost 6.2 s there. The pressure scalar from
+        # dispatch N is checked (already materialized) before dispatch
+        # N+1 — no pipeline sync on the hot path.
+        self._td_flush_partial = jax.jit(
+            lambda s: step.td_flush_partial(self.cfg, s),
+            donate_argnums=(0,))
+        self._stage_pressure = jax.jit(step.stage_pressure)
+        from collections import deque
+        # pressure scalars from recent dispatches: checked at lag 2 so
+        # the int() readback never blocks on an in-flight fold (lag 1
+        # would serialize dispatch N+1's launch on N's completion)
+        self._pressures: deque = deque()
         # dependency graph (single-shard slice; the sharded tier keeps its
         # own stacked DepGraph — see parallel/depgraph.py)
         self.dep = dg.init(self.opts.dep_pair_capacity,
@@ -273,19 +300,29 @@ class Runtime:
                                wire.RESP_SAMPLE_DT)
         self._n_conn_raw -= len(crecs)
         self._n_resp_raw -= len(rrecs)
+        # the lag-2 pressure scalar is materialized by now: flush the
+        # fullest stages BEFORE this dispatch if headroom is low
+        if (len(self._pressures) >= 2
+                and int(self._pressures.popleft())
+                > self.cfg.td_stage_cap // 2):
+            self.state = self._td_flush_partial(self.state)
+            self.stats.bump("td_partial_flushes")
         with self.stats.timeit("fold_dispatch"):
             cbs = decode.conn_slab(crecs, K, self.cfg.conn_batch)
             rbs = decode.resp_slab(rrecs, K, self.cfg.resp_batch)
             self.state, self.dep = self._fold_many_dep(
                 self.state, self.dep, cbs, rbs, self._tick_no)
+        self._pressures.append(self._stage_pressure(self.state))
         self._td_dirty = True
         self.stats.bump("slab_dispatches")
 
     def flush(self) -> int:
         """Fold all staged raw records (single-microbatch path when they
-        fit one, padded partial slab otherwise) and compress staged
-        digest samples. Called at every cadence/query boundary — after
-        it, state is fully query-ready. Returns records folded."""
+        fit one, padded partial slab otherwise). Called at every
+        cadence/query boundary — after it, every QUERY view is current
+        (no query subsystem reads the all-time digest; its stage drains
+        on tick cadence / ``td_drain``, off the <1s query path).
+        Returns records folded."""
         n = self._n_conn_raw + self._n_resp_raw
         while self._n_conn_raw or self._n_resp_raw:
             if (self._n_conn_raw <= self.cfg.conn_batch
@@ -301,15 +338,35 @@ class Runtime:
                 rb = decode.resp_batch(rrecs, self.cfg.resp_batch)
                 self.state = self._fold(self.state, cb, rb)
                 self.dep = self._dep_step(self.dep, cb, self._tick_no)
+                self._td_dirty = True     # resp samples staged
             else:
                 self._dispatch_slab()
-        if self._td_dirty:     # digest stage may hold samples from
-            self.state = self._td_flush(self.state)   # fold_many runs
-            self._td_dirty = False
-            self._cols.bump()
         if n:
             self._cols.bump()
         return n
+
+    def td_drain(self, max_iters: int | None = None) -> int:
+        """Drain the digest stage with O(m) partial flushes.
+
+        Iteration count scales with the number of ACTIVE stages (entities
+        holding samples), not capacity — the toy/test case drains in one
+        pass. Unbounded by default (direct ``svc_snapshot`` consumers
+        want exact digests); ``run_tick`` passes a bound to amortize the
+        north-star worst case (every entity active) across ticks —
+        overflowing stages drop + count, and the loghist remains the
+        lossless estimator, mirroring the reference's ~50% response
+        sampling (``common/gy_ebpf.h:29``). Returns flushes run."""
+        self.flush()
+        i = 0
+        while max_iters is None or i < max_iters:
+            if int(self._stage_pressure(self.state)) <= 0:
+                self._td_dirty = False
+                self._pressures.clear()
+                break
+            self.state = self._td_flush_partial(self.state)
+            self.stats.bump("td_partial_flushes")
+            i += 1
+        return i
 
     # ------------------------------------------------------------ cadence
     def run_tick(self) -> dict:
@@ -320,6 +377,8 @@ class Runtime:
         """Close one 5s window: classify → alerts → windows tick →
         maintenance cadences. Returns a tick report."""
         self.flush()
+        if self._td_dirty:    # tick-cadence digest compression (bounded)
+            self.td_drain(max_iters=self.opts.td_drain_iters_per_tick)
         report = {}
         self.state = self._classify(self.state)
         self._cols.bump()             # classify + tick mutate views
@@ -586,7 +645,10 @@ class Runtime:
         self._pending = b""
         self._cols.bump()
         self._cols.clear()
-        self._td_dirty = False
+        # the checkpoint may carry a non-empty digest stage (per-tick
+        # drains are bounded): mark dirty so the tick cadence drains it
+        self._td_dirty = True
+        self._pressures.clear()
         self.state, extra = ckpt.restore(path, self.cfg, self.state)
         # the dep graph is not checkpointed: reset it (edges rebuild from
         # live traffic) and realign the host tick mirror so TTL deltas

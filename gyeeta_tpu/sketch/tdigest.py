@@ -88,54 +88,6 @@ def update(sk: TDigest, values, valid=None) -> TDigest:
     )
 
 
-def update_routed(sk: TDigest, rows, values, valid=None, route_cap: int = 128):
-    """Per-entity batched update: fold B samples into S per-entity digests.
-
-    ``sk`` has entity shape (S, C); ``rows``: (B,) int32 target entity row
-    (<0 = drop); ``values``: (B,) float32. Samples are routed into a dense
-    (S, route_cap) staging tensor (sort by row + position-in-segment
-    scatter), then every entity recompresses centroids+samples in one vmapped
-    pass. Fixed-shape → jits; per-entity per-step overflow beyond
-    ``route_cap`` is dropped and returned as a count (callers keep the
-    loghist path as the lossless-count estimator; north-star configs #3/#5
-    need 1k+ per-service digests — this is that path).
-
-    Returns (new_digest, n_overflow).
-    """
-    S, C = sk.means.shape
-    B = rows.shape[0]
-    vals = values.astype(jnp.float32)
-    ok = rows >= 0
-    if valid is not None:
-        ok = ok & valid
-    rows_ok = jnp.where(ok, rows, S)            # S = drop lane
-    order = jnp.argsort(rows_ok)
-    r_s = rows_ok[order]
-    v_s = vals[order]
-    lane = jnp.arange(B, dtype=jnp.int32)
-    first = jnp.concatenate([jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
-    seg_start = jax.lax.cummax(jnp.where(first, lane, 0))
-    pos = lane - seg_start                      # position within entity
-    keep = (r_s < S) & (pos < route_cap)
-    n_overflow = jnp.sum((r_s < S) & (pos >= route_cap)).astype(jnp.int32)
-    tgt_row = jnp.where(keep, r_s, S)
-    tgt_pos = jnp.where(keep, pos, 0)
-    stage_v = jnp.zeros((S + 1, route_cap), jnp.float32)
-    stage_w = jnp.zeros((S + 1, route_cap), jnp.float32)
-    stage_v = stage_v.at[tgt_row, tgt_pos].set(v_s, mode="drop")
-    stage_w = stage_w.at[tgt_row, tgt_pos].set(
-        jnp.where(keep, 1.0, 0.0), mode="drop")
-    all_m = jnp.concatenate([sk.means, stage_v[:S]], axis=-1)
-    all_w = jnp.concatenate([sk.weights, stage_w[:S]], axis=-1)
-    new_m, new_w = jax.vmap(_compress, in_axes=(0, 0, None))(all_m, all_w, C)
-    vmin = sk.vmin.at[tgt_row].min(
-        jnp.where(keep, v_s, jnp.inf), mode="drop")
-    vmax = sk.vmax.at[tgt_row].max(
-        jnp.where(keep, v_s, -jnp.inf), mode="drop")
-    return TDigest(means=new_m, weights=new_w, vmin=vmin, vmax=vmax), \
-        n_overflow
-
-
 def stage_samples(stage_v, stage_n, rows, values, valid=None):
     """Append a batch of per-entity samples into a (S, cap) staging
     buffer WITHOUT compressing — the amortization half of the buffered
@@ -194,6 +146,50 @@ def flush_staged(sk: TDigest, stage_v, stage_n):
         vmin=jnp.minimum(sk.vmin, v_for_min.min(axis=-1)),
         vmax=jnp.maximum(sk.vmax, v_for_max.max(axis=-1)),
     ), jnp.zeros_like(stage_v), jnp.zeros_like(stage_n)
+
+
+def flush_staged_topm(sk: TDigest, stage_v, stage_n, m: int):
+    """Partial flush: compress only the ``m`` entities with the fullest
+    stages — cost O(m·(C+cap)·log) instead of O(S·(C+cap)·log).
+
+    The full ``flush_staged`` vmaps the compression sort over EVERY
+    entity row even when almost all stages are empty; at north-star
+    geometry (S=65k) that is a ~38M-element sort per flush — measured
+    6.2 s on one CPU core and the dominant term of the r4 fold collapse
+    (VERDICT r4 weak #3). Entities outside the top-m keep their staged
+    samples (nothing is lost); callers drain iteratively or let
+    pressure re-trigger. Selection by ``lax.top_k`` over the fill
+    counts; rows with zero staged samples pass through untouched.
+
+    Returns (new_digest, stage_v, stage_n) with the flushed rows' stage
+    cleared.
+    """
+    S, C = sk.means.shape
+    cap = stage_v.shape[1]
+    m = min(m, S)
+    nsel, idx = jax.lax.top_k(stage_n, m)              # (m,)
+    occ = jnp.arange(cap)[None, :] < nsel[:, None]     # (m, cap)
+    sel_means = sk.means[idx]
+    sel_weights = sk.weights[idx]
+    sel_stage = stage_v[idx]
+    all_m = jnp.concatenate([sel_means, sel_stage], axis=-1)
+    all_w = jnp.concatenate([sel_weights, occ.astype(jnp.float32)],
+                            axis=-1)
+    new_m, new_w = jax.vmap(_compress, in_axes=(0, 0, None))(all_m, all_w,
+                                                             C)
+    # empty-stage rows: recompression is a no-op in value but not in
+    # centroid layout — keep the original row bit-for-bit instead
+    has = nsel > 0
+    new_m = jnp.where(has[:, None], new_m, sel_means)
+    new_w = jnp.where(has[:, None], new_w, sel_weights)
+    v_for_min = jnp.where(occ, sel_stage, jnp.inf)
+    v_for_max = jnp.where(occ, sel_stage, -jnp.inf)
+    return TDigest(
+        means=sk.means.at[idx].set(new_m),
+        weights=sk.weights.at[idx].set(new_w),
+        vmin=sk.vmin.at[idx].min(v_for_min.min(axis=-1)),
+        vmax=sk.vmax.at[idx].max(v_for_max.max(axis=-1)),
+    ), stage_v.at[idx].set(0.0), stage_n.at[idx].set(0)
 
 
 def merge(a: TDigest, b: TDigest) -> TDigest:

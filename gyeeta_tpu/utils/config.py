@@ -22,7 +22,7 @@ ENV_PREFIX = "GYT_"
 # EngineCfg ints settable via cfg file/env; loghist specs via *_vmin etc.
 _INT_FIELDS = {"svc_capacity", "n_hosts", "hll_p_svc", "hll_p_global",
                "cms_depth", "cms_width", "topk_capacity", "td_capacity",
-               "td_route_cap", "conn_batch", "resp_batch",
+               "conn_batch", "resp_batch",
                "listener_batch", "fold_k", "task_capacity"}
 
 
@@ -38,6 +38,11 @@ class RuntimeOpts(NamedTuple):
     api_max_age_ticks: int = 360            # evict idle (svc,api) rows 30m
     debug_level: int = 0                    # hot-reloadable
     resp_sample_pct: float = 100.0          # hot-reloadable duty cycle
+    td_drain_iters_per_tick: int = 2        # bounded digest compression
+    #                                         per tick (O(td_flush_m)
+    #                                         each); overflow drops are
+    #                                         counted, loghist stays the
+    #                                         lossless percentile path
     # dependency graph (parallel/depgraph.py): slab sizes + TTLs
     # in-flight unpaired halves: sized so one flattened fold_k-deep
     # dispatch of one-sided halves (fold_k × conn_batch = 32768 by

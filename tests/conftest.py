@@ -7,7 +7,10 @@ import os
 
 # Force-override: the driver environment pins JAX_PLATFORMS to the TPU
 # backend; tests must run on the virtual CPU mesh regardless.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# GYT_TEST_PLATFORM lets the TPU watcher run the opt-in scale geometry
+# on the real chip (single-device tests only — mesh tests need 8).
+_PLAT = os.environ.get("GYT_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _PLAT
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -28,7 +31,8 @@ import pytest
 # The axon TPU plugin's sitecustomize calls jax.config.update("jax_platforms",
 # "axon,cpu") at interpreter start, which outranks the JAX_PLATFORMS env var —
 # force the virtual CPU platform back explicitly (before any backend init).
-jax.config.update("jax_platforms", "cpu")
+if _PLAT == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture

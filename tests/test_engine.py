@@ -21,7 +21,7 @@ def cfg():
         svc_capacity=64, n_hosts=8,
         resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
         hll_p_svc=6, hll_p_global=10, cms_depth=2, cms_width=1 << 10,
-        topk_capacity=64, td_capacity=32, td_route_cap=32,
+        topk_capacity=64, td_capacity=32,
         td_sample_stride=1,     # digest every sample: this module checks
         #                         sketch accuracy, not sampling policy
         conn_batch=128, resp_batch=256, listener_batch=64)
@@ -46,6 +46,9 @@ def folded(cfg):
         resps.append(rdec)
         st = fold(st, decode.conn_batch(cdec, cfg.conn_batch),
                   decode.resp_batch(rdec, cfg.resp_batch))
+    # digest samples stage during folds; compress before readback
+    # (runtime does this on tick cadence / td_drain)
+    st = jax.jit(lambda s: step.td_flush(cfg, s))(st)
     jax.block_until_ready(st)
     return st, np.concatenate(conns), np.concatenate(resps)
 

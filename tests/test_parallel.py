@@ -26,7 +26,7 @@ def cfg():
         svc_capacity=32, n_hosts=16,
         resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=32),
         hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
-        topk_capacity=16, td_capacity=8, td_route_cap=8,
+        topk_capacity=16, td_capacity=8,
         conn_batch=32, resp_batch=32, listener_batch=32)
 
 

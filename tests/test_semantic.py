@@ -123,7 +123,7 @@ def cfg():
         svc_capacity=32, n_hosts=8,
         resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
         hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
-        topk_capacity=16, td_capacity=16, td_route_cap=16,
+        topk_capacity=16, td_capacity=16,
         conn_batch=64, resp_batch=4096, listener_batch=32)
 
 
