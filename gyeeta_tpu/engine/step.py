@@ -276,7 +276,20 @@ def ingest_trace(cfg: EngineCfg, st: AggState, tb) -> AggState:
     """Fold a TraceBatch into the per-(svc, api) slab: counters +
     response-time loghist (the REQ_TRACE_TRAN fan-in aggregation,
     ``gy_comm_proto.h:3288`` — per-API latency sketches, north-star
-    config #5)."""
+    config #5).
+
+    Also upserts the SERVICE row: a parsed server-side transaction is
+    direct evidence of a live listener (stronger than a resp sample,
+    which stays lookup-only) — so trace-only sources (pcap files,
+    traced conns without a listener stream) still materialize svcstate
+    rows for the trace→resp bridge to land on."""
+    svc_tbl, svc_rows = table.upsert_fast(st.tbl, tb.svc_hi, tb.svc_lo,
+                                          tb.valid)
+    svc_ok = tb.valid & (svc_rows >= 0)
+    svc_host = st.svc_host.at[
+        jnp.where(svc_ok, svc_rows, cfg.svc_capacity)].set(
+        tb.host_id, mode="drop")
+    st = st._replace(tbl=svc_tbl, svc_host=svc_host)
     valid = tb.valid
     tbl, rows = table.upsert(st.api_tbl, tb.key_hi, tb.key_lo, valid)
     ok = valid & (rows >= 0)

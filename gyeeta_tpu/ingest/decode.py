@@ -452,6 +452,24 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
         yield ("names", nm)
 
 
+def resp_from_trace(recs: np.ndarray) -> np.ndarray:
+    """REQ_TRACE records → RESP_SAMPLE records (the trace→resp bridge).
+
+    Every parsed transaction carries a measured request→response
+    latency; replaying it into the per-service response stream makes
+    the svcstate loghist/t-digest percentiles measure REAL latencies
+    wherever traces exist (pcap files, traced conns, stock-partha
+    streams) — the role of the reference's eBPF response probes
+    (``partha/gy_ebpf_kernel.bpf.c:836-931`` feeding
+    ``common/gy_socket_stat.cc:1554``), with the protocol parser as
+    the observation point instead of a kprobe."""
+    out = np.zeros(len(recs), wire.RESP_SAMPLE_DT)
+    out["glob_id"] = recs["svc_glob_id"]
+    out["resp_usec"] = recs["resp_usec"]
+    out["host_id"] = recs["host_id"]
+    return out
+
+
 def trace_batch(recs: np.ndarray, size: int = wire.MAX_TRACE_PER_BATCH
                 ) -> TraceBatch:
     n = _check_fit(recs, size)

@@ -33,14 +33,29 @@ from gyeeta_tpu.ingest import wire
 from gyeeta_tpu.utils.intern import InternTable
 
 # ----------------------------------------------------- reference constants
+REF_MAGIC_PS = 0x05555505        # PS_ADHOC_MAGIC (partha → shyama)
 REF_MAGIC_PM = 0x05666605        # PM_HDR_MAGIC (partha → madhava)
 REF_MAGICS = {0x05555505, 0x05666605, 0x05777705, 0x05888805}
 
-REF_COMM_EVENT_NOTIFY = 14       # COMM_TYPE_E
+# COMM_TYPE_E (gy_comm_proto.h:124)
+REF_COMM_PS_REGISTER_REQ = 2
+REF_COMM_PM_CONNECT_CMD = 3
+REF_COMM_PS_REGISTER_RESP = 8
+REF_COMM_PM_CONNECT_RESP = 9
+REF_COMM_EVENT_NOTIFY = 14
 
+REF_NOTIFY_TASK_TOP_PROCS = 0x303
+REF_NOTIFY_NEW_LISTENER = 0x307
 REF_NOTIFY_LISTENER_STATE = 0x309
 REF_NOTIFY_TCP_CONN = 0x30C
 REF_NOTIFY_AGGR_TASK_STATE = 0x310
+REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
+
+# version encoding: get_version_from_string("a.b.c", 3) = a<<16|b<<8|c
+REF_COMM_VERSION = 1             # COMM_VERSION_NUM (gy_comm_proto.h:16)
+REF_MIN_PARTHA_VERSION = 0x000400   # "0.4.0" (server/sversion.cc:15)
+REF_MADHAVA_VERSION = 0x000502      # presented version (≥ partha's
+#                                     gmin_madhava_version "0.5.0")
 
 AF_INET, AF_INET6 = 2, 10
 
@@ -121,6 +136,127 @@ REF_AGGR_TASK_DT = np.dtype([
     ("tailpad", "u1", (2,)),
 ])
 assert REF_AGGR_TASK_DT.itemsize == 72
+
+# NEW_LISTENER fixed part (gy_comm_proto.h:1531); cmdline_len_ bytes of
+# cmdline + padding_len_ bytes follow each record. ns_ip_port_ is
+# NS_IP_PORT (gy_inet_inc.h:105): IP_PORT + the listener netns inode.
+REF_NEW_LISTENER_DT = np.dtype([
+    ("ns_ip_port", REF_IP_PORT_DT), ("inode", "<u8"),
+    ("glob_id", "<u8"), ("aggr_glob_id", "<u8"),
+    ("related_listen_id", "<u8"), ("tstart_usec", "<u8"),
+    ("ser_aggr_task_id", "<u8"),
+    ("is_any_ip", "u1"), ("is_pre_existing", "u1"),
+    ("no_aggr_stats", "u1"), ("no_resp_stats", "u1"),
+    ("comm", "S16"), ("start_pid", "<i4"),
+    ("cmdline_len", "<u2"), ("padding_len", "u1"),
+    ("tailpad", "u1", (5,)),
+])
+assert REF_NEW_LISTENER_DT.itemsize == 112
+
+# ACTIVE_CONN_STATS (gy_comm_proto.h:2766) — fixed-size aggregate of one
+# (listener, client process-group) pair's active traffic
+REF_ACTIVE_CONN_DT = np.dtype([
+    ("listener_glob_id", "<u8"), ("cli_aggr_task_id", "<u8"),
+    ("ser_comm", "S16"), ("cli_comm", "S16"),
+    ("machid_hi", "<u8"), ("machid_lo", "<u8"),
+    ("remote_madhava_id", "<u8"),
+    ("bytes_sent", "<u8"), ("bytes_received", "<u8"),
+    ("cli_delay_msec", "<u4"), ("ser_delay_msec", "<u4"),
+    ("max_rtt_msec", "<f4"),
+    ("active_conns", "<u2"),
+    ("connflags", "u1"),          # bit0 cli_listener_proc, bit1
+    #                               is_remote_listen, bit2 is_remote_cli
+    ("tailpad", "u1", (1,)),
+])
+assert REF_ACTIVE_CONN_DT.itemsize == 104
+
+# TASK_TOP_PROCS (gy_comm_proto.h:1415): one 16B header then four
+# variable-count arrays of fixed-size entries
+REF_TOP_HDR_DT = np.dtype([
+    ("nprocs", "<u2"), ("npg_procs", "<u2"), ("nrss_procs", "<u2"),
+    ("nfork_procs", "<u2"), ("ext_data_len", "<u2"),
+    ("tailpad", "u1", (6,)),
+])
+REF_TOP_TASK_DT = np.dtype([
+    ("aggr_task_id", "<u8"), ("pid", "<i4"), ("ppid", "<i4"),
+    ("rss_mb", "<u4"), ("cpupct", "<f4"), ("comm", "S16"),
+])
+REF_TOP_PG_DT = np.dtype([
+    ("aggr_task_id", "<u8"), ("pg_pid", "<i4"), ("cpid", "<i4"),
+    ("ntasks", "<i4"), ("tot_rss_mb", "<u4"), ("tot_cpupct", "<f4"),
+    ("pg_comm", "S16"), ("child_comm", "S16"), ("tailpad", "u1", (4,)),
+])
+REF_TOP_FORK_DT = np.dtype([
+    ("aggr_task_id", "<u8"), ("pid", "<i4"), ("ppid", "<i4"),
+    ("nfork_per_sec", "<i4"), ("comm", "S16"), ("tailpad", "u1", (4,)),
+])
+assert REF_TOP_HDR_DT.itemsize == 16
+assert REF_TOP_TASK_DT.itemsize == 40
+assert REF_TOP_PG_DT.itemsize == 64
+assert REF_TOP_FORK_DT.itemsize == 40
+
+# ------------------------------------------------ registration handshake
+# PS_REGISTER_REQ_S (gy_comm_proto.h:584) — partha's opener to shyama
+REF_PS_REGISTER_REQ_DT = np.dtype([
+    ("comm_version", "<u4"), ("partha_version", "<u4"),
+    ("min_shyama_version", "<u4"), ("pad0", "u1", (4,)),
+    ("machine_id_hi", "<u8"), ("machine_id_lo", "<u8"),
+    ("hostname", "S256"), ("write_access_key", "S64"),
+    ("cluster_name", "S64"), ("region_name", "S64"),
+    ("zone_name", "S64"),
+    ("kern_version_num", "<u4"), ("pad1", "u1", (4,)),
+    ("curr_sec", "<i8"), ("last_mdisconn_sec", "<i8"),
+    ("last_madhava_id", "<u8"), ("flags", "<u8"),
+    ("extra_bytes", "u1", (512,)),
+])
+assert REF_PS_REGISTER_REQ_DT.itemsize == 1096
+
+# PS_REGISTER_RESP_S (gy_comm_proto.h:616) — points partha at a madhava
+REF_PS_REGISTER_RESP_DT = np.dtype([
+    ("error_code", "<i4"), ("error_string", "S256"),
+    ("comm_version", "<u4"), ("shyama_version", "<u4"),
+    ("pad0", "u1", (4,)),
+    ("shyama_id", "<u8"), ("flags", "<u8"),
+    ("partha_ident_key", "<u8"), ("madhava_expiry_sec", "<i8"),
+    ("madhava_id", "<u8"), ("madhava_port", "<u2"),
+    ("madhava_hostname", "S256"), ("madhava_name", "S64"),
+    ("extra_bytes", "u1", (800,)), ("tailpad", "u1", (6,)),
+])
+assert REF_PS_REGISTER_RESP_DT.itemsize == 1440
+
+# PM_CONNECT_CMD_S (gy_comm_proto.h:648) — partha's opener to madhava
+REF_PM_CONNECT_CMD_DT = np.dtype([
+    ("comm_version", "<u4"), ("partha_version", "<u4"),
+    ("min_madhava_version", "<u4"), ("pad0", "u1", (4,)),
+    ("machine_id_hi", "<u8"), ("machine_id_lo", "<u8"),
+    ("partha_ident_key", "<u8"),
+    ("hostname", "S256"), ("write_access_key", "S64"),
+    ("cluster_name", "S64"), ("region_name", "S64"),
+    ("zone_name", "S64"),
+    ("madhava_id", "<u8"), ("cli_type", "<u4"),
+    ("kern_version_num", "<u4"),
+    ("curr_sec", "<i8"), ("clock_sec", "<i8"),
+    ("process_uptime_sec", "<i8"), ("last_connect_sec", "<i8"),
+    ("flags", "<u8"), ("extra_bytes", "u1", (512,)),
+])
+assert REF_PM_CONNECT_CMD_DT.itemsize == 1120
+
+# PM_CONNECT_RESP_S (gy_comm_proto.h:691)
+REF_PM_CONNECT_RESP_DT = np.dtype([
+    ("error_code", "<i4"), ("error_string", "S256"),
+    ("pad0", "u1", (4,)),
+    ("madhava_id", "<u8"), ("comm_version", "<u4"),
+    ("madhava_version", "<u4"),
+    ("region_name", "S64"), ("zone_name", "S64"),
+    ("madhava_name", "S64"),
+    ("curr_sec", "<i8"), ("clock_sec", "<u8"), ("flags", "<u8"),
+    ("extra_bytes", "u1", (512,)),
+])
+assert REF_PM_CONNECT_RESP_DT.itemsize == 1008
+
+# CLI_TYPE_E (gy_comm_proto.h:91)
+REF_CLI_TYPE_REQ_RESP = 0
+REF_CLI_TYPE_REQ_ONLY = 1
 
 _HSZ = REF_HEADER_DT.itemsize
 _ESZ = REF_EVENT_NOTIFY_DT.itemsize
@@ -283,13 +419,366 @@ def decode_aggr_task(payload: bytes, nevents: int, host_id: int
     return out, names
 
 
+def decode_new_listener(payload: bytes, nevents: int, host_id: int
+                        ) -> tuple[np.ndarray, list]:
+    """NEW_LISTENER walk → GYT LISTENER_INFO records (the svcinfo
+    registry feed) + intern entries for comm/cmdline strings."""
+    fsz = REF_NEW_LISTENER_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 2048, "new_listener")
+    out = np.zeros(nevents, wire.LISTENER_INFO_DT)
+    names: list = []
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"new_listener record {i} truncated")
+        rec = np.frombuffer(payload, REF_NEW_LISTENER_DT, count=1,
+                            offset=off)[0]
+        cmdlen = int(rec["cmdline_len"])
+        end = off + fsz + cmdlen + int(rec["padding_len"])
+        if end > len(payload):
+            raise RefFrameError(f"new_listener record {i} overflows")
+        r = out[i]
+        _copy_ip_port(r["addr"], rec["ns_ip_port"])
+        for f in ("glob_id", "related_listen_id", "is_any_ip"):
+            r[f] = rec[f]
+        r["tusec_start"] = rec["tstart_usec"]
+        r["pid"] = rec["start_pid"]
+        comm = rec["comm"].tobytes().split(b"\x00", 1)[0].decode(
+            "utf-8", "replace")
+        if comm:
+            nid = InternTable.intern(comm, wire.NAME_KIND_COMM)
+            r["comm_id"] = nid
+            names.append((wire.NAME_KIND_COMM, nid, comm))
+        if cmdlen:
+            # NAME_KIND_COMM: the kind svcinfo resolves cmdline_id
+            # through (utils/svcreg.py:93), same as the GYT agent
+            cmdline = payload[off + fsz: off + fsz + cmdlen].split(
+                b"\x00", 1)[0].decode("utf-8", "replace")
+            nid = InternTable.intern(cmdline, wire.NAME_KIND_COMM)
+            r["cmdline_id"] = nid
+            names.append((wire.NAME_KIND_COMM, nid, cmdline))
+        r["host_id"] = host_id
+        off = end
+    return out, names
+
+
+def decode_active_conn(payload: bytes, nevents: int, host_id: int
+                       ) -> tuple[np.ndarray, list]:
+    """ACTIVE_CONN_STATS → synthetic GYT TCP_CONN records.
+
+    Each reference record aggregates one (listener, client
+    process-group) pair's live traffic; the engine's conn fold keys
+    flows by 5-tuple, so the synthetic record carries a flow identity
+    derived from (listener_glob_id, cli_aggr_task_id, remote machine)
+    — unique and STABLE per pair, so repeated stats for the same pair
+    hit the same flow slot (bytes accumulate; the distinct-client HLL
+    counts each pair once, matching the reference's per-pair
+    aggregation in its activeconn tables)."""
+    fsz = REF_ACTIVE_CONN_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 2048, "active_conn_stats")
+    recs = np.frombuffer(payload, REF_ACTIVE_CONN_DT, count=nevents)
+    out = np.zeros(nevents, wire.TCP_CONN_DT)
+    names: list = []
+    out["ser_glob_id"] = recs["listener_glob_id"]
+    out["cli_task_aggr_id"] = recs["cli_aggr_task_id"]
+    out["bytes_sent"] = recs["bytes_sent"]
+    out["bytes_rcvd"] = recs["bytes_received"]
+    out["peer_machine_id_hi"] = recs["machid_hi"]
+    out["peer_machine_id_lo"] = recs["machid_lo"]
+    out["ser_madhava_id"] = recs["remote_madhava_id"]
+    # synthetic flow identity: mix the pair ids into the client
+    # address bytes + port so decode.conn_batch's flow key is unique
+    # per (svc, cli-group, remote machine) and repeatable
+    cli_aggr = np.ascontiguousarray(recs["cli_aggr_task_id"])
+    mix = np.ascontiguousarray(
+        recs["listener_glob_id"]
+        ^ np.uint64(0x9E3779B97F4A7C15) * cli_aggr
+        ^ recs["machid_lo"])
+    ip = out["cli"]["ip"]
+    ip[:, 0:8] = mix.view(np.uint8).reshape(-1, 8)
+    ip[:, 8:16] = cli_aggr.view(np.uint8).reshape(-1, 8)
+    out["cli"]["port"] = (mix & np.uint64(0xFFFF)).astype(np.uint16)
+    out["ser"]["port"] = 1
+    # server-side observation unless the listener itself is remote
+    is_remote_listen = (recs["connflags"] & 2) != 0
+    out["flags"] = np.where(is_remote_listen, 0, 2)   # is_accept bit
+    out["host_id"] = host_id
+    for i in range(nevents):
+        for f in ("ser_comm", "cli_comm"):
+            s = recs[i][f].tobytes().split(b"\x00", 1)[0].decode(
+                "utf-8", "replace")
+            if s:
+                nid = InternTable.intern(s, wire.NAME_KIND_COMM)
+                out[i]["ser_comm_id" if f == "ser_comm"
+                       else "cli_comm_id"] = nid
+                names.append((wire.NAME_KIND_COMM, nid, s))
+    return out, names
+
+
+def decode_task_top_procs(payload: bytes, nevents: int, host_id: int
+                          ) -> tuple[np.ndarray, list]:
+    """TASK_TOP_PROCS → GYT AGGR_TASK_STATE records.
+
+    The reference sends top-N CPU / process-group / RSS / fork-rate
+    slices per host; GYT's topcpu/toppgcpu/toprss/topfork subsystems
+    are sort presets over the task slab, so the slices fold as task
+    records (cpu%/rss from the top lists, fork rate from the fork
+    list) and the views come out the same way the host-collector path
+    produces them (``net/taskproc.py``)."""
+    hsz = REF_TOP_HDR_DT.itemsize
+    rows: list = []
+    names: list = []
+    off = 0
+    for i in range(nevents):
+        if off + hsz > len(payload):
+            raise RefFrameError(f"task_top_procs {i} truncated")
+        hdr = np.frombuffer(payload, REF_TOP_HDR_DT, count=1,
+                            offset=off)[0]
+        np_, npg, nrss, nfork = (int(hdr["nprocs"]),
+                                 int(hdr["npg_procs"]),
+                                 int(hdr["nrss_procs"]),
+                                 int(hdr["nfork_procs"]))
+        need = (hsz + (np_ + nrss) * REF_TOP_TASK_DT.itemsize
+                + npg * REF_TOP_PG_DT.itemsize
+                + nfork * REF_TOP_FORK_DT.itemsize)
+        # caps are the reference's TASK_MAX_*_N (gy_comm_proto.h:1418);
+        # ext_data_len_ is defined as exactly the four arrays' bytes
+        # (TASK_TOP_PROCS::validate, gy_comm_proto.cc:677) — a nonzero
+        # mismatch means a layout drift we must not guess through
+        ext = int(hdr["ext_data_len"])
+        if np_ > 15 or npg > 10 or nrss > 8 or nfork > 5 \
+                or off + need > len(payload) \
+                or (ext and ext != need - hsz):
+            raise RefFrameError(f"task_top_procs {i} overflows")
+        o = off + hsz
+        top = np.frombuffer(payload, REF_TOP_TASK_DT, count=np_,
+                            offset=o)
+        o += np_ * REF_TOP_TASK_DT.itemsize
+        pg = np.frombuffer(payload, REF_TOP_PG_DT, count=npg, offset=o)
+        o += npg * REF_TOP_PG_DT.itemsize
+        rss = np.frombuffer(payload, REF_TOP_TASK_DT, count=nrss,
+                            offset=o)
+        o += nrss * REF_TOP_TASK_DT.itemsize
+        fork = np.frombuffer(payload, REF_TOP_FORK_DT, count=nfork,
+                             offset=o)
+        off = off + need
+        # group-id keyed merge: one task record per distinct aggr id
+        acc: dict = {}
+
+        def _merge(aid, comm, cpupct=0.0, rss_mb=0, ntasks=1,
+                   forks=0.0):
+            a = acc.setdefault(int(aid), dict(
+                comm=comm, cpupct=0.0, rss_mb=0, ntasks=0, forks=0.0))
+            a["cpupct"] = max(a["cpupct"], float(cpupct))
+            a["rss_mb"] = max(a["rss_mb"], int(rss_mb))
+            a["ntasks"] = max(a["ntasks"], int(ntasks))
+            a["forks"] = max(a["forks"], float(forks))
+        for t in top:
+            _merge(t["aggr_task_id"], t["comm"], t["cpupct"],
+                   t["rss_mb"])
+        for t in pg:
+            _merge(t["aggr_task_id"], t["pg_comm"], t["tot_cpupct"],
+                   t["tot_rss_mb"], t["ntasks"])
+        for t in rss:
+            _merge(t["aggr_task_id"], t["comm"], t["cpupct"],
+                   t["rss_mb"])
+        for t in fork:
+            _merge(t["aggr_task_id"], t["comm"],
+                   forks=t["nfork_per_sec"])
+        for aid, a in acc.items():
+            r = np.zeros(1, wire.AGGR_TASK_DT)[0]
+            r["aggr_task_id"] = aid
+            comm = a["comm"].tobytes().split(b"\x00", 1)[0].decode(
+                "utf-8", "replace") if a["comm"] is not None else ""
+            if comm:
+                nid = InternTable.intern(comm, wire.NAME_KIND_COMM)
+                r["comm_id"] = nid
+                names.append((wire.NAME_KIND_COMM, nid, comm))
+            r["total_cpu_pct"] = a["cpupct"]
+            r["rss_mb"] = a["rss_mb"]
+            r["ntasks_total"] = max(a["ntasks"], 1)
+            r["forks_sec"] = a["forks"]
+            r["host_id"] = host_id
+            rows.append(r)
+    out = np.array(rows, wire.AGGR_TASK_DT) if rows \
+        else np.empty(0, wire.AGGR_TASK_DT)
+    return out, names
+
+
 _DECODER_OF = {
     REF_NOTIFY_TCP_CONN: (decode_tcp_conn, wire.NOTIFY_TCP_CONN),
     REF_NOTIFY_LISTENER_STATE: (decode_listener_state,
                                 wire.NOTIFY_LISTENER_STATE),
     REF_NOTIFY_AGGR_TASK_STATE: (decode_aggr_task,
                                  wire.NOTIFY_AGGR_TASK_STATE),
+    REF_NOTIFY_NEW_LISTENER: (decode_new_listener,
+                              wire.NOTIFY_LISTENER_INFO),
+    REF_NOTIFY_ACTIVE_CONN_STATS: (decode_active_conn,
+                                   wire.NOTIFY_TCP_CONN),
+    REF_NOTIFY_TASK_TOP_PROCS: (decode_task_top_procs,
+                                wire.NOTIFY_AGGR_TASK_STATE),
 }
+
+
+# ------------------------------------------------ registration handshake
+def _cstr(rec_field) -> str:
+    return rec_field.tobytes().split(b"\x00", 1)[0].decode(
+        "utf-8", "replace")
+
+
+def parse_ps_register_req(body: bytes) -> dict:
+    """PS_REGISTER_REQ_S payload → field dict (raises on short body)."""
+    if len(body) < REF_PS_REGISTER_REQ_DT.itemsize:
+        raise RefFrameError("short PS_REGISTER_REQ_S")
+    r = np.frombuffer(body, REF_PS_REGISTER_REQ_DT, count=1)[0]
+    return {
+        "comm_version": int(r["comm_version"]),
+        "partha_version": int(r["partha_version"]),
+        "min_shyama_version": int(r["min_shyama_version"]),
+        "machine_id_hi": int(r["machine_id_hi"]),
+        "machine_id_lo": int(r["machine_id_lo"]),
+        "hostname": _cstr(r["hostname"]),
+        "cluster_name": _cstr(r["cluster_name"]),
+        "region_name": _cstr(r["region_name"]),
+        "zone_name": _cstr(r["zone_name"]),
+        "kern_version_num": int(r["kern_version_num"]),
+        "last_madhava_id": int(r["last_madhava_id"]),
+    }
+
+
+def parse_pm_connect_cmd(body: bytes) -> dict:
+    """PM_CONNECT_CMD_S payload → field dict."""
+    if len(body) < REF_PM_CONNECT_CMD_DT.itemsize:
+        raise RefFrameError("short PM_CONNECT_CMD_S")
+    r = np.frombuffer(body, REF_PM_CONNECT_CMD_DT, count=1)[0]
+    return {
+        "comm_version": int(r["comm_version"]),
+        "partha_version": int(r["partha_version"]),
+        "min_madhava_version": int(r["min_madhava_version"]),
+        "machine_id_hi": int(r["machine_id_hi"]),
+        "machine_id_lo": int(r["machine_id_lo"]),
+        "partha_ident_key": int(r["partha_ident_key"]),
+        "hostname": _cstr(r["hostname"]),
+        "cluster_name": _cstr(r["cluster_name"]),
+        "madhava_id": int(r["madhava_id"]),
+        "cli_type": int(r["cli_type"]),
+    }
+
+
+def _ref_frame(data_type: int, payload: np.ndarray, magic: int) -> bytes:
+    hdr = np.zeros(1, REF_HEADER_DT)
+    hdr[0]["magic"] = magic
+    hdr[0]["total_sz"] = _HSZ + payload.nbytes
+    hdr[0]["data_type"] = data_type
+    return hdr.tobytes() + payload.tobytes()
+
+
+def encode_ps_register_resp(error_code: int, error_string: str,
+                            madhava_hostname: str, madhava_port: int,
+                            partha_ident_key: int, madhava_id: int,
+                            curr_sec: int) -> bytes:
+    """Byte-exact PS_REGISTER_RESP_S frame (the shyama reply that
+    points the partha at its madhava — here: ourselves)."""
+    r = np.zeros(1, REF_PS_REGISTER_RESP_DT)
+    v = r[0]
+    v["error_code"] = error_code
+    v["error_string"] = error_string.encode()[:255]
+    v["comm_version"] = REF_COMM_VERSION
+    v["shyama_version"] = REF_MADHAVA_VERSION
+    v["shyama_id"] = madhava_id ^ 0x5359414D41       # distinct role id
+    v["partha_ident_key"] = partha_ident_key
+    v["madhava_expiry_sec"] = curr_sec + 900
+    v["madhava_id"] = madhava_id
+    v["madhava_port"] = madhava_port
+    v["madhava_hostname"] = madhava_hostname.encode()[:255]
+    v["madhava_name"] = b"gyt-tpu"
+    return _ref_frame(REF_COMM_PS_REGISTER_RESP, r, REF_MAGIC_PS)
+
+
+def encode_pm_connect_resp(error_code: int, error_string: str,
+                           madhava_id: int, curr_sec: int) -> bytes:
+    """Byte-exact PM_CONNECT_RESP_S frame."""
+    r = np.zeros(1, REF_PM_CONNECT_RESP_DT)
+    v = r[0]
+    v["error_code"] = error_code
+    v["error_string"] = error_string.encode()[:255]
+    v["madhava_id"] = madhava_id
+    v["comm_version"] = REF_COMM_VERSION
+    v["madhava_version"] = REF_MADHAVA_VERSION
+    v["madhava_name"] = b"gyt-tpu"
+    v["curr_sec"] = curr_sec
+    v["clock_sec"] = curr_sec
+    return _ref_frame(REF_COMM_PM_CONNECT_RESP, r, REF_MAGIC_PM)
+
+
+def encode_ps_register_req(machine_id_hi: int, machine_id_lo: int,
+                           hostname: str = "parthahost",
+                           partha_version: int = 0x000501,
+                           comm_version: int = REF_COMM_VERSION,
+                           curr_sec: int = 0) -> bytes:
+    """Synthesized stock-partha PS_REGISTER_REQ_S (fixture source —
+    what partha/gy_paconnhdlr.cc:1730 sends)."""
+    r = np.zeros(1, REF_PS_REGISTER_REQ_DT)
+    v = r[0]
+    v["comm_version"] = comm_version
+    v["partha_version"] = partha_version
+    v["min_shyama_version"] = 0x000500
+    v["machine_id_hi"] = machine_id_hi
+    v["machine_id_lo"] = machine_id_lo
+    v["hostname"] = hostname.encode()[:255]
+    v["cluster_name"] = b"cluster0"
+    v["curr_sec"] = curr_sec
+    return _ref_frame(REF_COMM_PS_REGISTER_REQ, r, REF_MAGIC_PS)
+
+
+def encode_pm_connect_cmd(machine_id_hi: int, machine_id_lo: int,
+                          partha_ident_key: int, madhava_id: int,
+                          hostname: str = "parthahost",
+                          partha_version: int = 0x000501,
+                          comm_version: int = REF_COMM_VERSION,
+                          min_madhava_version: int = 0x000500,
+                          cli_type: int = REF_CLI_TYPE_REQ_ONLY,
+                          curr_sec: int = 0) -> bytes:
+    """Synthesized stock-partha PM_CONNECT_CMD_S."""
+    r = np.zeros(1, REF_PM_CONNECT_CMD_DT)
+    v = r[0]
+    v["comm_version"] = comm_version
+    v["partha_version"] = partha_version
+    v["min_madhava_version"] = min_madhava_version
+    v["machine_id_hi"] = machine_id_hi
+    v["machine_id_lo"] = machine_id_lo
+    v["partha_ident_key"] = partha_ident_key
+    v["hostname"] = hostname.encode()[:255]
+    v["cluster_name"] = b"cluster0"
+    v["madhava_id"] = madhava_id
+    v["cli_type"] = cli_type
+    v["curr_sec"] = curr_sec
+    return _ref_frame(REF_COMM_PM_CONNECT_CMD, r, REF_MAGIC_PM)
+
+
+def parse_ps_register_resp(buf: bytes) -> dict:
+    """Client-side decode of PS_REGISTER_RESP_S (fixture assertions)."""
+    hdr = np.frombuffer(buf, REF_HEADER_DT, count=1)[0]
+    r = np.frombuffer(buf, REF_PS_REGISTER_RESP_DT, count=1,
+                      offset=_HSZ)[0]
+    return {"data_type": int(hdr["data_type"]),
+            "error_code": int(r["error_code"]),
+            "error_string": _cstr(r["error_string"]),
+            "partha_ident_key": int(r["partha_ident_key"]),
+            "madhava_id": int(r["madhava_id"]),
+            "madhava_port": int(r["madhava_port"]),
+            "madhava_hostname": _cstr(r["madhava_hostname"])}
+
+
+def parse_pm_connect_resp(buf: bytes) -> dict:
+    hdr = np.frombuffer(buf, REF_HEADER_DT, count=1)[0]
+    r = np.frombuffer(buf, REF_PM_CONNECT_RESP_DT, count=1,
+                      offset=_HSZ)[0]
+    return {"data_type": int(hdr["data_type"]),
+            "error_code": int(r["error_code"]),
+            "error_string": _cstr(r["error_string"]),
+            "madhava_id": int(r["madhava_id"]),
+            "madhava_version": int(r["madhava_version"])}
 
 
 def adapt(buf: bytes, host_id: int) -> tuple[bytes, int]:

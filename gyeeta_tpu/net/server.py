@@ -45,10 +45,19 @@ class GytServer:
     def __init__(self, rt: Runtime, host: str = "127.0.0.1",
                  port: int = 0, tick_interval: Optional[float] = 5.0,
                  hostmap_path: Optional[str] = None,
-                 record_path: Optional[str] = None):
+                 record_path: Optional[str] = None,
+                 advertise_host: Optional[str] = None):
         self.rt = rt
         self.host = host
         self.port = port
+        # the madhava address handed to stock parthas in
+        # PS_REGISTER_RESP_S: a wildcard bind is not dialable, so it
+        # falls back to the machine's hostname (configure explicitly
+        # when parthas reach the server through NAT/a service VIP)
+        import socket as _socket
+        self.advertise_host = advertise_host or (
+            host if host not in ("", "0.0.0.0", "::") else
+            _socket.gethostname())
         self.tick_interval = tick_interval
         # optional wire capture (utils/replay.py): every complete-frame
         # run fed to the runtime is also appended to the capture file
@@ -68,6 +77,15 @@ class GytServer:
         # reference's CLI_TYPE_RESP_REQ conns carry this, gy_comm_proto.h)
         self._event_writers: dict[int, asyncio.StreamWriter] = {}
         self._open_conns: set = set()      # every live conn's writer
+        # stock-partha registration state: machine-id → the ident key
+        # issued at PS_REGISTER (the SM_PARTHA_IDENT_NOTIFY flow,
+        # gy_comm_proto.h:946 — shyama hands the key to madhava; the
+        # single controller holds both roles so a dict suffices)
+        self._ref_idents: dict[int, int] = {}
+        # stable madhava id presented to stock parthas (sticky across
+        # a process run; parthas compare it on reconnect)
+        import secrets as _sec
+        self._madhava_id = _sec.randbits(63) | 1
 
     # -------------------------------------------------------- registration
     def _load_hostmap(self) -> dict:
@@ -91,6 +109,11 @@ class GytServer:
         if int(req["conn_type"]) != wire.CONN_EVENT:
             return wire.REG_OK, 0xFFFFFFFF    # query conns hold no host slot
         mid = (int(req["machine_id_hi"]) << 64) | int(req["machine_id_lo"])
+        return self._host_for_machine(mid)
+
+    def _host_for_machine(self, mid: int) -> tuple[int, int]:
+        """Sticky machine-id → dense host_id allocation (shared by the
+        GYT and stock-partha registration paths)."""
         hid = self.hostmap.get(mid)
         if hid is None:
             if len(self.hostmap) >= self.rt.cfg.n_hosts:
@@ -173,9 +196,11 @@ class GytServer:
             self.rt.stats.bump("trace_sets_pushed", n)
         return n
 
-    async def _read_frame(self, reader) -> tuple[int, bytes]:
-        """→ (data_type, payload_bytes). Raises IncompleteReadError at EOF."""
-        hdr_b = await reader.readexactly(_HSZ)
+    async def _read_frame(self, reader, first: bytes = b""
+                          ) -> tuple[int, bytes]:
+        """→ (data_type, payload_bytes). Raises IncompleteReadError at EOF.
+        ``first`` carries bytes already peeked off the stream."""
+        hdr_b = first + await reader.readexactly(_HSZ - len(first))
         hdr = np.frombuffer(hdr_b, wire.HEADER_DT, count=1)[0]
         if hdr["magic"] not in (wire.MAGIC_PM, wire.MAGIC_MS,
                                 wire.MAGIC_NQ):
@@ -187,13 +212,131 @@ class GytServer:
         pad = int(hdr["padding_sz"])
         return int(hdr["data_type"]), body[: len(body) - pad]
 
+    async def _ref_conn(self, reader, writer, first: bytes) -> None:
+        """Stock-partha connection: the gy_comm_proto registration
+        handshake, then the reference NOTIFY stream via the adapter.
+
+        The single controller plays BOTH reference roles
+        (``gy_comm_proto.h:584-952``): a PS_REGISTER_REQ_S gets a
+        PS_REGISTER_RESP_S pointing the partha at ourselves as its
+        madhava (ident key issued here, the SM_PARTHA_IDENT_NOTIFY
+        flow collapsed); a PM_CONNECT_CMD_S validates versions + the
+        ident key, allocates the sticky host_id, replies
+        PM_CONNECT_RESP_S, and hands the conn to the event loop —
+        where ``refproto.adapt`` folds the notify stream natively.
+        """
+        import secrets
+        import time as _time
+
+        RP = refproto
+        hdr_b = first + await reader.readexactly(
+            RP.REF_HEADER_DT.itemsize - len(first))
+        while True:
+            hdr = np.frombuffer(hdr_b, RP.REF_HEADER_DT, count=1)[0]
+            if int(hdr["magic"]) not in RP.REF_MAGICS:
+                raise wire.FrameError(
+                    f"bad reference magic 0x{int(hdr['magic']):08x}")
+            total = int(hdr["total_sz"])
+            if total < len(hdr_b) or total >= wire.MAX_COMM_DATA_SZ:
+                raise wire.FrameError(f"bad ref total_sz {total}")
+            body = await reader.readexactly(total - len(hdr_b))
+            dtype = int(hdr["data_type"])
+            now = int(_time.time())
+            if dtype == RP.REF_COMM_PS_REGISTER_REQ:
+                req = RP.parse_ps_register_req(body)
+                err, es = self._ref_gate(req, "min_shyama_version")
+                key = 0
+                if not err:
+                    mid = ((req["machine_id_hi"] << 64)
+                           | req["machine_id_lo"])
+                    # bound the unauthenticated-registration state:
+                    # slack over n_hosts for churned machine ids, but
+                    # no unbounded growth from random-id floods
+                    if mid not in self._ref_idents and \
+                            len(self._ref_idents) >= \
+                            4 * self.rt.cfg.n_hosts:
+                        err, es = 116, "max partha registrations"
+                    else:
+                        key = self._ref_idents.setdefault(
+                            mid, secrets.randbits(63) | 1)
+                writer.write(RP.encode_ps_register_resp(
+                    err, es, self.advertise_host, self.port, key,
+                    self._madhava_id, now))
+                await writer.drain()
+                if err:
+                    self.rt.stats.bump("conns_ref_rejected")
+                    return
+                self.rt.stats.bump("ref_ps_registered")
+                # the partha now dials its madhava (us) on new conns;
+                # this shyama conn stays up for status traffic
+            elif dtype == RP.REF_COMM_PM_CONNECT_CMD:
+                req = RP.parse_pm_connect_cmd(body)
+                err, es = self._ref_gate(req, "min_madhava_version")
+                mid = ((req["machine_id_hi"] << 64)
+                       | req["machine_id_lo"])
+                host_id = 0
+                if not err and self._ref_idents.get(mid) != \
+                        req["partha_ident_key"]:
+                    err, es = 113, ("unknown partha ident key - "
+                                    "register with shyama first")
+                if not err:
+                    status, host_id = self._host_for_machine(mid)
+                    if status != wire.REG_OK:
+                        err, es = 116, "max partha hosts exceeded"
+                writer.write(RP.encode_pm_connect_resp(
+                    err, es, self._madhava_id, now))
+                await writer.drain()
+                if err:
+                    self.rt.stats.bump("conns_ref_rejected")
+                    return
+                self.rt.stats.bump("ref_pm_connected")
+                # conns_ref_adapted is counted by the event loop when
+                # it sees the first reference-magic data (one count
+                # per adapted conn, same as direct-stream ref conns)
+                await self._event_loop(reader, host_id)
+                return
+            else:
+                # pre-registration frame of an unhandled type: skip it
+                # whole (the reference's recv loop does the same for
+                # unknown events)
+                self.rt.stats.bump("frames_ref_skipped")
+            hdr_b = await reader.readexactly(RP.REF_HEADER_DT.itemsize)
+
+    def _ref_gate(self, req: dict, min_field: str) -> tuple[int, str]:
+        """Version gates of the reference's validate_fields
+        (``gy_comm_proto.h:55-56``): comm version must match ours;
+        partha must be ≥ our floor; our version must satisfy the
+        partha's floor. → (err_code, error_string)."""
+        RP = refproto
+        if req["comm_version"] != RP.REF_COMM_VERSION:
+            return 101, (f"comm version {req['comm_version']} "
+                         f"unsupported (need {RP.REF_COMM_VERSION})")
+        if req["partha_version"] < RP.REF_MIN_PARTHA_VERSION:
+            return 103, "partha version below minimum supported"
+        if req.get(min_field, 0) > RP.REF_MADHAVA_VERSION:
+            return 102, "server version below partha's minimum"
+        return 0, ""
+
     async def _handle_conn(self, reader, writer) -> None:
         peer = writer.get_extra_info("peername")
         self._open_conns.add(writer)
         try:
+            # peek the first header: a reference COMM_HEADER magic means
+            # a STOCK PARTHA — route it through the gy_comm_proto
+            # registration handshake instead of GYT registration
+            try:
+                first = await reader.readexactly(4)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if int.from_bytes(first, "little") in refproto.REF_MAGICS:
+                try:
+                    await self._ref_conn(reader, writer, first)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    pass
+                return
             # every conn opens with one REGISTER_REQ declaring its role
             try:
-                dtype, payload = await self._read_frame(reader)
+                dtype, payload = await self._read_frame(reader, first)
             except (asyncio.IncompleteReadError, ConnectionError):
                 return
             if dtype != wire.COMM_REGISTER_REQ:

@@ -91,6 +91,9 @@ class ShardedRuntime:
         self._resp_raw: list = []
         self._n_conn_raw = 0
         self._n_resp_raw = 0
+        # per-host native-resp-stream presence (trace→resp bridge
+        # precedence, see Runtime)
+        self._host_has_resp = np.zeros(self.cfg.n_hosts, bool)
 
         self.state = sharded.init_sharded(self.cfg, self.mesh)
         shd = leading_sharding(self.mesh)
@@ -208,6 +211,8 @@ class ShardedRuntime:
             n += len(conn)
         resp = recs.pop(wire.NOTIFY_RESP_SAMPLE, None)
         if resp is not None and len(resp):
+            hid = resp["host_id"]
+            self._host_has_resp[hid[hid < self.cfg.n_hosts]] = True
             self._resp_raw.append(resp)
             self._n_resp_raw += len(resp)
             self.stats.bump("resp_events", len(resp))
@@ -246,6 +251,18 @@ class ShardedRuntime:
                     decode.trace_batch, chunks[0],
                     wire.MAX_TRACE_PER_BATCH))
                 n += len(chunks[0])
+                if self.opts.trace_resp_bridge:
+                    rs = decode.resp_from_trace(chunks[0])
+                    # per-host precedence (see Runtime.feed): native
+                    # resp streams win; the bridge fills the gaps
+                    hid = rs["host_id"]
+                    rs = rs[(hid >= self.cfg.n_hosts)
+                            | ~self._host_has_resp[
+                                np.minimum(hid, self.cfg.n_hosts - 1)]]
+                    if len(rs):
+                        self._resp_raw.append(rs)
+                        self._n_resp_raw += len(rs)
+                        self.stats.bump("resp_from_trace", len(rs))
             elif kind == "listener_info":
                 self.stats.bump("listener_infos",
                                 self.svcreg.update(chunks[0]))

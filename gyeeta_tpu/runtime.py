@@ -54,6 +54,10 @@ class Runtime:
         self._resp_raw: list = []
         self._n_conn_raw = 0
         self._n_resp_raw = 0
+        # hosts with a native RESP_SAMPLE stream: the trace→resp bridge
+        # skips them (per-host precedence — no double counting when a
+        # host sends both streams)
+        self._host_has_resp = np.zeros(self.cfg.n_hosts, bool)
         self._td_dirty = False        # digest stage may be non-empty
         from gyeeta_tpu.utils.colcache import ColumnCache
         self._cols = ColumnCache()    # version-keyed snapshot memo
@@ -212,6 +216,8 @@ class Runtime:
             n += len(conn)
         resp = recs.pop(wire.NOTIFY_RESP_SAMPLE, None)
         if resp is not None and len(resp):
+            hid = resp["host_id"]
+            self._host_has_resp[hid[hid < self.cfg.n_hosts]] = True
             self._resp_raw.append(resp)
             self._n_resp_raw += len(resp)
             self.stats.bump("resp_events", len(resp))
@@ -246,6 +252,18 @@ class Runtime:
                 self.state = self._fold_trace(self.state, trb)
                 n += len(chunks[0])
                 self.stats.bump("trace_records", len(chunks[0]))
+                if self.opts.trace_resp_bridge:
+                    rs = decode.resp_from_trace(chunks[0])
+                    # per-host precedence: hosts with a native resp
+                    # stream are never bridged (no double counting)
+                    hid = rs["host_id"]
+                    rs = rs[(hid >= self.cfg.n_hosts)
+                            | ~self._host_has_resp[
+                                np.minimum(hid, self.cfg.n_hosts - 1)]]
+                    if len(rs):
+                        self._resp_raw.append(rs)
+                        self._n_resp_raw += len(rs)
+                        self.stats.bump("resp_from_trace", len(rs))
             elif kind == "listener_info":
                 self.stats.bump("listener_infos",
                                 self.svcreg.update(chunks[0]))

@@ -38,6 +38,17 @@ class RuntimeOpts(NamedTuple):
     api_max_age_ticks: int = 360            # evict idle (svc,api) rows 30m
     debug_level: int = 0                    # hot-reloadable
     resp_sample_pct: float = 100.0          # hot-reloadable duty cycle
+    trace_resp_bridge: bool = True          # parsed transactions also
+    #                                         feed the per-svc response
+    #                                         sketches (real latencies —
+    #                                         the eBPF xmit-probe resp
+    #                                         stream analogue, ref
+    #                                         common/gy_socket_stat.cc:1554).
+    #                                         Per-host precedence: a host
+    #                                         with a native RESP_SAMPLE
+    #                                         stream is never bridged, so
+    #                                         dual-stream hosts don't
+    #                                         double-count transactions.
     td_drain_iters_per_tick: int = 2        # bounded digest compression
     #                                         per tick (O(td_flush_m)
     #                                         each); overflow drops are

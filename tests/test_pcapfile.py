@@ -137,6 +137,16 @@ def test_transactions_feed_runtime():
     rt.feed(wire.encode_frames_chunked(wire.NOTIFY_NAME_INTERN,
                                        name_recs)
             + wire.encode_frames_chunked(wire.NOTIFY_REQ_TRACE, recs))
+    # trace→resp bridge (VERDICT r4 #4): the pcap transactions' REAL
+    # latencies reach the per-svc response sketches — svcstate p95
+    # reflects the capture, no simulator resp stream involved
+    svc = rt.query({"subsys": "svcstate",
+                    "filter": "{ svcstate.svcid = '0000000000abc123' }"})
+    assert svc["nrecs"] == 1
+    true_ms = [t.resp_usec / 1e3 for t in f.transactions]
+    assert svc["recs"][0]["nqry5s"] == len(recs)
+    assert svc["recs"][0]["p95resp5s"] == \
+        pytest.approx(max(true_ms), rel=0.35, abs=0.5)
     rt.run_tick()
     out = rt.query({"subsys": "tracereq"})
     assert out["nrecs"] == 1

@@ -1,0 +1,264 @@
+"""Stock-partha registration handshake + the remaining hot subtypes
+(VERDICT r4 #5).
+
+Done-criterion: a synthesized stock-partha session — PS_REGISTER_REQ_S
+→ PS_REGISTER_RESP_S (shyama role), PM_CONNECT_CMD_S →
+PM_CONNECT_RESP_S (madhava role), then a gy_comm_proto NOTIFY stream
+including NEW_LISTENER / ACTIVE_CONN_STATS / TASK_TOP_PROCS — is
+accepted end-to-end with ZERO GYT-specific frames on the wire.
+Ref: gy_comm_proto.h:584-952 (handshake), :1531 (NEW_LISTENER),
+:2766 (ACTIVE_CONN_STATS), :1415 (TASK_TOP_PROCS).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import refproto as RP
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
+                conn_batch=64, resp_batch=64, fold_k=2)
+
+MID_HI, MID_LO = 0xFEED0001, 0xBEEF0002
+
+
+# ------------------------------------------------------ fixture builders
+def _ref_frame(subtype: int, nevents: int, payload: bytes) -> bytes:
+    body_len = RP._HSZ + RP._ESZ + len(payload)
+    total = (body_len + 7) & ~7
+    hdr = np.zeros((), RP.REF_HEADER_DT)
+    hdr["magic"] = RP.REF_MAGIC_PM
+    hdr["total_sz"] = total
+    hdr["data_type"] = RP.REF_COMM_EVENT_NOTIFY
+    hdr["padding_sz"] = total - body_len
+    ev = np.zeros((), RP.REF_EVENT_NOTIFY_DT)
+    ev["subtype"] = subtype
+    ev["nevents"] = nevents
+    return (hdr.tobytes() + ev.tobytes() + payload
+            + b"\x00" * (total - body_len))
+
+
+def _new_listener_record(glob_id: int, port: int, comm: bytes,
+                         cmdline: bytes = b"") -> bytes:
+    rec = np.zeros((), RP.REF_NEW_LISTENER_DT)
+    rec["ns_ip_port"]["aftype"] = RP.AF_INET
+    rec["ns_ip_port"]["ip32_be"] = int.from_bytes(
+        bytes([10, 1, 2, 3]), "little")
+    rec["ns_ip_port"]["port"] = port
+    rec["inode"] = 4026531956
+    rec["glob_id"] = glob_id
+    rec["related_listen_id"] = glob_id
+    rec["tstart_usec"] = 1_700_000_000_000_000
+    rec["comm"] = comm
+    rec["start_pid"] = 1234
+    rec["cmdline_len"] = len(cmdline)
+    pad = (-(RP.REF_NEW_LISTENER_DT.itemsize + len(cmdline))) % 8
+    rec["padding_len"] = pad
+    return rec.tobytes() + cmdline + b"\x00" * pad
+
+
+def _active_conn_record(glob_id: int, cli_aggr: int, nbytes: int,
+                        nconns: int = 3) -> bytes:
+    rec = np.zeros((), RP.REF_ACTIVE_CONN_DT)
+    rec["listener_glob_id"] = glob_id
+    rec["cli_aggr_task_id"] = cli_aggr
+    rec["ser_comm"] = b"ref-server"
+    rec["cli_comm"] = b"ref-caller"
+    rec["machid_lo"] = 0x77
+    rec["bytes_sent"] = nbytes
+    rec["bytes_received"] = nbytes // 4
+    rec["active_conns"] = nconns
+    return rec.tobytes()
+
+
+def _top_procs_payload() -> bytes:
+    hdr = np.zeros((), RP.REF_TOP_HDR_DT)
+    hdr["nprocs"] = 2
+    hdr["npg_procs"] = 1
+    hdr["nrss_procs"] = 1
+    hdr["nfork_procs"] = 1
+    # ext_data_len_ = the four arrays' exact bytes (gy_comm_proto.cc:677)
+    hdr["ext_data_len"] = 2 * 40 + 1 * 64 + 1 * 40 + 1 * 40
+    top = np.zeros(2, RP.REF_TOP_TASK_DT)
+    top[0]["aggr_task_id"] = 0xA0
+    top[0]["pid"] = 100
+    top[0]["cpupct"] = 91.5
+    top[0]["rss_mb"] = 512
+    top[0]["comm"] = b"hot-proc"
+    top[1]["aggr_task_id"] = 0xA1
+    top[1]["cpupct"] = 20.0
+    top[1]["comm"] = b"warm-proc"
+    pg = np.zeros(1, RP.REF_TOP_PG_DT)
+    pg[0]["aggr_task_id"] = 0xA2
+    pg[0]["ntasks"] = 7
+    pg[0]["tot_cpupct"] = 55.0
+    pg[0]["tot_rss_mb"] = 2048
+    pg[0]["pg_comm"] = b"pg-leader"
+    rss = np.zeros(1, RP.REF_TOP_TASK_DT)
+    rss[0]["aggr_task_id"] = 0xA3
+    rss[0]["rss_mb"] = 9000
+    rss[0]["comm"] = b"big-rss"
+    fork = np.zeros(1, RP.REF_TOP_FORK_DT)
+    fork[0]["aggr_task_id"] = 0xA4
+    fork[0]["nfork_per_sec"] = 33
+    fork[0]["comm"] = b"forker"
+    return (hdr.tobytes() + top.tobytes() + pg.tobytes()
+            + rss.tobytes() + fork.tobytes())
+
+
+# ------------------------------------------------------------ unit tests
+def test_handshake_layout_sizes_match_reference_abi():
+    assert RP.REF_PS_REGISTER_REQ_DT.itemsize == 1096
+    assert RP.REF_PS_REGISTER_RESP_DT.itemsize == 1440
+    assert RP.REF_PM_CONNECT_CMD_DT.itemsize == 1120
+    assert RP.REF_PM_CONNECT_RESP_DT.itemsize == 1008
+    assert RP.REF_NEW_LISTENER_DT.itemsize == 112
+    assert RP.REF_ACTIVE_CONN_DT.itemsize == 104
+    assert RP.REF_TOP_HDR_DT.itemsize == 16
+    assert RP.REF_TOP_TASK_DT.itemsize == 40
+    assert RP.REF_TOP_PG_DT.itemsize == 64
+    assert RP.REF_TOP_FORK_DT.itemsize == 40
+
+
+def test_new_listener_adapts_to_listener_info():
+    glob = 0xBEE1
+    buf = _ref_frame(RP.REF_NOTIFY_NEW_LISTENER, 2,
+                     _new_listener_record(glob, 8443, b"nginx",
+                                          b"/usr/sbin/nginx -g daemon")
+                     + _new_listener_record(glob + 1, 9090, b"promd"))
+    rt = Runtime(CFG)
+    gyt, consumed = RP.adapt(buf, host_id=4)
+    assert consumed == len(buf)
+    rt.feed(gyt)
+    out = rt.query({"subsys": "svcinfo"})
+    by_comm = {r["comm"]: r for r in out["recs"]}
+    assert "nginx" in by_comm and "promd" in by_comm
+    assert by_comm["nginx"]["port"] == 8443
+    assert "daemon" in by_comm["nginx"]["cmdline"]
+    rt.close()
+
+
+def test_active_conn_stats_fold_as_conn_traffic():
+    glob = 0xCAFE01
+    payload = (_active_conn_record(glob, 0xC1, 40_000)
+               + _active_conn_record(glob, 0xC2, 20_000))
+    buf = _ref_frame(RP.REF_NOTIFY_ACTIVE_CONN_STATS, 2, payload)
+    rt = Runtime(CFG)
+    gyt, consumed = RP.adapt(buf, host_id=1)
+    assert consumed == len(buf)
+    rt.feed(gyt)
+    rt.run_tick()
+    out = rt.query({"subsys": "svcstate",
+                    "filter": f"{{ svcstate.svcid = '{glob:016x}' }}"})
+    assert out["nrecs"] == 1
+    # the two caller groups carry distinct synthetic flow identities →
+    # the distinct-client HLL sees both (svcstate kb columns come from
+    # LISTENER_STATE, which stock parthas stream separately)
+    assert out["recs"][0]["nclients"] >= 2
+    rt.close()
+
+
+def test_task_top_procs_feed_top_views():
+    buf = _ref_frame(RP.REF_NOTIFY_TASK_TOP_PROCS, 1,
+                     _top_procs_payload())
+    rt = Runtime(CFG)
+    gyt, consumed = RP.adapt(buf, host_id=0)
+    assert consumed == len(buf)
+    rt.feed(gyt)
+    rt.run_tick()
+    top = rt.query({"subsys": "topcpu"})
+    assert top["recs"][0]["comm"] == "hot-proc"
+    rss = rt.query({"subsys": "toprss"})
+    assert rss["recs"][0]["comm"] == "big-rss"
+    fork = rt.query({"subsys": "topfork"})
+    assert fork["recs"][0]["comm"] == "forker"
+    rt.close()
+
+
+# ------------------------------------------------------- e2e handshake
+async def _stock_partha_session():
+    from gyeeta_tpu.net import GytServer
+
+    rt = Runtime(CFG)
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    try:
+        # ---- shyama role: PS_REGISTER_REQ -> RESP with ident key
+        r1, w1 = await asyncio.open_connection(host, port)
+        w1.write(RP.encode_ps_register_req(MID_HI, MID_LO,
+                                           hostname="stockpartha"))
+        await w1.drain()
+        ps = RP.parse_ps_register_resp(
+            await r1.readexactly(16 + RP.REF_PS_REGISTER_RESP_DT.itemsize))
+        assert ps["data_type"] == RP.REF_COMM_PS_REGISTER_RESP
+        assert ps["error_code"] == 0, ps["error_string"]
+        assert ps["partha_ident_key"] != 0
+        assert ps["madhava_port"] == port
+        w1.close()
+
+        # ---- madhava role: PM_CONNECT_CMD with the issued key
+        r2, w2 = await asyncio.open_connection(host, port)
+        w2.write(RP.encode_pm_connect_cmd(
+            MID_HI, MID_LO, ps["partha_ident_key"], ps["madhava_id"]))
+        await w2.drain()
+        pm = RP.parse_pm_connect_resp(
+            await r2.readexactly(16 + RP.REF_PM_CONNECT_RESP_DT.itemsize))
+        assert pm["data_type"] == RP.REF_COMM_PM_CONNECT_RESP
+        assert pm["error_code"] == 0, pm["error_string"]
+        assert pm["madhava_id"] == ps["madhava_id"]
+
+        # ---- notify stream on the registered conn (stock frames only)
+        glob = 0x57CC01
+        w2.write(_ref_frame(RP.REF_NOTIFY_NEW_LISTENER, 1,
+                            _new_listener_record(glob, 8080, b"svc-a"))
+                 + _ref_frame(RP.REF_NOTIFY_ACTIVE_CONN_STATS, 1,
+                              _active_conn_record(glob, 0xCA, 64_000))
+                 + _ref_frame(RP.REF_NOTIFY_TASK_TOP_PROCS, 1,
+                              _top_procs_payload()))
+        await w2.drain()
+        await asyncio.sleep(0.3)
+        rt.flush()
+        rt.run_tick()
+        svc = rt.query({"subsys": "svcstate",
+                        "filter": f"{{ svcstate.svcid = "
+                                  f"'{glob:016x}' }}"})
+        info = rt.query({"subsys": "svcinfo"})
+        top = rt.query({"subsys": "topcpu"})
+
+        # ---- negatives: wrong ident key / wrong comm version
+        r3, w3 = await asyncio.open_connection(host, port)
+        w3.write(RP.encode_pm_connect_cmd(MID_HI, MID_LO, 0xBAD,
+                                          ps["madhava_id"]))
+        await w3.drain()
+        bad = RP.parse_pm_connect_resp(
+            await r3.readexactly(16 + RP.REF_PM_CONNECT_RESP_DT.itemsize))
+        r4, w4 = await asyncio.open_connection(host, port)
+        w4.write(RP.encode_ps_register_req(MID_HI, MID_LO,
+                                           comm_version=99))
+        await w4.drain()
+        badv = RP.parse_ps_register_resp(
+            await r4.readexactly(16 + RP.REF_PS_REGISTER_RESP_DT.itemsize))
+        for w in (w2, w3, w4):
+            w.close()
+        return svc, info, top, bad, badv, rt
+    finally:
+        await srv.stop()
+
+
+def test_stock_partha_end_to_end():
+    svc, info, top, bad, badv, rt = asyncio.run(_stock_partha_session())
+    assert svc["nrecs"] == 1 and svc["recs"][0]["nclients"] >= 1
+    assert any(r["comm"] == "svc-a" and r["port"] == 8080
+               for r in info["recs"])
+    assert top["recs"][0]["comm"] == "hot-proc"
+    assert bad["error_code"] == 113
+    assert "ident" in bad["error_string"]
+    assert badv["error_code"] == 101
+    assert rt.stats.snapshot().get("ref_ps_registered") == 1
+    assert rt.stats.snapshot().get("conns_ref_adapted", 0) >= 1
+    rt.close()

@@ -1,0 +1,123 @@
+"""Trace→response bridge: parsed transactions feed the per-service
+response sketches (VERDICT r4 #4).
+
+The reference's per-service p95s come from eBPF response probes
+(``partha/gy_ebpf_kernel.bpf.c:836-931`` → handler
+``common/gy_socket_stat.cc:1554``). That kernel tier cannot exist here,
+but every parsed transaction (pcap replay, traced conns, stock-partha
+streams) already carries a measured request→response latency — the
+bridge replays those into the RESP_SAMPLE hot path so svcstate's
+loghist/t-digest percentiles measure REAL latencies, making the
+simulator's lognormal resp stream optional.
+"""
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import decode, wire
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sketch import loghist
+from gyeeta_tpu.utils.config import RuntimeOpts
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return EngineCfg(
+        svc_capacity=32, n_hosts=8,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=128),
+        hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
+        topk_capacity=16, td_capacity=16,
+        conn_batch=64, resp_batch=256, listener_batch=32)
+
+
+SVC = 0x00AB_CDEF_1234_5678
+
+
+def _trace_recs(lat_usec: np.ndarray, svc: int = SVC,
+                host: int = 3) -> np.ndarray:
+    recs = np.zeros(len(lat_usec), wire.REQ_TRACE_DT)
+    recs["svc_glob_id"] = svc
+    recs["api_id"] = 0x11
+    recs["tusec"] = 1_700_000_000_000_000
+    recs["resp_usec"] = lat_usec
+    recs["bytes_in"] = 200
+    recs["bytes_out"] = 1000
+    recs["status"] = 200
+    recs["proto"] = 1
+    recs["host_id"] = host
+    return recs
+
+
+def _trace_frames(recs: np.ndarray) -> bytes:
+    step = wire.MAX_TRACE_PER_BATCH
+    return b"".join(
+        wire.encode_frame(wire.NOTIFY_REQ_TRACE, recs[i:i + step])
+        for i in range(0, len(recs), step))
+
+
+def test_resp_from_trace_fields():
+    lat = np.array([10, 2000, 500_000], np.uint32)
+    rs = decode.resp_from_trace(_trace_recs(lat, svc=7, host=5))
+    assert rs.dtype == wire.RESP_SAMPLE_DT
+    assert (rs["glob_id"] == 7).all()
+    assert (rs["resp_usec"] == lat).all()
+    assert (rs["host_id"] == 5).all()
+
+
+def test_bridge_feeds_svcstate_percentiles(cfg):
+    """E2E (done criteria, VERDICT r4 #4): trace transactions with a
+    known latency distribution → svcstate p95 matches the actual
+    distribution, with NO simulator resp stream anywhere."""
+    rt = Runtime(cfg)
+    rng = np.random.default_rng(11)
+    lat = rng.lognormal(np.log(20_000), 0.5, 6000).astype(np.uint32)
+    n = rt.feed(_trace_frames(_trace_recs(lat)))
+    assert n == len(lat)
+    assert rt.stats.counters["resp_from_trace"] == len(lat)
+
+    out = rt.query({"subsys": "svcstate",
+                    "filter": f"{{ svcstate.svcid = '{SVC:016x}' }}"})
+    assert out["nrecs"] == 1
+    rec = out["recs"][0]
+    true_p95_ms = float(np.percentile(lat, 95)) / 1e3
+    # loghist buckets are log-spaced: generous relative bound
+    assert rec["p95resp5s"] == pytest.approx(true_p95_ms, rel=0.25)
+    assert rec["nqry5s"] == len(lat)
+    rt.close()
+
+
+def test_bridge_host_precedence(cfg):
+    """A host with a native RESP_SAMPLE stream is never bridged (no
+    double counting); trace-only hosts still are."""
+    rt = Runtime(cfg)
+    # host 3 sends native resp samples first
+    rs = np.zeros(100, wire.RESP_SAMPLE_DT)
+    rs["glob_id"] = SVC
+    rs["resp_usec"] = 10_000
+    rs["host_id"] = 3
+    rt.feed(wire.encode_frame(wire.NOTIFY_RESP_SAMPLE, rs))
+    # traces from host 3 (native-resp host) and host 5 (trace-only)
+    lat = np.full(200, 50_000, np.uint32)
+    rt.feed(_trace_frames(_trace_recs(lat, host=3)))
+    rt.feed(_trace_frames(_trace_recs(lat, host=5)))
+    assert rt.stats.counters["resp_from_trace"] == 200   # host 5 only
+    out = rt.query({"subsys": "svcstate",
+                    "filter": f"{{ svcstate.svcid = '{SVC:016x}' }}"})
+    # 100 native + 200 bridged (host 5); host 3's 200 NOT double-fed
+    assert out["recs"][0]["nqry5s"] == 300
+    rt.close()
+
+
+def test_bridge_disabled(cfg):
+    rt = Runtime(cfg, RuntimeOpts(trace_resp_bridge=False))
+    lat = np.full(500, 30_000, np.uint32)
+    rt.feed(_trace_frames(_trace_recs(lat)))
+    assert "resp_from_trace" not in rt.stats.counters
+    out = rt.query({"subsys": "svcstate",
+                    "filter": f"{{ svcstate.svcid = '{SVC:016x}' }}"})
+    # the trace fold still creates the (svc, api) slab rows, but no
+    # response samples reach the svc sketches
+    for rec in out["recs"]:
+        assert rec["p95resp5s"] == 0
+    rt.close()
