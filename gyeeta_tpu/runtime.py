@@ -33,6 +33,11 @@ from gyeeta_tpu.utils.intern import InternTable
 from gyeeta_tpu.utils.selfstats import Stats
 
 
+# a native resp stream is "live" for bridge-suppression purposes if it
+# reported within this many base ticks (2 min at 5s)
+_RESP_FRESH_TICKS = 24
+
+
 class Runtime:
     def __init__(self, cfg: Optional[EngineCfg] = None,
                  opts: Optional[RuntimeOpts] = None,
@@ -54,10 +59,15 @@ class Runtime:
         self._resp_raw: list = []
         self._n_conn_raw = 0
         self._n_resp_raw = 0
-        # hosts with a native RESP_SAMPLE stream: the trace→resp bridge
-        # skips them (per-host precedence — no double counting when a
-        # host sends both streams)
-        self._host_has_resp = np.zeros(self.cfg.n_hosts, bool)
+        # last tick each host sent a native RESP_SAMPLE: the trace→resp
+        # bridge skips hosts with a RECENT native stream (per-host
+        # precedence — no steady-state double counting when a host
+        # sends both; a dead resp stream un-suppresses after
+        # _RESP_FRESH_TICKS). Startup transient: trace frames arriving
+        # before the host's first resp frame are bridged and may
+        # overlap the first native window — bounded by one window.
+        self._host_resp_tick = np.full(self.cfg.n_hosts, -(10 ** 9),
+                                       np.int64)
         self._td_dirty = False        # digest stage may be non-empty
         from gyeeta_tpu.utils.colcache import ColumnCache
         self._cols = ColumnCache()    # version-keyed snapshot memo
@@ -217,7 +227,8 @@ class Runtime:
         resp = recs.pop(wire.NOTIFY_RESP_SAMPLE, None)
         if resp is not None and len(resp):
             hid = resp["host_id"]
-            self._host_has_resp[hid[hid < self.cfg.n_hosts]] = True
+            self._host_resp_tick[hid[hid < self.cfg.n_hosts]] = \
+                self._tick_no
             self._resp_raw.append(resp)
             self._n_resp_raw += len(resp)
             self.stats.bump("resp_events", len(resp))
@@ -254,12 +265,14 @@ class Runtime:
                 self.stats.bump("trace_records", len(chunks[0]))
                 if self.opts.trace_resp_bridge:
                     rs = decode.resp_from_trace(chunks[0])
-                    # per-host precedence: hosts with a native resp
-                    # stream are never bridged (no double counting)
+                    # per-host precedence: hosts with a RECENT native
+                    # resp stream are not bridged (no double counting;
+                    # a dead native stream un-suppresses)
                     hid = rs["host_id"]
-                    rs = rs[(hid >= self.cfg.n_hosts)
-                            | ~self._host_has_resp[
-                                np.minimum(hid, self.cfg.n_hosts - 1)]]
+                    fresh = (self._tick_no - self._host_resp_tick[
+                        np.minimum(hid, self.cfg.n_hosts - 1)]
+                        <= _RESP_FRESH_TICKS)
+                    rs = rs[(hid >= self.cfg.n_hosts) | ~fresh]
                     if len(rs):
                         self._resp_raw.append(rs)
                         self._n_resp_raw += len(rs)
@@ -459,6 +472,21 @@ class Runtime:
         report["alerts_fired"] = len(fired)
         for a in fired:
             self.notifylog.add_alert(a)
+
+        # drop-pressure signal (VERDICT r4 #10): growing insert/overflow
+        # drops become notifymsg entries + selfstats gauges
+        from gyeeta_tpu.utils import droppressure
+        self._last_drops = droppressure.check(
+            {"svc": int(np.asarray(self.state.tbl.n_drop)),
+             "task": int(np.asarray(self.state.task_tbl.n_drop)),
+             "api": int(np.asarray(self.state.api_tbl.n_drop)),
+             "dep": int(np.asarray(self.dep.n_dropped))},
+            {"svc": self.cfg.svc_capacity,
+             "task": self.cfg.task_capacity,
+             "api": self.cfg.api_capacity,
+             "dep": self.opts.dep_pair_capacity},
+            getattr(self, "_last_drops", {}),
+            self.notifylog, self.stats)
 
         self.state = self._tick(self.state)
         if tick % self.opts.task_age_every_ticks == 0:

@@ -16,7 +16,13 @@ class ColumnCache:
         self._cache: dict = {}
 
     def bump(self) -> None:
+        """Invalidate AND evict. Entries can hold LazyCols whose group
+        loaders close over the full device AggState — keeping stale
+        entries until their subsys is re-queried would pin a second
+        multi-GB state on device (and defeat fold donation, which
+        silently copies when another live reference exists)."""
         self.version += 1
+        self._cache.clear()
 
     def clear(self) -> None:
         self._cache.clear()

@@ -1,0 +1,44 @@
+"""Drop-pressure monitoring: turn silent insert/overflow drops into
+operator signals (VERDICT r4 #10).
+
+Device tables drop inserts at probe exhaustion (``engine/table.py``
+``n_drop``), the dep graph and the a2a pairing tier drop on dispatch
+overflow (``parallel/depgraph.py``/``pairing.py`` ``n_dropped``).
+Every drop is counted, but a counter an operator must poll is not a
+signal — the reference prints pool/capture-stats pressure on cadence
+(``common/gy_svc_net_capture.h:191`` print_stats) and raises
+notifications for resource pressure. This helper diffs the counters
+each tick and emits a notifymsg (warn; error when the growth rate
+says the table is badly undersized) + selfstats gauges.
+"""
+
+from __future__ import annotations
+
+# growth per tick above this fraction of capacity = sizing failure
+_ERROR_FRAC = 0.01
+
+
+def check(drops: dict, caps: dict, last: dict, notifylog, stats) -> dict:
+    """Compare cumulative drop counters against the previous tick.
+
+    ``drops``: {name: cumulative count}; ``caps``: {name: capacity};
+    ``last``: previous tick's ``drops`` (mutated copy returned).
+    Emits one notifymsg per tick listing every growing counter.
+    """
+    grew = {}
+    for name, v in drops.items():
+        stats.gauge(f"drops_{name}", v)
+        d = v - last.get(name, 0)
+        if d > 0:
+            grew[name] = d
+    if grew:
+        severe = any(d >= max(_ERROR_FRAC * caps.get(n, 1 << 30), 1.0)
+                     for n, d in grew.items())
+        detail = ", ".join(f"{n}+{int(d)} (total {int(drops[n])})"
+                           for n, d in sorted(grew.items()))
+        notifylog.add(
+            f"insert drops growing: {detail} — table under-sized or "
+            f"overload; raise capacity or shed load",
+            ntype="error" if severe else "warn", source="selfmon")
+        stats.bump("drop_pressure_events")
+    return dict(drops)
