@@ -47,6 +47,11 @@ PHASE_ORDER = ("fold_toy", "fold_ns", "feed_ns", "feed_toy")
 
 
 def _geometry(which: str):
+    """→ (cfg, sim, dep_pair_capacity, dep_edge_capacity).
+
+    Dep capacities scale with the geometry: the edge working set is
+    ≈ fleet_services × per-svc caller fan-in (sim cli_groups_per_svc),
+    sized at ~50% load like the service slab."""
     from gyeeta_tpu.engine.aggstate import EngineCfg
     from gyeeta_tpu.sim.partha import ParthaSim
 
@@ -54,11 +59,12 @@ def _geometry(which: str):
         # slab = 2× services (≤70% open-addressing load, table.py)
         cfg = EngineCfg(svc_capacity=131072, n_hosts=50048,
                         task_capacity=65536)
-        sim = ParthaSim(n_hosts=512, n_svcs=128, n_clients=8192)
-    else:
-        cfg = EngineCfg()
-        sim = ParthaSim(n_hosts=64, n_svcs=8, n_clients=4096)
-    return cfg, sim
+        sim = ParthaSim(n_hosts=512, n_svcs=128, n_clients=8192,
+                        cli_groups_per_svc=4)
+        return cfg, sim, 65536, 524288   # 256k steady edges at 50%
+    cfg = EngineCfg()
+    sim = ParthaSim(n_hosts=64, n_svcs=8, n_clients=4096)
+    return cfg, sim, 65536, 16384
 
 
 def _probe_accelerator(timeout_s: float = 120.0,
@@ -96,14 +102,19 @@ def _probe_accelerator(timeout_s: float = 120.0,
     return False, log
 
 
-def _bench_fold(cfg, sim, dev, label: str) -> dict:
-    """Steady-state fold_many throughput with the production flush
-    policy (lagged pressure check → partial flush, as the runtime
-    does). Returns {rate, ms_per_dispatch, n_flushes}."""
+def _bench_fold(cfg, sim, dev, label: str, dep_pairs: int,
+                dep_edges: int) -> dict:
+    """Steady-state ingest-fold throughput: the PRODUCTION dispatch
+    (engine fold + dependency-graph fold in one jit, both donated —
+    exactly ``Runtime._fold_many_dep``) with the production flush
+    policy (lagged pressure check → partial flush). The dep fold used
+    to be billed only to the feed path, making feed_vs_fold compare
+    different machines. Returns {rate, ms_per_dispatch, n_flushes}."""
     import jax
     import numpy as np
 
     from gyeeta_tpu.engine import aggstate, step
+    from gyeeta_tpu.parallel import depgraph as dg
 
     K = cfg.fold_k
 
@@ -121,19 +132,23 @@ def _bench_fold(cfg, sim, dev, label: str) -> dict:
     n_distinct = 2  # cycle staged slabs so inputs aren't degenerate
     slabs = [stage() for _ in range(n_distinct)]
 
-    fold = step.jit_fold_many(cfg)
+    fold = jax.jit(
+        lambda s, d, c, r: (step.fold_many(cfg, s, c, r),
+                            dg.dep_fold_many(d, c, 0)),
+        donate_argnums=(0, 1))
     flushp = jax.jit(lambda s: step.td_flush_partial(cfg, s),
                      donate_argnums=(0,))
     pressure_of = jax.jit(step.stage_pressure)
     # state materializes ON the device (jnp zeros) — no host-side
     # multi-GiB buffer rides the tunnel
     st = jax.device_put(aggstate.init(cfg), dev)
+    dep = jax.device_put(dg.init(dep_pairs, dep_edges), dev)
 
     # warmup / compile — also makes every slab key table-resident, so
     # the measured loop runs the steady-state upsert fast path
     t0 = time.perf_counter()
     for i in range(2 * n_distinct):
-        st = fold(st, *slabs[i % n_distinct])
+        st, dep = fold(st, dep, *slabs[i % n_distinct])
     st = flushp(st)
     jax.block_until_ready(st)
     print(f"bench[{label}]: warmup+compile {time.perf_counter() - t0:.1f}s",
@@ -143,7 +158,7 @@ def _bench_fold(cfg, sim, dev, label: str) -> dict:
     # calibrate call count for ~2s of measurement, bounded for slow hosts
     t0 = time.perf_counter()
     for i in range(4):
-        st = fold(st, *slabs[i % n_distinct])
+        st, dep = fold(st, dep, *slabs[i % n_distinct])
     jax.block_until_ready(st)
     per_call = (time.perf_counter() - t0) / 4
     calls = max(4, min(500, int(2.0 / max(per_call, 1e-6))))
@@ -160,7 +175,7 @@ def _bench_fold(cfg, sim, dev, label: str) -> dict:
                 int(pressures.popleft()) > cfg.td_stage_cap // 2:
             st = flushp(st)
             n_flushes += 1
-        st = fold(st, *slabs[i % n_distinct])
+        st, dep = fold(st, dep, *slabs[i % n_distinct])
         pressures.append(pressure_of(st))
     jax.block_until_ready(st)
     elapsed = time.perf_counter() - t0
@@ -170,29 +185,38 @@ def _bench_fold(cfg, sim, dev, label: str) -> dict:
           f"{elapsed:.2f}s ({elapsed / calls * 1e3:.2f}ms/dispatch, "
           f"{n_flushes} partial flushes, {rate:,.0f} ev/s)",
           file=sys.stderr, flush=True)
-    del st, slabs
+    del st, dep, slabs
     return {"rate": rate, "ms_per_dispatch": elapsed / calls * 1e3,
             "n_flushes": n_flushes, "per_call_s": per_call}
 
 
-def _bench_feed(cfg, sim, label: str) -> float:
+def _bench_feed(cfg, sim, label: str, dep_pairs: int,
+                dep_edges: int) -> float:
     """Feed-path throughput: the PRODUCT ingest loop (bytes → native
     deframe → decode → staged K-slab fold), not just the device fold —
-    VERDICT r4 #3 requires ≥0.8× of fold_many at both geometries.
+    VERDICT r4 #3 requires ≥0.8× of the fold at both geometries.
     Frames are pre-generated so the sim's RNG cost isn't billed to the
     server path."""
     import jax
 
     from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.utils.config import RuntimeOpts
 
     K = cfg.fold_k
-    rt = Runtime(cfg)
+    rt = Runtime(cfg, RuntimeOpts(dep_pair_capacity=dep_pairs,
+                                  dep_edge_capacity=dep_edges))
     n_bufs = 4
     ev_per_buf = K * (cfg.conn_batch + cfg.resp_batch)
     bufs = [sim.conn_frames(K * cfg.conn_batch)
             + sim.resp_frames(K * cfg.resp_batch) for _ in range(n_bufs)]
-    for b in bufs:                      # warm compiles + absorb inserts
-        rt.feed(b)
+    # warm EVERY jit the measured loop can touch (slab fold, partial
+    # flush, pressure readback, single-batch flush path) + absorb
+    # first-seen inserts — a stray in-loop compile once cost the toy
+    # measurement 0.7s and read as a fake feed-path deficit
+    for _ in range(3):
+        for b in bufs:
+            rt.feed(b)
+    rt.td_drain(max_iters=1)
     rt.flush()
     jax.block_until_ready(rt.state)
     # calibrate from one timed feed call
@@ -222,23 +246,24 @@ def _run_phase(phase: str) -> dict:
     print(f"bench[{phase}]: device={dev.platform}:{dev.device_kind}",
           file=sys.stderr, flush=True)
     if phase == "fold_ns":
-        cfg, sim = _geometry("ns")
-        r = _bench_fold(cfg, sim, dev, "northstar")
+        cfg, sim, dp, de = _geometry("ns")
+        r = _bench_fold(cfg, sim, dev, "northstar", dp, de)
         return {"rate": round(r["rate"], 1),
                 "ms_per_dispatch": round(r["ms_per_dispatch"], 3),
                 "device": f"{dev.platform}:{dev.device_kind}"}
     if phase == "fold_toy":
-        cfg, sim = _geometry("toy")
-        r = _bench_fold(cfg, sim, dev, "toy")
+        cfg, sim, dp, de = _geometry("toy")
+        r = _bench_fold(cfg, sim, dev, "toy", dp, de)
         return {"rate": round(r["rate"], 1),
                 "ms_per_dispatch": round(r["ms_per_dispatch"], 3),
                 "device": f"{dev.platform}:{dev.device_kind}"}
     if phase == "feed_ns":
-        cfg, sim = _geometry("ns")
-        return {"rate": round(_bench_feed(cfg, sim, "northstar"), 1)}
+        cfg, sim, dp, de = _geometry("ns")
+        return {"rate": round(
+            _bench_feed(cfg, sim, "northstar", dp, de), 1)}
     if phase == "feed_toy":
-        cfg, sim = _geometry("toy")
-        return {"rate": round(_bench_feed(cfg, sim, "toy"), 1)}
+        cfg, sim, dp, de = _geometry("toy")
+        return {"rate": round(_bench_feed(cfg, sim, "toy", dp, de), 1)}
     raise SystemExit(f"unknown phase {phase!r}")
 
 

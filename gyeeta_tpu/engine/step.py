@@ -286,10 +286,18 @@ def ingest_trace(cfg: EngineCfg, st: AggState, tb) -> AggState:
     svc_tbl, svc_rows = table.upsert_fast(st.tbl, tb.svc_hi, tb.svc_lo,
                                           tb.valid)
     svc_ok = tb.valid & (svc_rows >= 0)
-    svc_host = st.svc_host.at[
-        jnp.where(svc_ok, svc_rows, cfg.svc_capacity)].set(
-        tb.host_id, mode="drop")
-    st = st._replace(tbl=svc_tbl, svc_host=svc_host)
+    svc_lanes = jnp.where(svc_ok, svc_rows, cfg.svc_capacity)
+    svc_host = st.svc_host.at[svc_lanes].set(tb.host_id, mode="drop")
+    # parsed server-side errors accumulate into the svc ser_errors
+    # gauge — REAL error counts for trace-observed services (the
+    # err-HTTP cheap tier's destination, gy_svc_net_capture.h:286).
+    # Hosts with a listener stream overwrite the gauge each 5s sweep
+    # (the agent's own count wins); trace-only sources keep the sum.
+    from gyeeta_tpu.ingest.decode import STAT_SER_ERRORS
+    svc_stats = st.svc_stats.at[svc_lanes, STAT_SER_ERRORS].add(
+        jnp.where(svc_ok & tb.is_err, 1.0, 0.0), mode="drop")
+    st = st._replace(tbl=svc_tbl, svc_host=svc_host,
+                     svc_stats=svc_stats)
     valid = tb.valid
     tbl, rows = table.upsert(st.api_tbl, tb.key_hi, tb.key_lo, valid)
     ok = valid & (rows >= 0)

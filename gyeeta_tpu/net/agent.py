@@ -133,6 +133,8 @@ class NetAgent:
             from gyeeta_tpu.net.tcpconn import TcpConnCollector
             self._tcpconn = TcpConnCollector(
                 host_id=hid, machine_id=self.machine_id)
+            if self._taskproc is not None:
+                self._taskproc.close()    # reconnect: no netlink leak
             self._taskproc = ProcTaskCollector(
                 host_id=hid, machine_id=self.machine_id)
         # server→agent control frames ride the same conn in reverse
@@ -255,6 +257,9 @@ class NetAgent:
         if self._ctrl_task:
             self._ctrl_task.cancel()
             self._ctrl_task = None
+        if self._taskproc is not None:
+            self._taskproc.close()        # netlink TASKSTATS socket
+            self._taskproc = None
         if self._writer:
             self._writer.close()
             try:

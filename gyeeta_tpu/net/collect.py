@@ -194,16 +194,23 @@ def collect_host_info(host_id: int = 0):
     r["kern_ver_id"] = mid(kern)
     r["distro_id"] = mid(distro)
     r["cputype_id"] = mid(cputype)
-    # no-egress stance: cloud IMDS intentionally not queried
-    r["instance_id"] = mid("")
-    r["region_id"] = mid("")
-    r["zone_id"] = mid("")
+    # cloud IMDS is config-gated (GYT_CLOUD_META=1) — the no-egress
+    # default stays, but the descope is a flag, not an absence
+    # (utils/cloudmeta.py; ref gy_cloud_metadata.cc:27-67)
+    from gyeeta_tpu.utils import cloudmeta
+    cm = cloudmeta.detect()
+    iid = cm["instance_id"] if cm else ""
+    region = cm["region"] if cm else ""
+    zone = cm["zone"] if cm else ""
+    r["instance_id"] = mid(iid)
+    r["region_id"] = mid(region)
+    r["zone_id"] = mid(zone)
     r["virt_type"] = 2 if in_container else (1 if hyper else 0)
-    r["cloud_type"] = 0
+    r["cloud_type"] = cm["cloud_type"] if cm else 0
     r["is_k8s"] = is_k8s
     names = InternTable.records(
         [(wire.NAME_KIND_MISC, mid(s), s)
-         for s in (kern, distro, cputype, "")])
+         for s in (kern, distro, cputype, "", iid, region, zone)])
     return out, names
 
 

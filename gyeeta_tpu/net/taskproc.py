@@ -83,14 +83,25 @@ class ProcTaskCollector:
     """
 
     def __init__(self, host_id: int = 0, machine_id: int = 1,
-                 max_groups: int = wire.MAX_TASKS_PER_BATCH):
+                 max_groups: int = wire.MAX_TASKS_PER_BATCH,
+                 netlink_delays: bool = True):
         self.host_id = host_id
         self.machine_id = machine_id
         self.max_groups = max_groups
         self._prev_pids: dict = {}     # pid -> starttime (fork detect)
         self._prev_group: dict = {}    # comm -> [cpu_ticks, blkio, runq]
+        self._prev_vm: dict = {}       # comm -> vm_delay_ns total
         self._prev_t = 0.0
         self._announced: set = set()   # comm ids already name-announced
+        # netlink TASKSTATS: swap-in + reclaim + thrashing delays, the
+        # classes schedstat cannot see (ref gy_acct_taskstat.h:209).
+        # Privilege-gated: None when CAP_NET_ADMIN/kernel support is
+        # absent — vm_delay_msec then stays 0 (documented degradation)
+        self._td = None
+        if netlink_delays:
+            from gyeeta_tpu.net import taskdelays
+            if taskdelays.available():
+                self._td = taskdelays.TaskDelayReader()
 
     def sweep(self, task_net=None, listener_of_comm=None
               ) -> tuple[np.ndarray, np.ndarray]:
@@ -108,6 +119,7 @@ class ProcTaskCollector:
                     np.empty(0, wire.NAME_INTERN_DT))
 
         groups: dict = {}   # comm -> [cpu, rss, n, forks, blkio, runq]
+        vm_now: dict = {}   # comm -> swap+reclaim+thrash delay ns total
         cur_pids: dict = {}
         for pid in pids:
             s = _read_pid(pid)
@@ -125,6 +137,13 @@ class ProcTaskCollector:
                 g[3] += 1              # new pid (or pid reuse) = a fork
             g[4] += blkio
             g[5] += runq
+            if self._td is not None:
+                d = self._td.get(int(pid))
+                if d is not None:
+                    vm_now[comm] = (vm_now.get(comm, 0)
+                                    + d["swapin_delay_ns"]
+                                    + d["freepages_delay_ns"]
+                                    + d["thrashing_delay_ns"])
         self._prev_pids = cur_pids
 
         # truncation: primary order is group size (the taskstate /
@@ -152,6 +171,7 @@ class ProcTaskCollector:
             for c in comms}
         self._prev_group = {c: [g[0], g[4], g[5]]
                             for c, g in groups.items()}
+        prev_vm_of, self._prev_vm = self._prev_vm, dict(vm_now)
         out = np.zeros(len(comms), wire.AGGR_TASK_DT)
         names = []
         from gyeeta_tpu.semantic import states as S
@@ -179,6 +199,10 @@ class ProcTaskCollector:
                     max(runq - pg[2], 0) / 1e6, 2**31)
                 r["blkio_delay_msec"] = min(
                     max(blkio - pg[1], 0) * 1000.0 / _CLK_TCK, 2**31)
+                if comm in vm_now:
+                    pv = prev_vm_of.get(comm, vm_now[comm])
+                    r["vm_delay_msec"] = min(
+                        max(vm_now[comm] - pv, 0) / 1e6, 2**31)
                 r["forks_sec"] = forks / dt
             r["rss_mb"] = min(int(rss), 2**32 - 1)
             r["ntasks_total"] = min(n, 2**16 - 1)
@@ -197,3 +221,8 @@ class ProcTaskCollector:
             r["host_id"] = self.host_id
         return out, (InternTable.records(names) if names
                      else np.empty(0, wire.NAME_INTERN_DT))
+
+    def close(self) -> None:
+        if self._td is not None:
+            self._td.close()
+            self._td = None

@@ -27,7 +27,7 @@ class ParthaSim:
     def __init__(self, n_hosts: int = 64, n_svcs: int = 16,
                  n_clients: int = 4096, seed: int = 42,
                  zipf_a: float = 1.3, n_groups: int = 8,
-                 host_base: int = 0):
+                 host_base: int = 0, cli_groups_per_svc: int = 8):
         self.n_hosts = n_hosts
         self.n_svcs = n_svcs
         self.n_clients = n_clients
@@ -37,6 +37,13 @@ class ParthaSim:
         #                              server-assigned host_id)
         self.rng = np.random.default_rng(seed)
         self.zipf_a = zipf_a
+        # distinct client PROCESS GROUPS calling each service: bounded
+        # per-svc fan-in (a service is called by a handful of
+        # deployments) — the dependency-edge working set then scales
+        # with the fleet (≈ n_svcs × this), matching the reference's
+        # bounded per-listener DEPENDS maps. Client IPs (flow identity,
+        # HLL diversity) stay zipf over the full n_clients pool.
+        self.cli_groups_per_svc = cli_groups_per_svc
         # stable 64-bit glob_ids per (host, svc): mixed so ids look like the
         # reference's hashed listener ids, not small integers; derived from
         # the GLOBAL host id so sims on different agents never collide
@@ -96,8 +103,13 @@ class ParthaSim:
         dur = (r.lognormal(1.0, 1.0, n) * 50_000).astype(np.uint64)
         out["tusec_start"] = self.tusec
         out["tusec_close"] = self.tusec + dur
+        # client group: one of the svc's bounded caller deployments
+        # (zipf over the pool so one deployment dominates per svc)
+        grp = (rank - 1) % self.cli_groups_per_svc
         out["cli_task_aggr_id"] = _splitmix64(
-            cli.astype(np.uint64) + np.uint64(0xABCD))
+            (host.astype(np.uint64) * np.uint64(131071)
+             + svc.astype(np.uint64)) * np.uint64(64)
+            + grp.astype(np.uint64) + np.uint64(0xABCD))
         out["ser_glob_id"] = self.glob_ids[host, svc]
         out["ser_related_listen_id"] = out["ser_glob_id"]
         nbytes = (r.pareto(1.5, n) + 1.0) * 2000.0

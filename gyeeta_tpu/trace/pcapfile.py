@@ -186,6 +186,23 @@ class FlowConversation(NamedTuple):
     transactions: list
 
 
+def write_pcap(frames, nsec: bool = False, linktype: int = _LINK_ETH
+               ) -> bytes:
+    """(tusec, frame_bytes) iterable → classic little-endian pcap
+    bytes (the capture round-trip, ref ``common/gy_pcap_write.cc:221``
+    — here for recording live captures into replayable fixtures).
+    ``parse_pcap(write_pcap(f))`` sees exactly the written frames."""
+    magic = _MAGIC_NSEC if nsec else _MAGIC_USEC
+    out = [struct.pack("<IHHiIII", magic, 2, 4, 0, 0, 262144, linktype)]
+    mul = 1000 if nsec else 1
+    for tusec, frame in frames:
+        frac = (tusec % 1_000_000) * mul
+        out.append(struct.pack("<IIII", tusec // 1_000_000, frac,
+                               len(frame), len(frame)))
+        out.append(frame)
+    return b"".join(out)
+
+
 def parse_pcap(buf: bytes, max_flows: int = 4096) -> list:
     """pcap bytes → [FlowConversation] (one per TCP flow with data).
 

@@ -51,6 +51,19 @@ class DnsCache:
     def annotate(self, ips) -> list:
         return [self.get(ip) for ip in ips]
 
+    def prime(self, ip: str, domain: str, ttl: float = 3600.0) -> None:
+        """Insert a PASSIVELY-LEARNED mapping (port-53 snoop,
+        ``trace/dnssnoop.py``) — what the IP was resolved AS, which
+        beats reverse lookups for CDN/VIP addresses. Same
+        oldest-expiry eviction as the resolver path: a full cache
+        keeps LEARNING (expired/negative entries go first)."""
+        if len(self._cache) >= self._capacity and ip not in self._cache:
+            for k in sorted(self._cache,
+                            key=lambda k: self._cache[k][1])[
+                    : max(1, self._capacity // 8)]:
+                del self._cache[k]
+        self._cache[ip] = (domain, self._clock() + ttl)
+
     # ------------------------------------------------------ background
     def _schedule(self, ip: str) -> None:
         if ip in self._queued:

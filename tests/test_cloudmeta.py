@@ -1,0 +1,111 @@
+"""Cloud IMDS collector (VERDICT r4 missing #8): config-gated, tested
+against a local fake metadata server for all three clouds.
+Ref: ``common/gy_cloud_metadata.cc:27-67``."""
+
+from __future__ import annotations
+
+import http.server
+import threading
+
+import pytest
+
+from gyeeta_tpu.utils import cloudmeta
+
+
+class _FakeIMDS(http.server.BaseHTTPRequestHandler):
+    mode = "aws"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, body: str, code: int = 200):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_PUT(self):
+        if self.mode == "aws" and self.path == "/latest/api/token":
+            return self._send("tok-123")
+        self._send("", 404)
+
+    def do_GET(self):
+        m, p = self.mode, self.path
+        if m == "aws":
+            # IMDSv2: the token must ride every data request
+            if self.headers.get("X-aws-ec2-metadata-token") != "tok-123":
+                return self._send("", 401)
+            if p == "/latest/meta-data/instance-id":
+                return self._send("i-0abc123")
+            if p.endswith("availability-zone"):
+                return self._send("us-west-2b")
+        elif m == "gcp":
+            if self.headers.get("Metadata-Flavor") != "Google":
+                return self._send("", 403)
+            if p == "/computeMetadata/v1/instance/id":
+                return self._send("8872615")
+            if p == "/computeMetadata/v1/instance/zone":
+                return self._send("projects/1/zones/europe-west4-a")
+        elif m == "azure":
+            if p.startswith("/metadata/instance/compute") \
+                    and self.headers.get("Metadata") == "true":
+                return self._send('{"vmId": "az-9", "location": '
+                                  '"westeurope", "zone": "2"}')
+        self._send("", 404)
+
+
+@pytest.fixture
+def imds():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _FakeIMDS)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_gated_off_by_default(monkeypatch):
+    monkeypatch.delenv("GYT_CLOUD_META", raising=False)
+    assert cloudmeta.detect() is None     # no egress without the flag
+
+
+def test_aws_imdsv2_flow(imds):
+    _FakeIMDS.mode = "aws"
+    cm = cloudmeta.detect(base=imds)
+    assert cm == {"cloud_type": cloudmeta.CLOUD_AWS,
+                  "instance_id": "i-0abc123",
+                  "region": "us-west-2", "zone": "us-west-2b"}
+
+
+def test_gcp_flow(imds):
+    _FakeIMDS.mode = "gcp"
+    cm = cloudmeta.detect(base=imds)
+    assert cm == {"cloud_type": cloudmeta.CLOUD_GCP,
+                  "instance_id": "8872615",
+                  "region": "europe-west4", "zone": "europe-west4-a"}
+
+
+def test_azure_flow(imds):
+    _FakeIMDS.mode = "azure"
+    cm = cloudmeta.detect(base=imds)
+    assert cm == {"cloud_type": cloudmeta.CLOUD_AZURE,
+                  "instance_id": "az-9", "region": "westeurope",
+                  "zone": "2"}
+
+
+def test_hostinfo_carries_cloud_fields(imds, monkeypatch):
+    """The host collector fills instance/region/zone when the gate is
+    on (env-driven, the product path)."""
+    _FakeIMDS.mode = "aws"
+    monkeypatch.setenv("GYT_CLOUD_META", "1")
+    monkeypatch.setenv("GYT_CLOUD_META_URL", imds)
+    from gyeeta_tpu.net import collect
+    from gyeeta_tpu.utils.intern import InternTable
+
+    recs, names = collect.collect_host_info(host_id=3)
+    r = recs[0]
+    assert r["cloud_type"] == cloudmeta.CLOUD_AWS
+    resolved = {int(n["name_id"]): bytes(n["name"]).split(b"\x00")[0]
+                for n in names}
+    assert resolved[int(r["instance_id"])] == b"i-0abc123"
+    assert resolved[int(r["zone_id"])] == b"us-west-2b"

@@ -1,0 +1,87 @@
+"""Passive DNS snooping (VERDICT r4 missing #5): port-53 responses →
+IP→domain mappings, unit + live-capture e2e.
+Ref: ``common/gy_dns_mapping.h:46`` (DNS packet capture → mapping)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+
+import pytest
+
+from gyeeta_tpu.trace import dnssnoop, livecap
+from gyeeta_tpu.utils.dnsmap import DnsCache
+
+
+def _dns_response(qname: str, answers, tid=0x1234) -> bytes:
+    """Build a response with name compression: answers point at the
+    question name via a 0xC00C pointer."""
+    out = struct.pack("!HHHHHH", tid, 0x8180, 1, len(answers), 0, 0)
+    for label in qname.split("."):
+        out += bytes([len(label)]) + label.encode()
+    out += b"\x00" + struct.pack("!HH", 1, 1)          # qtype A, IN
+    for ip in answers:
+        packed = socket.inet_aton(ip) if "." in ip else \
+            socket.inet_pton(socket.AF_INET6, ip)
+        rtype = 1 if "." in ip else 28
+        out += (b"\xc0\x0c" + struct.pack("!HHIH", rtype, 1, 300,
+                                          len(packed)) + packed)
+    return out
+
+
+def test_parse_response_a_and_aaaa():
+    msg = _dns_response("api.shop.example",
+                        ["203.0.113.9", "2001:db8::7"])
+    got = dnssnoop.parse_response(msg)
+    assert ("api.shop.example", "203.0.113.9") in got
+    assert ("api.shop.example", "2001:db8::7") in got
+
+
+def test_parse_rejects_queries_and_garbage():
+    query = struct.pack("!HHHHHH", 1, 0x0100, 1, 0, 0, 0) + b"\x00" * 5
+    assert dnssnoop.parse_response(query) == []
+    assert dnssnoop.parse_response(b"\x00" * 4) == []
+    # compression loop must not hang
+    loop = struct.pack("!HHHHHH", 1, 0x8180, 0, 1, 0, 0) + b"\xc0\x0c"
+    assert dnssnoop.parse_response(loop) == []
+
+
+def test_cache_priming_beats_reverse_lookup():
+    dc = DnsCache()
+    dc.prime("203.0.113.9", "api.shop.example")
+    assert dc.get("203.0.113.9") == "api.shop.example"
+    dc.close()
+
+
+@pytest.mark.skipif(not livecap.available("lo"),
+                    reason="needs CAP_NET_RAW")
+def test_live_snoop_on_loopback():
+    """A REAL UDP datagram from port 53 on lo is snooped into
+    mappings while unrelated traffic is untouched."""
+    cap = livecap.LiveCapture("lo", ports=set(), dns_snoop=True)
+    try:
+        resolver = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        resolver.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        resolver.bind(("127.0.0.1", 53))       # root: the DNS side
+        cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        cli.bind(("127.0.0.1", 0))
+        resolver.sendto(_dns_response("db.prod.internal",
+                                      ["198.51.100.4"]),
+                        cli.getsockname())
+        cli.recvfrom(4096)
+        deadline = time.time() + 5
+        while time.time() < deadline and not cap._dns:
+            cap.poll()
+            time.sleep(0.05)
+        pairs = cap.drain_dns()
+        resolver.close()
+        cli.close()
+    finally:
+        cap.close()
+    assert ("db.prod.internal", "198.51.100.4") in pairs
+    dc = DnsCache()
+    for name, ip in pairs:
+        dc.prime(ip, name)
+    assert dc.get("198.51.100.4") == "db.prod.internal"
+    dc.close()

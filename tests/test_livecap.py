@@ -1,0 +1,164 @@
+"""Live AF_PACKET capture e2e (VERDICT r4 #9): REAL loopback traffic
+→ raw packet socket → flow reassembly → parsed transactions →
+Runtime, including the error tier feeding real ``ser_errors``.
+
+Privilege-gated: skips cleanly without CAP_NET_RAW (the reference's
+capture tier likewise requires the cap,
+``common/gy_svc_net_capture.h:153``).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.trace import livecap
+
+pytestmark = pytest.mark.skipif(
+    not livecap.available("lo"),
+    reason="needs CAP_NET_RAW for AF_PACKET capture")
+
+
+def _http_server(sock, responses):
+    """Accept one conn; answer each request with the next response."""
+    conn, _ = sock.accept()
+    with conn:
+        for body, status in responses:
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    return
+                data += chunk
+            conn.sendall(
+                b"HTTP/1.1 %d X\r\nContent-Length: %d\r\n\r\n%s"
+                % (status, len(body), body))
+
+
+def _run_conversation(port_holder, responses, requests):
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    port_holder.append(port)
+    t = threading.Thread(target=_http_server, args=(srv, responses),
+                         daemon=True)
+    t.start()
+    return srv, t, port
+
+
+def test_live_capture_parses_real_http_and_errors():
+    ports: list = []
+    srv, t, port = _run_conversation(
+        ports,
+        responses=[(b"ok", 200), (b"boom", 500)],
+        requests=None)
+    cap = livecap.LiveCapture("lo", ports={port})
+    try:
+        cli = socket.create_connection(("127.0.0.1", port))
+        for path in (b"/api/items/7", b"/api/items/9"):
+            cli.sendall(b"GET " + path + b" HTTP/1.1\r\nHost: t\r\n"
+                        b"Content-Length: 0\r\n\r\n")
+            # wait for the reply before the next request (pipelining
+            # would be fine for the parser; sequencing keeps the
+            # fixture deterministic)
+            resp = b""
+            while b"\r\n\r\n" not in resp:
+                resp += cli.recv(4096)
+        cli.close()
+        t.join(timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline and cap.n_frames < 4:
+            cap.poll()
+            time.sleep(0.05)
+        flows = cap.drain()
+    finally:
+        cap.close()
+        srv.close()
+    assert len(flows) == 1
+    txns = flows[0].transactions
+    assert len(txns) == 2
+    assert txns[0].api == "GET /api/items/{}"
+    assert not txns[0].is_error and txns[1].is_error
+    assert txns[0].resp_usec >= 0
+
+    # → Runtime: tracereq rows + REAL ser_errors on svcstate
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.trace.proto import transactions_to_records
+
+    recs, name_recs = transactions_to_records(txns, svc_glob_id=0xE77,
+                                              host_id=1)
+    rt = Runtime(EngineCfg(n_hosts=4, svc_capacity=64, conn_batch=64,
+                           resp_batch=64, fold_k=2))
+    rt.feed(wire.encode_frames_chunked(wire.NOTIFY_NAME_INTERN,
+                                       name_recs)
+            + wire.encode_frames_chunked(wire.NOTIFY_REQ_TRACE, recs))
+    out = rt.query({"subsys": "svcstate",
+                    "filter": "{ svcstate.svcid = '0000000000000e77' }"})
+    assert out["nrecs"] == 1
+    assert out["recs"][0]["sererr"] == 1          # the 500, counted
+    tr = rt.query({"subsys": "tracereq"})
+    assert tr["nrecs"] >= 1
+    rt.close()
+
+
+def test_err_only_tier_keeps_only_errors():
+    """The cheap tier: same capture, only error transactions survive
+    the drain (the reference's error-HTTP capture mode)."""
+    ports: list = []
+    srv, t, port = _run_conversation(
+        ports,
+        responses=[(b"ok", 200), (b"gone", 503), (b"ok", 200)],
+        requests=None)
+    cap = livecap.LiveCapture("lo", ports={port}, err_only=True)
+    try:
+        cli = socket.create_connection(("127.0.0.1", port))
+        for _ in range(3):
+            cli.sendall(b"GET /x HTTP/1.1\r\nHost: t\r\n"
+                        b"Content-Length: 0\r\n\r\n")
+            resp = b""
+            while b"\r\n\r\n" not in resp:
+                resp += cli.recv(4096)
+        cli.close()
+        t.join(timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline and cap.n_frames < 6:
+            cap.poll()
+            time.sleep(0.05)
+        flows = cap.drain()
+    finally:
+        cap.close()
+        srv.close()
+    assert len(flows) == 1
+    assert [t.status for t in flows[0].transactions] == [503]
+
+
+def test_port_filter_excludes_other_traffic():
+    """Frames on non-selected ports never enter the ring (the
+    dynamic-BPF-filter analogue)."""
+    ports: list = []
+    srv, t, port = _run_conversation(ports, responses=[(b"ok", 200)],
+                                     requests=None)
+    cap = livecap.LiveCapture("lo", ports={port + 1})   # wrong port
+    try:
+        cli = socket.create_connection(("127.0.0.1", port))
+        cli.sendall(b"GET / HTTP/1.1\r\nHost: t\r\n"
+                    b"Content-Length: 0\r\n\r\n")
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += cli.recv(4096)
+        cli.close()
+        for _ in range(10):
+            cap.poll()
+            time.sleep(0.02)
+        assert cap.drain() == []
+    finally:
+        cap.close()
+        srv.close()

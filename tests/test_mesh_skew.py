@@ -1,0 +1,155 @@
+"""Multi-chip correctness at realistic shapes with SKEW (VERDICT r4 #8).
+
+The toy dryruns validated collectives on balanced tiny inputs; real
+fleets are skewed — one madhava absorbs a hot cluster while others
+idle. These tests run the sharded runtime on an 8-device mesh with
+thousands of services per shard and a deliberately skewed host→shard
+distribution, asserting capacity discipline end-to-end: a2a
+``cap_per_dest`` overflow is COUNTED (not silent), table ``n_drop``
+accounts every lost insert, the psum rollup balances at high fan-in,
+and queries stay correct under imbalance.
+Ref capacity contract: ``server/gy_mconnhdlr.h:94`` (bounded
+unresolved-conn maps); this repo's discipline: parallel/pairing.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.parallel import make_mesh, pairing
+from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+from gyeeta_tpu.sketch import loghist
+from gyeeta_tpu.utils.config import RuntimeOpts
+
+N_DEV = 8
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < N_DEV, reason="needs 8 virtual devices")
+
+
+def _cfg():
+    # thousands of services per shard: 2048 rows/shard × 8 shards,
+    # fed at ~50% load — a realistic madhava slice, not a toy
+    return EngineCfg(
+        svc_capacity=2048, n_hosts=256,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=64),
+        hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 8,
+        topk_capacity=32, td_capacity=16,
+        conn_batch=512, resp_batch=512, listener_batch=256)
+
+
+def _skewed_conns(n: int, n_svcs: int, rng) -> np.ndarray:
+    """TCP_CONN records with 80% of traffic from hosts ≡ 0 (mod 8) —
+    shard 0 absorbs the hot cluster while the rest idle."""
+    hot = rng.random(n) < 0.8
+    host = np.where(hot, (rng.integers(0, 32, n) * 8) % 256,
+                    rng.integers(0, 256, n))
+    recs = np.zeros(n, wire.TCP_CONN_DT)
+    svc = rng.integers(0, n_svcs, n)
+    recs["ser_glob_id"] = 0x5000_0000 + host.astype(np.uint64) * 64 + svc
+    recs["host_id"] = host
+    recs["flags"] = 2                                  # accept-side
+    recs["bytes_sent"] = rng.integers(100, 10_000, n)
+    recs["cli"]["port"] = rng.integers(1024, 65535, n)
+    recs["cli"]["ip"][:, 12:] = rng.integers(
+        0, 255, (n, 4)).astype(np.uint8)
+    return recs
+
+
+def test_skewed_fleet_folds_and_queries():
+    """Skewed ingest at thousands-of-svcs scale: every accepted insert
+    lands or is counted dropped, rollup balances, queries correct."""
+    cfg = _cfg()
+    mesh = make_mesh(N_DEV)
+    srt = ShardedRuntime(cfg, mesh, RuntimeOpts(
+        dep_pair_capacity=4096, dep_edge_capacity=1024))
+    rng = np.random.default_rng(13)
+    total = 0
+    for _ in range(4):
+        recs = _skewed_conns(4096, 48, rng)
+        total += len(recs)
+        srt.feed(b"".join(
+            wire.encode_frame(wire.NOTIFY_TCP_CONN, recs[i:i + 1024])
+            for i in range(0, len(recs), 1024)))
+    srt.flush()
+    rep = srt.run_tick()
+    assert rep["tick"] == 1
+
+    st = srt.state
+    n_live = int(np.asarray(st.tbl.n_live).sum())
+    n_drop = int(np.asarray(st.tbl.n_drop).sum())
+    # every distinct (host, svc) key either lives or was counted:
+    # ~1536 hot-cluster pairs (32 hosts × 48 svcs, saturated) plus
+    # ~2900 distinct cold draws (3277 uniform draws over 12288 pairs)
+    assert n_live + n_drop >= 3500
+    assert n_live > 2000                       # thousands live
+    # per-shard occupancy is SKEWED: shard 0 holds the hot cluster
+    per_shard = np.asarray(st.tbl.n_live)
+    assert per_shard[0] > per_shard.mean() * 2
+
+    # cluster-wide query over the imbalanced mesh stays correct
+    q = srt.query({"subsys": "svcstate", "maxrecs": 10,
+                   "sortcol": "kbin15s", "sortdesc": True})
+    assert q["nrecs"] == 10
+    assert q["ntotal"] == n_live
+    # drop-pressure discipline: any drops were surfaced, not silent
+    if n_drop:
+        assert srt.stats.counters.get("drop_pressure_events", 0) >= 1
+
+
+def test_a2a_overflow_counted_under_skew():
+    """All flows target ONE destination shard with a tiny
+    cap_per_dest: the a2a dispatch must drop the overflow AND count
+    it — n_paired + n_dropped accounts for every half sent."""
+    mesh = make_mesh(N_DEV)
+    from gyeeta_tpu.parallel.mesh import leading_sharding
+    shd = leading_sharding(mesh)
+    B, CAP = 64, 16
+    pt = pairing.pair_init_sharded(mesh, 1024)
+    rng = np.random.default_rng(5)
+    # rejection-sample flow keys so EVERY flow's owner_shard is 3 —
+    # all 8 sources dispatch into one destination's cap_per_dest
+    pool_hi = rng.integers(1, 2**31, 80_000).astype(np.uint32)
+    pool_lo = rng.integers(1, 2**31, 80_000).astype(np.uint32)
+    own = np.asarray(pairing.owner_shard(pool_hi, pool_lo, N_DEV))
+    sel = np.nonzero(own == 3)[0][: N_DEV * B]
+    assert len(sel) == N_DEV * B
+    fhi = pool_hi[sel].reshape(N_DEV, B)
+    flo = pool_lo[sel].reshape(N_DEV, B)
+    ones = np.ones((N_DEV, B), bool)
+    put = lambda x: jax.device_put(x, shd)  # noqa: E731
+    pair = pairing.pairing_fn(mesh, cap_per_dest=CAP)
+    pt, stats = pair(pt, put(fhi), put(flo), put(ones), put(ones))
+    jax.block_until_ready(pt)
+    n_sent = N_DEV * B
+    n_drop = float(stats["n_dropped"])
+    assert n_drop > 0, "overflow must be counted"
+    # accepted halves ≤ what the dest could take; total accounted
+    assert n_drop >= n_sent - N_DEV * CAP
+    # survivors: pair them with their accept halves — still functional
+    pt, stats2 = pair(pt, put(fhi), put(flo),
+                      put(np.zeros((N_DEV, B), bool)), put(ones))
+    jax.block_until_ready(pt)
+    assert float(stats2["n_paired"]) > 0
+
+
+def test_rollup_balances_at_fanin():
+    """High fan-in rollup: global counters equal the sum of skewed
+    per-shard contributions exactly (psum correctness at size)."""
+    cfg = _cfg()
+    mesh = make_mesh(N_DEV)
+    srt = ShardedRuntime(cfg, mesh)
+    rng = np.random.default_rng(3)
+    recs = _skewed_conns(8192, 32, rng)
+    srt.feed(b"".join(
+        wire.encode_frame(wire.NOTIFY_TCP_CONN, recs[i:i + 1024])
+        for i in range(0, len(recs), 1024)))
+    srt.flush()
+    from gyeeta_tpu.parallel import rollup
+    g = rollup.rollup_fn(cfg, mesh)(srt.state)
+    jax.block_until_ready(g)
+    assert float(g.n_conn) == len(recs)

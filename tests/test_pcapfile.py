@@ -153,6 +153,34 @@ def test_transactions_feed_runtime():
     assert out["recs"][0]["api"] == "GET /api/users/{}"
 
 
+def test_write_pcap_roundtrip(tmp_path):
+    """write_pcap(frames) parses back identically — the capture
+    round-trip (ref gy_pcap_write.cc:221), including a live-capture
+    record file that replays through the file-ingest path."""
+    from gyeeta_tpu.trace.pcapfile import write_pcap
+
+    req = (b"GET /api/users/42 HTTP/1.1\r\nHost: x\r\n"
+           b"Content-Length: 0\r\n\r\n")
+    resp = b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"
+    t = 1_700_000_000_000_000
+    frames = [
+        (t, _eth_ip_tcp(CLI, 40000, SER, 80, 101, req)),
+        (t + 9000, _eth_ip_tcp(SER, 80, CLI, 40000, 500, resp)),
+    ]
+    buf = write_pcap(frames)
+    (f,) = parse_pcap(buf)
+    assert f.transactions[0].api == "GET /api/users/{}"
+    assert f.transactions[0].resp_usec == 9000
+    # nanosecond variant preserves sub-usec framing
+    (f2,) = parse_pcap(write_pcap(frames, nsec=True))
+    assert f2.transactions[0].resp_usec == f.transactions[0].resp_usec
+    # file round-trip
+    p = tmp_path / "cap.pcap"
+    p.write_bytes(buf)
+    (f3,) = parse_pcap(p.read_bytes())
+    assert f3.transactions[0].api == f.transactions[0].api
+
+
 def test_true_network_reorder_and_seq_wrap():
     """Later-seq bytes captured EARLIER still reassemble (monotonized
     time merge can't undo seq order), and a flow whose sequence space
