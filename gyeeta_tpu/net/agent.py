@@ -66,7 +66,8 @@ class NetAgent:
     def __init__(self, machine_id: Optional[int] = None, seed: int = 0,
                  n_svcs: int = 4, n_groups: int = 6,
                  wire_version: int = version.CURR_WIRE_VERSION,
-                 collect: bool = False, real: bool = False):
+                 collect: bool = False, real: bool = False,
+                 livecap: bool = False, cap_ifname: str = "lo"):
         self.machine_id = machine_id if machine_id is not None \
             else H.hash_bytes_np(f"sim-agent-{seed}".encode())
         self.seed = seed
@@ -81,6 +82,16 @@ class NetAgent:
         # absent in real mode (they need eBPF the reference has and
         # userspace does not).
         self.real = real
+        # livecap=True (with real=True): REQ_TRACE_SET enables start a
+        # privilege-gated AF_PACKET capture of the traced listeners'
+        # ports; parsed transactions stream as REQ_TRACE frames — the
+        # reference's per-svc capture activation (gy_svc_net_capture.h
+        # :153), with the packet socket as the observation point
+        self.livecap = livecap
+        self.cap_ifname = cap_ifname
+        self._cap = None
+        self._cap_ports: set = set()
+        self._cap_denied = False      # CAP_NET_RAW refused (final)
         self.host_id: Optional[int] = None
         self.sim: Optional[ParthaSim] = None
         self._tcpconn = None
@@ -251,7 +262,59 @@ class NetAgent:
         hs[0]["ntasks_issue"] = int(trecs["ntasks_issue"].sum())
         hs[0]["curr_state"] = 1               # OK; issues come from the
         hs[0]["host_id"] = self.host_id       # server-side classifiers
-        return buf + wire.encode_frame(wire.NOTIFY_HOST_STATE, hs)
+        buf += wire.encode_frame(wire.NOTIFY_HOST_STATE, hs)
+        if self.livecap:
+            buf += self._livecap_frames()
+        return buf
+
+    def _livecap_frames(self) -> bytes:
+        """Drain the live capture → REQ_TRACE frames for traced svcs.
+
+        The capture's port set tracks the TRACED listeners (trace
+        control diff → ports via the sock_diag listener registry).
+        Retargeting mutates the live socket's port filter in place —
+        still-traced services keep their buffered frames and in-flight
+        TCP state. Degrades to no-op without CAP_NET_RAW (cached);
+        transient open failures retry next sweep."""
+        from gyeeta_tpu.trace import livecap as LC
+        from gyeeta_tpu.trace.proto import transactions_to_records
+
+        want = self._tcpconn.listener_ports(self.trace_enabled)
+        if not want:
+            if self._cap is not None:
+                self._cap.close()
+                self._cap = None
+            self._cap_ports = set()
+            return b""
+        if self._cap is None:
+            if self._cap_denied:
+                return b""
+            try:
+                self._cap = LC.LiveCapture(self.cap_ifname, ports=want)
+                self._cap_ports = set(want)
+            except PermissionError:
+                self._cap_denied = True       # no CAP_NET_RAW: final
+                return b""
+            except OSError:
+                return b""                    # transient: retry later
+        elif want != self._cap_ports:
+            # in-place retarget: keep the socket + buffered frames
+            self._cap.ports = set(want)
+            self._cap_ports = set(want)
+        self._cap.poll()
+        buf = b""
+        for f in self._cap.drain():
+            gid = self._tcpconn.resolve_listener(
+                f.ser[0], f.ser[1], gids=self.trace_enabled)
+            if gid is None:
+                continue
+            recs, name_recs = transactions_to_records(
+                f.transactions, svc_glob_id=gid, host_id=self.host_id)
+            buf += (wire.encode_frames_chunked(
+                wire.NOTIFY_NAME_INTERN, name_recs)
+                + wire.encode_frames_chunked(wire.NOTIFY_REQ_TRACE,
+                                             recs))
+        return buf
 
     async def close(self) -> None:
         if self._ctrl_task:
@@ -260,6 +323,9 @@ class NetAgent:
         if self._taskproc is not None:
             self._taskproc.close()        # netlink TASKSTATS socket
             self._taskproc = None
+        if self._cap is not None:
+            self._cap.close()             # AF_PACKET socket
+            self._cap = None
         if self._writer:
             self._writer.close()
             try:

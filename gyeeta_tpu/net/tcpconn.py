@@ -352,6 +352,38 @@ class TcpConnCollector:
         self._conn_prev: dict = {}         # key -> [acked, recvd, t0us, pre]
         self._first_sweep = True
 
+    # -- live-capture targeting --------------------------------------
+    def listener_ports(self, gids) -> set:
+        """TCP ports of the given listener glob ids (the live-capture
+        port filter; one registry owns the (addr, port) → gid shape)."""
+        return {port for (_a, port), (gid, _c)
+                in self._known_listeners.items() if gid in gids}
+
+    def resolve_listener(self, addr16: bytes, port: int,
+                         gids=None) -> Optional[int]:
+        """(captured server addr, port) → listener glob id.
+
+        Exact (addr, port) match wins; otherwise a wildcard-bound
+        listener on the port (0.0.0.0/:: — the common case, and the
+        reason port-only inversion would misattribute dual-stack
+        listeners); otherwise any listener on the port. Restricted to
+        ``gids`` when given so an untraced listener sharing the port
+        can never claim traced records."""
+        if len(addr16) == 4:          # pcap v4 → v4-mapped (registry
+            addr16 = b"\x00" * 10 + b"\xff\xff" + addr16   # format)
+        best = None
+        for (a, p), (gid, _c) in self._known_listeners.items():
+            if p != port or (gids is not None and gid not in gids):
+                continue
+            if a == addr16:
+                return gid
+            if a in (b"\x00" * 16,
+                     b"\x00" * 10 + b"\xff\xff" + b"\x00" * 4):
+                best = gid                         # wildcard bind
+            elif best is None:
+                best = gid
+        return best
+
     # -- one sweep ---------------------------------------------------
     def _snapshot(self) -> tuple:
         """→ (sockets, have_bytes). have_bytes is False on the /proc

@@ -66,8 +66,13 @@ class LiveCapture:
                  ports: Optional[set] = None,
                  err_only: bool = False,
                  max_frames: int = 65536,
-                 snaplen: int = 4096,
+                 snaplen: int = 65535,
                  dns_snoop: bool = False):
+        # snaplen default covers full loopback/GSO frames: recv()
+        # TRUNCATES to the buffer and a cut frame poisons the flow's
+        # TCP reassembly (sequence gap) — whole-frame capture is the
+        # correctness default; shrink only for err-only tiers that
+        # parse headers alone
         self.ifname = ifname
         self.ports = set(ports) if ports else None
         self.err_only = err_only
@@ -82,6 +87,13 @@ class LiveCapture:
                                    socket.htons(ETH_P_ALL))
         self._sock.bind((ifname, 0))
         self._sock.setblocking(False)
+        try:
+            # polled on sweep cadence (seconds apart): a deep kernel
+            # buffer absorbs the between-poll burst
+            self._sock.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_RCVBUF, 8 << 20)
+        except OSError:
+            pass
 
     # ------------------------------------------------------------ intake
     def _want(self, frame: bytes) -> bool:

@@ -178,27 +178,75 @@ def _req_signature(ptype: int, body: bytes) -> str | None:
     return None
 
 
+# response-stream tokens with a u16le length prefix the walk can skip
+# (gy_sybase_proto.h token shapes): CAPABILITY, ENVCHANGE, INFO,
+# PARAMFMT, ROWFMT, CONTROL, ORDERBY
+_U16_TOKENS = frozenset((0xE2, 0xE3, 0xA6, 0xEC, 0xEE, 0xAE, 0xA9))
+# u32le length: ROWFMT2/PARAMFMT2/ORDERBY2-class wide tokens
+_U32_TOKENS = frozenset((0x63, 0x20, 0x61))
+TOK_RETURNSTATUS = 0x79      # fixed: token + i32
+
+
 def _scan_response(body: bytes) -> tuple:
-    """→ (closed, is_error): validated EED/ERROR scan + the final
-    DONE/DONEPROC at the message tail (MORE bit clear ⇒ closed)."""
+    """→ (closed, is_error).
+
+    STRUCTURED front walk: tokens are consumed by their declared
+    shapes (DONE* 9 bytes, EED/ERROR/infra tokens length-prefixed)
+    until the first unsized token (ROW/PARAMS data needs the column
+    state of the 5299-LoC reference to size). Error evidence is
+    accepted only from tokens reached structurally — ROW PAYLOAD
+    BYTES ARE NEVER SCANNED, so 0xAA/0xE5 bytes inside result data
+    cannot false-positive (the r4 heuristic scanned the whole body
+    and could). Errors raised mid-rows still surface through the
+    final DONE's error bit, which the server sets for errored
+    commands (the tail anchor below)."""
     is_err = False
-    # the reference's resync heuristic: a real EED token's u16 length
-    # fits the remaining buffer and its severity byte is sane
     off = 0
     n = len(body)
-    while off + 3 <= n:
+    while off < n:
         tok = body[off]
+        if tok in (TOK_DONE, TOK_DONEPROC, TOK_DONEINPROC):
+            if off + 9 > n:
+                break
+            if _le16(body, off + 1) & DONE_ERROR:
+                is_err = True
+            off += 9
+            continue
         if tok in (TOK_EED, TOK_ERROR):
+            if off + 3 > n:
+                break
             ln = _le16(body, off + 1)
-            if 10 <= ln <= n - off - 3:
+            if ln < 6:
+                # a real EED/ERROR carries at least msgid+state+class;
+                # shorter means the stream is not token-aligned here —
+                # stop rather than fabricate a severity from the next
+                # token's bytes
+                break
+            if tok == TOK_ERROR:
+                is_err = True
+            else:
                 # EED: len, msgid u32, state u8, class(severity) u8
-                sev = body[off + 8] if tok == TOK_EED and \
-                    off + 9 <= n else 11
+                sev = body[off + 8] if off + 9 <= n else 11
                 if sev > 10:
                     is_err = True
-                off += 3 + ln
-                continue
-        off += 1
+            off += 3 + ln
+            continue
+        if tok == TOK_RETURNSTATUS:
+            off += 5
+            continue
+        if tok in _U16_TOKENS:
+            if off + 3 > n:
+                break
+            off += 3 + _le16(body, off + 1)
+            continue
+        if tok in _U32_TOKENS:
+            if off + 5 > n:
+                break
+            off += 5 + _le32(body, off + 1)
+            continue
+        # unsized token (ROW 0xD1, PARAMS 0xD7, …): structure is lost
+        # from here — stop; the tail DONE still closes the message
+        break
     closed = False
     if n >= 9:
         tail_tok = body[n - 9]

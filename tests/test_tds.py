@@ -133,6 +133,42 @@ def test_more_bit_keeps_transaction_open():
     assert t.resp_usec == 90
 
 
+def test_row_bytes_matching_error_tokens_do_not_false_positive():
+    """Adversarial (VERDICT r4 weak #6): mid-stream ROW payloads
+    containing 0xAA/0xE5 bytes with plausible trailing lengths must
+    NOT read as errors — error evidence is only accepted from tokens
+    reached by the structured front walk, never from row data."""
+    import struct as _s
+
+    # ROWFMT (0xEE, u16 len) then ROW (0xD1) tokens whose payload is
+    # crafted to look like ERROR/EED tokens to a byte scanner: 0xAA
+    # followed by a length that fits, 0xE5 with sane severity byte
+    rowfmt = b"\xee" + _s.pack("<H", 6) + b"\x01\x00\x00\x00\x26\x04"
+    evil_row1 = b"\xd1" + b"\xaa" + _s.pack("<H", 12) + b"X" * 12
+    evil_row2 = b"\xd1" + b"\xe5" + _s.pack("<H", 20) + b"\x00" * 5 \
+        + bytes([14]) + b"Y" * 14
+    body = rowfmt + evil_row1 + evil_row2 + done(0, 2)
+    p = SybaseParser()
+    p.feed_request(pkt(TYPE_LANG, b"select blob from t"), 0)
+    p.feed_response(resp(body), 77)
+    (t,) = p.drain()
+    assert not t.is_error, "row bytes misread as error tokens"
+    assert t.resp_usec == 77
+
+    # the same stream with the DONE error bit set IS an error (errors
+    # raised mid-rows surface through the final DONE)
+    p.feed_request(pkt(TYPE_LANG, b"select blob from t"), 100)
+    p.feed_response(resp(rowfmt + evil_row1 + done(0x0002, 0)), 180)
+    (t2,) = p.drain()
+    assert t2.is_error
+
+    # a REAL pre-row error token still detects structurally
+    p.feed_request(pkt(TYPE_LANG, b"select 1/0"), 200)
+    p.feed_response(resp(eed(14) + done(0x0002, 0)), 260)
+    (t3,) = p.drain()
+    assert t3.is_error
+
+
 def test_attention_and_garbage_resilience():
     p = SybaseParser()
     p.feed_request(pkt(6, b""), 0)              # ATTN: ignored
