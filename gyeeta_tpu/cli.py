@@ -15,7 +15,18 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
+
+# operator platform pin: GYT_PLATFORM=cpu forces the CPU backend
+# BEFORE any jax import (the JAX_PLATFORMS env var alone is overridden
+# by site configs on some hosts — e.g. the axon sitecustomize pins
+# jax_platforms — and a wedged accelerator tunnel then blocks startup
+# forever with no error)
+_plat = os.environ.get("GYT_PLATFORM")
+if _plat:
+    import jax
+    jax.config.update("jax_platforms", _plat)
 
 
 def _cmd_query(argv) -> None:

@@ -67,6 +67,9 @@ class GytServer:
             self._recorder = StreamRecorder(record_path)
         self._server: Optional[asyncio.AbstractServer] = None
         self._tick_task: Optional[asyncio.Task] = None
+        # optional liveness watchdog (utils/crashguard.TickWatchdog):
+        # beaten after each successful tick; the daemon arms it
+        self.watchdog = None
         # machine-id → host_id stickiness (the pardbmap_ placement map,
         # gy_shconnhdlr.cc:5876); optionally persisted across restarts
         self._hostmap_path = pathlib.Path(hostmap_path) \
@@ -165,6 +168,8 @@ class GytServer:
             try:
                 self.rt.run_tick()
                 await self.push_trace_control()
+                if self.watchdog is not None:
+                    self.watchdog.beat()      # liveness heartbeat
             except Exception:                     # pragma: no cover
                 log.exception("tick failed")
 

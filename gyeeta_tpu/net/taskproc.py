@@ -102,6 +102,18 @@ class ProcTaskCollector:
             from gyeeta_tpu.net import taskdelays
             if taskdelays.available():
                 self._td = taskdelays.TaskDelayReader()
+        # cn_proc connector: EVENT-accurate fork counting (ref consumes
+        # the proc-connector stream, gy_misc.h:1181) — replaces the
+        # starttime/appearance inference when the multicast join is
+        # permitted; same degradation discipline as the delays
+        self._pc = None
+        if netlink_delays:
+            from gyeeta_tpu.net import procconn
+            if procconn.available():
+                try:
+                    self._pc = procconn.ProcConnector()
+                except OSError:
+                    self._pc = None
 
     def sweep(self, task_net=None, listener_of_comm=None
               ) -> tuple[np.ndarray, np.ndarray]:
@@ -121,12 +133,14 @@ class ProcTaskCollector:
         groups: dict = {}   # comm -> [cpu, rss, n, forks, blkio, runq]
         vm_now: dict = {}   # comm -> swap+reclaim+thrash delay ns total
         cur_pids: dict = {}
+        comm_of_pid: dict = {}
         for pid in pids:
             s = _read_pid(pid)
             if s is None:
                 continue
             comm, cpu, rss, starttime, blkio, runq = s
             cur_pids[pid] = starttime
+            comm_of_pid[int(pid)] = comm
             g = groups.setdefault(comm, [0, 0.0, 0, 0, 0, 0])
             g[0] += cpu
             g[1] += rss
@@ -145,6 +159,26 @@ class ProcTaskCollector:
                                     + d["freepages_delay_ns"]
                                     + d["thrashing_delay_ns"])
         self._prev_pids = cur_pids
+
+        if self._pc is not None:
+            # event-accurate forks override the starttime inference:
+            # count FORK events by the parent's comm group (parent
+            # resolved from this sweep's /proc read; a parent that
+            # already exited falls through silently)
+            from gyeeta_tpu.net.procconn import PROC_EVENT_FORK
+            ev_forks: dict = {}
+            for e in self._pc.poll():
+                # new PROCESSES only: a thread clone also emits FORK
+                # but with child_pid != child_tgid — counting those
+                # would inflate thread-pool-heavy comms
+                if e.what == PROC_EVENT_FORK \
+                        and e.child_pid == e.child_tgid:
+                    comm = comm_of_pid.get(e.tgid)
+                    if comm is not None:
+                        ev_forks[comm] = ev_forks.get(comm, 0) + 1
+            for comm, nf in ev_forks.items():
+                if comm in groups:
+                    groups[comm][3] = nf
 
         # truncation: primary order is group size (the taskstate /
         # topcpu signal), with a BOUNDED reserve of slots for the top
@@ -226,3 +260,6 @@ class ProcTaskCollector:
         if self._td is not None:
             self._td.close()
             self._td = None
+        if self._pc is not None:
+            self._pc.close()
+            self._pc = None

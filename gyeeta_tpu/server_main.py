@@ -18,6 +18,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import signal
 from typing import Optional
 
@@ -70,10 +71,32 @@ class Daemon:
         log.info("gyt-server listening on %s:%d (svc_capacity=%d, "
                  "n_hosts=%d)", host, port, self.rt.cfg.svc_capacity,
                  self.rt.cfg.n_hosts)
+        # crash forensics + liveness watchdog (component row 8: the
+        # reference's fatal-signal backtraces + scheduler watchdogs)
+        from gyeeta_tpu.utils import crashguard
+        if self.rt.opts.checkpoint_dir:
+            os.makedirs(self.rt.opts.checkpoint_dir, exist_ok=True)
+            crash_path = f"{self.rt.opts.checkpoint_dir}/gyt_crash.log"
+        else:
+            crash_path = "/tmp/gyt_crash.log"
+        crashguard.enable_crash_dumps(crash_path)
+        watchdog = None
+        if self.args.tick_interval:
+            watchdog = crashguard.TickWatchdog(
+                stall_after_s=max(12 * self.args.tick_interval, 30.0),
+                on_stall=lambda gap: self.rt.notifylog.add(
+                    f"serving loop stalled for {gap:.0f}s "
+                    f"(stacks in {crash_path})", ntype="error",
+                    source="selfmon"))
+            watchdog.beat()
+            watchdog.start()
+            self.srv.watchdog = watchdog
         stats_task = asyncio.create_task(self._stats_loop())
         try:
             await self.stop_event.wait()
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             stats_task.cancel()
             await self.shutdown()
 

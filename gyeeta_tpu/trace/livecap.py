@@ -66,9 +66,10 @@ class LiveCapture:
                  ports: Optional[set] = None,
                  err_only: bool = False,
                  max_frames: int = 65536,
-                 snaplen: int = 65535,
+                 snaplen: int = 1 << 17,
                  dns_snoop: bool = False):
-        # snaplen default covers full loopback/GSO frames: recv()
+        # snaplen default covers full loopback/GSO frames WITH their
+        # link header (14B ethernet + up to 64KiB IP > 65535): recv()
         # TRUNCATES to the buffer and a cut frame poisons the flow's
         # TCP reassembly (sequence gap) — whole-frame capture is the
         # correctness default; shrink only for err-only tiers that
@@ -83,6 +84,10 @@ class LiveCapture:
         self.n_frames = 0
         self._frames: list[tuple[int, bytes]] = []
         self._dns: list[tuple[str, str]] = []
+        # cross-drain continuity for boundary-spanning transactions
+        self._carry: list[tuple[int, bytes]] = []
+        self._emitted: dict = {}      # flow key -> txns already emitted
+        self._pending_age: dict = {}  # flow key -> drains w/o progress
         self._sock = socket.socket(socket.AF_PACKET, socket.SOCK_RAW,
                                    socket.htons(ETH_P_ALL))
         self._sock.bind((ifname, 0))
@@ -149,22 +154,81 @@ class LiveCapture:
         return got
 
     # ------------------------------------------------------------- drain
+    @staticmethod
+    def _flow_key(frame: bytes):
+        """Frame → normalized flow key (parse_pcap's key), or None."""
+        l3 = PF._l3_offset(PF._LINK_ETH, frame)
+        if l3 is None:
+            return None
+        parsed = PF._parse_ip_tcp(frame[l3:])
+        if parsed is None:
+            return None
+        src, sport, dst, dport = parsed[:4]
+        a, b = (src, sport), (dst, dport)
+        return (a, b) if a <= b else (b, a)
+
+    # retained pending flows age out after this many drains without a
+    # completed transaction (half-open conns must not pin frames)
+    _PENDING_MAX_DRAINS = 8
+    _PENDING_MAX_FRAMES = 4096
+
     def drain(self, record_path: Optional[str] = None):
-        """Parse buffered frames → [FlowConversation] (pcap-file
-        semantics; the buffer resets). ``err_only`` filters each
-        flow's transactions to errors. ``record_path`` additionally
-        appends the drained capture as a replayable pcap file (the
-        write round-trip, ``pcapfile.write_pcap``)."""
-        frames, self._frames = self._frames, []
-        if not frames:
-            return []
-        buf = PF.write_pcap(frames)
-        if record_path:
+        """Parse buffered frames → [FlowConversation] with NEW
+        transactions only. Flows whose parser still holds an
+        unanswered request keep their frames across drains, so a
+        transaction spanning a capture window (the slow ones — exactly
+        the interesting tail) completes in a later drain instead of
+        splitting. ``err_only`` filters transactions to errors;
+        ``record_path`` appends the NEW frames as replayable pcap."""
+        new_frames, self._frames = self._frames, []
+        if record_path and new_frames:
+            buf_new = PF.write_pcap(new_frames)
             with open(record_path, "ab") as f:
                 # one global header per file: append records only when
                 # the file already exists with content
-                f.write(buf if f.tell() == 0 else buf[24:])
-        flows = PF.parse_pcap(buf)
+                f.write(buf_new if f.tell() == 0 else buf_new[24:])
+        frames = sorted(self._carry + new_frames)
+        self._carry = []
+        if not frames:
+            return []
+        flows = PF.parse_pcap(PF.write_pcap(frames),
+                              include_pending=True)
+        by_key: dict = {}
+        for tus, fr in frames:
+            k = self._flow_key(fr)
+            if k is not None:
+                by_key.setdefault(k, []).append((tus, fr))
+        out = []
+        seen_keys = set()
+        for f in flows:
+            a, b = f.cli, f.ser
+            k = (a, b) if a <= b else (b, a)
+            seen_keys.add(k)
+            done_before = self._emitted.get(k, 0)
+            new_txns = f.transactions[done_before:]
+            if f.pending:
+                age = self._pending_age.get(k, 0) + (0 if new_txns
+                                                     else 1)
+                kept = by_key.get(k, [])[-self._PENDING_MAX_FRAMES:]
+                if age <= self._PENDING_MAX_DRAINS:
+                    self._carry.extend(kept)
+                    self._emitted[k] = len(f.transactions)
+                    self._pending_age[k] = age
+                else:                      # stale half-open flow
+                    self._emitted.pop(k, None)
+                    self._pending_age.pop(k, None)
+            else:
+                self._emitted.pop(k, None)
+                self._pending_age.pop(k, None)
+            if new_txns:
+                f = f._replace(transactions=list(new_txns))
+                out.append(f)
+        # bookkeeping for keys that produced no flow this round
+        for k in list(self._emitted):
+            if k not in seen_keys:
+                self._emitted.pop(k, None)
+                self._pending_age.pop(k, None)
+        flows = out
         if self.err_only:
             for f in flows:
                 f.transactions[:] = [t for t in f.transactions

@@ -184,6 +184,9 @@ class FlowConversation(NamedTuple):
     ser: tuple
     proto: int
     transactions: list
+    pending: bool = False     # parser still holds an unanswered
+    #                           request / partial buffers (the flow's
+    #                           conversation spans past this capture)
 
 
 def write_pcap(frames, nsec: bool = False, linktype: int = _LINK_ETH
@@ -203,12 +206,16 @@ def write_pcap(frames, nsec: bool = False, linktype: int = _LINK_ETH
     return b"".join(out)
 
 
-def parse_pcap(buf: bytes, max_flows: int = 4096) -> list:
+def parse_pcap(buf: bytes, max_flows: int = 4096,
+               include_pending: bool = False) -> list:
     """pcap bytes → [FlowConversation] (one per TCP flow with data).
 
     Direction: the SYN sender is the client; SYN-less flows (capture
     started mid-conversation) fall back to "lower endpoint dialed
     higher port" and protocol detection disambiguates.
+    ``include_pending`` also returns transaction-less flows whose
+    parser holds an unanswered request (live-capture windows retain
+    their frames so boundary-spanning transactions complete later).
     """
     endian, nsec, linktype, off = _read_global_header(buf)
     div = 1000 if nsec else 1
@@ -280,7 +287,9 @@ def parse_pcap(buf: bytes, max_flows: int = 4096) -> list:
             else:
                 parser.feed_response(chunk, tusec)
         txns = parser.drain()
-        if txns:
+        pending = bool(getattr(parser, "_pending", ()))
+        if txns or (include_pending and pending):
             out.append(FlowConversation(cli=cli, ser=ser, proto=proto,
-                                        transactions=txns))
+                                        transactions=txns,
+                                        pending=pending))
     return out

@@ -32,10 +32,17 @@ class NotifyLog:
                  clock: Optional[callable] = None):
         self._ring: collections.deque = collections.deque(maxlen=maxlen)
         self._clock = clock or time.time
+        # producers include non-loop threads (the crashguard watchdog,
+        # alert delivery callbacks): a lock keeps columns()'s snapshot
+        # iteration safe against cross-thread appends
+        import threading
+        self._lock = threading.Lock()
 
     def add(self, msg: str, ntype: str = NOTIFY_INFO,
             source: str = "server") -> None:
-        self._ring.append(Notification(self._clock(), ntype, source, msg))
+        with self._lock:
+            self._ring.append(
+                Notification(self._clock(), ntype, source, msg))
 
     def add_alert(self, alert) -> None:
         """One fired :class:`~gyeeta_tpu.alerts.manager.Alert` → entry
@@ -51,7 +58,8 @@ class NotifyLog:
 
     def columns(self, names=None):
         """Newest first."""
-        rows = list(self._ring)[::-1]
+        with self._lock:
+            rows = list(self._ring)[::-1]
         n = len(rows)
 
         def obj(vals):

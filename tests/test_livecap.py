@@ -140,6 +140,76 @@ def test_err_only_tier_keeps_only_errors():
     assert [t.status for t in flows[0].transactions] == [503]
 
 
+def test_transaction_spanning_drains_completes():
+    """A request captured in one drain window whose response arrives
+    in the NEXT window still yields its transaction (pending-flow
+    frames carry across drains) — and is emitted exactly once."""
+    import threading
+
+    release = threading.Event()       # gates the SECOND response
+
+    def gated_server(sock):
+        conn, _ = sock.accept()
+        with conn:
+            for i in range(2):
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        return
+                    data += chunk
+                if i == 1:
+                    release.wait(10)  # the drain happens before this
+                conn.sendall(b"HTTP/1.1 200 X\r\n"
+                             b"Content-Length: 2\r\n\r\nok")
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    t = threading.Thread(target=gated_server, args=(srv,), daemon=True)
+    t.start()
+    cap = livecap.LiveCapture("lo", ports={port})
+    try:
+        cli = socket.create_connection(("127.0.0.1", port))
+        cli.sendall(b"GET /slow/1 HTTP/1.1\r\nHost: t\r\n"
+                    b"Content-Length: 0\r\n\r\n")
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += cli.recv(4096)
+        # second request sent; its response is GATED past the drain
+        cli.sendall(b"GET /slow/2 HTTP/1.1\r\nHost: t\r\n"
+                    b"Content-Length: 0\r\n\r\n")
+        time.sleep(0.3)
+        for _ in range(20):
+            cap.poll()
+            time.sleep(0.02)
+        mid = cap.drain()
+        got_mid = sum(len(f.transactions) for f in mid)
+        assert got_mid == 1                 # only the answered one
+        release.set()
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += cli.recv(4096)
+        cli.close()
+        t.join(timeout=5)
+        before = cap.n_frames
+        deadline = time.time() + 5
+        while time.time() < deadline and cap.n_frames == before:
+            cap.poll()
+            time.sleep(0.02)
+        for _ in range(10):                 # absorb the burst fully
+            cap.poll()
+            time.sleep(0.02)
+        late = cap.drain()
+    finally:
+        cap.close()
+        srv.close()
+    txns = [t for f in late for t in f.transactions]
+    assert [t.api for t in txns] == ["GET /slow/{}"]  # ONCE, not resent
+
+
 def test_port_filter_excludes_other_traffic():
     """Frames on non-selected ports never enter the ring (the
     dynamic-BPF-filter analogue)."""
