@@ -338,15 +338,32 @@ class ShardedRuntime:
                 if (isinstance(idx, slice) and idx.start is not None
                         and idx.stop is not None
                         and idx.start <= s < idx.stop):
-                    # sh.data is single-device: slicing it is local
+                    if sh.data.platform() == "cpu":
+                        # zero-copy host view (see _shard_state for
+                        # the lifetime discipline)
+                        return np.asarray(sh.data)[s - idx.start]
+                    # accelerator: slice stays on-device
                     return sh.data[s - idx.start]
         return np.asarray(x)[s]
 
     def _shard_state(self, s: int):
-        """Shard s's full state slice (leaves stay device arrays; the
-        provider's jitted snapshot consumes them and only its outputs
-        come to host)."""
-        return jax.tree.map(lambda x: self._shard_leaf(x, s), self.state)
+        """Shard s's full state slice for the per-shard column
+        providers.
+
+        On the CPU platform the slice is a zero-copy NUMPY VIEW of the
+        shard's buffer (measured: eager jnp slicing costs ~26-430 ms
+        PER LEAF in dispatch overhead — ~10 s per merge at the 51k
+        geometry, the r5 post-tick cold-query profile; the view is
+        0.01 ms). Views alias device buffers, so they must never
+        outlive a donating fold: ColumnCache holds them (here and in
+        the providers' LazyCols closures) and ``feed`` bumps/evicts at
+        entry, BEFORE any donating dispatch — queries and feeds share
+        one thread, so no view survives into a fold. On accelerators
+        the device-side slice path keeps data on-chip."""
+        return self._cols.get(
+            f"__shard_state_{s}",
+            lambda: jax.tree.map(lambda x: self._shard_leaf(x, s),
+                                 self.state))
 
     def _hosts_ever_reported(self, s: int) -> np.ndarray:
         """Shard s's ``host_last_tick`` as a host array — the single
@@ -421,7 +438,8 @@ class ShardedRuntime:
         if all(isinstance(p[0], LazyCols) for p in parts):
             # lazy groups concatenate on first reference — a sharded
             # query reads only the groups its filter/sort names
-            cols = merge_lazy([p[0] for p in parts])
+            cols = merge_lazy([p[0] for p in parts],
+                              widths=[len(p[1]) for p in parts])
         else:
             cols = {k: np.concatenate([p[0][k] for p in parts])
                     for k in parts[0][0]}
@@ -596,6 +614,11 @@ class ShardedRuntime:
         query subsystem reads the digest, so this is off the <1s
         query path."""
         self.flush()
+        # the flushes below DONATE state: cached zero-copy shard views
+        # (and LazyCols closures) from the current version must be
+        # evicted BEFORE the first donating dispatch, or a later
+        # cache-hit query would read reused buffers
+        self._cols.bump()
         i = 0
         while max_iters is None or i < max_iters:
             if int(self._td_pressure(self.state)) <= 0:

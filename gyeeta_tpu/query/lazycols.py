@@ -94,18 +94,33 @@ class LazyCols(dict):
         return out
 
 
-def merge_lazy(parts) -> "LazyCols":
+def merge_lazy(parts, widths=None) -> "LazyCols":
     """Concatenate per-shard LazyCols into one lazy merged set.
 
     Eager columns concatenate now; each lazy group concatenates on
     first reference — so a sharded filter/sort query still reads only
-    the groups it names (the sharded half of VERDICT r4 #6). Row
-    loaders don't survive the merge (result indices span shards); the
-    projection path falls back to group materialization + slicing.
-    """
+    the groups it names. Row loaders DO survive the merge: merged
+    result indices split by shard offset and route to each part's own
+    row loader, so projection of ``maxrecs`` rows stays O(result) on
+    the mesh too (the sharded half of VERDICT r4 #6).
+
+    ``widths`` (per-part row counts) is required when the parts carry
+    no eager columns to derive it from."""
     eager_keys = list(dict.keys(parts[0]))
     eager = {k: np.concatenate([np.asarray(dict.__getitem__(p, k))
                                 for p in parts]) for k in eager_keys}
+    if widths is None:
+        if not eager_keys:
+            raise ValueError(
+                "merge_lazy needs explicit widths when parts have no "
+                "eager columns (zero offsets would misroute every "
+                "row-loader index)")
+        widths = [len(dict.__getitem__(p, eager_keys[0]))
+                  for p in parts]
+    offsets = np.concatenate([[0], np.cumsum(widths)])
+    cols_of_group: dict = {}
+    for c, g in parts[0]._group_of.items():
+        cols_of_group.setdefault(g, []).append(c)
 
     def _concat_group(g):
         def load():
@@ -114,8 +129,30 @@ def merge_lazy(parts) -> "LazyCols":
                     for c in ds[0]}
         return load
 
+    def _rows_group(g):
+        def load(idx):
+            idx = np.asarray(idx, np.int64)
+            if len(idx) == 0:
+                return {c: np.empty(0, np.float64)
+                        for c in cols_of_group[g]}
+            shard = np.searchsorted(offsets, idx, "right") - 1
+            out: dict = {}
+            for s in np.unique(shard):
+                at = np.nonzero(shard == s)[0]
+                got = parts[s].rows_many(cols_of_group[g],
+                                         idx[at] - offsets[s])
+                for c, v in got.items():
+                    col = out.get(c)
+                    if col is None:
+                        col = np.empty(len(idx), np.asarray(v).dtype)
+                        out[c] = col
+                    col[at] = v
+            return out
+        return load
+
     return LazyCols(eager, dict(parts[0]._group_of),
-                    {g: _concat_group(g) for g in parts[0]._load})
+                    {g: _concat_group(g) for g in parts[0]._load},
+                    {g: _rows_group(g) for g in parts[0]._load})
 
 
 def rows_of(cols, colnames, idx: np.ndarray) -> dict:

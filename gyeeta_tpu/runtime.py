@@ -388,6 +388,10 @@ class Runtime:
         lossless estimator, mirroring the reference's ~50% response
         sampling (``common/gy_ebpf.h:29``). Returns flushes run."""
         self.flush()
+        # the flushes below donate state: evict cached column closures
+        # capturing the current state object (a cache hit after the
+        # donation would dereference deleted device buffers)
+        self._cols.bump()
         i = 0
         while max_iters is None or i < max_iters:
             if int(self._stage_pressure(self.state)) <= 0:
