@@ -203,6 +203,13 @@ def main() -> None:
               f"{big['warm_filtered_sorted_p99_ms']}ms "
               f"({big['n_services']} svcs)", flush=True)
 
+    # the one-line metric must agree with meets_target: worst over
+    # EVERY gated number, both stages
+    if "big_51k" in out:
+        out["worst_p99_ms"] = max(
+            out["worst_p99_ms"],
+            out["big_51k"]["post_tick_cold_ms"],
+            out["big_51k"]["warm_filtered_sorted_p99_ms"])
     art = os.environ.get("GYT_QUERYLAT_ART", "QUERYLAT_r05.json")
     with open(art, "w") as f:
         json.dump(out, f, indent=1)

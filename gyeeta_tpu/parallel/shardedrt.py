@@ -322,6 +322,11 @@ class ShardedRuntime:
         is fully query-ready afterwards. Called at every tick/query
         boundary."""
         folded = self._n_conn_raw + self._n_resp_raw
+        if folded:
+            # evict BEFORE the donating dispatches: cached zero-copy
+            # shard views must never alias a donated buffer (the
+            # single-node twin bumps here too)
+            self._cols.bump()
         while self._n_conn_raw or self._n_resp_raw:
             self._dispatch_slab(self.cfg.conn_batch,
                                 self.cfg.resp_batch)

@@ -133,8 +133,9 @@ def merge_lazy(parts, widths=None) -> "LazyCols":
         def load(idx):
             idx = np.asarray(idx, np.int64)
             if len(idx) == 0:
-                return {c: np.empty(0, np.float64)
-                        for c in cols_of_group[g]}
+                # delegate so empty columns keep their REAL dtypes
+                # (string groups are object arrays, not float64)
+                return parts[0].rows_many(cols_of_group[g], idx)
             shard = np.searchsorted(offsets, idx, "right") - 1
             out: dict = {}
             for s in np.unique(shard):
