@@ -62,6 +62,14 @@ def _cmd_agent(argv) -> None:
                     help="observe THIS host's real TCP connections and "
                     "listeners (sock_diag sweep) instead of simulated "
                     "flows; implies --collect semantics for flows only")
+    ap.add_argument("--livecap", action="store_true",
+                    help="with --real: when the server enables tracing "
+                    "for a listener (REQ_TRACE_SET), capture its "
+                    "port's live traffic via AF_PACKET and stream "
+                    "parsed transactions (needs CAP_NET_RAW; degrades "
+                    "cleanly without)")
+    ap.add_argument("--cap-ifname", default="lo",
+                    help="interface for --livecap captures")
     ap.add_argument("--n-agents", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--interval", type=float, default=5.0)
@@ -72,7 +80,8 @@ def _cmd_agent(argv) -> None:
     async def run():
         from gyeeta_tpu.net.agent import NetAgent
         agents = [NetAgent(seed=args.seed + i, collect=args.collect,
-                           real=args.real)
+                           real=args.real, livecap=args.livecap,
+                           cap_ifname=args.cap_ifname)
                   for i in range(args.n_agents)]
         for a in agents:
             hid = await a.connect(args.host, args.port)

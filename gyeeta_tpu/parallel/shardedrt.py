@@ -324,8 +324,12 @@ class ShardedRuntime:
         folded = self._n_conn_raw + self._n_resp_raw
         if folded:
             # evict BEFORE the donating dispatches: cached zero-copy
-            # shard views must never alias a donated buffer (the
-            # single-node twin bumps here too)
+            # shard views must never alias a donated buffer. (The
+            # single-node twin bumps AFTER its folds — safe there
+            # because its closures hold jax arrays that error loudly
+            # if ever read post-donation, and the single thread has no
+            # read window mid-flush; numpy views would read reused
+            # memory SILENTLY, so this path evicts up front.)
             self._cols.bump()
         while self._n_conn_raw or self._n_resp_raw:
             self._dispatch_slab(self.cfg.conn_batch,
