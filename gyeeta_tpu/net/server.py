@@ -46,7 +46,8 @@ class GytServer:
                  port: int = 0, tick_interval: Optional[float] = 5.0,
                  hostmap_path: Optional[str] = None,
                  record_path: Optional[str] = None,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 feed_pipeline: bool = False):
         self.rt = rt
         self.host = host
         self.port = port
@@ -80,6 +81,17 @@ class GytServer:
         # reference's CLI_TYPE_RESP_REQ conns carry this, gy_comm_proto.h)
         self._event_writers: dict[int, asyncio.StreamWriter] = {}
         self._open_conns: set = set()      # every live conn's writer
+        # optional L1/L2 decode pipeline (multi-core hosts): deframe
+        # runs on a worker thread; tick/query paths barrier through
+        # _feed_barrier so no submitted bytes are invisible at a
+        # cadence or query boundary
+        self._pipe = None
+        if feed_pipeline:
+            from gyeeta_tpu.ingest.pipeline import FeedPipeline
+            # the recorder moves INTO the pipeline: only buffers that
+            # decoded cleanly get recorded (replayability; see the
+            # pipeline docstring for the poison-frame divergence)
+            self._pipe = FeedPipeline(rt, recorder=self._recorder)
         # stock-partha registration state: machine-id → the ident key
         # issued at PS_REGISTER (the SM_PARTHA_IDENT_NOTIFY flow,
         # gy_comm_proto.h:946 — shyama hands the key to madhava; the
@@ -132,6 +144,20 @@ class GytServer:
                 source="agent")
         return wire.REG_OK, hid
 
+    # ----------------------------------------------------------- feed path
+    def _feed(self, buf: bytes) -> int:
+        """Ingest complete-frame bytes: through the decode pipeline
+        when enabled, else directly."""
+        if self._pipe is not None:
+            return self._pipe.feed(buf)
+        return self.rt.feed(buf)
+
+    def _feed_barrier(self) -> None:
+        """Make every submitted byte visible (pipeline barrier) before
+        a tick or query reads state."""
+        if self._pipe is not None:
+            self._pipe.flush()
+
     # ------------------------------------------------------------- serving
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
@@ -160,12 +186,15 @@ class GytServer:
         if self._recorder is not None:
             rec, self._recorder = self._recorder, None
             rec.close()      # live conns see None, never a closed file
+        if self._pipe is not None:
+            self._pipe.close()           # barrier + worker shutdown
         self.rt.close()      # alert delivery worker + history handle
 
     async def _tick_loop(self) -> None:
         while True:
             await asyncio.sleep(self.tick_interval)
             try:
+                self._feed_barrier()
                 self.rt.run_tick()
                 await self.push_trace_control()
                 if self.watchdog is not None:
@@ -415,9 +444,11 @@ class GytServer:
                     raise
                 pending = data[k:]
                 if gyt:
-                    self.rt.feed(gyt)
+                    self._feed(gyt)
+                    # pipeline mode records inside the pipeline (only
+                    # validated buffers)
                     rec = self._recorder
-                    if rec is not None:
+                    if rec is not None and self._pipe is None:
                         rec.write(gyt)
                 continue
             try:
@@ -432,9 +463,9 @@ class GytServer:
                 # feed FIRST: a chunk that fails deep validation
                 # (nevents caps) must not poison the capture file —
                 # recorded bytes are exactly the ingested bytes
-                self.rt.feed(data[:k])
+                self._feed(data[:k])
                 rec = self._recorder   # no await between check & write
-                if rec is not None:
+                if rec is not None and self._pipe is None:
                     rec.write(data[:k])
 
     async def _query_loop(self, reader, writer) -> None:
@@ -456,6 +487,7 @@ class GytServer:
             outstanding += 1
             try:
                 self.rt.stats.bump("net_queries")
+                self._feed_barrier()
                 out = self.rt.query(req)
             except Exception as e:
                 outstanding -= 1

@@ -214,6 +214,12 @@ class Runtime:
             self._pending = b""       # poison frame: drop buffer, resync
             raise
         self._pending = data[consumed:]
+        return self.ingest_records(recs)
+
+    def ingest_records(self, recs: dict) -> int:
+        """Fold a drained {subtype: record array} dict (the post-
+        deframe half of :meth:`feed` — the feed pipeline's decode
+        worker hands these over, ``ingest/pipeline.py``)."""
         n = 0
         # conn/resp hot path: stage the raw record arrays as-is — the
         # per-slab decode in _dispatch_slab is the only decode they get

@@ -62,7 +62,9 @@ class Daemon:
         self.srv = GytServer(self.rt, host=args.host, port=args.port,
                              tick_interval=args.tick_interval,
                              hostmap_path=args.hostmap,
-                             record_path=args.record)
+                             record_path=args.record,
+                             feed_pipeline=getattr(
+                                 args, "feed_pipeline", False))
         self._hot = C.HotReload(args.config, opts) if args.config else None
         self.stop_event = asyncio.Event()
 
@@ -186,6 +188,10 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--record", help="tee ingested wire bytes to this "
                     "capture file (replay with `gyeeta_tpu replay`)")
     ap.add_argument("--tick-interval", type=float, default=5.0)
+    ap.add_argument("--feed-pipeline", action="store_true",
+                    help="deframe/decode on a worker thread (the "
+                    "reference's L1/L2 split; useful on multi-core "
+                    "hosts — the native decoders release the GIL)")
     ap.add_argument("--stats-interval", type=float, default=60.0)
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
