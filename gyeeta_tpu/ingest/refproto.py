@@ -50,6 +50,7 @@ REF_NOTIFY_LISTENER_STATE = 0x309
 REF_NOTIFY_TCP_CONN = 0x30C
 REF_NOTIFY_AGGR_TASK_STATE = 0x310
 REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
+REF_NOTIFY_LISTEN_TASKMAP = 0x314
 
 # version encoding: get_version_from_string("a.b.c", 3) = a<<16|b<<8|c
 REF_COMM_VERSION = 1             # COMM_VERSION_NUM (gy_comm_proto.h:16)
@@ -262,8 +263,41 @@ _HSZ = REF_HEADER_DT.itemsize
 _ESZ = REF_EVENT_NOTIFY_DT.itemsize
 
 
+# LISTEN_TASKMAP_NOTIFY fixed part (gy_comm_proto.h:2813); nlisten_
+# u64 listener glob ids then naggr u64 task ids follow each record
+REF_LISTEN_TASKMAP_DT = np.dtype([
+    ("related_listen_id", "<u8"), ("ser_comm", "S16"),
+    ("nlisten", "<u2"), ("naggr_taskid", "<u2"),
+    ("tailpad", "u1", (4,)),
+])
+assert REF_LISTEN_TASKMAP_DT.itemsize == 32
+
+
 class RefFrameError(wire.FrameError):
     pass
+
+
+class RefSession:
+    """Per-connection adapter state for a stock-partha stream.
+
+    The reference resolves task↔listener linkage server-side from
+    LISTEN_TASKMAP events (``gy_comm_proto.h:2813``); this holds that
+    map so subsequent AGGR_TASK_STATE records carry their
+    ``related_listen_id`` (without it, stock task rows never link to
+    their services — taskstate.relsvcid / svcprocmap would stay
+    empty for stock fleets). Bounded: newest mappings win."""
+
+    MAX_TASKS = 1 << 20
+
+    def __init__(self):
+        self.rel_of_task: dict = {}
+
+    def learn_taskmap(self, rel_id: int, task_ids) -> None:
+        for t in task_ids:
+            if len(self.rel_of_task) >= self.MAX_TASKS \
+                    and int(t) not in self.rel_of_task:
+                self.rel_of_task.clear()     # epoch reset, re-learns
+            self.rel_of_task[int(t)] = rel_id
 
 
 def _check_nevents(nevents: int, payload: bytes, fsz: int, cap: int,
@@ -383,7 +417,32 @@ def decode_listener_state(payload: bytes, nevents: int, host_id: int
     return out, names
 
 
-def decode_aggr_task(payload: bytes, nevents: int, host_id: int
+def decode_listen_taskmap(payload: bytes, nevents: int,
+                          session: "RefSession") -> None:
+    """LISTEN_TASKMAP walk → session task→listener map (no GYT frames;
+    linkage applies to later AGGR_TASK_STATE records)."""
+    fsz = REF_LISTEN_TASKMAP_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 2048, "listen_taskmap")
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"listen_taskmap record {i} truncated")
+        rec = np.frombuffer(payload, REF_LISTEN_TASKMAP_DT, count=1,
+                            offset=off)[0]
+        nl, na = int(rec["nlisten"]), int(rec["naggr_taskid"])
+        if nl > 2048 or na > 128:        # the reference's own caps
+            raise RefFrameError(f"listen_taskmap record {i} overflows")
+        end = off + fsz + (nl + na) * 8
+        if end > len(payload):
+            raise RefFrameError(f"listen_taskmap record {i} overflows")
+        tasks = np.frombuffer(payload, "<u8", count=na,
+                              offset=off + fsz + nl * 8)
+        session.learn_taskmap(int(rec["related_listen_id"]), tasks)
+        off = end
+
+
+def decode_aggr_task(payload: bytes, nevents: int, host_id: int,
+                     session: "RefSession | None" = None
                      ) -> tuple[np.ndarray, list]:
     fsz = REF_AGGR_TASK_DT.itemsize
     _check_nevents(nevents, payload, fsz, wire.MAX_TASKS_PER_BATCH,
@@ -412,8 +471,11 @@ def decode_aggr_task(payload: bytes, nevents: int, host_id: int
             nid = InternTable.intern(comm, wire.NAME_KIND_COMM)
             r["comm_id"] = nid
             names.append((wire.NAME_KIND_COMM, nid, comm))
-        # the reference resolves task→listener linkage server-side via
-        # its listen-taskmap events; absent here → 0 (unlinked)
+        # task→listener linkage from the session's LISTEN_TASKMAP map
+        # (sessionless callers: 0 = unlinked)
+        if session is not None:
+            r["related_listen_id"] = session.rel_of_task.get(
+                int(rec["aggr_task_id"]), 0)
         r["host_id"] = host_id
         off = end
     return out, names
@@ -605,18 +667,22 @@ def decode_task_top_procs(payload: bytes, nevents: int, host_id: int
     return out, names
 
 
+# subtype → (decoder, gyt_subtype, wants_session): session-aware
+# decoders take the per-conn RefSession as a keyword (table-encoded so
+# the dispatch loop stays generic as stateful subtypes accumulate)
 _DECODER_OF = {
-    REF_NOTIFY_TCP_CONN: (decode_tcp_conn, wire.NOTIFY_TCP_CONN),
+    REF_NOTIFY_TCP_CONN: (decode_tcp_conn, wire.NOTIFY_TCP_CONN,
+                          False),
     REF_NOTIFY_LISTENER_STATE: (decode_listener_state,
-                                wire.NOTIFY_LISTENER_STATE),
+                                wire.NOTIFY_LISTENER_STATE, False),
     REF_NOTIFY_AGGR_TASK_STATE: (decode_aggr_task,
-                                 wire.NOTIFY_AGGR_TASK_STATE),
+                                 wire.NOTIFY_AGGR_TASK_STATE, True),
     REF_NOTIFY_NEW_LISTENER: (decode_new_listener,
-                              wire.NOTIFY_LISTENER_INFO),
+                              wire.NOTIFY_LISTENER_INFO, False),
     REF_NOTIFY_ACTIVE_CONN_STATS: (decode_active_conn,
-                                   wire.NOTIFY_TCP_CONN),
+                                   wire.NOTIFY_TCP_CONN, False),
     REF_NOTIFY_TASK_TOP_PROCS: (decode_task_top_procs,
-                                wire.NOTIFY_AGGR_TASK_STATE),
+                                wire.NOTIFY_AGGR_TASK_STATE, False),
 }
 
 
@@ -781,7 +847,8 @@ def parse_pm_connect_resp(buf: bytes) -> dict:
             "madhava_version": int(r["madhava_version"])}
 
 
-def adapt(buf: bytes, host_id: int) -> tuple[bytes, int]:
+def adapt(buf: bytes, host_id: int,
+          session: "RefSession | None" = None) -> tuple[bytes, int]:
     """Reference byte stream → GYT wire frames, ready for
     ``Runtime.feed``.
 
@@ -789,7 +856,9 @@ def adapt(buf: bytes, host_id: int) -> tuple[bytes, int]:
     caller, epoll-resume semantics like ``wire.decode_frames``);
     adapts known partha→madhava event subtypes, emits NAME_INTERN
     frames for every trailing string, and skips unknown subtypes
-    frame-whole. Returns ``(gyt_bytes, consumed)``.
+    frame-whole. ``session`` carries per-connection adapter state
+    (the LISTEN_TASKMAP task→listener linkage). Returns
+    ``(gyt_bytes, consumed)``.
     """
     out: list[bytes] = []
     off = 0
@@ -812,11 +881,27 @@ def adapt(buf: bytes, host_id: int) -> tuple[bytes, int]:
                 and total - pad >= _HSZ + _ESZ:
             ev = np.frombuffer(buf, REF_EVENT_NOTIFY_DT, count=1,
                                offset=off + _HSZ)[0]
-            dec = _DECODER_OF.get(int(ev["subtype"]))
+            subtype = int(ev["subtype"])
+            # payload slices LAZILY: unknown subtypes skip frame-whole
+            # without paying a bytes copy on the ingest hot path
+            if subtype == REF_NOTIFY_LISTEN_TASKMAP:
+                # stateful, frameless: updates the session linkage map
+                if session is not None:
+                    decode_listen_taskmap(
+                        buf[off + _HSZ + _ESZ: off + total - pad],
+                        int(ev["nevents"]), session)
+                off += total
+                continue
+            dec = _DECODER_OF.get(subtype)
             if dec is not None:
-                fn, gyt_subtype = dec
+                fn, gyt_subtype, wants_session = dec
                 payload = buf[off + _HSZ + _ESZ: off + total - pad]
-                recs, names = fn(payload, int(ev["nevents"]), host_id)
+                if wants_session:
+                    recs, names = fn(payload, int(ev["nevents"]),
+                                     host_id, session=session)
+                else:
+                    recs, names = fn(payload, int(ev["nevents"]),
+                                     host_id)
                 if names:
                     out.append(wire.encode_frames_chunked(
                         wire.NOTIFY_NAME_INTERN,

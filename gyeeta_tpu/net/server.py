@@ -427,6 +427,7 @@ class GytServer:
         (recorded bytes are always replayable GYT frames)."""
         pending = b""
         ref_mode = False
+        ref_session = refproto.RefSession()   # per-conn adapter state
         while True:
             data = await reader.read(_READ_SZ)
             if not data:
@@ -438,7 +439,8 @@ class GytServer:
                 self.rt.stats.bump("conns_ref_adapted")
             if ref_mode:
                 try:
-                    gyt, k = refproto.adapt(data, host_id)
+                    gyt, k = refproto.adapt(data, host_id,
+                                            session=ref_session)
                 except wire.FrameError:
                     self.rt.stats.bump("frames_bad")
                     raise

@@ -80,10 +80,27 @@ def test_fuzz_refproto_adapter_never_crashes():
     ev["subtype"] = refproto.REF_NOTIFY_TCP_CONN
     ev["nevents"] = 2
     valid = hdr.tobytes() + ev.tobytes() + body
+    # a taskmap frame rides along so the stateful decode path is
+    # fuzzed too (it is unreachable without a session)
+    tm = np.zeros((), refproto.REF_LISTEN_TASKMAP_DT)
+    tm["related_listen_id"] = 0xFEED
+    tm["nlisten"] = 1
+    tm["naggr_taskid"] = 2
+    tmbody = tm.tobytes() + np.asarray([1, 2, 3], "<u8").tobytes()
+    hdr2 = np.zeros((), refproto.REF_HEADER_DT)
+    hdr2["magic"] = refproto.REF_MAGIC_PM
+    hdr2["total_sz"] = 16 + 8 + len(tmbody)
+    hdr2["data_type"] = refproto.REF_COMM_EVENT_NOTIFY
+    ev2 = np.zeros((), refproto.REF_EVENT_NOTIFY_DT)
+    ev2["subtype"] = refproto.REF_NOTIFY_LISTEN_TASKMAP
+    ev2["nevents"] = 1
+    valid = valid + hdr2.tobytes() + ev2.tobytes() + tmbody
     for trial in range(300):
         buf = _mutate(valid * 2, RNG, int(RNG.integers(1, 10)))
+        sess = refproto.RefSession()
         try:
-            gyt, consumed = refproto.adapt(buf, host_id=1)
+            gyt, consumed = refproto.adapt(buf, host_id=1,
+                                           session=sess)
             assert 0 <= consumed <= len(buf)
             wire.decode_frames(gyt)      # adapter output stays valid
         except wire.FrameError:

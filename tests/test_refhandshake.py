@@ -180,6 +180,51 @@ def test_task_top_procs_feed_top_views():
     rt.close()
 
 
+def _taskmap_record(rel_id: int, listen_ids, task_ids) -> bytes:
+    rec = np.zeros((), RP.REF_LISTEN_TASKMAP_DT)
+    rec["related_listen_id"] = rel_id
+    rec["ser_comm"] = b"svcproc"
+    rec["nlisten"] = len(listen_ids)
+    rec["naggr_taskid"] = len(task_ids)
+    return (rec.tobytes()
+            + np.asarray(listen_ids, "<u8").tobytes()
+            + np.asarray(task_ids, "<u8").tobytes())
+
+
+def _aggr_task_record(aggr_id: int, comm: bytes) -> bytes:
+    rec = np.zeros((), RP.REF_AGGR_TASK_DT)
+    rec["aggr_task_id"] = aggr_id
+    rec["onecomm"] = comm
+    rec["total_cpu_pct"] = 5.0
+    rec["ntasks_total"] = 2
+    return rec.tobytes()
+
+
+def test_listen_taskmap_links_stock_tasks():
+    """LISTEN_TASKMAP → session map → later AGGR_TASK_STATE records
+    carry related_listen_id (taskstate.relsvcid links to the service
+    for stock fleets; sessionless adaptation stays unlinked)."""
+    rel = 0x7E57_0001
+    sess = RP.RefSession()
+    buf = (_ref_frame(RP.REF_NOTIFY_LISTEN_TASKMAP, 1,
+                      _taskmap_record(rel, [rel], [0xAB1, 0xAB2]))
+           + _ref_frame(RP.REF_NOTIFY_AGGR_TASK_STATE, 2,
+                        _aggr_task_record(0xAB1, b"linked-proc")
+                        + _aggr_task_record(0xFFF, b"other-proc")))
+    gyt, consumed = RP.adapt(buf, host_id=2, session=sess)
+    assert consumed == len(buf)
+    frames, _ = wire.decode_frames(gyt)
+    tasks = dict(frames)[wire.NOTIFY_AGGR_TASK_STATE]
+    by_id = {int(r["aggr_task_id"]): r for r in tasks}
+    assert int(by_id[0xAB1]["related_listen_id"]) == rel
+    assert int(by_id[0xFFF]["related_listen_id"]) == 0
+    # sessionless: no linkage, no crash
+    gyt2, _ = RP.adapt(buf, host_id=2)
+    frames2, _ = wire.decode_frames(gyt2)
+    tasks2 = dict(frames2)[wire.NOTIFY_AGGR_TASK_STATE]
+    assert all(int(r["related_listen_id"]) == 0 for r in tasks2)
+
+
 # ------------------------------------------------------- e2e handshake
 async def _stock_partha_session():
     from gyeeta_tpu.net import GytServer
