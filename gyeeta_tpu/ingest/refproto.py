@@ -48,9 +48,14 @@ REF_NOTIFY_TASK_TOP_PROCS = 0x303
 REF_NOTIFY_NEW_LISTENER = 0x307
 REF_NOTIFY_LISTENER_STATE = 0x309
 REF_NOTIFY_TCP_CONN = 0x30C
+REF_NOTIFY_CPU_MEM_STATE = 0x30F
 REF_NOTIFY_AGGR_TASK_STATE = 0x310
 REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
 REF_NOTIFY_LISTEN_TASKMAP = 0x314
+REF_NOTIFY_HOST_STATE = 0x31C        # current version (NOTIFY_PM_EVT
+#                                      enum order: 0x301 TASK_MINI_ADD
+#                                      … 0x31B LISTEN_CLUSTER_INFO,
+#                                      0x31C HOST_STATE)
 
 # version encoding: get_version_from_string("a.b.c", 3) = a<<16|b<<8|c
 REF_COMM_VERSION = 1             # COMM_VERSION_NUM (gy_comm_proto.h:16)
@@ -263,6 +268,54 @@ _HSZ = REF_HEADER_DT.itemsize
 _ESZ = REF_EVENT_NOTIFY_DT.itemsize
 
 
+# CPU_MEM_STATE_NOTIFY fixed part (gy_comm_proto.h:2024, 200 bytes);
+# cpu_state_string_len_ + mem_state_string_len_ bytes of state strings
+# + padding_len_ follow each record
+REF_CPU_MEM_DT = np.dtype([
+    ("cpu_pct", "<f4"), ("usercpu_pct", "<f4"), ("syscpu_pct", "<f4"),
+    ("iowait_pct", "<f4"), ("cumul_core_cpu_pct", "<f4"),
+    ("forks_sec", "<u4"), ("procs_running", "<u4"), ("cs_sec", "<u4"),
+    ("cs_p95_sec", "<u4"), ("cs_5min_p95_sec", "<u4"),
+    ("cpu_p95", "<u4"), ("cpu_5min_p95", "<u4"),
+    ("fork_p95_sec", "<u4"), ("fork_5min_p95_sec", "<u4"),
+    ("procs_p95", "<u4"), ("procs_5min_p95", "<u4"),
+    ("cpu_state", "u1"), ("cpu_issue", "u1"),
+    ("cpu_issue_bit_hist", "u1"), ("cpu_severe_issue_hist", "u1"),
+    ("cpu_state_string_len", "u1"), ("pad0", "u1", (3,)),
+    ("rss_pct", "<f4"), ("pad1", "u1", (4,)),
+    ("rss_memory_mb", "<u8"), ("total_memory_mb", "<u8"),
+    ("cached_memory_mb", "<u8"), ("locked_memory_mb", "<u8"),
+    ("committed_memory_mb", "<u8"),
+    ("committed_pct", "<f4"), ("pad2", "u1", (4,)),
+    ("swap_free_mb", "<u8"), ("swap_total_mb", "<u8"),
+    ("pg_inout_sec", "<u4"), ("swap_inout_sec", "<u4"),
+    ("reclaim_stalls", "<u4"), ("pgmajfault", "<u4"),
+    ("oom_kill", "<u4"), ("rss_pct_p95", "<u4"),
+    ("pginout_p95", "<u8"), ("swpinout_p95", "<u8"),
+    ("allocstall_p95", "<u8"),
+    ("mem_state", "u1"), ("mem_issue", "u1"),
+    ("mem_issue_bit_hist", "u1"), ("mem_severe_issue_hist", "u1"),
+    ("mem_state_string_len", "u1"), ("padding_len", "u1"),
+    ("tailpad", "u1", (2,)),
+])
+assert REF_CPU_MEM_DT.itemsize == 200
+
+# HOST_STATE_NOTIFY (gy_comm_proto.h:2289, 56 bytes, nevents == 1)
+REF_HOST_STATE_DT = np.dtype([
+    ("curr_time_usec", "<u8"),
+    ("ntasks_issue", "<u4"), ("ntasks_severe", "<u4"),
+    ("ntasks", "<u4"),
+    ("nlisten_issue", "<u4"), ("nlisten_severe", "<u4"),
+    ("nlisten", "<u4"),
+    ("curr_state", "u1"), ("issue_bit_hist", "u1"),
+    ("cpu_issue", "u1"), ("mem_issue", "u1"),
+    ("severe_cpu_issue", "u1"), ("severe_mem_issue", "u1"),
+    ("pad0", "u1", (2,)),
+    ("total_cpu_delayms", "<u4"), ("total_vm_delayms", "<u4"),
+    ("total_io_delayms", "<u4"), ("tailpad", "u1", (4,)),
+])
+assert REF_HOST_STATE_DT.itemsize == 56
+
 # LISTEN_TASKMAP_NOTIFY fixed part (gy_comm_proto.h:2813); nlisten_
 # u64 listener glob ids then naggr u64 task ids follow each record
 REF_LISTEN_TASKMAP_DT = np.dtype([
@@ -291,6 +344,7 @@ class RefSession:
 
     def __init__(self):
         self.rel_of_task: dict = {}
+        self.ncpus = 0               # estimated core count (cpu_mem)
 
     def learn_taskmap(self, rel_id: int, task_ids) -> None:
         for t in task_ids:
@@ -415,6 +469,77 @@ def decode_listener_state(payload: bytes, nevents: int, host_id: int
         r["host_id"] = host_id
         off = end
     return out, names
+
+
+def decode_cpu_mem(payload: bytes, nevents: int, host_id: int,
+                   session: "RefSession | None" = None
+                   ) -> tuple[np.ndarray, list]:
+    """CPU_MEM_STATE_NOTIFY walk → GYT CPU_MEM records (2s host
+    gauges; state strings skipped — the engine classifies itself).
+
+    Semantic mapping caveats (the struct carries neither per-core
+    maxima nor a core count):
+    - ``cumul_core_cpu_pct_`` is the SUM across cores (can exceed
+      100); GYT's ``max_core_cpu_pct`` (hottest core) falls back to
+      the host average ``cpu_pct`` — conservative: a saturated single
+      core is under-reported, a healthy multi-core host is never
+      false-flagged.
+    - ``ncpus`` (classifier thresholds scale with it) is ESTIMATED as
+      round(sum/average) when the host is busy enough for the ratio
+      to be stable (≥5% cpu), cached on the session."""
+    fsz = REF_CPU_MEM_DT.itemsize
+    _check_nevents(nevents, payload, fsz, wire.MAX_CPUMEM_PER_BATCH,
+                   "cpu_mem")
+    out = np.zeros(nevents, wire.CPU_MEM_DT)
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"cpu_mem record {i} truncated")
+        rec = np.frombuffer(payload, REF_CPU_MEM_DT, count=1,
+                            offset=off)[0]
+        end = (off + fsz + int(rec["cpu_state_string_len"])
+               + int(rec["mem_state_string_len"])
+               + int(rec["padding_len"]))
+        if end > len(payload):
+            raise RefFrameError(f"cpu_mem record {i} overflows")
+        r = out[i]
+        for f in ("cpu_pct", "usercpu_pct", "syscpu_pct",
+                  "iowait_pct", "cs_sec", "forks_sec",
+                  "procs_running", "rss_pct", "pg_inout_sec",
+                  "swap_inout_sec"):
+            r[f] = rec[f]
+        cpu = float(rec["cpu_pct"])
+        if session is not None and cpu >= 5.0:
+            session.ncpus = max(1, round(
+                float(rec["cumul_core_cpu_pct"]) / cpu))
+        r["ncpus"] = session.ncpus if session is not None else 0
+        r["max_core_cpu_pct"] = cpu          # see docstring caveat
+        r["commit_pct"] = rec["committed_pct"]
+        tot_swap = float(rec["swap_total_mb"])
+        r["swap_free_pct"] = (100.0 * float(rec["swap_free_mb"])
+                              / tot_swap) if tot_swap else 100.0
+        r["allocstall_sec"] = rec["reclaim_stalls"]
+        r["oom_kills"] = rec["oom_kill"]
+        r["host_id"] = host_id
+        off = end
+    return out, []
+
+
+def decode_host_state(payload: bytes, nevents: int, host_id: int
+                      ) -> tuple[np.ndarray, list]:
+    """HOST_STATE_NOTIFY → GYT HOST_STATE records (fixed size)."""
+    fsz = REF_HOST_STATE_DT.itemsize
+    _check_nevents(nevents, payload, fsz, wire.MAX_HOSTS_PER_BATCH,
+                   "host_state")
+    recs = np.frombuffer(payload, REF_HOST_STATE_DT, count=nevents)
+    out = np.zeros(nevents, wire.HOST_STATE_DT)
+    for f in ("curr_time_usec", "ntasks_issue", "ntasks_severe",
+              "ntasks", "nlisten_issue", "nlisten_severe", "nlisten",
+              "curr_state", "issue_bit_hist", "cpu_issue", "mem_issue",
+              "severe_cpu_issue", "severe_mem_issue"):
+        out[f] = recs[f]
+    out["host_id"] = host_id
+    return out, []
 
 
 def decode_listen_taskmap(payload: bytes, nevents: int,
@@ -683,6 +808,10 @@ _DECODER_OF = {
                                    wire.NOTIFY_TCP_CONN, False),
     REF_NOTIFY_TASK_TOP_PROCS: (decode_task_top_procs,
                                 wire.NOTIFY_AGGR_TASK_STATE, False),
+    REF_NOTIFY_CPU_MEM_STATE: (decode_cpu_mem,
+                               wire.NOTIFY_CPU_MEM_STATE, True),
+    REF_NOTIFY_HOST_STATE: (decode_host_state,
+                            wire.NOTIFY_HOST_STATE, False),
 }
 
 

@@ -225,6 +225,67 @@ def test_listen_taskmap_links_stock_tasks():
     assert all(int(r["related_listen_id"]) == 0 for r in tasks2)
 
 
+def test_cpu_mem_and_host_state_adapt():
+    """Stock CPU_MEM_STATE (with trailing state strings) + HOST_STATE
+    → cpumem/hoststate views populate for stock fleets."""
+    cm = np.zeros((), RP.REF_CPU_MEM_DT)
+    cm["cpu_pct"] = 72.5
+    cm["cumul_core_cpu_pct"] = 72.5 * 16     # 16-core sum
+    cm["usercpu_pct"] = 60.0
+    cm["rss_pct"] = 41.0
+    cm["committed_pct"] = 55.0
+    cm["swap_free_mb"] = 512
+    cm["swap_total_mb"] = 2048
+    cm["reclaim_stalls"] = 7
+    cm["oom_kill"] = 1
+    cstr, mstr = b"cpu high", b"mem ok"
+    cm["cpu_state_string_len"] = len(cstr)
+    cm["mem_state_string_len"] = len(mstr)
+    act = RP.REF_CPU_MEM_DT.itemsize + len(cstr) + len(mstr)
+    cm["padding_len"] = (-act) % 8
+    cm_body = cm.tobytes() + cstr + mstr + b"\x00" * ((-act) % 8)
+
+    hs = np.zeros((), RP.REF_HOST_STATE_DT)
+    hs["curr_time_usec"] = 1_700_000_000_000_000
+    hs["ntasks"] = 120
+    hs["ntasks_issue"] = 3
+    hs["nlisten"] = 9
+    hs["curr_state"] = 2
+    hs["cpu_issue"] = 1
+
+    buf = (_ref_frame(RP.REF_NOTIFY_CPU_MEM_STATE, 1, cm_body)
+           + _ref_frame(RP.REF_NOTIFY_HOST_STATE, 1, hs.tobytes()))
+    rt = Runtime(CFG)
+    sess = RP.RefSession()
+    gyt, consumed = RP.adapt(buf, host_id=5, session=sess)
+    assert consumed == len(buf)
+    assert sess.ncpus == 16          # estimated from sum/average
+    # a healthy 16-core host (72.5% avg) must NOT flag core
+    # saturation: max_core maps to the average, not the cross-core sum
+    recs, _ = wire.decode_frames(gyt)
+    cmrec = dict(recs)[wire.NOTIFY_CPU_MEM_STATE][0]
+    assert abs(float(cmrec["max_core_cpu_pct"]) - 72.5) < 0.1
+    assert int(cmrec["ncpus"]) == 16
+    rt.feed(gyt)
+    rt.run_tick()
+    cmq = rt.query({"subsys": "cpumem",
+                    "filter": "{ cpumem.hostid = 5 }"})
+    assert cmq["nrecs"] == 1
+    row = cmq["recs"][0]
+    assert abs(row["cpu"] - 72.5) < 0.1
+    assert abs(row["rsspct"] - 41.0) < 0.1
+    assert abs(row["commitpct"] - 55.0) < 0.1
+    assert abs(row["swapfreepct"] - 25.0) < 0.1   # 512/2048
+    hq = rt.query({"subsys": "hoststate",
+                   "filter": "{ hoststate.hostid = 5 }"})
+    assert hq["nrecs"] == 1
+    assert hq["recs"][0]["nproc"] == 120
+    assert hq["recs"][0]["nprocissue"] == 3
+    assert hq["recs"][0]["nlisten"] == 9
+    assert hq["recs"][0]["cpuissue"] is True
+    rt.close()
+
+
 # ------------------------------------------------------- e2e handshake
 async def _stock_partha_session():
     from gyeeta_tpu.net import GytServer
