@@ -52,6 +52,7 @@ REF_NOTIFY_CPU_MEM_STATE = 0x30F
 REF_NOTIFY_AGGR_TASK_STATE = 0x310
 REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
 REF_NOTIFY_LISTEN_TASKMAP = 0x314
+REF_NOTIFY_HOST_INFO = 0x317
 REF_NOTIFY_HOST_STATE = 0x31C        # current version (NOTIFY_PM_EVT
 #                                      enum order: 0x301 TASK_MINI_ADD
 #                                      … 0x31B LISTEN_CLUSTER_INFO,
@@ -316,6 +317,25 @@ REF_HOST_STATE_DT = np.dtype([
 ])
 assert REF_HOST_STATE_DT.itemsize == 56
 
+# HOST_INFO_NOTIFY (gy_comm_proto.h:2844, 704 bytes, nevents == 1)
+REF_HOST_INFO_DT = np.dtype([
+    ("distribution_name", "S128"), ("kern_version_string", "S64"),
+    ("kern_version_num", "<u4"), ("instance_id", "S128"),
+    ("cloud_type", "S64"), ("processor_model", "S128"),
+    ("cpu_vendor", "S64"),
+    ("cores_online", "<u2"), ("cores_offline", "<u2"),
+    ("max_cores", "<u2"), ("isolated_cores", "<u2"),
+    ("ram_mb", "<u4"), ("corrupted_ram_mb", "<u4"),
+    ("num_numa_nodes", "<u2"), ("max_cores_per_socket", "<u2"),
+    ("threads_per_core", "<u2"), ("pad0", "u1", (6,)),
+    ("boot_time_sec", "<i8"),
+    ("l1_dcache_kb", "<u4"), ("l2_cache_kb", "<u4"),
+    ("l3_cache_kb", "<u4"), ("l4_cache_kb", "<u4"),
+    ("is_virtual_cpu", "u1"), ("virtualization_type", "S64"),
+    ("tailpad", "u1", (7,)),
+])
+assert REF_HOST_INFO_DT.itemsize == 704
+
 # LISTEN_TASKMAP_NOTIFY fixed part (gy_comm_proto.h:2813); nlisten_
 # u64 listener glob ids then naggr u64 task ids follow each record
 REF_LISTEN_TASKMAP_DT = np.dtype([
@@ -540,6 +560,48 @@ def decode_host_state(payload: bytes, nevents: int, host_id: int
         out[f] = recs[f]
     out["host_id"] = host_id
     return out, []
+
+
+def decode_host_info(payload: bytes, nevents: int, host_id: int
+                     ) -> tuple[np.ndarray, list]:
+    """HOST_INFO_NOTIFY → GYT HOST_INFO records + interned strings
+    (the hostinfo inventory view for stock fleets)."""
+    fsz = REF_HOST_INFO_DT.itemsize
+    _check_nevents(nevents, payload, fsz, wire.MAX_HOST_INFO_PER_BATCH,
+                   "host_info")
+    recs = np.frombuffer(payload, REF_HOST_INFO_DT, count=nevents)
+    out = np.zeros(nevents, wire.HOST_INFO_DT)
+    names: list = []
+    for i in range(nevents):
+        rec = recs[i]
+        r = out[i]
+        r["ncpus"] = rec["cores_online"]
+        r["nnuma"] = max(int(rec["num_numa_nodes"]), 1)
+        r["ram_mb"] = rec["ram_mb"]
+        # wire value is attacker-controlled: clamp into the unsigned
+        # usec field instead of letting numpy raise OverflowError
+        boot = int(rec["boot_time_sec"])
+        r["boot_tusec"] = min(max(boot, 0), (1 << 63) // 10**6) \
+            * 1_000_000
+        # region/zone are not in HOST_INFO (they ride PS_REGISTER /
+        # cloud metadata): intern '' like the agent collector so the
+        # view renders empty, not a hex-id fallback
+        for src, dst in (("kern_version_string", "kern_ver_id"),
+                         ("distribution_name", "distro_id"),
+                         ("processor_model", "cputype_id"),
+                         ("instance_id", "instance_id"),
+                         (None, "region_id"), (None, "zone_id")):
+            s = _cstr(rec[src]) if src else ""
+            nid = InternTable.intern(s, wire.NAME_KIND_MISC)
+            r[dst] = nid
+            names.append((wire.NAME_KIND_MISC, nid, s))
+        cloud = _cstr(rec["cloud_type"]).lower()
+        r["cloud_type"] = (1 if "aws" in cloud else
+                           2 if "gcp" in cloud or "google" in cloud
+                           else 3 if "azure" in cloud else 0)
+        r["virt_type"] = 1 if rec["is_virtual_cpu"] else 0
+        r["host_id"] = host_id
+    return out, names
 
 
 def decode_listen_taskmap(payload: bytes, nevents: int,
@@ -812,6 +874,8 @@ _DECODER_OF = {
                                wire.NOTIFY_CPU_MEM_STATE, True),
     REF_NOTIFY_HOST_STATE: (decode_host_state,
                             wire.NOTIFY_HOST_STATE, False),
+    REF_NOTIFY_HOST_INFO: (decode_host_info,
+                           wire.NOTIFY_HOST_INFO, False),
 }
 
 

@@ -286,6 +286,40 @@ def test_cpu_mem_and_host_state_adapt():
     rt.close()
 
 
+def test_host_info_adapts_to_inventory():
+    """Stock HOST_INFO_NOTIFY → hostinfo inventory view (distro,
+    kernel, cpu model, cores/ram, cloud fields)."""
+    hi = np.zeros((), RP.REF_HOST_INFO_DT)
+    hi["distribution_name"] = b"Ubuntu 22.04.4 LTS"
+    hi["kern_version_string"] = b"5.15.0-105-generic"
+    hi["kern_version_num"] = 0x050F00
+    hi["instance_id"] = b"i-0d15c0ffee"
+    hi["cloud_type"] = b"AWS"
+    hi["processor_model"] = b"AMD EPYC 7B13"
+    hi["cores_online"] = 32
+    hi["ram_mb"] = 128 * 1024
+    hi["num_numa_nodes"] = 2
+    hi["boot_time_sec"] = 1_700_000_000
+    hi["is_virtual_cpu"] = 1
+    buf = _ref_frame(RP.REF_NOTIFY_HOST_INFO, 1, hi.tobytes())
+    rt = Runtime(CFG)
+    gyt, consumed = RP.adapt(buf, host_id=6)
+    assert consumed == len(buf)
+    rt.feed(gyt)
+    out = rt.query({"subsys": "hostinfo",
+                    "filter": "{ hostinfo.hostid = 6 }"})
+    assert out["nrecs"] == 1
+    row = out["recs"][0]
+    assert row["dist"] == "Ubuntu 22.04.4 LTS"
+    assert row["kernverstr"] == "5.15.0-105-generic"
+    assert row["cputype"] == "AMD EPYC 7B13"
+    assert row["ncpus"] == 32
+    assert row["rammb"] == 128 * 1024
+    assert row["instanceid"] == "i-0d15c0ffee"
+    assert row["cloud"] == "aws" and row["virt"] == "vm"
+    rt.close()
+
+
 # ------------------------------------------------------- e2e handshake
 async def _stock_partha_session():
     from gyeeta_tpu.net import GytServer
