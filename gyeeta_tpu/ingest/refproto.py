@@ -362,9 +362,13 @@ class RefSession:
 
     MAX_TASKS = 1 << 20
 
-    def __init__(self):
+    def __init__(self, region: str = "", zone: str = ""):
         self.rel_of_task: dict = {}
         self.ncpus = 0               # estimated core count (cpu_mem)
+        # cluster placement from the PM_CONNECT handshake (HOST_INFO
+        # itself does not carry region/zone — the wire does)
+        self.region = region
+        self.zone = zone
 
     def learn_taskmap(self, rel_id: int, task_ids) -> None:
         for t in task_ids:
@@ -562,10 +566,13 @@ def decode_host_state(payload: bytes, nevents: int, host_id: int
     return out, []
 
 
-def decode_host_info(payload: bytes, nevents: int, host_id: int
+def decode_host_info(payload: bytes, nevents: int, host_id: int,
+                     session: "RefSession | None" = None
                      ) -> tuple[np.ndarray, list]:
     """HOST_INFO_NOTIFY → GYT HOST_INFO records + interned strings
-    (the hostinfo inventory view for stock fleets)."""
+    (the hostinfo inventory view for stock fleets). Region/zone come
+    from the session (the PM_CONNECT handshake carries them; this
+    struct does not)."""
     fsz = REF_HOST_INFO_DT.itemsize
     _check_nevents(nevents, payload, fsz, wire.MAX_HOST_INFO_PER_BATCH,
                    "host_info")
@@ -583,23 +590,26 @@ def decode_host_info(payload: bytes, nevents: int, host_id: int
         boot = int(rec["boot_time_sec"])
         r["boot_tusec"] = min(max(boot, 0), (1 << 63) // 10**6) \
             * 1_000_000
-        # region/zone are not in HOST_INFO (they ride PS_REGISTER /
-        # cloud metadata): intern '' like the agent collector so the
-        # view renders empty, not a hex-id fallback
-        for src, dst in (("kern_version_string", "kern_ver_id"),
-                         ("distribution_name", "distro_id"),
-                         ("processor_model", "cputype_id"),
-                         ("instance_id", "instance_id"),
-                         (None, "region_id"), (None, "zone_id")):
-            s = _cstr(rec[src]) if src else ""
-            nid = InternTable.intern(s, wire.NAME_KIND_MISC)
+        region = session.region if session is not None else ""
+        zone = session.zone if session is not None else ""
+        for val, dst in ((_cstr(rec["kern_version_string"]),
+                          "kern_ver_id"),
+                         (_cstr(rec["distribution_name"]), "distro_id"),
+                         (_cstr(rec["processor_model"]), "cputype_id"),
+                         (_cstr(rec["instance_id"]), "instance_id"),
+                         (region, "region_id"), (zone, "zone_id")):
+            nid = InternTable.intern(val, wire.NAME_KIND_MISC)
             r[dst] = nid
-            names.append((wire.NAME_KIND_MISC, nid, s))
+            names.append((wire.NAME_KIND_MISC, nid, val))
         cloud = _cstr(rec["cloud_type"]).lower()
         r["cloud_type"] = (1 if "aws" in cloud else
                            2 if "gcp" in cloud or "google" in cloud
                            else 3 if "azure" in cloud else 0)
-        r["virt_type"] = 1 if rec["is_virtual_cpu"] else 0
+        virt = _cstr(rec["virtualization_type"]).lower()
+        r["virt_type"] = (2 if any(m in virt for m in
+                                   ("docker", "lxc", "container",
+                                    "podman")) else
+                          1 if rec["is_virtual_cpu"] else 0)
         r["host_id"] = host_id
     return out, names
 
@@ -875,7 +885,7 @@ _DECODER_OF = {
     REF_NOTIFY_HOST_STATE: (decode_host_state,
                             wire.NOTIFY_HOST_STATE, False),
     REF_NOTIFY_HOST_INFO: (decode_host_info,
-                           wire.NOTIFY_HOST_INFO, False),
+                           wire.NOTIFY_HOST_INFO, True),
 }
 
 
@@ -919,6 +929,8 @@ def parse_pm_connect_cmd(body: bytes) -> dict:
         "partha_ident_key": int(r["partha_ident_key"]),
         "hostname": _cstr(r["hostname"]),
         "cluster_name": _cstr(r["cluster_name"]),
+        "region_name": _cstr(r["region_name"]),
+        "zone_name": _cstr(r["zone_name"]),
         "madhava_id": int(r["madhava_id"]),
         "cli_type": int(r["cli_type"]),
     }
@@ -997,7 +1009,8 @@ def encode_pm_connect_cmd(machine_id_hi: int, machine_id_lo: int,
                           comm_version: int = REF_COMM_VERSION,
                           min_madhava_version: int = 0x000500,
                           cli_type: int = REF_CLI_TYPE_REQ_ONLY,
-                          curr_sec: int = 0) -> bytes:
+                          curr_sec: int = 0, region: str = "",
+                          zone: str = "") -> bytes:
     """Synthesized stock-partha PM_CONNECT_CMD_S."""
     r = np.zeros(1, REF_PM_CONNECT_CMD_DT)
     v = r[0]
@@ -1009,6 +1022,8 @@ def encode_pm_connect_cmd(machine_id_hi: int, machine_id_lo: int,
     v["partha_ident_key"] = partha_ident_key
     v["hostname"] = hostname.encode()[:255]
     v["cluster_name"] = b"cluster0"
+    v["region_name"] = region.encode()[:63]
+    v["zone_name"] = zone.encode()[:63]
     v["madhava_id"] = madhava_id
     v["cli_type"] = cli_type
     v["curr_sec"] = curr_sec

@@ -327,7 +327,11 @@ class GytServer:
                 # conns_ref_adapted is counted by the event loop when
                 # it sees the first reference-magic data (one count
                 # per adapted conn, same as direct-stream ref conns)
-                await self._event_loop(reader, host_id)
+                await self._event_loop(
+                    reader, host_id,
+                    ref_session=refproto.RefSession(
+                        region=req.get("region_name", ""),
+                        zone=req.get("zone_name", "")))
                 return
             else:
                 # pre-registration frame of an unhandled type: skip it
@@ -409,7 +413,8 @@ class GytServer:
             except (ConnectionError, OSError):   # pragma: no cover
                 pass
 
-    async def _event_loop(self, reader, host_id: int = 0) -> None:
+    async def _event_loop(self, reader, host_id: int = 0,
+                          ref_session=None) -> None:
         """Bulk ingest: socket bytes → Runtime.feed.
 
         Partial-frame reassembly happens HERE, per connection: the
@@ -427,7 +432,8 @@ class GytServer:
         (recorded bytes are always replayable GYT frames)."""
         pending = b""
         ref_mode = False
-        ref_session = refproto.RefSession()   # per-conn adapter state
+        if ref_session is None:               # per-conn adapter state
+            ref_session = refproto.RefSession()
         while True:
             data = await reader.read(_READ_SZ)
             if not data:

@@ -139,7 +139,9 @@ def test_adapt_partial_frame_resume():
 
 def test_adapt_unknown_subtype_skipped():
     inner = np.zeros(4, "<u8").tobytes()
-    buf = (_ref_frame(0x30F, 1, inner)          # CPU_MEM: not adapted
+    # 0x30A LISTENER_DEPENDENCY: a real reference subtype with no
+    # adapter (CPU_MEM gained one in r5) — must skip frame-whole
+    buf = (_ref_frame(0x30A, 1, inner)
            + _ref_frame(RP.REF_NOTIFY_TCP_CONN, 1,
                         _conn_record(0xCC03, 80, 10)))
     gyt, consumed = RP.adapt(buf, host_id=2)
