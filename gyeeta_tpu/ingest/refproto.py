@@ -51,8 +51,10 @@ REF_NOTIFY_TCP_CONN = 0x30C
 REF_NOTIFY_CPU_MEM_STATE = 0x30F
 REF_NOTIFY_AGGR_TASK_STATE = 0x310
 REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
+REF_NOTIFY_LISTENER_DOMAIN = 0x313
 REF_NOTIFY_LISTEN_TASKMAP = 0x314
 REF_NOTIFY_HOST_INFO = 0x317
+REF_NOTIFY_NOTIFICATION_MSG = 0x319
 REF_NOTIFY_HOST_STATE = 0x31C        # current version (NOTIFY_PM_EVT
 #                                      enum order: 0x301 TASK_MINI_ADD
 #                                      … 0x31B LISTEN_CLUSTER_INFO,
@@ -336,6 +338,23 @@ REF_HOST_INFO_DT = np.dtype([
 ])
 assert REF_HOST_INFO_DT.itemsize == 704
 
+# NOTIFICATION_MSG (gy_comm_proto.h:2913, 8 bytes + msglen_ text)
+REF_NOTIFICATION_MSG_DT = np.dtype([
+    ("type", "u1"), ("pad0", "u1"), ("msglen", "<u2"),
+    ("padding_len", "u1"), ("tailpad", "u1", (3,)),
+])
+assert REF_NOTIFICATION_MSG_DT.itemsize == 8
+_REF_MSGTYPES = {0: "info", 1: "warn", 2: "error", 3: "error"}
+
+# LISTENER_DOMAIN_NOTIFY (gy_comm_proto.h:2724, 16 bytes + domain/tag)
+REF_LISTENER_DOMAIN_DT = np.dtype([
+    ("glob_id", "<u8"),
+    ("domain_string_len", "u1"), ("tag_len", "u1"),
+    ("padding_len", "u1"), ("tailpad", "u1", (5,)),
+])
+assert REF_LISTENER_DOMAIN_DT.itemsize == 16
+
+
 # LISTEN_TASKMAP_NOTIFY fixed part (gy_comm_proto.h:2813); nlisten_
 # u64 listener glob ids then naggr u64 task ids follow each record
 REF_LISTEN_TASKMAP_DT = np.dtype([
@@ -369,6 +388,17 @@ class RefSession:
         # itself does not carry region/zone — the wire does)
         self.region = region
         self.zone = zone
+        # frameless notify payloads collected for the serving edge
+        # (bounded; the edge drains them after every adapt run)
+        self.notifications: list = []    # (ntype_str, message)
+        self.domains: list = []          # (glob_id, domain, tag)
+
+    # drained by the serving edge after each adapt() run
+    MAX_PENDING = 1024
+
+    def _push(self, lst: list, item) -> None:
+        if len(lst) < self.MAX_PENDING:
+            lst.append(item)
 
     def learn_taskmap(self, rel_id: int, task_ids) -> None:
         for t in task_ids:
@@ -636,6 +666,66 @@ def decode_listen_taskmap(payload: bytes, nevents: int,
                               offset=off + fsz + nl * 8)
         session.learn_taskmap(int(rec["related_listen_id"]), tasks)
         off = end
+
+
+def decode_notification_msg(payload: bytes, nevents: int,
+                            session: "RefSession") -> None:
+    """NOTIFICATION_MSG walk → session notifications (the agent's
+    operator messages land in the notifymsg ring)."""
+    fsz = REF_NOTIFICATION_MSG_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 128, "notification_msg")
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"notification_msg {i} truncated")
+        rec = np.frombuffer(payload, REF_NOTIFICATION_MSG_DT, count=1,
+                            offset=off)[0]
+        ln = int(rec["msglen"])
+        end = off + fsz + ln + int(rec["padding_len"])
+        if ln > 512 or end > len(payload):
+            raise RefFrameError(f"notification_msg {i} overflows")
+        msg = payload[off + fsz: off + fsz + ln].split(
+            b"\x00", 1)[0].decode("utf-8", "replace")
+        if msg:
+            session._push(session.notifications,
+                          (_REF_MSGTYPES.get(int(rec["type"]), "info"),
+                           msg))
+        off = end
+
+
+def decode_listener_domain(payload: bytes, nevents: int,
+                           session: "RefSession") -> None:
+    """LISTENER_DOMAIN walk → session (glob_id, domain, tag) — the
+    serving edge resolves the listener's bind address and primes the
+    DNS cache (resolved-AS names for svcipclust annotations)."""
+    fsz = REF_LISTENER_DOMAIN_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 512, "listener_domain")
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"listener_domain {i} truncated")
+        rec = np.frombuffer(payload, REF_LISTENER_DOMAIN_DT, count=1,
+                            offset=off)[0]
+        dlen, tlen = int(rec["domain_string_len"]), int(rec["tag_len"])
+        end = off + fsz + dlen + tlen + int(rec["padding_len"])
+        if end > len(payload):
+            raise RefFrameError(f"listener_domain {i} overflows")
+        dom = payload[off + fsz: off + fsz + dlen].split(
+            b"\x00", 1)[0].decode("utf-8", "replace")
+        tag = payload[off + fsz + dlen: off + fsz + dlen + tlen].split(
+            b"\x00", 1)[0].decode("utf-8", "replace")
+        if dom or tag:
+            session._push(session.domains,
+                          (int(rec["glob_id"]), dom, tag))
+        off = end
+
+
+# frameless stateful subtypes: consume into the session, emit nothing
+_SESSION_DECODERS = {
+    REF_NOTIFY_LISTEN_TASKMAP: decode_listen_taskmap,
+    REF_NOTIFY_NOTIFICATION_MSG: decode_notification_msg,
+    REF_NOTIFY_LISTENER_DOMAIN: decode_listener_domain,
+}
 
 
 def decode_aggr_task(payload: bytes, nevents: int, host_id: int,
@@ -1092,12 +1182,12 @@ def adapt(buf: bytes, host_id: int,
             subtype = int(ev["subtype"])
             # payload slices LAZILY: unknown subtypes skip frame-whole
             # without paying a bytes copy on the ingest hot path
-            if subtype == REF_NOTIFY_LISTEN_TASKMAP:
-                # stateful, frameless: updates the session linkage map
+            sdec = _SESSION_DECODERS.get(subtype)
+            if sdec is not None:
+                # stateful, frameless: consumed into the session
                 if session is not None:
-                    decode_listen_taskmap(
-                        buf[off + _HSZ + _ESZ: off + total - pad],
-                        int(ev["nevents"]), session)
+                    sdec(buf[off + _HSZ + _ESZ: off + total - pad],
+                         int(ev["nevents"]), session)
                 off += total
                 continue
             dec = _DECODER_OF.get(subtype)

@@ -85,6 +85,8 @@ class GytServer:
         # runs on a worker thread; tick/query paths barrier through
         # _feed_barrier so no submitted bytes are invisible at a
         # cadence or query boundary
+        # stock LISTENER_DOMAIN payloads awaiting svcreg resolution
+        self._pending_domains: dict = {}
         self._pipe = None
         if feed_pipeline:
             from gyeeta_tpu.ingest.pipeline import FeedPipeline
@@ -144,6 +146,44 @@ class GytServer:
                 source="agent")
         return wire.REG_OK, hid
 
+    _DOMAIN_MAX_PENDING = 8192
+    _DOMAIN_MAX_AGE_TICKS = 12
+
+    def _drain_ref_session(self, sess) -> None:
+        """Route frameless stock-partha payloads collected by the
+        adapter session: agent NOTIFICATION_MSGs → the notifymsg ring;
+        LISTENER_DOMAIN names queue for tick-time resolution (the
+        referenced LISTENER_INFO may still ride the decode pipeline —
+        resolving inline would force a pipeline barrier per batch)."""
+        if sess.notifications:
+            msgs, sess.notifications = sess.notifications, []
+            for ntype, msg in msgs:
+                self.rt.notifylog.add(msg, ntype=ntype, source="agent")
+        if sess.domains:
+            doms, sess.domains = sess.domains, []
+            for gid, dom, _tag in doms:
+                if dom and len(self._pending_domains) < \
+                        self._DOMAIN_MAX_PENDING:
+                    self._pending_domains[gid] = (dom, 0)
+
+    def _resolve_pending_domains(self) -> None:
+        """Tick-cadence domain resolution (after run_tick: the feed
+        barrier already ran). Unresolvable entries retry for a few
+        ticks — a listener announced slightly later still gets its
+        domain — then drop COUNTED, not silently."""
+        if not self._pending_domains:
+            return
+        nxt: dict = {}
+        for gid, (dom, age) in self._pending_domains.items():
+            info = self.rt.svcreg.get(gid)
+            if info is not None:
+                self.rt.dns.prime(info["ip"], dom)
+            elif age + 1 < self._DOMAIN_MAX_AGE_TICKS:
+                nxt[gid] = (dom, age + 1)
+            else:
+                self.rt.stats.bump("ref_domains_unresolved")
+        self._pending_domains = nxt
+
     # ----------------------------------------------------------- feed path
     def _feed(self, buf: bytes) -> int:
         """Ingest complete-frame bytes: through the decode pipeline
@@ -196,6 +236,7 @@ class GytServer:
             try:
                 self._feed_barrier()
                 self.rt.run_tick()
+                self._resolve_pending_domains()
                 await self.push_trace_control()
                 if self.watchdog is not None:
                     self.watchdog.beat()      # liveness heartbeat
@@ -458,6 +499,9 @@ class GytServer:
                     rec = self._recorder
                     if rec is not None and self._pipe is None:
                         rec.write(gyt)
+                # drain AFTER the feed: domain payloads reference
+                # listeners whose LISTENER_INFO may ride the same batch
+                self._drain_ref_session(ref_session)
                 continue
             try:
                 k = wire.complete_prefix(data)

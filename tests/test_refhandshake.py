@@ -320,6 +320,66 @@ def test_host_info_adapts_to_inventory():
     rt.close()
 
 
+def test_notification_msg_and_listener_domain():
+    """NOTIFICATION_MSG → notifymsg ring; LISTENER_DOMAIN → DNS cache
+    keyed by the listener's bind address — through a real server
+    session."""
+    from gyeeta_tpu.net import GytServer
+
+    async def main():
+        rt = Runtime(CFG)
+        srv = GytServer(rt, tick_interval=None)
+        host, port = await srv.start()
+        r1, w1 = await asyncio.open_connection(host, port)
+        w1.write(RP.encode_ps_register_req(0x41, 0x42))
+        await w1.drain()
+        ps = RP.parse_ps_register_resp(await r1.readexactly(16 + 1440))
+        r2, w2 = await asyncio.open_connection(host, port)
+        w2.write(RP.encode_pm_connect_cmd(
+            0x41, 0x42, ps["partha_ident_key"], ps["madhava_id"]))
+        await w2.drain()
+        RP.parse_pm_connect_resp(await r2.readexactly(16 + 1008))
+
+        glob = 0xD0A1
+        nm = np.zeros((), RP.REF_NOTIFICATION_MSG_DT)
+        msg = b"disk nearly full on /var"
+        nm["type"] = 1                       # WARN
+        nm["msglen"] = len(msg)
+        nm["padding_len"] = (-(8 + len(msg))) % 8
+        nm_body = nm.tobytes() + msg + b"\x00" * int(nm["padding_len"])
+
+        dom = b"api.shop.example"
+        ld = np.zeros((), RP.REF_LISTENER_DOMAIN_DT)
+        ld["glob_id"] = glob
+        ld["domain_string_len"] = len(dom)
+        ld["padding_len"] = (-(16 + len(dom))) % 8
+        ld_body = ld.tobytes() + dom + b"\x00" * int(ld["padding_len"])
+
+        w2.write(_ref_frame(RP.REF_NOTIFY_NEW_LISTENER, 1,
+                            _new_listener_record(glob, 8443, b"shopd"))
+                 + _ref_frame(RP.REF_NOTIFY_NOTIFICATION_MSG, 1,
+                              nm_body)
+                 + _ref_frame(RP.REF_NOTIFY_LISTENER_DOMAIN, 1,
+                              ld_body))
+        await w2.drain()
+        await asyncio.sleep(0.3)
+        rt.flush()
+        out = rt.query({"subsys": "notifymsg", "maxrecs": 20})
+        assert any("disk nearly full" in r["msg"]
+                   and r["type"] == "warn" and r["source"] == "agent"
+                   for r in out["recs"]), out["recs"]
+        # domains resolve on tick cadence (the listener may announce
+        # in the same batch; the server retries for a few ticks)
+        srv._resolve_pending_domains()
+        ip = rt.svcreg.get(glob)["ip"]
+        assert rt.dns.get(ip) == "api.shop.example"
+        w1.close()
+        w2.close()
+        await srv.stop()
+
+    asyncio.run(main())
+
+
 # ------------------------------------------------------- e2e handshake
 async def _stock_partha_session():
     from gyeeta_tpu.net import GytServer
