@@ -48,6 +48,7 @@ REF_NOTIFY_TASK_TOP_PROCS = 0x303
 REF_NOTIFY_NEW_LISTENER = 0x307
 REF_NOTIFY_LISTENER_STATE = 0x309
 REF_NOTIFY_TCP_CONN = 0x30C
+REF_NOTIFY_NAT_TCP = 0x30D
 REF_NOTIFY_CPU_MEM_STATE = 0x30F
 REF_NOTIFY_AGGR_TASK_STATE = 0x310
 REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
@@ -338,6 +339,16 @@ REF_HOST_INFO_DT = np.dtype([
 ])
 assert REF_HOST_INFO_DT.itemsize == 704
 
+# NAT_TCP_NOTIFY (gy_comm_proto.h:1744, 136 bytes): conntrack
+# orig↔nat tuple pairs resolved AFTER the conn notify
+REF_NAT_TCP_DT = np.dtype([
+    ("orig_cli", REF_IP_PORT_DT), ("orig_ser", REF_IP_PORT_DT),
+    ("nat_cli", REF_IP_PORT_DT), ("nat_ser", REF_IP_PORT_DT),
+    ("is_snat", "u1"), ("is_dnat", "u1"), ("is_ipvs", "u1"),
+    ("tailpad", "u1", (5,)),
+])
+assert REF_NAT_TCP_DT.itemsize == 136
+
 # NOTIFICATION_MSG (gy_comm_proto.h:2913, 8 bytes + msglen_ text)
 REF_NOTIFICATION_MSG_DT = np.dtype([
     ("type", "u1"), ("pad0", "u1"), ("msglen", "<u2"),
@@ -392,6 +403,9 @@ class RefSession:
         # (bounded; the edge drains them after every adapt run)
         self.notifications: list = []    # (ntype_str, message)
         self.domains: list = []          # (glob_id, domain, tag)
+        self.nat_conns: list = []        # TCP_CONN record arrays (NAT
+        #                                  annotations for the VIP
+        #                                  registry; never engine-fed)
 
     # drained by the serving edge after each adapt() run
     MAX_PENDING = 1024
@@ -720,11 +734,61 @@ def decode_listener_domain(payload: bytes, nevents: int,
         off = end
 
 
+# NAT_TCP batch cap — the reference's NAT_TCP_NOTIFY::MAX_NUM_CONNS
+REF_MAX_NAT_PER_BATCH = 2048
+
+
+def _ip16_col(tup) -> np.ndarray:
+    """(N,) REF_IP_PORT records → (N, 16) wire addresses (v4-mapped
+    where aftype is AF_INET) — the vectorized :func:`_ip16`."""
+    raw = np.ascontiguousarray(tup["ip128"])
+    v4 = np.zeros_like(raw)
+    v4[:, 10:12] = 0xFF
+    v4[:, 12:16] = np.ascontiguousarray(
+        tup["ip32_be"]).view(np.uint8).reshape(-1, 4)
+    is4 = (np.ascontiguousarray(tup["aftype"]) == AF_INET)[:, None]
+    return np.where(is4, v4, raw)
+
+
+def decode_nat_tcp(payload: bytes, nevents: int,
+                   session: "RefSession") -> None:
+    """NAT_TCP walk → session NAT annotations.
+
+    Conntrack resolves some translations AFTER the conn notify; the
+    reference fixes the conn up server-side. Here the DNAT/IPVS pairs
+    become synthetic TCP_CONN records carrying ONLY tuple fields
+    (ser = the dialed VIP, nat_* = the translated tuple,
+    ser_glob_id = 0) for the VIP/NAT cluster registry — never
+    engine-fed, so no phantom connections are counted. Pure-SNAT
+    records (server tuple unchanged) are dropped: registering a
+    service's own address as its "VIP" would fabricate self-clusters
+    and eat the bounded registry."""
+    fsz = REF_NAT_TCP_DT.itemsize
+    _check_nevents(nevents, payload, fsz, REF_MAX_NAT_PER_BATCH,
+                   "nat_tcp")
+    recs = np.frombuffer(payload, REF_NAT_TCP_DT, count=nevents)
+    ser_ip = _ip16_col(recs["orig_ser"])
+    nat_ser_ip = _ip16_col(recs["nat_ser"])
+    translated = ((recs["is_dnat"] | recs["is_ipvs"]) != 0) & (
+        (ser_ip != nat_ser_ip).any(axis=1)
+        | (recs["orig_ser"]["port"] != recs["nat_ser"]["port"]))
+    recs = recs[translated]
+    if not len(recs):
+        return
+    out = np.zeros(len(recs), wire.TCP_CONN_DT)
+    for src, dst in (("orig_cli", "cli"), ("orig_ser", "ser"),
+                     ("nat_cli", "nat_cli"), ("nat_ser", "nat_ser")):
+        out[dst]["ip"] = _ip16_col(recs[src])
+        out[dst]["port"] = recs[src]["port"]
+    session._push(session.nat_conns, out)
+
+
 # frameless stateful subtypes: consume into the session, emit nothing
 _SESSION_DECODERS = {
     REF_NOTIFY_LISTEN_TASKMAP: decode_listen_taskmap,
     REF_NOTIFY_NOTIFICATION_MSG: decode_notification_msg,
     REF_NOTIFY_LISTENER_DOMAIN: decode_listener_domain,
+    REF_NOTIFY_NAT_TCP: decode_nat_tcp,
 }
 
 

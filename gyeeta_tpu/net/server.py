@@ -165,6 +165,11 @@ class GytServer:
                 if dom and len(self._pending_domains) < \
                         self._DOMAIN_MAX_PENDING:
                     self._pending_domains[gid] = (dom, 0)
+        if sess.nat_conns:
+            nats, sess.nat_conns = sess.nat_conns, []
+            for recs in nats:
+                # VIP/NAT registry only — never engine-fed
+                self.rt.natclusters.observe_conns(recs)
 
     def _resolve_pending_domains(self) -> None:
         """Tick-cadence domain resolution (after run_tick: the feed

@@ -380,6 +380,66 @@ def test_notification_msg_and_listener_domain():
     asyncio.run(main())
 
 
+def test_nat_tcp_feeds_vip_registry():
+    """NAT_TCP pairs land in the VIP/NAT cluster registry (DNAT to a
+    VIP → backend mapping) without counting phantom connections."""
+    def ipp(a, b, c, d, port):
+        r = np.zeros((), RP.REF_IP_PORT_DT)
+        r["aftype"] = RP.AF_INET
+        r["ip32_be"] = int.from_bytes(bytes([a, b, c, d]), "little")
+        r["port"] = port
+        return r
+
+    glob = 0xF1EE
+    # the NAT event carries the ONLY knowledge of the VIP: the conn
+    # notify below is a plain accept half on the backend tuple (no
+    # nat fields) — resolution must come from decode_nat_tcp's tuple
+    # mapping, so a tuple-copy regression fails this test
+    nat = np.zeros((), RP.REF_NAT_TCP_DT)
+    nat["orig_cli"] = ipp(10, 0, 0, 7, 40002)
+    nat["orig_ser"] = ipp(10, 9, 9, 9, 443)        # the VIP, dialed
+    nat["nat_cli"] = ipp(10, 0, 0, 7, 40002)
+    nat["nat_ser"] = ipp(10, 1, 1, 5, 8443)        # real backend
+    nat["is_dnat"] = 1
+    # pure-SNAT record: must be DROPPED (self-VIP fabrication)
+    snat = np.zeros((), RP.REF_NAT_TCP_DT)
+    snat["orig_cli"] = ipp(10, 0, 0, 3, 40004)
+    snat["orig_ser"] = ipp(10, 1, 1, 5, 8443)
+    snat["nat_cli"] = ipp(192, 168, 0, 1, 61000)
+    snat["nat_ser"] = ipp(10, 1, 1, 5, 8443)       # server unchanged
+    snat["is_snat"] = 1
+    conn = np.zeros((), RP.REF_TCP_CONN_DT)
+    conn["cli"] = ipp(10, 0, 0, 7, 40002)
+    conn["ser"] = ipp(10, 1, 1, 5, 8443)           # backend tuple
+    conn["ser_glob_id"] = glob
+    conn["is_accept"] = 1
+    conn["bytes_sent"] = 100
+
+    rt = Runtime(CFG)
+    sess = RP.RefSession()
+    buf = _ref_frame(RP.REF_NOTIFY_NAT_TCP, 2,
+                     nat.tobytes() + snat.tobytes())
+    gyt, consumed = RP.adapt(buf, host_id=1, session=sess)
+    assert consumed == len(buf) and gyt == b""     # frameless
+    assert len(sess.nat_conns) == 1
+    assert len(sess.nat_conns[0]) == 1             # SNAT dropped
+    n_before = rt.stats.counters.get("conn_events", 0)
+    for recs in sess.nat_conns:
+        rt.natclusters.observe_conns(recs)         # pending half
+    sess.nat_conns = []
+    # the backend's accept half resolves the pending VIP
+    buf2 = _ref_frame(RP.REF_NOTIFY_TCP_CONN, 1, conn.tobytes())
+    gyt2, _ = RP.adapt(buf2, host_id=1, session=sess)
+    rt.feed(gyt2)
+    # no phantom conn from the NAT records themselves
+    assert rt.stats.counters.get("conn_events", 0) == n_before + 1
+    cols, live = rt.natclusters.columns(rt.names)
+    assert live.any(), "VIP cluster not registered"
+    vips = [v for v, ok in zip(cols["vip"], live) if ok]
+    assert any("10.9.9.9" in v for v in vips), vips
+    rt.close()
+
+
 # ------------------------------------------------------- e2e handshake
 async def _stock_partha_session():
     from gyeeta_tpu.net import GytServer
