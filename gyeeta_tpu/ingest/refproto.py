@@ -56,6 +56,7 @@ REF_NOTIFY_LISTENER_DOMAIN = 0x313
 REF_NOTIFY_LISTEN_TASKMAP = 0x314
 REF_NOTIFY_HOST_INFO = 0x317
 REF_NOTIFY_NOTIFICATION_MSG = 0x319
+REF_NOTIFY_REQ_TRACE_TRAN = 0x31D
 REF_NOTIFY_HOST_STATE = 0x31C        # current version (NOTIFY_PM_EVT
 #                                      enum order: 0x301 TASK_MINI_ADD
 #                                      … 0x31B LISTEN_CLUSTER_INFO,
@@ -348,6 +349,34 @@ REF_NAT_TCP_DT = np.dtype([
     ("tailpad", "u1", (5,)),
 ])
 assert REF_NAT_TCP_DT.itemsize == 136
+
+# REQ_TRACE_TRAN / API_TRAN (gy_proto_common.h:140, 176 bytes fixed;
+# request_len_ bytes of request text + lenext_ ext fields + padlen_
+# follow each record) — the reference's request-trace stream
+REF_GY_IP_ADDR_DT = np.dtype([
+    ("ip128", "u1", (16,)), ("ip32_be", "<u4"),
+    ("aftype", "<i2"), ("ipflags", "<u2"),
+])
+assert REF_GY_IP_ADDR_DT.itemsize == 24
+REF_API_TRAN_DT = np.dtype([
+    ("treq_usec", "<u8"), ("tres_usec", "<u8"), ("tupd_usec", "<u8"),
+    ("reqlen", "<u8"), ("reslen", "<u8"), ("reqnum", "<u8"),
+    ("response_usec", "<u8"), ("reaction_usec", "<u8"),
+    ("tconnect_usec", "<u8"),
+    ("cliip", REF_GY_IP_ADDR_DT), ("serip", REF_GY_IP_ADDR_DT),
+    ("glob_id", "<u8"), ("conn_id", "<u8"),
+    ("comm", "S16"),
+    ("errorcode", "<i4"), ("app_sleep_ms", "<u4"),
+    ("tran_type", "<u4"),
+    ("proto", "<u2"), ("cliport", "<u2"), ("serport", "<u2"),
+    ("request_len", "<u2"), ("lenext", "<u2"),
+    ("padlen", "u1"), ("tailpad", "u1", (1,)),
+])
+assert REF_API_TRAN_DT.itemsize == 176
+
+# reference PROTO_TYPES (gy_proto_common.h:14) → GYT trace protos
+_REF_PROTO_MAP = {1: 1, 2: 4, 3: 2, 5: 3, 7: 6}   # HTTP1, HTTP2,
+#                 Postgres, Mongo, Sybase; others → 0 (unknown)
 
 # NOTIFICATION_MSG (gy_comm_proto.h:2913, 8 bytes + msglen_ text)
 REF_NOTIFICATION_MSG_DT = np.dtype([
@@ -750,6 +779,85 @@ def _ip16_col(tup) -> np.ndarray:
     return np.where(is4, v4, raw)
 
 
+def decode_req_trace_tran(payload: bytes, nevents: int, host_id: int
+                          ) -> tuple[np.ndarray, list]:
+    """REQ_TRACE_TRAN walk → GYT REQ_TRACE records + interned API
+    signatures.
+
+    The reference streams RAW request text per transaction and
+    normalizes server-side; here the request normalizes through the
+    SAME :func:`~gyeeta_tpu.trace.proto.normalize_sql`-style signature
+    path the local parsers use, so stock-partha traces and
+    locally-captured traces aggregate under identical API ids. The
+    trace→resp bridge then feeds svcstate latencies for free, and
+    error transactions accumulate into ser_errors (the trace fold)."""
+    from gyeeta_tpu.trace.proto import normalize_http, normalize_sql
+    from gyeeta_tpu.utils import hashing as H
+
+    fsz = REF_API_TRAN_DT.itemsize
+    # tolerant cap: the reference producer batches ≤256 (API_TRAN::
+    # MAX_NUM_REQS) but our pipeline accepts its own trace batch size
+    _check_nevents(nevents, payload, fsz, wire.MAX_TRACE_PER_BATCH,
+                   "req_trace_tran")
+    out = np.zeros(nevents, wire.REQ_TRACE_DT)
+    names: list = []
+    seen: dict = {}
+    seen_comm: dict = {}
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"req_trace_tran {i} truncated")
+        rec = np.frombuffer(payload, REF_API_TRAN_DT, count=1,
+                            offset=off)[0]
+        rlen = int(rec["request_len"])
+        end = (off + fsz + rlen + int(rec["lenext"])
+               + int(rec["padlen"]))
+        if rlen > 16384 or end > len(payload):
+            raise RefFrameError(f"req_trace_tran {i} overflows")
+        req_text = payload[off + fsz: off + fsz + rlen].split(
+            b"\x00", 1)[0]
+        proto = int(rec["proto"])
+        if not req_text:
+            api = "(empty)"
+        elif proto in (1, 2) and b" " in req_text:   # HTTP1/HTTP2:
+            meth, _, path = req_text.partition(b" ")  # method + path
+            api = normalize_http(meth, path.split(b" ", 1)[0])
+        else:
+            api = normalize_sql(req_text)
+        api_id = seen.get(api)
+        if api_id is None:
+            # unsalted content hash — the id convention of
+            # transactions_to_records (trace/proto.py:292), so stock
+            # and locally-parsed traces share API identities
+            api_id = int(H.hash_bytes_np(api.encode()))
+            seen[api] = api_id
+            names.append((wire.NAME_KIND_API, api_id, api))
+        r = out[i]
+        r["svc_glob_id"] = rec["glob_id"]
+        r["api_id"] = api_id
+        r["conn_id"] = rec["conn_id"]
+        r["tusec"] = rec["treq_usec"]
+        r["resp_usec"] = min(int(rec["response_usec"]), 0xFFFFFFFF)
+        r["bytes_in"] = min(int(rec["reqlen"]), 0xFFFFFFFF)
+        r["bytes_out"] = min(int(rec["reslen"]), 0xFFFFFFFF)
+        err = int(rec["errorcode"])
+        r["status"] = min(abs(err), 0xFFFF)
+        r["is_error"] = err != 0
+        r["proto"] = _REF_PROTO_MAP.get(proto, 0)
+        r["host_id"] = host_id
+        comm = rec["comm"].tobytes().split(b"\x00", 1)[0].decode(
+            "utf-8", "replace")
+        if comm:
+            cid = seen_comm.get(comm)
+            if cid is None:            # trace batches repeat one comm:
+                cid = InternTable.intern(comm, wire.NAME_KIND_COMM)
+                seen_comm[comm] = cid  # dedup the announcements
+                names.append((wire.NAME_KIND_COMM, cid, comm))
+            r["cli_comm_id"] = cid
+        off = end
+    return out, names
+
+
 def decode_nat_tcp(payload: bytes, nevents: int,
                    session: "RefSession") -> None:
     """NAT_TCP walk → session NAT annotations.
@@ -1040,6 +1148,8 @@ _DECODER_OF = {
                             wire.NOTIFY_HOST_STATE, False),
     REF_NOTIFY_HOST_INFO: (decode_host_info,
                            wire.NOTIFY_HOST_INFO, True),
+    REF_NOTIFY_REQ_TRACE_TRAN: (decode_req_trace_tran,
+                                wire.NOTIFY_REQ_TRACE, False),
 }
 
 

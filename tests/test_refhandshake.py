@@ -440,6 +440,55 @@ def test_nat_tcp_feeds_vip_registry():
     rt.close()
 
 
+def _api_tran(glob: int, req: bytes, resp_usec: int, proto: int = 1,
+              err: int = 0, comm: bytes = b"stock-web") -> bytes:
+    rec = np.zeros((), RP.REF_API_TRAN_DT)
+    rec["treq_usec"] = 1_700_000_000_000_000
+    rec["response_usec"] = resp_usec
+    rec["reqlen"] = len(req)
+    rec["reslen"] = 512
+    rec["glob_id"] = glob
+    rec["conn_id"] = 0xC0
+    rec["comm"] = comm
+    rec["errorcode"] = err
+    rec["proto"] = proto
+    rec["request_len"] = len(req)
+    rec["padlen"] = (-(RP.REF_API_TRAN_DT.itemsize + len(req))) % 8
+    return rec.tobytes() + req + b"\x00" * int(rec["padlen"])
+
+
+def test_req_trace_tran_adapts_stock_traces():
+    """Stock REQ_TRACE_TRAN → tracereq rows with normalized API
+    signatures identical to the local parsers' convention, plus the
+    trace→resp bridge (real latencies) and ser_errors."""
+    glob = 0x7ACE
+    buf = _ref_frame(
+        RP.REF_NOTIFY_REQ_TRACE_TRAN, 3,
+        _api_tran(glob, b"GET /api/users/123 HTTP/1.1", 20_000)
+        + _api_tran(glob, b"GET /api/users/456 HTTP/1.1", 30_000)
+        + _api_tran(glob, b"select * from orders where id = 77",
+                    55_000, proto=3, err=1, comm=b"stock-db"))
+    rt = Runtime(CFG)
+    gyt, consumed = RP.adapt(buf, host_id=2)
+    assert consumed == len(buf)
+    rt.feed(gyt)
+    tr = rt.query({"subsys": "tracereq", "maxrecs": 20})
+    by_api = {r["api"]: r for r in tr["recs"]}
+    # HTTP path ids normalize with the LOCAL parsers' {} convention
+    assert "GET /api/users/{}" in by_api, by_api.keys()
+    assert by_api["GET /api/users/{}"]["nreq"] == 2
+    assert "select * from orders where id = $" in by_api
+    assert by_api["select * from orders where id = $"]["nerr"] == 1
+    # the trace→resp bridge carried the REAL latencies into svcstate
+    svc = rt.query({"subsys": "svcstate",
+                    "filter": f"{{ svcstate.svcid = '{glob:016x}' }}"})
+    assert svc["nrecs"] == 1
+    assert svc["recs"][0]["nqry5s"] == 3
+    assert svc["recs"][0]["sererr"] == 1
+    assert svc["recs"][0]["p95resp5s"] > 10.0       # ~55ms tail
+    rt.close()
+
+
 # ------------------------------------------------------- e2e handshake
 async def _stock_partha_session():
     from gyeeta_tpu.net import GytServer
