@@ -55,6 +55,7 @@ REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
 REF_NOTIFY_LISTENER_DOMAIN = 0x313
 REF_NOTIFY_LISTEN_TASKMAP = 0x314
 REF_NOTIFY_HOST_INFO = 0x317
+REF_NOTIFY_HOST_CPU_MEM_CHANGE = 0x318
 REF_NOTIFY_NOTIFICATION_MSG = 0x319
 REF_NOTIFY_REQ_TRACE_TRAN = 0x31D
 REF_NOTIFY_HOST_STATE = 0x31C        # current version (NOTIFY_PM_EVT
@@ -377,6 +378,18 @@ assert REF_API_TRAN_DT.itemsize == 176
 # reference PROTO_TYPES (gy_proto_common.h:14) → GYT trace protos
 _REF_PROTO_MAP = {1: 1, 2: 4, 3: 2, 5: 3, 7: 6}   # HTTP1, HTTP2,
 #                 Postgres, Mongo, Sybase; others → 0 (unknown)
+
+# HOST_CPU_MEM_CHANGE (gy_comm_proto.h:2886, 32 bytes, nevents == 1)
+REF_CPU_MEM_CHANGE_DT = np.dtype([
+    ("cpu_changed", "u1"), ("pad0", "u1"),
+    ("new_cores_online", "<u2"), ("new_cores_offline", "<u2"),
+    ("old_cores_online", "<u2"), ("old_cores_offline", "<u2"),
+    ("mem_changed", "u1"), ("pad1", "u1"),
+    ("new_ram_mb", "<u4"), ("old_ram_mb", "<u4"),
+    ("mem_corrupt_changed", "u1"), ("pad2", "u1", (3,)),
+    ("new_corrupted_ram_mb", "<u4"), ("old_corrupted_ram_mb", "<u4"),
+])
+assert REF_CPU_MEM_CHANGE_DT.itemsize == 32
 
 # NOTIFICATION_MSG (gy_comm_proto.h:2913, 8 bytes + msglen_ text)
 REF_NOTIFICATION_MSG_DT = np.dtype([
@@ -858,6 +871,31 @@ def decode_req_trace_tran(payload: bytes, nevents: int, host_id: int
     return out, names
 
 
+def decode_cpu_mem_change(payload: bytes, nevents: int,
+                          session: "RefSession") -> None:
+    """HOST_CPU_MEM_CHANGE → operator notifications (cores on/offline,
+    RAM resize, memory corruption — the reference raises the same as
+    host notifications)."""
+    fsz = REF_CPU_MEM_CHANGE_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 16, "cpu_mem_change")
+    recs = np.frombuffer(payload, REF_CPU_MEM_CHANGE_DT, count=nevents)
+    for rec in recs:
+        if rec["cpu_changed"]:
+            session._push(session.notifications, (
+                "warn", f"host cores changed: "
+                f"{int(rec['old_cores_online'])} → "
+                f"{int(rec['new_cores_online'])} online"))
+        if rec["mem_changed"]:
+            session._push(session.notifications, (
+                "warn", f"host RAM changed: {int(rec['old_ram_mb'])}"
+                f" → {int(rec['new_ram_mb'])} MB"))
+        if rec["mem_corrupt_changed"]:
+            session._push(session.notifications, (
+                "error", f"corrupted RAM changed: "
+                f"{int(rec['old_corrupted_ram_mb'])} → "
+                f"{int(rec['new_corrupted_ram_mb'])} MB"))
+
+
 def decode_nat_tcp(payload: bytes, nevents: int,
                    session: "RefSession") -> None:
     """NAT_TCP walk → session NAT annotations.
@@ -897,6 +935,7 @@ _SESSION_DECODERS = {
     REF_NOTIFY_NOTIFICATION_MSG: decode_notification_msg,
     REF_NOTIFY_LISTENER_DOMAIN: decode_listener_domain,
     REF_NOTIFY_NAT_TCP: decode_nat_tcp,
+    REF_NOTIFY_HOST_CPU_MEM_CHANGE: decode_cpu_mem_change,
 }
 
 

@@ -489,6 +489,24 @@ def test_req_trace_tran_adapts_stock_traces():
     rt.close()
 
 
+def test_host_cpu_mem_change_raises_notifications():
+    ch = np.zeros((), RP.REF_CPU_MEM_CHANGE_DT)
+    ch["cpu_changed"] = 1
+    ch["old_cores_online"] = 16
+    ch["new_cores_online"] = 8
+    ch["mem_corrupt_changed"] = 1
+    ch["old_corrupted_ram_mb"] = 0
+    ch["new_corrupted_ram_mb"] = 64
+    sess = RP.RefSession()
+    buf = _ref_frame(RP.REF_NOTIFY_HOST_CPU_MEM_CHANGE, 1, ch.tobytes())
+    gyt, consumed = RP.adapt(buf, host_id=3, session=sess)
+    assert consumed == len(buf) and gyt == b""
+    kinds = {n[0] for n in sess.notifications}
+    msgs = " | ".join(n[1] for n in sess.notifications)
+    assert kinds == {"warn", "error"}
+    assert "16 → 8" in msgs and "corrupted RAM" in msgs
+
+
 # ------------------------------------------------------- e2e handshake
 async def _stock_partha_session():
     from gyeeta_tpu.net import GytServer
