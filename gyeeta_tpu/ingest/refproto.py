@@ -45,6 +45,7 @@ REF_COMM_PM_CONNECT_RESP = 9
 REF_COMM_EVENT_NOTIFY = 14
 
 REF_NOTIFY_TASK_TOP_PROCS = 0x303
+REF_NOTIFY_TASK_AGGR = 0x305
 REF_NOTIFY_NEW_LISTENER = 0x307
 REF_NOTIFY_LISTENER_STATE = 0x309
 REF_NOTIFY_TCP_CONN = 0x30C
@@ -378,6 +379,16 @@ assert REF_API_TRAN_DT.itemsize == 176
 # reference PROTO_TYPES (gy_proto_common.h:14) → GYT trace protos
 _REF_PROTO_MAP = {1: 1, 2: 4, 3: 2, 5: 3, 7: 6}   # HTTP1, HTTP2,
 #                 Postgres, Mongo, Sybase; others → 0 (unknown)
+
+# TASK_AGGR_NOTIFY (gy_comm_proto.h:1290, 48 bytes + cmdline/tag):
+# process-group announcements carrying the task→listener linkage
+REF_TASK_AGGR_DT = np.dtype([
+    ("aggr_task_id", "<u8"), ("related_listen_id", "<u8"),
+    ("comm", "S16"), ("uid", "<u4"), ("gid", "<u4"),
+    ("cmdline_len", "<u2"), ("tag_len", "u1"), ("procflags", "u1"),
+    ("padding_len", "u1"), ("tailpad", "u1", (3,)),
+])
+assert REF_TASK_AGGR_DT.itemsize == 48
 
 # HOST_CPU_MEM_CHANGE (gy_comm_proto.h:2886, 32 bytes, nevents == 1)
 REF_CPU_MEM_CHANGE_DT = np.dtype([
@@ -871,6 +882,29 @@ def decode_req_trace_tran(payload: bytes, nevents: int, host_id: int
     return out, names
 
 
+def decode_task_aggr(payload: bytes, nevents: int,
+                     session: "RefSession") -> None:
+    """TASK_AGGR walk → session task→listener linkage (a second
+    source besides LISTEN_TASKMAP: group announcements carry their
+    related_listen_id directly)."""
+    fsz = REF_TASK_AGGR_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 1200, "task_aggr")
+    off = 0
+    for i in range(nevents):
+        if off + fsz > len(payload):
+            raise RefFrameError(f"task_aggr {i} truncated")
+        rec = np.frombuffer(payload, REF_TASK_AGGR_DT, count=1,
+                            offset=off)[0]
+        end = (off + fsz + int(rec["cmdline_len"])
+               + int(rec["tag_len"]) + int(rec["padding_len"]))
+        if end > len(payload):
+            raise RefFrameError(f"task_aggr {i} overflows")
+        rel = int(rec["related_listen_id"])
+        if rel:
+            session.learn_taskmap(rel, [int(rec["aggr_task_id"])])
+        off = end
+
+
 def decode_cpu_mem_change(payload: bytes, nevents: int,
                           session: "RefSession") -> None:
     """HOST_CPU_MEM_CHANGE → operator notifications (cores on/offline,
@@ -936,6 +970,7 @@ _SESSION_DECODERS = {
     REF_NOTIFY_LISTENER_DOMAIN: decode_listener_domain,
     REF_NOTIFY_NAT_TCP: decode_nat_tcp,
     REF_NOTIFY_HOST_CPU_MEM_CHANGE: decode_cpu_mem_change,
+    REF_NOTIFY_TASK_AGGR: decode_task_aggr,
 }
 
 

@@ -489,6 +489,29 @@ def test_req_trace_tran_adapts_stock_traces():
     rt.close()
 
 
+def test_task_aggr_links_without_taskmap():
+    """TASK_AGGR announcements alone (no LISTEN_TASKMAP) link later
+    task-state records to their service."""
+    rel, aggr = 0x6E1, 0x6A2
+    ta = np.zeros((), RP.REF_TASK_AGGR_DT)
+    ta["aggr_task_id"] = aggr
+    ta["related_listen_id"] = rel
+    ta["comm"] = b"announced"
+    cmdline = b"/usr/bin/announced --serve"
+    ta["cmdline_len"] = len(cmdline)
+    ta["padding_len"] = (-(48 + len(cmdline))) % 8
+    body = ta.tobytes() + cmdline + b"\x00" * int(ta["padding_len"])
+    sess = RP.RefSession()
+    buf = (_ref_frame(RP.REF_NOTIFY_TASK_AGGR, 1, body)
+           + _ref_frame(RP.REF_NOTIFY_AGGR_TASK_STATE, 1,
+                        _aggr_task_record(aggr, b"announced")))
+    gyt, consumed = RP.adapt(buf, host_id=1, session=sess)
+    assert consumed == len(buf)
+    frames, _ = wire.decode_frames(gyt)
+    tasks = dict(frames)[wire.NOTIFY_AGGR_TASK_STATE]
+    assert int(tasks[0]["related_listen_id"]) == rel
+
+
 def test_host_cpu_mem_change_raises_notifications():
     ch = np.zeros((), RP.REF_CPU_MEM_CHANGE_DT)
     ch["cpu_changed"] = 1
