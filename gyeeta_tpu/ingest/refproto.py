@@ -456,6 +456,13 @@ class RefSession:
         # (bounded; the edge drains them after every adapt run)
         self.notifications: list = []    # (ntype_str, message)
         self.domains: list = []          # (glob_id, domain, tag)
+        # adaptation observability: events per reference subtype
+        # (drained into selfstats as ref_evt_0x<subtype> counters) +
+        # frames skipped whole (unknown subtype, non-NOTIFY data
+        # types, truncated NOTIFY bodies)
+        import collections
+        self.n_events = collections.Counter()   # subtype -> count
+        self.n_skipped = 0
         self.nat_conns: list = []        # TCP_CONN record arrays (NAT
         #                                  annotations for the VIP
         #                                  registry; never engine-fed)
@@ -1436,6 +1443,7 @@ def adapt(buf: bytes, host_id: int,
                 if session is not None:
                     sdec(buf[off + _HSZ + _ESZ: off + total - pad],
                          int(ev["nevents"]), session)
+                    session.n_events[subtype] += int(ev["nevents"])
                 off += total
                 continue
             dec = _DECODER_OF.get(subtype)
@@ -1454,5 +1462,13 @@ def adapt(buf: bytes, host_id: int,
                         InternTable.records(names)))
                 out.append(wire.encode_frames_chunked(gyt_subtype,
                                                       recs))
+                if session is not None:
+                    session.n_events[subtype] += len(recs)
+            elif session is not None:
+                session.n_skipped += 1
+        elif session is not None:
+            # non-NOTIFY data types and truncated NOTIFY bodies skip
+            # frame-whole too — count them so data loss is visible
+            session.n_skipped += 1
         off += total
     return b"".join(out), off

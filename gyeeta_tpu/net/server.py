@@ -170,6 +170,17 @@ class GytServer:
             for recs in nats:
                 # VIP/NAT registry only — never engine-fed
                 self.rt.natclusters.observe_conns(recs)
+        if sess.n_events:
+            evs = sess.n_events
+            sess.n_events = type(evs)()
+            for subtype, cnt in evs.items():
+                self.rt.stats.bump(f"ref_evt_0x{subtype:x}", cnt)
+        if sess.n_skipped:
+            # distinct from frames_ref_skipped (pre-registration
+            # handshake skips): this counts post-adapt whole-frame
+            # skips (unknown subtype / non-NOTIFY / truncated)
+            self.rt.stats.bump("ref_unadapted_frames", sess.n_skipped)
+            sess.n_skipped = 0
 
     def _resolve_pending_domains(self) -> None:
         """Tick-cadence domain resolution (after run_tick: the feed

@@ -373,6 +373,11 @@ def test_notification_msg_and_listener_domain():
         srv._resolve_pending_domains()
         ip = rt.svcreg.get(glob)["ip"]
         assert rt.dns.get(ip) == "api.shop.example"
+        # adaptation observability: per-subtype counters surfaced
+        c = rt.stats.counters
+        assert c.get(f"ref_evt_0x{RP.REF_NOTIFY_NEW_LISTENER:x}") == 1
+        assert c.get(
+            f"ref_evt_0x{RP.REF_NOTIFY_NOTIFICATION_MSG:x}") == 1
         w1.close()
         w2.close()
         await srv.stop()
