@@ -190,13 +190,47 @@ def _bench_fold(cfg, sim, dev, label: str, dep_pairs: int,
             "n_flushes": n_flushes, "per_call_s": per_call}
 
 
+def _stage_rates(cfg, bufs, ev_per_buf: int) -> dict:
+    """Host-stage isolation: deframe-only and decode-only throughput on
+    the same pre-generated buffers the feed loop eats. Emitted next to
+    ``feed_path_events_per_sec`` so a future feed regression can be
+    attributed to a stage (wire walk vs columnar packing vs fold)."""
+    from gyeeta_tpu.ingest import decode, native, wire
+
+    K = cfg.fold_k
+
+    def rate(f, min_s: float = 0.5):
+        f(0)                               # warm
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < min_s:
+            f(n % len(bufs))
+            n += 1
+        return n * ev_per_buf / (time.perf_counter() - t0)
+
+    deframe = rate(lambda i: native.drain(bufs[i]))
+    drained = [native.drain(b)[0] for b in bufs]
+    recs = [(d.get(wire.NOTIFY_TCP_CONN), d.get(wire.NOTIFY_RESP_SAMPLE))
+            for d in drained]
+
+    def dec(i):
+        conn, resp = recs[i]
+        decode.conn_slab([] if conn is None else [conn], K,
+                         cfg.conn_batch)
+        decode.resp_slab([] if resp is None else [resp], K,
+                         cfg.resp_batch)
+
+    return {"deframe_ev_per_sec": round(deframe, 1),
+            "decode_ev_per_sec": round(rate(dec), 1)}
+
+
 def _bench_feed(cfg, sim, label: str, dep_pairs: int,
-                dep_edges: int) -> float:
+                dep_edges: int) -> dict:
     """Feed-path throughput: the PRODUCT ingest loop (bytes → native
     deframe → decode → staged K-slab fold), not just the device fold —
     VERDICT r4 #3 requires ≥0.8× of the fold at both geometries.
     Frames are pre-generated so the sim's RNG cost isn't billed to the
-    server path."""
+    server path. Returns {rate, deframe_ev_per_sec, decode_ev_per_sec}."""
     import jax
 
     from gyeeta_tpu.runtime import Runtime
@@ -232,10 +266,13 @@ def _bench_feed(cfg, sim, label: str, dep_pairs: int,
     rt.flush()
     jax.block_until_ready(rt.state)
     feed_rate = feed_calls * ev_per_buf / (time.perf_counter() - t0)
-    print(f"bench[{label}]: feed path {feed_rate:,.0f} ev/s",
+    stages = _stage_rates(cfg, bufs, ev_per_buf)
+    print(f"bench[{label}]: feed path {feed_rate:,.0f} ev/s "
+          f"(deframe {stages['deframe_ev_per_sec']:,.0f}, "
+          f"decode {stages['decode_ev_per_sec']:,.0f})",
           file=sys.stderr, flush=True)
     rt.close()
-    return feed_rate
+    return {"rate": round(feed_rate, 1), **stages}
 
 
 def _run_phase(phase: str) -> dict:
@@ -259,11 +296,10 @@ def _run_phase(phase: str) -> dict:
                 "device": f"{dev.platform}:{dev.device_kind}"}
     if phase == "feed_ns":
         cfg, sim, dp, de = _geometry("ns")
-        return {"rate": round(
-            _bench_feed(cfg, sim, "northstar", dp, de), 1)}
+        return _bench_feed(cfg, sim, "northstar", dp, de)
     if phase == "feed_toy":
         cfg, sim, dp, de = _geometry("toy")
-        return {"rate": round(_bench_feed(cfg, sim, "toy", dp, de), 1)}
+        return _bench_feed(cfg, sim, "toy", dp, de)
     raise SystemExit(f"unknown phase {phase!r}")
 
 
@@ -347,11 +383,22 @@ def _orchestrate(platform: str | None, degraded: bool,
         result["feed_path_events_per_sec"] = fns["rate"]
         if "rate" in ns:
             result["feed_vs_fold"] = round(fns["rate"] / ns["rate"], 3)
+        # per-stage breakdown (ISSUE 1): attribute future feed-path
+        # regressions to deframe / decode / fold instead of one blended
+        # number
+        for k in ("deframe_ev_per_sec", "decode_ev_per_sec"):
+            if k in fns:
+                result[k] = fns[k]
+        if "rate" in ns:
+            result["fold_ev_per_sec"] = ns["rate"]
     if "rate" in ftoy:
         result["toy_feed_path_events_per_sec"] = ftoy["rate"]
         if "rate" in toy:
             result["toy_feed_vs_fold"] = round(
                 ftoy["rate"] / toy["rate"], 3)
+        for k in ("deframe_ev_per_sec", "decode_ev_per_sec"):
+            if k in ftoy:
+                result["toy_" + k] = ftoy[k]
     failed = [p for p, v in phases.items() if "rate" not in v]
     if failed:
         result["phases_failed"] = failed

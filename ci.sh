@@ -8,6 +8,23 @@
 #                      once before shipping
 set -e
 cd "$(dirname "$0")"
+
+# Build the native ingest extension from source — never trust a
+# checked-in libgytdeframe.so (a stale binary would silently fall back
+# or, worse, pass tests the current deframe.cpp wouldn't). A broken
+# compile fails CI loudly; a host without a C++ toolchain skips with a
+# reason and the suite runs on the pure-Python decode path.
+if command -v g++ >/dev/null 2>&1; then
+    rm -f gyeeta_tpu/ingest/native/libgytdeframe.so
+    if ! python -m gyeeta_tpu.ingest.native.build; then
+        echo "ci: FATAL — native ingest extension failed to compile" >&2
+        exit 1
+    fi
+else
+    echo "ci: SKIP native build (no C++ toolchain on this host);" \
+         "tests run on the pure-Python decode path" >&2
+fi
+
 if [ "$1" = "fast" ]; then
     shift
     exec python -m pytest tests/ -q -m "not slow" "$@"

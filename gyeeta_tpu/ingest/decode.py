@@ -19,12 +19,35 @@ layout.
 
 from __future__ import annotations
 
+import logging
 from typing import NamedTuple
 
 import numpy as np
 
-from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.ingest import native, wire
 from gyeeta_tpu.utils import hashing as H
+
+_log = logging.getLogger("gyeeta_tpu.ingest")
+_warned_fallback = False
+
+
+def _count_path(stats, used_native: bool, n: int) -> None:
+    """Per-session native-vs-fallback decode counters (selfstats:
+    ``ref_native_decoded`` / ``ref_fallback_decoded``) — a silently
+    missing .so is visible in the counters, plus a one-time warning."""
+    global _warned_fallback
+    if stats is not None and n:
+        stats.bump("ref_native_decoded" if used_native
+                   else "ref_fallback_decoded", n)
+    if not used_native and not _warned_fallback:
+        _warned_fallback = True
+        import os
+        if os.environ.get("GYT_PY_INGEST", "") in ("", "0"):
+            _log.warning(
+                "native ingest decoder unavailable (libgytdeframe.so) — "
+                "pure-Python decode fallback in use; build it with "
+                "`python -m gyeeta_tpu.ingest.native.build` (selfstats "
+                "counter: ref_fallback_decoded)")
 
 
 def split_u64(a) -> tuple[np.ndarray, np.ndarray]:
@@ -221,9 +244,12 @@ _LISTENER_STAT_FIELDS = (
 )
 
 
-def take_raw(lst: list, want: int, dtype) -> np.ndarray:
-    """Pop up to ``want`` records off a raw-record-array backlog (the
-    slab staging discipline shared by both runtimes)."""
+def take_raw_chunks(lst: list, want: int) -> tuple[list, int]:
+    """Pop up to ``want`` records off a raw-record-array backlog as a
+    LIST of array views — zero copies, no concatenation (the slab
+    staging discipline shared by both runtimes). The columnar *_parts
+    builders decode each chunk into the output slab at its lane offset,
+    so a contiguous record array is never materialized."""
     out, got = [], 0
     while lst and got < want:
         a = lst[0]
@@ -235,6 +261,15 @@ def take_raw(lst: list, want: int, dtype) -> np.ndarray:
             a = a[:take]
         out.append(a)
         got += take
+    return out, got
+
+
+def take_raw(lst: list, want: int, dtype) -> np.ndarray:
+    """Contiguous-array form of :func:`take_raw_chunks` (the sharded
+    runtime's host_id routing needs one array). Copy-free when the
+    drain is served by a single staged array — the common small-drain
+    path; only a multi-chunk take concatenates."""
+    out, _ = take_raw_chunks(lst, want)
     if not out:
         return np.empty(0, dtype)
     return out[0] if len(out) == 1 else np.concatenate(out)
@@ -302,31 +337,123 @@ def conn_batch(recs: np.ndarray, size: int = wire.MAX_CONNS_PER_BATCH
     )
 
 
+def alloc_conn_cols(size: int) -> dict:
+    """Zeroed flat ConnBatch columns (everything but ``valid``) in the
+    exact dtypes the device fold consumes — the preallocated buffers
+    the native wire→columnar decoders write into."""
+    u32 = lambda: np.zeros(size, np.uint32)     # noqa: E731
+    f32 = lambda: np.zeros(size, np.float32)    # noqa: E731
+    return dict(
+        svc_hi=u32(), svc_lo=u32(), flow_hi=u32(), flow_lo=u32(),
+        cli_hi=u32(), cli_lo=u32(), cli_task_hi=u32(),
+        cli_task_lo=u32(), cli_rel_hi=u32(), cli_rel_lo=u32(),
+        bytes_sent=f32(), bytes_rcvd=f32(), duration_us=f32(),
+        host_id=np.zeros(size, np.int32),
+        is_close=np.zeros(size, bool),
+        is_accept=np.zeros(size, bool))
+
+
+def _concat_chunks(chunks: list, dtype) -> np.ndarray:
+    if not chunks:
+        return np.empty(0, dtype)
+    return chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+
+
+def conn_batch_parts(chunks: list, size: int, stats=None) -> ConnBatch:
+    """A LIST of raw TCP_CONN chunks (total ≤ size) → one flat padded
+    ConnBatch: each chunk decodes straight into the preallocated column
+    buffers at its lane offset (native path; no staging concatenate, no
+    per-chunk pad+stack). Fallback: the NumPy reference decoder over
+    the concatenated chunks — bit-identical output either way."""
+    n = sum(len(c) for c in chunks)
+    if n > size:
+        raise ValueError(
+            f"{n} records exceed batch size {size}; split upstream")
+    if native.available():
+        cols = alloc_conn_cols(size)
+        off = 0
+        ok = True
+        for c in chunks:
+            if len(c):
+                if not native.decode_conn_into(c, cols, off):
+                    ok = False       # library vanished mid-batch
+                    break
+                off += len(c)
+        if ok:
+            valid = np.zeros(size, bool)
+            valid[:n] = True
+            _count_path(stats, True, n)
+            return ConnBatch(valid=valid, **cols)
+    _count_path(stats, False, n)
+    return conn_batch(_concat_chunks(chunks, wire.TCP_CONN_DT), size)
+
+
+def resp_batch_parts(chunks: list, size: int, stats=None) -> RespBatch:
+    """A LIST of raw RESP_SAMPLE chunks (total ≤ size) → one flat
+    padded RespBatch (see :func:`conn_batch_parts`)."""
+    n = sum(len(c) for c in chunks)
+    if n > size:
+        raise ValueError(
+            f"{n} records exceed batch size {size}; split upstream")
+    if native.available():
+        svc_hi = np.zeros(size, np.uint32)
+        svc_lo = np.zeros(size, np.uint32)
+        resp_us = np.zeros(size, np.float32)
+        host_id = np.zeros(size, np.int32)
+        off = 0
+        ok = True
+        for c in chunks:
+            if len(c):
+                if not native.decode_resp_into(c, svc_hi, svc_lo,
+                                               resp_us, host_id, off):
+                    ok = False       # library vanished mid-batch
+                    break
+                off += len(c)
+        if ok:
+            valid = np.zeros(size, bool)
+            valid[:n] = True
+            _count_path(stats, True, n)
+            return RespBatch(svc_hi=svc_hi, svc_lo=svc_lo,
+                             resp_us=resp_us, host_id=host_id,
+                             valid=valid)
+    _count_path(stats, False, n)
+    return resp_batch(_concat_chunks(chunks, wire.RESP_SAMPLE_DT), size)
+
+
 def conn_batch_fast(recs: np.ndarray,
-                    size: int = wire.MAX_CONNS_PER_BATCH) -> ConnBatch:
+                    size: int = wire.MAX_CONNS_PER_BATCH,
+                    stats=None) -> ConnBatch:
     """Columnar conn decode via the native C++ path when built
     (bit-identical; ~4x faster), else :func:`conn_batch`."""
-    from gyeeta_tpu.ingest import native
-    cb = native.decode_conn(recs, size)
-    return cb if cb is not None else conn_batch(recs, size)
+    return conn_batch_parts([recs], size, stats=stats)
 
 
-def conn_slab(recs: np.ndarray, k: int, b: int) -> ConnBatch:
-    """TCP_CONN records (n ≤ k·b) → ConnBatch with (k, b) stacked
-    columns: ONE flat columnar decode + a free reshape, replacing k
-    per-chunk decodes plus a tree-wide ``np.stack`` (the r3 feed-path
-    hot spot). Record i lands in flattened lane i; padding collects at
-    the slab tail — lane placement is only ever consumed through the
-    ``valid`` mask, so tail-padding and per-chunk padding are
-    equivalent to the fold."""
-    cb = conn_batch_fast(recs, k * b)
+def resp_batch_fast(recs: np.ndarray,
+                    size: int = wire.MAX_RESP_PER_BATCH,
+                    stats=None) -> RespBatch:
+    """Columnar resp decode via the native C++ path when built
+    (bit-identical), else :func:`resp_batch`."""
+    return resp_batch_parts([recs], size, stats=stats)
+
+
+def conn_slab(recs, k: int, b: int, stats=None) -> ConnBatch:
+    """TCP_CONN records (n ≤ k·b; an array or a list of chunk arrays)
+    → ConnBatch with (k, b) stacked columns: ONE flat columnar decode
+    + a free reshape, replacing k per-chunk decodes plus a tree-wide
+    ``np.stack`` (the r3 feed-path hot spot). Record i lands in
+    flattened lane i; padding collects at the slab tail — lane
+    placement is only ever consumed through the ``valid`` mask, so
+    tail-padding and per-chunk padding are equivalent to the fold."""
+    chunks = recs if isinstance(recs, list) else [recs]
+    cb = conn_batch_parts(chunks, k * b, stats=stats)
     return ConnBatch(*(x.reshape(k, b) for x in cb))
 
 
-def resp_slab(recs: np.ndarray, k: int, b: int) -> RespBatch:
-    """RESP_SAMPLE records (n ≤ k·b) → RespBatch with (k, b) stacked
-    columns (see :func:`conn_slab`)."""
-    rb = resp_batch(recs, k * b)
+def resp_slab(recs, k: int, b: int, stats=None) -> RespBatch:
+    """RESP_SAMPLE records (n ≤ k·b; array or chunk list) → RespBatch
+    with (k, b) stacked columns (see :func:`conn_slab`)."""
+    chunks = recs if isinstance(recs, list) else [recs]
+    rb = resp_batch_parts(chunks, k * b, stats=stats)
     return RespBatch(*(x.reshape(k, b) for x in rb))
 
 
@@ -364,6 +491,32 @@ def listener_batch(recs: np.ndarray,
     )
 
 
+def listener_batch_fast(recs: np.ndarray,
+                        size: int = wire.MAX_LISTENERS_PER_BATCH,
+                        stats=None) -> ListenerBatch:
+    """Native columnar LISTENER_STATE decode (id split + one-pass stat
+    matrix pack), else :func:`listener_batch` — bit-identical."""
+    n = _check_fit(recs, size)
+    if not native.available():
+        _count_path(stats, False, n)
+        return listener_batch(recs, size)
+    r = recs[:n]
+    svc_hi = np.zeros(size, np.uint32)
+    svc_lo = np.zeros(size, np.uint32)
+    stat_m = np.zeros((size, NSTAT), np.float32)
+    host_id = np.zeros(size, np.int32)
+    if not (native.split_u64_into(r, "glob_id", svc_hi, svc_lo)
+            and native.pack_f32_into(r, _LISTENER_STAT_FIELDS, stat_m)
+            and native.pack_i32_into(r, "host_id", host_id)):
+        _count_path(stats, False, n)     # library vanished mid-batch
+        return listener_batch(recs, size)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    _count_path(stats, True, n)
+    return ListenerBatch(svc_hi=svc_hi, svc_lo=svc_lo, stats=stat_m,
+                         host_id=host_id, valid=valid)
+
+
 def task_batch(recs: np.ndarray, size: int = wire.MAX_TASKS_PER_BATCH
                ) -> TaskBatch:
     """AGGR_TASK_STATE records → columnar microbatch (ref
@@ -388,6 +541,41 @@ def task_batch(recs: np.ndarray, size: int = wire.MAX_TASKS_PER_BATCH
         host_id=_pad(r["host_id"].astype(np.int32), size),
         valid=valid,
     )
+
+
+def task_batch_fast(recs: np.ndarray,
+                    size: int = wire.MAX_TASKS_PER_BATCH,
+                    stats=None) -> TaskBatch:
+    """Native columnar AGGR_TASK_STATE decode, else :func:`task_batch`
+    — bit-identical."""
+    n = _check_fit(recs, size)
+    if not native.available():
+        _count_path(stats, False, n)
+        return task_batch(recs, size)
+    r = recs[:n]
+    u32 = lambda: np.zeros(size, np.uint32)     # noqa: E731
+    i32 = lambda: np.zeros(size, np.int32)      # noqa: E731
+    cols = dict(key_hi=u32(), key_lo=u32(), comm_hi=u32(),
+                comm_lo=u32(), rel_hi=u32(), rel_lo=u32())
+    stat_m = np.zeros((size, NTASKSTAT), np.float32)
+    state, issue, host_id = i32(), i32(), i32()
+    if not (native.split_u64_into(r, "aggr_task_id", cols["key_hi"],
+                                  cols["key_lo"])
+            and native.split_u64_into(r, "comm_id", cols["comm_hi"],
+                                      cols["comm_lo"])
+            and native.split_u64_into(r, "related_listen_id",
+                                      cols["rel_hi"], cols["rel_lo"])
+            and native.pack_f32_into(r, _TASK_STAT_FIELDS, stat_m)
+            and native.pack_i32_into(r, "curr_state", state)
+            and native.pack_i32_into(r, "curr_issue", issue)
+            and native.pack_i32_into(r, "host_id", host_id)):
+        _count_path(stats, False, n)     # library vanished mid-batch
+        return task_batch(recs, size)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    _count_path(stats, True, n)
+    return TaskBatch(stats=stat_m, state=state, issue=issue,
+                     host_id=host_id, valid=valid, **cols)
 
 
 def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
@@ -511,6 +699,28 @@ def cpumem_batch(recs: np.ndarray, size: int = wire.MAX_CPUMEM_PER_BATCH
     )
 
 
+def cpumem_batch_fast(recs: np.ndarray,
+                      size: int = wire.MAX_CPUMEM_PER_BATCH,
+                      stats=None) -> CpuMemBatch:
+    """Native columnar CPU_MEM_STATE decode, else :func:`cpumem_batch`
+    — bit-identical."""
+    n = _check_fit(recs, size)
+    if not native.available():
+        _count_path(stats, False, n)
+        return cpumem_batch(recs, size)
+    r = recs[:n]
+    vals = np.zeros((size, NCM), np.float32)
+    host_id = np.zeros(size, np.int32)
+    if not (native.pack_f32_into(r, _CM_FIELDS, vals)
+            and native.pack_i32_into(r, "host_id", host_id)):
+        _count_path(stats, False, n)     # library vanished mid-batch
+        return cpumem_batch(recs, size)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    _count_path(stats, True, n)
+    return CpuMemBatch(host_id=host_id, vals=vals, valid=valid)
+
+
 def host_batch(recs: np.ndarray, size: int = wire.MAX_HOSTS_PER_BATCH
                ) -> HostBatch:
     n = _check_fit(recs, size)
@@ -525,3 +735,25 @@ def host_batch(recs: np.ndarray, size: int = wire.MAX_HOSTS_PER_BATCH
         panel=_pad(panel, size),
         valid=valid,
     )
+
+
+def host_batch_fast(recs: np.ndarray,
+                    size: int = wire.MAX_HOSTS_PER_BATCH,
+                    stats=None) -> HostBatch:
+    """Native columnar HOST_STATE decode, else :func:`host_batch` —
+    bit-identical."""
+    n = _check_fit(recs, size)
+    if not native.available():
+        _count_path(stats, False, n)
+        return host_batch(recs, size)
+    r = recs[:n]
+    panel = np.zeros((size, NHOSTCOL), np.float32)
+    host_id = np.zeros(size, np.int32)
+    if not (native.pack_f32_into(r, _HOST_PANEL_FIELDS, panel)
+            and native.pack_i32_into(r, "host_id", host_id)):
+        _count_path(stats, False, n)     # library vanished mid-batch
+        return host_batch(recs, size)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    _count_path(stats, True, n)
+    return HostBatch(host_id=host_id, panel=panel, valid=valid)

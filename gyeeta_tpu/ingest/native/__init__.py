@@ -9,11 +9,25 @@ The subtype table is pushed INTO the library from ``wire.DTYPE_OF_SUBTYPE``
 at load time (``gyt_set_table``) and echoed back (``gyt_layout``) — the
 native path structurally cannot drift from wire.py the way a compiled-in
 table could.
+
+Beyond deframing, this module is the host half of the **wire→columnar
+compiler**: ``decode_conn_into``/``decode_resp_into`` and the generic
+``split_u64_into``/``pack_f32_into``/``pack_i32_into`` kernels decode raw
+record arrays straight into caller-provided preallocated NumPy column
+buffers at a lane offset (zero-copy, GIL released for the whole pass).
+Column plans (field offset + scalar kind) are compiled HERE from the
+wire.py dtypes and executed in C++ — ``ingest/decode.py`` keeps the
+bit-identical NumPy reference implementations as the fallback.
+
+Setting ``GYT_PY_INGEST=1`` forces the pure-Python path everywhere (a
+``GYT_BENCH_ABLATE``-style debug knob; see OPERATIONS.md) — checked on
+every load so tests can toggle it per-process.
 """
 
 from __future__ import annotations
 
 import ctypes
+import os
 import pathlib
 
 import numpy as np
@@ -32,6 +46,14 @@ _ERRNAMES = {1: "bad magic", 2: "bad total_sz", 3: "batch cap exceeded",
 # drain() output ordering; derived from wire.py, never hand-maintained
 _SCAN_ORDER = tuple(sorted(wire.DTYPE_OF_SUBTYPE))
 
+# scalar kind codes of the C++ pack kernels (deframe.cpp PackKind)
+_KIND = {("u", 1): 1, ("u", 2): 2, ("u", 4): 3, ("u", 8): 4,
+         ("i", 4): 5, ("f", 4): 6}
+
+
+def _forced_python() -> bool:
+    return os.environ.get("GYT_PY_INGEST", "") not in ("", "0")
+
 
 def _ensure_built() -> bool:
     """Build (or rebuild, if deframe.cpp is newer) the shared object."""
@@ -48,6 +70,8 @@ def _ensure_built() -> bool:
 
 def _load():
     global _lib, _load_failed
+    if _forced_python():
+        return None
     if _lib is not None or _load_failed:
         return _lib
     if not _ensure_built():
@@ -65,22 +89,21 @@ def _load():
 
 def _bind_and_handshake(lib):
     global _lib
+    i64p = ctypes.POINTER(ctypes.c_int64)
     lib.gyt_set_table.restype = ctypes.c_int32
-    lib.gyt_set_table.argtypes = [ctypes.POINTER(ctypes.c_int64),
-                                  ctypes.c_int32]
+    lib.gyt_set_table.argtypes = [i64p, ctypes.c_int32]
     lib.gyt_extract.restype = ctypes.c_int32
     lib.gyt_extract.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
-        ctypes.c_void_p, ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-        ctypes.POINTER(ctypes.c_int64)]
-    lib.gyt_scan.restype = ctypes.c_int32
-    lib.gyt_scan.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, i64p, i64p, i64p]
+    lib.gyt_extract_multi.restype = ctypes.c_int32
+    lib.gyt_extract_multi.argtypes = [
         ctypes.c_char_p, ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        ctypes.POINTER(ctypes.c_void_p), i64p, i64p, i64p]
+    lib.gyt_scan.restype = ctypes.c_int32
+    lib.gyt_scan.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p, i64p]
     lib.gyt_layout.restype = ctypes.c_int32
-    lib.gyt_layout.argtypes = [ctypes.POINTER(ctypes.c_int64),
-                               ctypes.c_int64]
+    lib.gyt_layout.argtypes = [i64p, ctypes.c_int64]
     # push the subtype table from wire.py (single source of truth) ...
     n = len(_SCAN_ORDER)
     tri = (ctypes.c_int64 * (3 * n))()
@@ -103,8 +126,7 @@ def _bind_and_handshake(lib):
             f"native deframer layout mismatch: {native} != {expect}")
     # columnar conn-decode layout push (same single-source discipline)
     lib.gyt_set_conn_layout.restype = ctypes.c_int32
-    lib.gyt_set_conn_layout.argtypes = [ctypes.POINTER(ctypes.c_int64),
-                                        ctypes.c_int32]
+    lib.gyt_set_conn_layout.argtypes = [i64p, ctypes.c_int32]
     lib.gyt_decode_conn.restype = ctypes.c_int32
     lib.gyt_decode_conn.argtypes = [ctypes.c_void_p, ctypes.c_int64] + \
         [ctypes.c_void_p] * 16
@@ -122,8 +144,164 @@ def _bind_and_handshake(lib):
     if rc != 0:
         raise RuntimeError(f"gyt_set_conn_layout: "
                            f"{_ERRNAMES.get(rc, rc)}")
+    # resp-decode layout push (wire.RESP_SAMPLE_DT)
+    lib.gyt_set_resp_layout.restype = ctypes.c_int32
+    lib.gyt_set_resp_layout.argtypes = [i64p, ctypes.c_int32]
+    lib.gyt_decode_resp.restype = ctypes.c_int32
+    lib.gyt_decode_resp.argtypes = [ctypes.c_void_p, ctypes.c_int64] + \
+        [ctypes.c_void_p] * 4
+    rdt = wire.RESP_SAMPLE_DT
+    rfields = [rdt.itemsize, rdt.fields["glob_id"][1],
+               rdt.fields["resp_usec"][1], rdt.fields["host_id"][1]]
+    rarr = (ctypes.c_int64 * len(rfields))(*rfields)
+    rc = lib.gyt_set_resp_layout(rarr, len(rfields))
+    if rc != 0:
+        raise RuntimeError(f"gyt_set_resp_layout: "
+                           f"{_ERRNAMES.get(rc, rc)}")
+    # generic pack kernels (column plans ride along each call)
+    lib.gyt_pack_f32.restype = ctypes.c_int32
+    lib.gyt_pack_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, i64p,
+        ctypes.c_int32, ctypes.c_void_p]
+    lib.gyt_split_u64.restype = ctypes.c_int32
+    lib.gyt_split_u64.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.gyt_pack_i32.restype = ctypes.c_int32
+    lib.gyt_pack_i32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p]
     _lib = lib
     return _lib
+
+
+def _check(rc: int, what: str) -> None:
+    if rc != 0:
+        raise RuntimeError(f"{what}: {_ERRNAMES.get(rc, rc)}")
+
+
+def _ptr(a, off: int = 0):
+    """ctypes pointer to lane ``off`` of a contiguous 1-D/2-D array."""
+    v = a[off:] if off else a
+    return v.ctypes.data_as(ctypes.c_void_p)
+
+
+def _recs_ptr(recs: np.ndarray):
+    recs = np.ascontiguousarray(recs)
+    # keep a reference alive for the duration of the call site
+    return recs, recs.ctypes.data_as(ctypes.c_void_p)
+
+
+# column plans: (dtype, fields) → compiled (src_off, kind) int64 array.
+# Compiled once per subtype from the wire.py dtype — the "compiler" half
+# of the wire→columnar path; deframe.cpp's kernels are the executor.
+_PLANS: dict = {}
+
+
+def _plan(dt: np.dtype, fields: tuple):
+    key = (dt, fields)
+    ops = _PLANS.get(key)
+    if ops is None:
+        vals = []
+        for f in fields:
+            fdt, foff = dt.fields[f][0], dt.fields[f][1]
+            vals += [foff, _KIND[(fdt.kind, fdt.itemsize)]]
+        ops = (ctypes.c_int64 * len(vals))(*vals)
+        _PLANS[key] = ops
+    return ops
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------ columnar kernels
+def decode_conn_into(recs: np.ndarray, cols: dict, off: int = 0) -> bool:
+    """Decode TCP_CONN records into flat column arrays at lane ``off``
+    (cols: the 16 non-valid ConnBatch columns, each contiguous and of
+    length >= off+len(recs)). Returns False when the native library is
+    unavailable — callers fall back to decode.conn_batch."""
+    lib = _load()
+    if lib is None:
+        return False
+    if recs.dtype != wire.TCP_CONN_DT:
+        raise TypeError(f"decode_conn_into needs TCP_CONN_DT records, "
+                        f"got {recs.dtype}")  # C++ walks layout offsets
+    recs, rp = _recs_ptr(recs)
+    _check(lib.gyt_decode_conn(
+        rp, len(recs),
+        _ptr(cols["svc_hi"], off), _ptr(cols["svc_lo"], off),
+        _ptr(cols["flow_hi"], off), _ptr(cols["flow_lo"], off),
+        _ptr(cols["cli_hi"], off), _ptr(cols["cli_lo"], off),
+        _ptr(cols["cli_task_hi"], off), _ptr(cols["cli_task_lo"], off),
+        _ptr(cols["cli_rel_hi"], off), _ptr(cols["cli_rel_lo"], off),
+        _ptr(cols["bytes_sent"], off), _ptr(cols["bytes_rcvd"], off),
+        _ptr(cols["duration_us"], off), _ptr(cols["host_id"], off),
+        _ptr(cols["is_close"], off), _ptr(cols["is_accept"], off)),
+        "gyt_decode_conn")
+    return True
+
+
+def decode_resp_into(recs: np.ndarray, svc_hi, svc_lo, resp_us, host_id,
+                     off: int = 0) -> bool:
+    """Decode RESP_SAMPLE records into flat columns at lane ``off``
+    (bit-identical to decode.resp_batch's numpy math)."""
+    lib = _load()
+    if lib is None:
+        return False
+    if recs.dtype != wire.RESP_SAMPLE_DT:
+        raise TypeError(f"decode_resp_into needs RESP_SAMPLE_DT records, "
+                        f"got {recs.dtype}")
+    recs, rp = _recs_ptr(recs)
+    _check(lib.gyt_decode_resp(
+        rp, len(recs), _ptr(svc_hi, off), _ptr(svc_lo, off),
+        _ptr(resp_us, off), _ptr(host_id, off)), "gyt_decode_resp")
+    return True
+
+
+def split_u64_into(recs: np.ndarray, field: str, hi, lo,
+                   off: int = 0) -> bool:
+    """One u64 record field → (hi, lo) uint32 columns at lane ``off``."""
+    lib = _load()
+    if lib is None:
+        return False
+    recs, rp = _recs_ptr(recs)
+    _check(lib.gyt_split_u64(
+        rp, len(recs), recs.dtype.itemsize, recs.dtype.fields[field][1],
+        _ptr(hi, off), _ptr(lo, off)), "gyt_split_u64")
+    return True
+
+
+def pack_f32_into(recs: np.ndarray, fields: tuple, out: np.ndarray,
+                  off: int = 0) -> bool:
+    """Record fields → float32 matrix rows [off:off+n) of ``out``
+    (shape (size, len(fields)), C-contiguous)."""
+    lib = _load()
+    if lib is None:
+        return False
+    if not out.flags.c_contiguous or out.dtype != np.float32 \
+            or out.shape[1] != len(fields):
+        raise ValueError(f"pack_f32_into needs a C-contiguous float32 "
+                         f"(size, {len(fields)}) output, got "
+                         f"{out.dtype}{out.shape}")
+    recs, rp = _recs_ptr(recs)
+    _check(lib.gyt_pack_f32(
+        rp, len(recs), recs.dtype.itemsize, _plan(recs.dtype, fields),
+        len(fields), _ptr(out, off)), "gyt_pack_f32")
+    return True
+
+
+def pack_i32_into(recs: np.ndarray, field: str, out, off: int = 0) -> bool:
+    """One scalar record field → int32 column at lane ``off``."""
+    lib = _load()
+    if lib is None:
+        return False
+    fdt = recs.dtype.fields[field][0]
+    recs, rp = _recs_ptr(recs)
+    _check(lib.gyt_pack_i32(
+        rp, len(recs), recs.dtype.itemsize, recs.dtype.fields[field][1],
+        _KIND[(fdt.kind, fdt.itemsize)], _ptr(out, off)), "gyt_pack_i32")
+    return True
 
 
 def decode_conn(recs, size: int):
@@ -131,60 +309,27 @@ def decode_conn(recs, size: int):
     native library is unavailable — callers fall back to
     decode.conn_batch). Semantics bit-identical to the Python decoder;
     tests/test_native_ingest.py diffs them on random records."""
-    lib = _load()
-    if lib is None:
+    if _load() is None:
         return None
     from gyeeta_tpu.ingest import decode as D
 
-    if recs.dtype != wire.TCP_CONN_DT:
-        raise TypeError(f"decode_conn needs TCP_CONN_DT records, got "
-                        f"{recs.dtype}")   # C++ walks layout offsets
     if len(recs) > size:
         raise ValueError(f"{len(recs)} records exceed batch size {size};"
                          f" split upstream")
-    n = len(recs)
-    recs = np.ascontiguousarray(recs)
-    u32 = lambda: np.zeros(size, np.uint32)     # noqa: E731
-    f32 = lambda: np.zeros(size, np.float32)    # noqa: E731
-    cols = dict(
-        svc_hi=u32(), svc_lo=u32(), flow_hi=u32(), flow_lo=u32(),
-        cli_hi=u32(), cli_lo=u32(), cli_task_hi=u32(),
-        cli_task_lo=u32(), cli_rel_hi=u32(), cli_rel_lo=u32(),
-        bytes_sent=f32(), bytes_rcvd=f32(), duration_us=f32(),
-        host_id=np.zeros(size, np.int32),
-        is_close=np.zeros(size, np.uint8),
-        is_accept=np.zeros(size, np.uint8))
-    ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
-    rc = lib.gyt_decode_conn(
-        recs.ctypes.data_as(ctypes.c_void_p), n,
-        ptr(cols["svc_hi"]), ptr(cols["svc_lo"]),
-        ptr(cols["flow_hi"]), ptr(cols["flow_lo"]),
-        ptr(cols["cli_hi"]), ptr(cols["cli_lo"]),
-        ptr(cols["cli_task_hi"]), ptr(cols["cli_task_lo"]),
-        ptr(cols["cli_rel_hi"]), ptr(cols["cli_rel_lo"]),
-        ptr(cols["bytes_sent"]), ptr(cols["bytes_rcvd"]),
-        ptr(cols["duration_us"]), ptr(cols["host_id"]),
-        ptr(cols["is_close"]), ptr(cols["is_accept"]))
-    if rc != 0:
-        raise RuntimeError(f"gyt_decode_conn: {_ERRNAMES.get(rc, rc)}")
+    cols = D.alloc_conn_cols(size)
+    decode_conn_into(recs, cols, 0)
     valid = np.zeros(size, bool)
-    valid[:n] = True
-    return D.ConnBatch(
-        valid=valid,
-        is_close=cols.pop("is_close").astype(bool),
-        is_accept=cols.pop("is_accept").astype(bool),
-        **cols)
-
-
-def available() -> bool:
-    return _load() is not None
+    valid[:len(recs)] = True
+    return D.ConnBatch(valid=valid, **cols)
 
 
 def drain(buf: bytes) -> tuple[dict, int]:
     """byte stream → ({subtype: structured record array}, consumed).
 
     Native path when built; identical semantics to the Python decoder
-    (validation errors raise wire.FrameError either way).
+    (validation errors raise wire.FrameError either way). Two passes
+    total: one sizing scan, then ONE frame walk that appends every
+    subtype's records into its preallocated array (gyt_extract_multi).
     """
     lib = _load()
     if lib is None:
@@ -195,24 +340,29 @@ def drain(buf: bytes) -> tuple[dict, int]:
     rc = lib.gyt_scan(buf, len(buf), counts, ctypes.byref(consumed))
     if rc != 0:
         raise wire.FrameError(f"native scan: {_ERRNAMES.get(rc, rc)}")
-    out = {}
+    out: dict = {}
+    outs = (ctypes.c_void_p * n)()
+    caps = (ctypes.c_int64 * n)()
+    nrec = (ctypes.c_int64 * n)()
+    nonempty = False
     for i, subtype in enumerate(_SCAN_ORDER):
-        nrecs = counts[i]
-        if nrecs == 0:
+        if counts[i] == 0:
             continue
-        dt = wire.DTYPE_OF_SUBTYPE[subtype]
-        rec = np.empty(nrecs, dt)
-        c2 = ctypes.c_int64()
-        nrec = ctypes.c_int64()
-        tot = ctypes.c_int64()
-        rc = lib.gyt_extract(
-            buf, len(buf), subtype,
-            rec.ctypes.data_as(ctypes.c_void_p), rec.nbytes,
-            ctypes.byref(c2), ctypes.byref(nrec), ctypes.byref(tot))
-        if rc != 0:
-            raise wire.FrameError(f"native extract: {_ERRNAMES.get(rc, rc)}")
-        assert nrec.value == nrecs, (nrec.value, nrecs)
+        rec = np.empty(counts[i], wire.DTYPE_OF_SUBTYPE[subtype])
         out[subtype] = rec
+        outs[i] = rec.ctypes.data
+        caps[i] = rec.nbytes
+        nonempty = True
+    if not nonempty:
+        return out, int(consumed.value)
+    c2 = ctypes.c_int64()
+    rc = lib.gyt_extract_multi(buf, len(buf), outs, caps, nrec,
+                               ctypes.byref(c2))
+    if rc != 0:
+        raise wire.FrameError(f"native extract: {_ERRNAMES.get(rc, rc)}")
+    for i, subtype in enumerate(_SCAN_ORDER):
+        if counts[i]:
+            assert nrec[i] == counts[i], (subtype, nrec[i], counts[i])
     return out, int(consumed.value)
 
 
@@ -220,6 +370,8 @@ def _drain_py(buf: bytes) -> tuple[dict, int]:
     frames, consumed = wire.decode_frames(buf)
     out: dict = {}
     for subtype, recs in frames:
+        if not len(recs):
+            continue     # drain contract: no empty entries (native parity)
         if subtype in out:
             out[subtype] = np.concatenate([out[subtype], recs])
         else:

@@ -22,13 +22,18 @@ def build(verbose: bool = True) -> pathlib.Path:
     # compile to a unique temp path + atomic rename: concurrent first-use
     # builds (multiple processes) must never load a half-written .so
     tmp = OUT.with_suffix(f".so.tmp{os.getpid()}")
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+    cxx = os.environ.get("GYT_NATIVE_CXX", "g++")
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC",
            "-Wall", "-Werror", str(SRC), "-o", str(tmp)]
     if verbose:
         print(" ".join(cmd))
     try:
         subprocess.run(cmd, check=True)
         os.replace(tmp, OUT)
+    except subprocess.CalledProcessError as e:
+        print(f"native build FAILED (rc={e.returncode}): {' '.join(cmd)}",
+              file=sys.stderr)
+        raise
     finally:
         tmp.unlink(missing_ok=True)
     return OUT

@@ -323,6 +323,61 @@ int32_t gyt_decode_conn(
 
 extern "C" {
 
+// One-pass multi-subtype extract: walk the frame stream ONCE and append
+// every known subtype's records into its own caller-provided buffer
+// (outs/out_caps indexed in gyt_set_table order; outs[i] may be null
+// when the scan counted zero records). This replaces the per-subtype
+// gyt_extract walk in drain(): scan + one extract pass total, instead
+// of scan + one walk per present subtype.
+int32_t gyt_extract_multi(const uint8_t* buf, int64_t len,
+                          uint8_t* const* outs, const int64_t* out_caps,
+                          int64_t* out_nrec, int64_t* consumed) {
+  int64_t off = 0;
+  int64_t written[MAX_TYPES];  // bytes appended per table slot
+  for (int32_t i = 0; i < g_ntypes; i++) {
+    written[i] = 0;
+    out_nrec[i] = 0;
+  }
+  *consumed = 0;
+  while (off + HDR_SZ <= len) {
+    Header h;
+    std::memcpy(&h, buf + off, sizeof(h));
+    if (h.magic != MAGIC_PM && h.magic != MAGIC_MS && h.magic != MAGIC_NQ)
+      return GYT_BAD_MAGIC;
+    const int64_t total = static_cast<int64_t>(h.total_sz);
+    if (total < HDR_SZ + EV_SZ || total >= MAX_COMM_DATA_SZ)
+      return GYT_BAD_TOTAL;
+    if (off + total > len) break;  // partial frame: resume later
+    if (h.data_type == COMM_EVENT_NOTIFY) {
+      EventNotify ev;
+      std::memcpy(&ev, buf + off + HDR_SZ, sizeof(ev));
+      const int32_t idx = index_of(ev.subtype);
+      if (idx >= 0) {
+        const SubtypeInfo& si = g_table[idx];
+        if (ev.nevents > si.cap) return GYT_CAP_EXCEEDED;
+        const int64_t nbytes =
+            static_cast<int64_t>(ev.nevents) * si.itemsize;
+        if (HDR_SZ + EV_SZ + nbytes > total) return GYT_NEV_OVERFLOW;
+        if (ev.nevents > 0) {
+          if (outs[idx] == nullptr ||
+              written[idx] + nbytes > out_caps[idx]) {
+            *consumed = off;
+            return GYT_OUT_FULL;
+          }
+          std::memcpy(outs[idx] + written[idx], buf + off + HDR_SZ + EV_SZ,
+                      static_cast<size_t>(nbytes));
+          written[idx] += nbytes;
+          out_nrec[idx] += ev.nevents;
+        }
+      }
+      // unknown subtypes skipped (forward compat)
+    }
+    off += total;
+  }
+  *consumed = off;
+  return GYT_OK;
+}
+
 // Count frames + records per subtype without copying (sizing pass).
 // counts: array of g_ntypes int64, in gyt_set_table order.
 int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
@@ -354,6 +409,174 @@ int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
     off += total;
   }
   *consumed = off;
+  return GYT_OK;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Generic record→column pack kernels: the executor half of the
+// wire→columnar compiler. Python compiles a column plan from the
+// numpy structured dtype (field offset + scalar kind per output
+// column — ingest/native/__init__.py builds it from wire.py, the same
+// single-source-of-truth discipline as the subtype table) and these
+// kernels execute it in one pass over the raw records, writing
+// straight into caller-provided preallocated column buffers. Casts
+// are the exact C equivalents of numpy's .astype() on the same
+// scalars, so the output is bit-identical to ingest/decode.py's
+// reference builders (the parity fuzz test diffs both).
+
+namespace {
+
+enum PackKind : int64_t {
+  PK_U1 = 1, PK_U2 = 2, PK_U4 = 3, PK_U8 = 4, PK_I4 = 5, PK_F4 = 6,
+};
+
+inline bool kind_ok(int64_t k) { return k >= PK_U1 && k <= PK_F4; }
+
+inline int64_t kind_size(int64_t k) {
+  switch (k) {
+    case PK_U1: return 1;
+    case PK_U2: return 2;
+    case PK_U4: case PK_I4: case PK_F4: return 4;
+    default: return 8;
+  }
+}
+
+inline float load_f32(const uint8_t* p, int64_t kind) {
+  switch (kind) {
+    case PK_U1: return static_cast<float>(*p);
+    case PK_U2: { uint16_t v; std::memcpy(&v, p, 2);
+                  return static_cast<float>(v); }
+    case PK_U4: { uint32_t v; std::memcpy(&v, p, 4);
+                  return static_cast<float>(v); }
+    case PK_U8: { uint64_t v; std::memcpy(&v, p, 8);
+                  return static_cast<float>(v); }
+    case PK_I4: { int32_t v; std::memcpy(&v, p, 4);
+                  return static_cast<float>(v); }
+    default:    { float v; std::memcpy(&v, p, 4); return v; }
+  }
+}
+
+inline int32_t load_i32(const uint8_t* p, int64_t kind) {
+  switch (kind) {
+    case PK_U1: return static_cast<int32_t>(*p);
+    case PK_U2: { uint16_t v; std::memcpy(&v, p, 2);
+                  return static_cast<int32_t>(v); }
+    case PK_U4: { uint32_t v; std::memcpy(&v, p, 4);
+                  return static_cast<int32_t>(v); }
+    case PK_U8: { uint64_t v; std::memcpy(&v, p, 8);
+                  return static_cast<int32_t>(v); }
+    case PK_I4: { int32_t v; std::memcpy(&v, p, 4); return v; }
+    default:    { float v; std::memcpy(&v, p, 4);
+                  return static_cast<int32_t>(v); }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// n records → (n, ncols) float32 row-major. ops = ncols pairs of
+// (src_offset, kind). The stat/panel/vals matrix builder for
+// LISTENER/HOST/TASK/CPU_MEM sweeps (replaces decode.py's per-field
+// python loops).
+int32_t gyt_pack_f32(const uint8_t* recs, int64_t n, int64_t itemsize,
+                     const int64_t* ops, int32_t ncols, float* out) {
+  if (itemsize <= 0 || ncols <= 0) return GYT_BAD_TABLE;
+  for (int32_t c = 0; c < ncols; c++) {
+    const int64_t off = ops[2 * c], kind = ops[2 * c + 1];
+    if (!kind_ok(kind) || off < 0 || off + kind_size(kind) > itemsize)
+      return GYT_BAD_TABLE;
+  }
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* r = recs + i * itemsize;
+    float* o = out + i * ncols;
+    for (int32_t c = 0; c < ncols; c++)
+      o[c] = load_f32(r + ops[2 * c], ops[2 * c + 1]);
+  }
+  return GYT_OK;
+}
+
+// One u64 field per record → (hi, lo) uint32 column pair (the TPU
+// 64-bit id split of decode.split_u64).
+int32_t gyt_split_u64(const uint8_t* recs, int64_t n, int64_t itemsize,
+                      int64_t off, uint32_t* hi, uint32_t* lo) {
+  if (itemsize <= 0 || off < 0 || off + 8 > itemsize)
+    return GYT_BAD_TABLE;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t v;
+    std::memcpy(&v, recs + i * itemsize + off, 8);
+    hi[i] = static_cast<uint32_t>(v >> 32);
+    lo[i] = static_cast<uint32_t>(v);
+  }
+  return GYT_OK;
+}
+
+// One scalar field per record → int32 column (host_id / state / issue).
+int32_t gyt_pack_i32(const uint8_t* recs, int64_t n, int64_t itemsize,
+                     int64_t off, int64_t kind, int32_t* out) {
+  if (itemsize <= 0 || !kind_ok(kind) || off < 0
+      || off + kind_size(kind) > itemsize)
+    return GYT_BAD_TABLE;
+  for (int64_t i = 0; i < n; i++)
+    out[i] = load_i32(recs + i * itemsize + off, kind);
+  return GYT_OK;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Specialized RESP_SAMPLE decode: the highest-rate subtype (4096/batch
+// vs 2048 conns) gets a fused single-pass kernel instead of three
+// generic ones. Layout pushed from wire.RESP_SAMPLE_DT like the conn
+// layout.
+
+namespace {
+
+struct RespLayout {
+  int64_t itemsize, glob_id, resp_usec, host_id;
+};
+
+RespLayout g_resp{};
+bool g_resp_set = false;
+
+}  // namespace
+
+extern "C" {
+
+int32_t gyt_set_resp_layout(const int64_t* fields, int32_t n) {
+  if (n != 4) return GYT_BAD_TABLE;
+  g_resp.itemsize = fields[0];
+  g_resp.glob_id = fields[1];
+  g_resp.resp_usec = fields[2];
+  g_resp.host_id = fields[3];
+  if (g_resp.itemsize <= 0 || g_resp.itemsize % 8 != 0)
+    return GYT_BAD_TABLE;
+  g_resp_set = true;
+  return GYT_OK;
+}
+
+// Decode n RESP_SAMPLE records into pre-allocated columns: glob_id
+// split, resp_usec → float32, host_id → int32 — bit-identical to
+// decode.resp_batch's numpy math.
+int32_t gyt_decode_resp(const uint8_t* recs, int64_t n, uint32_t* svc_hi,
+                        uint32_t* svc_lo, float* resp_us,
+                        int32_t* host_id) {
+  if (!g_resp_set) return GYT_BAD_TABLE;
+  const RespLayout& L = g_resp;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* r = recs + i * L.itemsize;
+    uint64_t gid;
+    uint32_t ru, hid;
+    std::memcpy(&gid, r + L.glob_id, 8);
+    std::memcpy(&ru, r + L.resp_usec, 4);
+    std::memcpy(&hid, r + L.host_id, 4);
+    svc_hi[i] = static_cast<uint32_t>(gid >> 32);
+    svc_lo[i] = static_cast<uint32_t>(gid);
+    resp_us[i] = static_cast<float>(ru);
+    host_id[i] = static_cast<int32_t>(hid);
+  }
   return GYT_OK;
 }
 

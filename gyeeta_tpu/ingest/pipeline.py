@@ -63,7 +63,7 @@ class FeedPipeline:
     def _deframe(self, buf: bytes):
         """Runs ON THE WORKER: native deframe with resume framing."""
         t0 = time.perf_counter()
-        data = self._pending + buf
+        data = (self._pending + buf) if self._pending else buf
         try:
             recs, consumed = native.drain(data)
         except wire.FrameError:

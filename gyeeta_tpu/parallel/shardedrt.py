@@ -184,13 +184,17 @@ class ShardedRuntime:
         }
 
     # ------------------------------------------------------------- ingest
-    def _stack(self, builder, recs, lanes):
+    def _stack(self, builder, recs, lanes, count_path: bool = True):
+        # the *_fast builders take a stats kwarg for the native-vs-
+        # fallback decode counters; trace_batch (python-only) does not
+        b = (lambda r, sz: builder(r, sz, stats=self.stats)) \
+            if count_path else builder
         return sharded.put_sharded(self.mesh, sharded.shard_batches(
-            self.cfg, self.mesh, (builder, lanes), recs, recs["host_id"]))
+            self.cfg, self.mesh, (b, lanes), recs, recs["host_id"]))
 
     def feed(self, buf: bytes) -> int:
         """Byte stream → routed stacked batches → sharded folds."""
-        data = self._pending + buf
+        data = (self._pending + buf) if self._pending else buf
         try:
             recs, consumed = native.drain(data)
         except wire.FrameError:
@@ -229,29 +233,29 @@ class ShardedRuntime:
                 self.cfg.listener_batch):
             if kind == "listener":
                 self.state = self._fold_lst(self.state, self._stack(
-                    decode.listener_batch, chunks[0],
+                    decode.listener_batch_fast, chunks[0],
                     self.cfg.listener_batch))
                 n += len(chunks[0])
             elif kind == "host":
                 self.state = self._fold_host(self.state, self._stack(
-                    decode.host_batch, chunks[0],
+                    decode.host_batch_fast, chunks[0],
                     wire.MAX_HOSTS_PER_BATCH))
                 n += len(chunks[0])
             elif kind == "task":
                 self.state = self._fold_task(self.state, self._stack(
-                    decode.task_batch, chunks[0],
+                    decode.task_batch_fast, chunks[0],
                     wire.MAX_TASKS_PER_BATCH))
                 n += len(chunks[0])
             elif kind == "cpumem":
                 self.state = self._fold_cm(self.state, self._stack(
-                    decode.cpumem_batch, chunks[0],
+                    decode.cpumem_batch_fast, chunks[0],
                     wire.MAX_CPUMEM_PER_BATCH))
                 n += len(chunks[0])
             elif kind == "trace":
                 self.traceconns.observe(chunks[0])
                 self.state = self._fold_trace(self.state, self._stack(
                     decode.trace_batch, chunks[0],
-                    wire.MAX_TRACE_PER_BATCH))
+                    wire.MAX_TRACE_PER_BATCH, count_path=False))
                 n += len(chunks[0])
                 if self.opts.trace_resp_bridge:
                     rs = decode.resp_from_trace(chunks[0])
@@ -303,7 +307,7 @@ class ShardedRuntime:
         self._n_conn_raw -= len(crecs)
         self._n_resp_raw -= len(rrecs)
         cbs = self._stack(decode.conn_batch_fast, crecs, lanes_c)
-        rbs = self._stack(decode.resp_batch, rrecs, lanes_r)
+        rbs = self._stack(decode.resp_batch_fast, rrecs, lanes_r)
         # previous dispatch's pressure scalar is ready by now: flush the
         # fullest per-shard stages before folding if headroom is low
         if (self._pressure is not None

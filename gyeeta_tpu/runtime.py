@@ -205,7 +205,9 @@ class Runtime:
         staged until the next ``feed``/``flush()``; ``run_tick``/
         ``query`` flush first, so staged events are never invisible at a
         cadence or query boundary."""
-        data = self._pending + buf
+        # no resume bytes pending (the common case): skip the big-buffer
+        # bytes concat — at slab geometry it copies ~9MB per feed
+        data = (self._pending + buf) if self._pending else buf
         try:
             with self.stats.timeit("deframe"):
                 recs, consumed = native.drain(data)
@@ -243,23 +245,25 @@ class Runtime:
                 recs, self.cfg.conn_batch, self.cfg.resp_batch,
                 self.cfg.listener_batch):
             if kind == "listener":
-                lb = decode.listener_batch(chunks[0],
-                                           self.cfg.listener_batch)
+                lb = decode.listener_batch_fast(chunks[0],
+                                                self.cfg.listener_batch,
+                                                stats=self.stats)
                 self.state = self._fold_lst(self.state, lb)
                 n += len(chunks[0])
                 self.stats.bump("listener_records", len(chunks[0]))
             elif kind == "host":
-                hb = decode.host_batch(chunks[0])
+                hb = decode.host_batch_fast(chunks[0], stats=self.stats)
                 self.state = self._fold_host(self.state, hb)
                 n += len(chunks[0])
                 self.stats.bump("host_records", len(chunks[0]))
             elif kind == "task":
-                tb = decode.task_batch(chunks[0])
+                tb = decode.task_batch_fast(chunks[0], stats=self.stats)
                 self.state = self._fold_task(self.state, tb)
                 n += len(chunks[0])
                 self.stats.bump("task_records", len(chunks[0]))
             elif kind == "cpumem":
-                cmb = decode.cpumem_batch(chunks[0])
+                cmb = decode.cpumem_batch_fast(chunks[0],
+                                               stats=self.stats)
                 self.state = self._fold_cm(self.state, cmb)
                 n += len(chunks[0])
                 self.stats.bump("cpumem_records", len(chunks[0]))
@@ -329,14 +333,15 @@ class Runtime:
         """One K-deep device dispatch: flat native columnar decode of up
         to K·B staged records straight into the stacked (K, B) layout
         (reshape, no copy), then the scan'd fold — no per-chunk decode,
-        no np.stack (VERDICT r3 #2)."""
+        no np.stack (VERDICT r3 #2). Staged chunks decode into the slab
+        buffers at their lane offsets — no staging concatenate either."""
         K = self.cfg.fold_k
-        crecs = decode.take_raw(self._conn_raw, K * self.cfg.conn_batch,
-                               wire.TCP_CONN_DT)
-        rrecs = decode.take_raw(self._resp_raw, K * self.cfg.resp_batch,
-                               wire.RESP_SAMPLE_DT)
-        self._n_conn_raw -= len(crecs)
-        self._n_resp_raw -= len(rrecs)
+        crecs, nc = decode.take_raw_chunks(self._conn_raw,
+                                           K * self.cfg.conn_batch)
+        rrecs, nr = decode.take_raw_chunks(self._resp_raw,
+                                           K * self.cfg.resp_batch)
+        self._n_conn_raw -= nc
+        self._n_resp_raw -= nr
         # the lag-2 pressure scalar is materialized by now: flush the
         # fullest stages BEFORE this dispatch if headroom is low
         if (len(self._pressures) >= 2
@@ -345,8 +350,10 @@ class Runtime:
             self.state = self._td_flush_partial(self.state)
             self.stats.bump("td_partial_flushes")
         with self.stats.timeit("fold_dispatch"):
-            cbs = decode.conn_slab(crecs, K, self.cfg.conn_batch)
-            rbs = decode.resp_slab(rrecs, K, self.cfg.resp_batch)
+            cbs = decode.conn_slab(crecs, K, self.cfg.conn_batch,
+                                   stats=self.stats)
+            rbs = decode.resp_slab(rrecs, K, self.cfg.resp_batch,
+                                   stats=self.stats)
             self.state, self.dep = self._fold_many_dep(
                 self.state, self.dep, cbs, rbs, self._tick_no)
         self._pressures.append(self._stage_pressure(self.state))
@@ -364,15 +371,15 @@ class Runtime:
         while self._n_conn_raw or self._n_resp_raw:
             if (self._n_conn_raw <= self.cfg.conn_batch
                     and self._n_resp_raw <= self.cfg.resp_batch):
-                crecs = decode.take_raw(self._conn_raw,
-                                       self.cfg.conn_batch,
-                                       wire.TCP_CONN_DT)
-                rrecs = decode.take_raw(self._resp_raw,
-                                       self.cfg.resp_batch,
-                                       wire.RESP_SAMPLE_DT)
+                crecs, _ = decode.take_raw_chunks(self._conn_raw,
+                                                  self.cfg.conn_batch)
+                rrecs, _ = decode.take_raw_chunks(self._resp_raw,
+                                                  self.cfg.resp_batch)
                 self._n_conn_raw = self._n_resp_raw = 0
-                cb = decode.conn_batch_fast(crecs, self.cfg.conn_batch)
-                rb = decode.resp_batch(rrecs, self.cfg.resp_batch)
+                cb = decode.conn_batch_parts(crecs, self.cfg.conn_batch,
+                                             stats=self.stats)
+                rb = decode.resp_batch_parts(rrecs, self.cfg.resp_batch,
+                                             stats=self.stats)
                 self.state = self._fold(self.state, cb, rb)
                 self.dep = self._dep_step(self.dep, cb, self._tick_no)
                 self._td_dirty = True     # resp samples staged

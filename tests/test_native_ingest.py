@@ -111,6 +111,209 @@ def test_all_subtypes_covered_by_native_table():
         assert nat[st].tobytes() == py[st].tobytes(), st
 
 
+def _rand_records(rng, dt, n):
+    nwords = max(n * dt.itemsize // 8, 1)
+    return np.frombuffer(
+        rng.integers(0, 2 ** 63, nwords, dtype=np.int64).tobytes(),
+        dt, count=n)
+
+
+def _drain_or_err(fn, buf):
+    try:
+        recs, consumed = fn(buf)
+        return recs, consumed, None
+    except wire.FrameError:
+        return None, None, "frame_error"
+
+
+@needs_native
+def test_parity_fuzz_streams():
+    """1000+ randomized mixed-subtype frame streams — including
+    truncated tails, poison frames (bad magic / bad total_sz /
+    nevents-over-cap / nevents-overflow) and unknown subtypes — must
+    decode IDENTICALLY through the native and NumPy paths: same record
+    bytes per subtype, same consumed count, same error outcomes."""
+    rng = np.random.default_rng(20260804)
+    subtypes = sorted(wire.DTYPE_OF_SUBTYPE)
+    n_err = n_err_py = n_trunc = 0
+    for trial in range(1000):
+        parts = []
+        for _ in range(int(rng.integers(1, 6))):
+            st = int(rng.choice(subtypes))
+            dt = wire.DTYPE_OF_SUBTYPE[st]
+            nev = int(rng.integers(0, 17))
+            frame = bytearray(wire.encode_frame(st, _rand_records(
+                rng, dt, nev)))
+            p = rng.random()
+            if p < 0.04:       # poison: bad magic
+                frame[0] ^= 0x5A
+            elif p < 0.08:     # poison: bad total_sz
+                frame[4:8] = int(rng.choice([4, 2 ** 25])).to_bytes(
+                    4, "little")
+            elif p < 0.12:     # poison: nevents over the subtype cap
+                frame[20:24] = (wire.MAX_OF_SUBTYPE[st] + 1).to_bytes(
+                    4, "little")
+            elif p < 0.16:     # poison: nevents overflows the frame
+                frame[20:24] = (nev + 8).to_bytes(4, "little")
+            elif p < 0.22:     # unknown subtype: skipped, never an error
+                frame[16:20] = int(rng.integers(500, 1000)).to_bytes(
+                    4, "little")
+            parts.append(bytes(frame))
+        buf = b"".join(parts)
+        if rng.random() < 0.25 and len(buf) > 4:  # truncated tail frame
+            buf = buf[: len(buf) - int(rng.integers(1, len(parts[-1])))]
+            n_trunc += 1
+        nat, cons_n, err_n = _drain_or_err(native.drain, buf)
+        py, cons_p, err_p = _drain_or_err(native._drain_py, buf)
+        assert err_n == err_p, (trial, err_n, err_p)
+        if err_n is not None:
+            n_err += 1
+            n_err_py += 1
+            continue
+        assert cons_n == cons_p, trial
+        assert set(nat) == set(py), trial
+        for st in nat:
+            assert nat[st].tobytes() == py[st].tobytes(), (trial, st)
+    # identical error counters across the whole fuzz run, and the fuzz
+    # actually exercised the poison/truncation branches
+    assert n_err == n_err_py
+    assert n_err > 50, n_err
+    assert n_trunc > 100, n_trunc
+
+
+@needs_native
+def test_native_resp_decode_parity():
+    """gyt_decode_resp must be bit-identical to decode.resp_batch."""
+    from gyeeta_tpu.ingest import decode
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=21)
+    recs = sim.resp_records(3000)
+    a = decode.resp_batch_fast(recs, 4096)
+    b = decode.resp_batch(recs, 4096)
+    for f in a._fields:
+        assert np.asarray(getattr(a, f)).tobytes() == \
+            np.asarray(getattr(b, f)).tobytes(), f
+
+
+@needs_native
+@pytest.mark.parametrize("fast,ref,dt,size", [
+    ("listener_batch_fast", "listener_batch", "LISTENER_STATE_DT", 64),
+    ("host_batch_fast", "host_batch", "HOST_STATE_DT", 64),
+    ("task_batch_fast", "task_batch", "AGGR_TASK_DT", 64),
+    ("cpumem_batch_fast", "cpumem_batch", "CPU_MEM_DT", 64),
+])
+def test_native_sweep_decode_parity(fast, ref, dt, size):
+    """The generic pack kernels (split_u64 / pack_f32 / pack_i32) must
+    reproduce every NumPy sweep builder bit-for-bit on random records
+    (random bits include NaN float patterns — compare bytes)."""
+    from gyeeta_tpu.ingest import decode
+
+    rng = np.random.default_rng(hash(fast) % 2 ** 31)
+    recs = _rand_records(rng, getattr(wire, dt), 40)
+    a = getattr(decode, fast)(recs, size)
+    b = getattr(decode, ref)(recs, size)
+    for f in a._fields:
+        assert np.asarray(getattr(a, f)).tobytes() == \
+            np.asarray(getattr(b, f)).tobytes(), f
+
+
+@needs_native
+def test_chunked_slab_assembly_parity():
+    """conn/resp *_parts builders decode a LIST of staged chunks into
+    the slab at lane offsets — output must equal the single-array
+    decode of the concatenation (no np.concatenate on the hot path)."""
+    from gyeeta_tpu.ingest import decode
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=13)
+    conn = sim.conn_records(700)
+    resp = sim.resp_records(1500)
+    cchunks = [conn[:100], conn[100:550], conn[550:]]
+    rchunks = [resp[:1], resp[1:999], resp[999:]]
+    a = decode.conn_batch_parts(cchunks, 1024)
+    b = decode.conn_batch(conn, 1024)
+    for f in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f)
+    ar = decode.resp_batch_parts(rchunks, 2048)
+    br = decode.resp_batch(resp, 2048)
+    for f in ar._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ar, f)), np.asarray(getattr(br, f)),
+            err_msg=f)
+    # slab form: (k, b) reshape of the same flat decode
+    s = decode.conn_slab(cchunks, 2, 512)
+    assert s.svc_hi.shape == (2, 512)
+    np.testing.assert_array_equal(s.svc_hi.reshape(-1), b.svc_hi[:1024])
+
+
+def test_take_raw_chunks_no_copy():
+    """take_raw_chunks returns views of the staged arrays (no
+    concatenate, no copy) and take_raw only concatenates multi-chunk
+    takes."""
+    from gyeeta_tpu.ingest import decode
+
+    a = np.zeros(100, wire.RESP_SAMPLE_DT)
+    b = np.zeros(50, wire.RESP_SAMPLE_DT)
+    lst = [a, b]
+    chunks, got = decode.take_raw_chunks(lst, 80)
+    assert got == 80 and len(chunks) == 1
+    assert chunks[0].base is a or chunks[0] is a  # view, not a copy
+    assert len(lst) == 2 and len(lst[0]) == 20
+    # single-array take returns the array itself — no copy
+    lst2 = [a]
+    out = decode.take_raw(lst2, 200, wire.RESP_SAMPLE_DT)
+    assert out is a
+
+
+def test_force_python_fallback_env(monkeypatch):
+    """GYT_PY_INGEST=1 forces the pure-Python decode path everywhere:
+    native.available() flips off, the fast builders fall back
+    (bit-identically) and the fallback counter records it."""
+    from gyeeta_tpu.ingest import decode
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=5)
+    recs = sim.resp_records(100)
+    monkeypatch.setenv("GYT_PY_INGEST", "1")
+    assert not native.available()
+    st = Stats()
+    rb = decode.resp_batch_fast(recs, 128, stats=st)
+    assert st.counters["ref_fallback_decoded"] == 100
+    assert "ref_native_decoded" not in st.counters
+    ref = decode.resp_batch(recs, 128)
+    for f in rb._fields:
+        assert np.asarray(getattr(rb, f)).tobytes() == \
+            np.asarray(getattr(ref, f)).tobytes(), f
+    # drain() falls back to the python decoder too
+    buf = sim.resp_frames(64)
+    py, consumed = native.drain(buf)
+    assert consumed == len(buf)
+    monkeypatch.delenv("GYT_PY_INGEST")
+
+
+@needs_native
+def test_native_path_counter(monkeypatch):
+    from gyeeta_tpu.ingest import decode
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    sim = ParthaSim(n_hosts=4, n_svcs=2, seed=6)
+    st = Stats()
+    decode.conn_batch_fast(sim.conn_records(64), 128, stats=st)
+    decode.listener_batch_fast(sim.listener_records()
+                               if hasattr(sim, "listener_records")
+                               else _rand_records(
+                                   np.random.default_rng(0),
+                                   wire.LISTENER_STATE_DT, 8),
+                               64, stats=st)
+    assert st.counters["ref_native_decoded"] >= 64
+    assert "ref_fallback_decoded" not in st.counters
+
+
 def test_native_conn_decode_parity():
     """gyt_decode_conn must be bit-identical to decode.conn_batch on
     random records, including NAT-translated tuples and accept flags."""
