@@ -1,0 +1,116 @@
+"""CI smoke: boot server + HTTP gateway, scrape GET /metrics, validate.
+
+The exposition contract an external scraper depends on, checked
+end-to-end with zero external deps: a Runtime behind a GytServer, a
+WebGateway in front, one HTTP GET, and a minimal Prometheus
+text-format parser (same grammar a real scraper applies — sample
+lines, cumulative ``le`` buckets, ``_count`` == +Inf bucket).
+Exit code 0 = contract holds. Run by ci.sh; standalone:
+``JAX_PLATFORMS=cpu python _metrics_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import re
+import sys
+
+_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\+Inf|-?[0-9.eE+-]+)$')
+
+
+def parse_exposition(text: str) -> dict:
+    """{family: [(labels, value)]}; raises AssertionError on any line
+    that is not a comment, blank, or well-formed sample."""
+    out: dict = {}
+    for ln in text.splitlines():
+        if not ln.strip() or ln.startswith("#"):
+            continue
+        m = _SAMPLE.match(ln)
+        assert m, f"malformed exposition line: {ln!r}"
+        v = math.inf if m.group(3) == "+Inf" else float(m.group(3))
+        out.setdefault(m.group(1), []).append((m.group(2) or "", v))
+    return out
+
+
+def validate(body: str) -> None:
+    series = parse_exposition(body)
+
+    # counters the feed path must have bumped
+    assert series["gyt_conn_events_total"][0][1] > 0, "no conn events"
+    assert ("gyt_ref_native_decoded_total" in series
+            or "gyt_ref_fallback_decoded_total" in series), \
+        "decode-path counters missing"
+
+    # ≥6 engine-health gauges from the batched device readback
+    eng = sorted(n for n in series if n.startswith("gyt_engine_"))
+    assert len(eng) >= 6, f"engine gauges missing: {eng}"
+    occ = series["gyt_engine_svc_occupancy_ratio"][0][1]
+    assert 0.0 < occ <= 1.0, f"bad occupancy {occ}"
+
+    # histogram contract per stage: cumulative, +Inf == _count
+    bucket = series.get("gyt_stage_duration_seconds_bucket", [])
+    assert bucket, "no timing histogram"
+    stages = sorted({re.search(r'stage="([^"]+)"', lb).group(1)
+                     for lb, _ in bucket})
+    assert "deframe" in stages, stages
+    for st in stages:
+        vals = [v for lb, v in bucket if f'stage="{st}"' in lb]
+        assert vals == sorted(vals), f"{st}: buckets not cumulative"
+        cnt = [v for lb, v in
+               series["gyt_stage_duration_seconds_count"]
+               if f'stage="{st}"' in lb]
+        assert cnt and cnt[0] == vals[-1], f"{st}: +Inf != _count"
+        sm = [v for lb, v in series["gyt_stage_duration_seconds_sum"]
+              if f'stage="{st}"' in lb]
+        assert sm and sm[0] >= 0.0, f"{st}: missing _sum"
+    print(f"metrics smoke: {len(series)} families, "
+          f"{len(eng)} engine gauges, stages={stages}", file=sys.stderr)
+
+
+async def scenario() -> str:
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.net import GytServer, NetAgent
+    from gyeeta_tpu.net.webgw import WebGateway
+    from gyeeta_tpu.runtime import Runtime
+
+    cfg = EngineCfg(n_hosts=4, svc_capacity=64, conn_batch=64,
+                    resp_batch=64, fold_k=2)
+    rt = Runtime(cfg)
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    agent = NetAgent(seed=1)
+    await agent.connect(host, port)
+    await agent.send_sweep(n_conn=128, n_resp=128)
+    await asyncio.sleep(0.05)
+    rt.run_tick()
+
+    gw = WebGateway(host, port)
+    gh, gp = await gw.start()
+    reader, writer = await asyncio.open_connection(gh, gp)
+    writer.write(b"GET /metrics HTTP/1.1\r\nHost: ci\r\n"
+                 b"Connection: close\r\n\r\n")
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    await agent.close()
+    await gw.stop()
+    await srv.stop()
+
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = head.splitlines()[0].decode()
+    assert " 200 " in status, f"bad status: {status}"
+    assert b"content-type: text/plain" in head.lower(), head
+    return body.decode()
+
+
+def main() -> int:
+    body = asyncio.run(scenario())
+    validate(body)
+    print("metrics smoke: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

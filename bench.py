@@ -271,8 +271,17 @@ def _bench_feed(cfg, sim, label: str, dep_pairs: int,
           f"(deframe {stages['deframe_ev_per_sec']:,.0f}, "
           f"decode {stages['decode_ev_per_sec']:,.0f})",
           file=sys.stderr, flush=True)
+    # embed the run's own telemetry (obs tier): counters incl. the
+    # native-vs-fallback decode path, per-stage latency histograms, and
+    # the engine-health gauges from one batched readback — a perf
+    # artifact that can't hide a silently-degraded decode path
+    rt.engine_health()
+    selfstats = {"counters": {k: v for k, v in
+                              sorted(rt.stats.snapshot().items())},
+                 "timings": rt.stats.timing_rows()}
     rt.close()
-    return {"rate": round(feed_rate, 1), **stages}
+    return {"rate": round(feed_rate, 1), **stages,
+            "selfstats": selfstats}
 
 
 def _run_phase(phase: str) -> dict:
@@ -379,6 +388,11 @@ def _orchestrate(platform: str | None, degraded: bool,
         **({"tpu_unreachable_cpu_fallback": True} if degraded else {}),
         **({"probe_attempts": probe_log} if probe_log else {}),
     }
+    # perf runs carry their own telemetry: the feed phase's selfstats
+    # snapshot (counters + stage histograms + engine-health gauges)
+    snap = fns.get("selfstats") or ftoy.get("selfstats")
+    if snap:
+        result["selfstats"] = snap
     if "rate" in fns:
         result["feed_path_events_per_sec"] = fns["rate"]
         if "rate" in ns:

@@ -25,8 +25,22 @@ else
          "tests run on the pure-Python decode path" >&2
 fi
 
+# /metrics exposition smoke: boot server + gateway, scrape, validate
+# the Prometheus text contract with the built-in minimal parser (no
+# external deps). Catches a broken scraper surface before the suite.
+echo "ci: /metrics exposition smoke" >&2
+if ! JAX_PLATFORMS=cpu python _metrics_smoke.py; then
+    echo "ci: FATAL — /metrics smoke failed" >&2
+    exit 1
+fi
+
 if [ "$1" = "fast" ]; then
     shift
     exec python -m pytest tests/ -q -m "not slow" "$@"
 fi
+# Full runs compile shard_map mesh programs; RELOADING those from the
+# persistent XLA cache segfaults on the 0.4.x jaxlib line (see
+# tests/conftest.py). Clear the test-scoped cache so every full run is
+# an all-miss (compile) run — slower, never crashing.
+rm -rf "$HOME/.cache/gyeeta_tpu_jax/tests_"* 2>/dev/null || true
 python -m pytest tests/ -q "$@"

@@ -1,9 +1,12 @@
-"""Command-line entry points: serve / query / agent / replay.
+"""Command-line entry points: serve / query / agent / replay / obs.
 
 ``python -m gyeeta_tpu serve …``   — the aggregation-server daemon
 ``python -m gyeeta_tpu query …``   — one-shot JSON query/CRUD client
 ``python -m gyeeta_tpu agent …``   — a (sim or collecting) host agent
 ``python -m gyeeta_tpu replay …``  — play a wire capture into a server
+``python -m gyeeta_tpu obs top``   — live self-monitor (counters,
+engine health, stage timings, recent pipeline spans); ``obs metrics``
+dumps the raw Prometheus exposition
 
 The reference splits these across binaries (gymadhava/gyshyama,
 partha, node webserver clients); one Python entry point with
@@ -137,6 +140,52 @@ def _cmd_replay(argv) -> None:
     asyncio.run(run())
 
 
+def _cmd_obs(argv) -> None:
+    ap = argparse.ArgumentParser(
+        prog="gyeeta_tpu obs",
+        description="self-observability clients: 'top' renders the "
+        "live selfstats/health/span surface; 'metrics' dumps the "
+        "Prometheus exposition text")
+    ap.add_argument("what", choices=("top", "metrics"))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10038)
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="top refresh cadence (seconds)")
+    ap.add_argument("--once", action="store_true",
+                    help="top: render one frame and exit")
+    args = ap.parse_args(argv)
+
+    async def run():
+        from gyeeta_tpu.net.agent import QueryClient
+        from gyeeta_tpu.obs import format_top
+        qc = QueryClient()
+        await qc.connect(args.host, args.port)
+        try:
+            if args.what == "metrics":
+                out = await qc.query({"subsys": "metrics"})
+                sys.stdout.write(out.get("text", ""))
+                return
+            prev, prev_t = None, 0.0
+            while True:
+                import time as _time
+                ss = await qc.query({"subsys": "selfstats"})
+                now = _time.time()
+                frame = format_top(
+                    ss, prev, (now - prev_t) if prev is not None else 0.0)
+                if not args.once:
+                    sys.stdout.write("\x1b[H\x1b[2J")   # clear screen
+                sys.stdout.write(frame)
+                sys.stdout.flush()
+                if args.once:
+                    return
+                prev, prev_t = ss.get("counters", {}), now
+                await asyncio.sleep(args.interval)
+        finally:
+            await qc.close()
+
+    asyncio.run(run())
+
+
 def _cmd_web(argv) -> None:
     ap = argparse.ArgumentParser(prog="gyeeta_tpu web")
     ap.add_argument("--host", default="127.0.0.1",
@@ -163,10 +212,10 @@ def _cmd_web(argv) -> None:
 
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] in ("query", "agent", "replay", "web"):
+    if argv and argv[0] in ("query", "agent", "replay", "web", "obs"):
         return {"query": _cmd_query, "agent": _cmd_agent,
-                "replay": _cmd_replay, "web": _cmd_web}[argv[0]](
-            argv[1:])
+                "replay": _cmd_replay, "web": _cmd_web,
+                "obs": _cmd_obs}[argv[0]](argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]
     from gyeeta_tpu.server_main import main as serve_main

@@ -425,6 +425,53 @@ def tick_5s(cfg: EngineCfg, st: AggState) -> AggState:
     )
 
 
+# ------------------------------------------------------- health readback
+# engine_health_vec layout: one f32 scalar per key, packed so the WHOLE
+# device-health surface reads back in a single small transfer per report
+# cadence (never per event). Reductions are sum over shards for counts
+# (stacked (n,) leaves on a mesh) and max for the stage-pressure signal.
+HEALTH_KEYS = (
+    "svc_live", "svc_tomb", "svc_drop",
+    "task_live", "task_tomb", "task_drop",
+    "api_live", "api_tomb", "api_drop",
+    "td_stage_max",
+    "n_conn", "n_resp", "n_resp_unknown", "n_td_overflow",
+    "dep_half_live", "dep_edge_live", "dep_edge_drop",
+    "dep_paired", "dep_expired", "dep_dropped",
+)
+
+
+def engine_health_vec(cfg: EngineCfg, st: AggState, dep) -> jnp.ndarray:
+    """Device-state health as ONE (len(HEALTH_KEYS),) f32 vector.
+
+    The PSketch lesson (PAPERS.md): sketch/slab occupancy and eviction
+    pressure are first-class monitored signals, and accelerator-side
+    aggregation structures fail silently (probe exhaustion, stage
+    saturation) unless their state is read back and exported. This is
+    the batched readback: slab fills + tombstones + probe-failure drop
+    counters for every keyed table, digest-stage pressure, dep-graph
+    pair/edge fill and drop counters, and the device event counters —
+    folded to scalars ON DEVICE so the host does one small transfer.
+    Works on single-chip state (() scalars) and stacked sharded state
+    ((n,) leaves) alike: ``sum`` reduces over shards, ``max`` keeps the
+    worst shard's pressure.
+    """
+    s = lambda v: jnp.sum(v).astype(jnp.float32)       # noqa: E731
+    vals = (
+        s(st.tbl.n_live), s(st.tbl.n_tomb), s(st.tbl.n_drop),
+        s(st.task_tbl.n_live), s(st.task_tbl.n_tomb),
+        s(st.task_tbl.n_drop),
+        s(st.api_tbl.n_live), s(st.api_tbl.n_tomb), s(st.api_tbl.n_drop),
+        jnp.max(st.td_stage_n).astype(jnp.float32),
+        s(st.n_conn), s(st.n_resp), s(st.n_resp_unknown),
+        s(st.n_td_overflow),
+        s(dep.half_tbl.n_live), s(dep.edge_tbl.n_live),
+        s(dep.edge_tbl.n_drop),
+        s(dep.n_paired), s(dep.n_expired), s(dep.n_dropped),
+    )
+    return jnp.stack(vals)
+
+
 def fold_step(cfg: EngineCfg, st: AggState, cb, rb) -> AggState:
     """The flagship fused step: one conn batch + one resp batch."""
     st = ingest_conn(cfg, st, cb)

@@ -10,6 +10,9 @@ query conn:
 - ``GET  /v1/<subsys>``      — convenience: query params ``filter``,
   ``maxrecs``, ``sortcol``, ``sortdesc``, ``tstart``, ``tend``
 - ``GET  /healthz``          — gateway + upstream liveness
+- ``GET  /metrics``          — Prometheus text-format exposition of the
+  upstream server's self-metrics (the ``metrics`` query subsystem,
+  rendered by ``obs/prom.py``) — point a standard scraper here
 
 One upstream :class:`~gyeeta_tpu.net.agent.QueryClient` serialized by
 a lock (the query conn multiplexes by seqid, but the client helper
@@ -139,6 +142,12 @@ class WebGateway:
                      body: bytes) -> None:
         path, _, qs = target.partition("?")
         try:
+            if method == "GET" and path == "/metrics":
+                out = await self._query({"subsys": "metrics"})
+                await self._respond_text(
+                    writer, 200, out.get("text", ""),
+                    out.get("content_type", "text/plain"))
+                return
             if method == "GET" and path == "/healthz":
                 out = await self._query({"subsys": "serverstatus"})
                 up = out.get("nrecs", 0) == 1
@@ -175,15 +184,26 @@ class WebGateway:
             await self._respond(writer, 502,
                                 {"error": "upstream unreachable"})
 
-    @staticmethod
-    async def _respond(writer, status: int, obj) -> None:
-        body = json.dumps(obj).encode()
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  413: "Payload Too Large", 431: "Headers Too Large",
-                  502: "Bad Gateway", 503: "Service Unavailable"}.get(
-            status, "Error")
+    _REASON = {200: "OK", 400: "Bad Request", 404: "Not Found",
+               413: "Payload Too Large", 431: "Headers Too Large",
+               502: "Bad Gateway", 503: "Service Unavailable"}
+
+    @classmethod
+    async def _respond(cls, writer, status: int, obj) -> None:
+        await cls._respond_bytes(writer, status, json.dumps(obj).encode(),
+                                 "application/json")
+
+    @classmethod
+    async def _respond_text(cls, writer, status: int, text: str,
+                            ctype: str) -> None:
+        await cls._respond_bytes(writer, status, text.encode(), ctype)
+
+    @classmethod
+    async def _respond_bytes(cls, writer, status: int, body: bytes,
+                             ctype: str) -> None:
+        reason = cls._REASON.get(status, "Error")
         writer.write(
             f"HTTP/1.1 {status} {reason}\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
         await writer.drain()

@@ -1,0 +1,85 @@
+"""Self-observability tier: exposition, device health, span tracing.
+
+Three pillars over the process-wide ``Stats`` registry
+(``utils/selfstats.py``):
+
+- ``obs/prom.py``   — Prometheus text-format exporter (``GET /metrics``
+  on the HTTP gateway; ``metrics`` query subsystem on the binary
+  protocol — one rendering for both, shared by both runtimes).
+- ``obs/health.py`` — engine device-state health: slab occupancy,
+  probe-failure/eviction counters, dep-graph fill, digest-stage
+  pressure, read back as ONE batched transfer per report cadence
+  (``engine/step.py:engine_health_vec``).
+- ``obs/spans.py``  — ring-buffer span tracer over the feed pipeline
+  (deframe → decode+fold per batch, with size and native-vs-fallback
+  path) + the opt-in ``GYT_JAX_PROFILE`` device-trace bracket.
+
+``python -m gyeeta_tpu obs top`` renders the live surface; see the
+Monitoring section of OPERATIONS.md for scrape config and alerting
+starting points.
+"""
+
+from __future__ import annotations
+
+from gyeeta_tpu.obs.spans import FoldProfiler, SpanTracer  # noqa: F401
+
+
+def format_top(selfstats: dict, prev_counters: dict | None = None,
+               interval_s: float = 0.0, width: int = 78) -> str:
+    """Render one ``obs top`` frame from a ``selfstats`` payload.
+
+    ``prev_counters`` + ``interval_s`` turn cumulative counters into
+    rates (the ``Stats.delta()`` view, computed client-side so the
+    monitor never mutates server state)."""
+    c = selfstats.get("counters", {})
+    lines = []
+    up = c.get("uptime_sec", 0)
+    lines.append(f"gyt self-monitor — uptime {up}s")
+
+    eng = {k: v for k, v in sorted(c.items())
+           if str(k).startswith("engine_")}
+    if eng:
+        lines.append("")
+        lines.append("engine health:")
+        for k, v in eng.items():
+            lines.append(f"  {k:<36} {v}")
+
+    plain = {k: v for k, v in sorted(c.items())
+             if not str(k).startswith("engine_")
+             and isinstance(v, (int, float))}
+    lines.append("")
+    hdr = f"  {'counter':<36} {'total':>12}"
+    if prev_counters is not None and interval_s > 0:
+        hdr += f" {'rate/s':>12}"
+    lines.append("counters:")
+    lines.append(hdr)
+    for k, v in plain.items():
+        if k == "uptime_sec":
+            continue
+        row = f"  {k:<36} {v:>12}"
+        if prev_counters is not None and interval_s > 0:
+            d = (v - prev_counters.get(k, 0)) / interval_s
+            row += f" {d:>12.1f}"
+        lines.append(row)
+
+    timings = selfstats.get("timings") or []
+    if timings:
+        lines.append("")
+        lines.append("stage timings:")
+        lines.append(f"  {'stage':<20} {'count':>9} {'p50ms':>9} "
+                     f"{'p95ms':>9} {'p99ms':>9} {'totalms':>11}")
+        for r in timings:
+            lines.append(
+                f"  {r['stage']:<20} {r['count']:>9} {r['p50ms']:>9} "
+                f"{r['p95ms']:>9} {r['p99ms']:>9} {r['totalms']:>11}")
+
+    spans = selfstats.get("spans") or []
+    if spans:
+        lines.append("")
+        lines.append("recent spans (newest first):")
+        lines.append(f"  {'stage':<16} {'wallms':>9} {'nrec':>9} path")
+        for s in spans[:16]:
+            lines.append(f"  {s['name']:<16} {s['wallms']:>9} "
+                         f"{s['nrec']:>9} {s.get('path', '')}")
+
+    return "\n".join(ln[:width] for ln in lines) + "\n"

@@ -1,0 +1,106 @@
+"""Prometheus text-format exposition of the framework self-metrics.
+
+The process-local ``Stats`` registry (``utils/selfstats.py``) was only
+reachable through the ``selfstats`` query subsystem — invisible to any
+standard scraper. This renders the SAME registry as exposition format
+0.0.4 text:
+
+- counters        → ``gyt_<name>_total`` (monotone ints: event counts,
+  decode-path counters, drop events, …)
+- gauges          → ``gyt_<name>`` (tick, drop totals, and the
+  ``engine_*`` device-health gauges from ``obs/health.py``)
+- timing hists    → ``gyt_stage_duration_seconds{stage=...}`` —
+  geometric buckets mapped to cumulative ``le`` buckets (seconds) with
+  ``_sum``/``_count``; trailing all-zero buckets are elided (+Inf
+  always emitted), a valid subset per the exposition spec
+- alert-manager   → ``gyt_alerts_<name>_total``
+
+One rendering function serves every surface: ``GET /metrics`` on the
+HTTP gateway and the ``metrics`` query subsystem on the binary
+protocol (both runtimes route through ``query/api.py:local_response``),
+so scraper and query client can never see different names.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+import numpy as np
+
+from gyeeta_tpu.utils import selfstats as SS
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _name(raw: str) -> str:
+    n = _SANITIZE.sub("_", str(raw))
+    if not _NAME_OK.match(n):
+        n = "_" + n
+    return n
+
+
+def _num(v) -> str:
+    f = float(v)
+    if f == float("inf"):
+        return "+Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render(stats, alerts=None) -> str:
+    """``Stats`` registry → exposition text. Engine-health gauges are
+    expected to already sit in ``stats.gauges`` (the runtimes fold the
+    batched readback in before rendering)."""
+    out: list[str] = []
+
+    for k in sorted(stats.counters):
+        v = stats.counters[k]
+        n = f"gyt_{_name(k)}_total"
+        out.append(f"# TYPE {n} counter")
+        out.append(f"{n} {_num(v)}")
+
+    if alerts is not None:
+        for k in sorted(alerts.stats):
+            n = f"gyt_alerts_{_name(k)}_total"
+            out.append(f"# TYPE {n} counter")
+            out.append(f"{n} {_num(alerts.stats[k])}")
+
+    gauges = dict(stats.gauges)
+    gauges["uptime_seconds"] = time.time() - stats.t_start
+    for k in sorted(gauges):
+        n = f"gyt_{_name(k)}"
+        out.append(f"# TYPE {n} gauge")
+        out.append(f"{n} {_num(gauges[k])}")
+
+    hists = stats.timing_hists()
+    if hists:
+        h_name = "gyt_stage_duration_seconds"
+        out.append(f"# TYPE {h_name} histogram")
+        for stage, counts, total_ms in hists:
+            lab = _name(stage)
+            cum = np.cumsum(counts)
+            n = int(cum[-1])
+            if n == 0:
+                continue
+            last = int(np.nonzero(counts)[0][-1])
+            for b in range(last + 1):
+                le = SS.bucket_upper_ms(b) / 1e3
+                out.append(f'{h_name}_bucket{{stage="{lab}",'
+                           f'le="{_num(le)}"}} {int(cum[b])}')
+            out.append(f'{h_name}_bucket{{stage="{lab}",le="+Inf"}} {n}')
+            out.append(f'{h_name}_sum{{stage="{lab}"}} '
+                       f'{repr(total_ms / 1e3)}')
+            out.append(f'{h_name}_count{{stage="{lab}"}} {n}')
+
+    return "\n".join(out) + "\n"
+
+
+def metrics_response(stats, alerts=None) -> dict:
+    """The ``metrics`` query-subsystem payload: exposition text plus
+    the content type the HTTP gateway must serve it under."""
+    return {"text": render(stats, alerts), "content_type": CONTENT_TYPE}

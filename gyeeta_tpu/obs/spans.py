@@ -1,0 +1,124 @@
+"""Pipeline span tracer: a fixed-size ring of per-stage spans.
+
+The reference attributes hot-path cost with ``GY_HISTOGRAM`` wrappers
+and prints them on a cadence; histograms answer "how slow is this
+stage" but not "what did the last slow batch look like". This ring
+keeps the most recent N spans of the feed pipeline — one span per
+stage per feed batch (deframe → decode+fold dispatch → tick), each
+carrying the batch size, the native-vs-fallback decode path, and the
+wall time — so an operator can see the actual recent batches, not just
+their distribution. Surfaced as ``selfstats.spans`` over the query
+protocol and rendered by ``python -m gyeeta_tpu obs top``.
+
+Overhead discipline: recording a span is two clock reads and one list
+slot write — no allocation beyond the tuple, no locks (the serving
+loop is single-threaded; the decode-pipeline worker never records).
+Wall times measure HOST time; jitted dispatches are async, so a
+"fold" span is the enqueue cost, and device time shows up in the
+blocking spans (tick/flush). For true device timelines use the
+``GYT_JAX_PROFILE`` knob below.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+_FIELDS = ("name", "t", "wallms", "nrec", "path")
+
+
+class SpanTracer:
+    """Lock-free single-writer ring buffer of (name, t, wallms, nrec,
+    path) spans. ``capacity`` bounds memory forever; old spans are
+    overwritten (the notifymsg-ring discipline)."""
+
+    __slots__ = ("_buf", "_cap", "_i", "total")
+
+    def __init__(self, capacity: int = 1024):
+        self._buf: list = [None] * max(capacity, 1)
+        self._cap = max(capacity, 1)
+        self._i = 0
+        self.total = 0          # spans ever recorded (overwrites included)
+
+    def record(self, name: str, t: float, wallms: float,
+               nrec: int = 0, path: str = "") -> None:
+        self._buf[self._i] = (name, t, wallms, nrec, path)
+        self._i = (self._i + 1) % self._cap
+        self.total += 1
+
+    @contextlib.contextmanager
+    def span(self, name: str, nrec: int = 0, path: str = ""):
+        """Record one span around a code block (host wall time)."""
+        t = time.time()
+        p0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, t, (time.perf_counter() - p0) * 1e3,
+                        nrec, path)
+
+    def __len__(self) -> int:
+        return min(self.total, self._cap)
+
+    def rows(self, last: int = 128) -> list[dict]:
+        """Newest-first span dicts (bounded by ``last``)."""
+        n = min(len(self), last)
+        out = []
+        for k in range(1, n + 1):
+            rec = self._buf[(self._i - k) % self._cap]
+            if rec is None:          # pragma: no cover — len() guards
+                break
+            out.append({f: (round(v, 4) if f == "wallms" else v)
+                        for f, v in zip(_FIELDS, rec)})
+        return out
+
+    def clear(self) -> None:
+        self._buf = [None] * self._cap
+        self._i = 0
+        self.total = 0
+
+
+class FoldProfiler:
+    """Opt-in ``jax.profiler`` bracketing of the first N fold
+    dispatches: ``GYT_JAX_PROFILE=<dir>`` arms it, and the trace
+    covers folds 1..N (``GYT_JAX_PROFILE_FOLDS``, default 20) — the
+    device-timeline complement to the host-side span ring. Never
+    active unless the env var is set; ``close()`` stops a trace that
+    didn't reach N folds (short-lived processes still get a file)."""
+
+    def __init__(self, env=None):
+        env = os.environ if env is None else env
+        self.dir = env.get("GYT_JAX_PROFILE") or None
+        self.n_folds = int(env.get("GYT_JAX_PROFILE_FOLDS", "20") or 20)
+        self._seen = 0
+        self._active = False
+
+    @property
+    def armed(self) -> bool:
+        return self.dir is not None and not (
+            self._seen >= self.n_folds and not self._active)
+
+    def on_fold(self) -> None:
+        """Call once per fold dispatch (hot path: two attribute reads
+        when the knob is unset)."""
+        if self.dir is None or self._seen >= self.n_folds:
+            if self._active:        # pragma: no cover — defensive
+                self._stop()
+            return
+        if not self._active:
+            import jax
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+        self._seen += 1
+        if self._seen >= self.n_folds:
+            self._stop()
+
+    def _stop(self) -> None:
+        import jax
+        jax.profiler.stop_trace()
+        self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            self._stop()

@@ -795,6 +795,32 @@ def columns_for(cfg: EngineCfg, st: AggState, subsys: str, names=None,
     return _COLUMNS_OF[subsys](cfg, st, names=names)
 
 
+# process-local subsystems answered by the runtime itself (no engine
+# columns): self-metrics readback + Prometheus exposition. Shared by
+# Runtime and ShardedRuntime so the two surfaces cannot drift.
+LOCAL_SUBSYS = ("selfstats", "metrics")
+
+
+def local_response(rt, req: dict):
+    """Answer a process-local subsystem for a runtime-like object
+    (``.stats``/``.alerts``, optional ``.spans`` ring, and
+    ``.engine_health()`` for the batched device readback), or None
+    when ``req`` targets an engine subsystem."""
+    subsys = req.get("subsys")
+    if subsys == "selfstats":
+        from gyeeta_tpu.utils.selfstats import selfstats_response
+        return selfstats_response(rt.stats, rt.alerts,
+                                  spans=getattr(rt, "spans", None))
+    if subsys == "metrics":
+        from gyeeta_tpu.obs import prom
+        # fold staged records + refresh the engine-health gauges so the
+        # scrape sees current device state (one batched transfer)
+        rt.flush()
+        rt.engine_health()
+        return prom.metrics_response(rt.stats, rt.alerts)
+    return None
+
+
 def execute(cfg: EngineCfg, st: AggState, opts: QueryOptions,
             names=None, dep=None, columns_fn=None, svcreg=None,
             aux=None) -> dict:

@@ -108,6 +108,24 @@ class Daemon:
             d = self.rt.stats.delta()
             if d:
                 log.info("stats %s", json.dumps(d, default=str))
+            # a silently-degraded native extension must be visible
+            # without a query client: the per-interval fallback decode
+            # rate rides the cadence log at WARNING (satellite of the
+            # obs tier; the one-time import warning can scroll away)
+            if d.get("ref_fallback_decoded"):
+                log.warning(
+                    "native decode FALLBACK active: %d events decoded "
+                    "in pure Python this interval (counter "
+                    "ref_fallback_decoded; rebuild with `python -m "
+                    "gyeeta_tpu.ingest.native.build`)",
+                    d["ref_fallback_decoded"])
+            # engine device-health gauges (refreshed each tick by the
+            # batched readback) — the print_stats() cadence analogue
+            eng = {k: v for k, v in self.rt.stats.gauges.items()
+                   if k.startswith("engine_")}
+            if eng:
+                log.info("health %s", json.dumps(eng, default=str,
+                                                 sort_keys=True))
             if self._hot:
                 new = self._hot.poll()
                 if new is not self.rt.opts:

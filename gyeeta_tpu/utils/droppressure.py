@@ -18,12 +18,23 @@ from __future__ import annotations
 _ERROR_FRAC = 0.01
 
 
+# sentinel key carried in the returned ``last`` dict: whether the
+# previous check saw growth (the enter/exit edge detector)
+_ACTIVE = "_pressure_active"
+
+
 def check(drops: dict, caps: dict, last: dict, notifylog, stats) -> dict:
     """Compare cumulative drop counters against the previous tick.
 
     ``drops``: {name: cumulative count}; ``caps``: {name: capacity};
     ``last``: previous tick's ``drops`` (mutated copy returned).
     Emits one notifymsg per tick listing every growing counter.
+
+    Counter surface (all visible in ``Stats.delta()`` and /metrics):
+    ``dropped_records_<name>`` attributes drops per subsystem per
+    cadence; ``drop_pressure_enter``/``drop_pressure_exit`` count the
+    pressure-state edges; the ``engine_drop_pressure`` gauge holds the
+    current state (1 = drops grew this tick).
     """
     grew = {}
     for name, v in drops.items():
@@ -31,6 +42,8 @@ def check(drops: dict, caps: dict, last: dict, notifylog, stats) -> dict:
         d = v - last.get(name, 0)
         if d > 0:
             grew[name] = d
+            stats.bump(f"dropped_records_{name}", int(d))
+    was_active = bool(last.get(_ACTIVE))
     if grew:
         severe = any(d >= max(_ERROR_FRAC * caps.get(n, 1 << 30), 1.0)
                      for n, d in grew.items())
@@ -41,4 +54,11 @@ def check(drops: dict, caps: dict, last: dict, notifylog, stats) -> dict:
             f"overload; raise capacity or shed load",
             ntype="error" if severe else "warn", source="selfmon")
         stats.bump("drop_pressure_events")
-    return dict(drops)
+        if not was_active:
+            stats.bump("drop_pressure_enter")
+    elif was_active:
+        stats.bump("drop_pressure_exit")
+    stats.gauge("engine_drop_pressure", 1.0 if grew else 0.0)
+    out = dict(drops)
+    out[_ACTIVE] = bool(grew)
+    return out

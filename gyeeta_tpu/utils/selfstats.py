@@ -76,10 +76,24 @@ class Stats:
                    "totalms": round(float(self._t_sum_ms[name]), 3)}
             for q, col in ((0.5, "p50ms"), (0.95, "p95ms"),
                            (0.99, "p99ms")):
-                b = int(np.searchsorted(cum, q * n))
+                # rank semantics: the q-quantile sample is the
+                # ceil(q*n)-th smallest, and the float product must not
+                # skip an exact-boundary bucket (0.99*100 is
+                # 99.000…0001 in binary; searchsorted on it walked past
+                # a bucket whose cumulative count is exactly 99)
+                r = min(n, max(1, math.ceil(q * n - 1e-9)))
+                b = int(np.searchsorted(cum, r, side="left"))
                 row[col] = round(self._bucket_ms(b), 4)
             out.append(row)
         return out
+
+    def timing_hists(self) -> list[tuple[str, np.ndarray, float]]:
+        """Raw geometric buckets per stage: (name, counts, total_ms) —
+        the exposition source (``obs/prom.py`` maps these to cumulative
+        ``le`` buckets)."""
+        return [(name, self._timings[name].copy(),
+                 float(self._t_sum_ms[name]))
+                for name in sorted(self._timings)]
 
     def snapshot(self) -> dict:
         out = dict(self.counters)
@@ -95,9 +109,25 @@ class Stats:
         return {k: v for k, v in out.items() if v}
 
 
-def selfstats_response(stats: Stats, alerts=None) -> dict:
+def selfstats_response(stats: Stats, alerts=None, spans=None) -> dict:
     """The ``selfstats`` query-subsystem payload (shared by both
-    runtimes so the surface cannot drift)."""
-    return {"counters": stats.snapshot(),
-            "timings": stats.timing_rows(),
-            "alerts": dict(alerts.stats) if alerts is not None else {}}
+    runtimes so the surface cannot drift). ``spans`` is the optional
+    pipeline span ring (``obs/spans.SpanTracer``) — its newest entries
+    ride the payload as ``selfstats.spans``."""
+    out = {"counters": stats.snapshot(),
+           "timings": stats.timing_rows(),
+           "alerts": dict(alerts.stats) if alerts is not None else {}}
+    if spans is not None:
+        out["spans"] = spans.rows()
+    return out
+
+
+# exposition helpers (obs/prom.py): geometric bucket b covers
+# (upper(b-1), upper(b)] with upper(0) = vmin — the cumulative-`le`
+# mapping needs the upper edges
+def bucket_upper_ms(b: int) -> float:
+    """Upper edge (ms) of timing bucket ``b``; the last bucket is
+    +Inf (it absorbs everything past vmin·γ^(NB-1))."""
+    if b >= _T_NB - 1:
+        return math.inf
+    return _T_VMIN_MS * _T_GAMMA ** b

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from gyeeta_tpu.engine.aggstate import EngineCfg
 from gyeeta_tpu.ingest import decode, wire
@@ -134,6 +135,8 @@ def test_cpumem_history_and_db_aggregation():
     assert all(r["max(cpu)"] > 0 for r in out["recs"])
 
 
+@pytest.mark.slow   # 8-device mesh program: shard_map executables must
+#                     stay out of the fast tier's compile cache (conftest)
 def test_sharded_cpumem_matches_single():
     from gyeeta_tpu.parallel import make_mesh
     from gyeeta_tpu.parallel.shardedrt import ShardedRuntime

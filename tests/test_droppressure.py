@@ -51,6 +51,56 @@ def test_check_warn_and_error_levels():
     assert st.counters["drop_pressure_events"] == 2
 
 
+def test_counter_transitions_via_stats_delta():
+    """Satellite: enter/exit pressure edges + per-subsystem drop
+    attribution, asserted through the real ``Stats.delta()`` cadence
+    view (what the serve loop logs)."""
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    log, st = _Log(), Stats()
+    caps = {"svc": 1000, "task": 1000}
+    st.delta()                                   # baseline the view
+
+    # tick 1: no drops anywhere — no pressure, no counters
+    last = droppressure.check({"svc": 0, "task": 0}, caps, {}, log, st)
+    assert st.delta() == {}
+    assert st.gauges["engine_drop_pressure"] == 0.0
+
+    # tick 2: svc drops grow → ENTER pressure, attributed to svc only
+    last = droppressure.check({"svc": 5, "task": 0}, caps, last, log, st)
+    d = st.delta()
+    assert d["drop_pressure_enter"] == 1
+    assert d["drop_pressure_events"] == 1
+    assert d["dropped_records_svc"] == 5
+    assert "dropped_records_task" not in d
+    assert st.gauges["engine_drop_pressure"] == 1.0
+
+    # tick 3: still growing (svc AND task) — no second enter edge,
+    # both subsystems attributed
+    last = droppressure.check({"svc": 8, "task": 2}, caps, last, log, st)
+    d = st.delta()
+    assert "drop_pressure_enter" not in d
+    assert d["dropped_records_svc"] == 3
+    assert d["dropped_records_task"] == 2
+
+    # tick 4: growth stops → EXIT pressure, gauge falls back to 0
+    last = droppressure.check({"svc": 8, "task": 2}, caps, last, log, st)
+    d = st.delta()
+    assert d["drop_pressure_exit"] == 1
+    assert "drop_pressure_events" not in d
+    assert st.gauges["engine_drop_pressure"] == 0.0
+
+    # tick 5: steady — no edges at all
+    last = droppressure.check({"svc": 8, "task": 2}, caps, last, log, st)
+    assert st.delta() == {}
+
+    # tick 6: drops resume → a SECOND enter edge
+    droppressure.check({"svc": 9, "task": 2}, caps, last, log, st)
+    assert st.delta()["drop_pressure_enter"] == 1
+    # cumulative gauges track the totals the whole way
+    assert st.gauges["drops_svc"] == 9 and st.gauges["drops_task"] == 2
+
+
 def test_overloaded_table_raises_signal():
     """E2E: feed far more distinct services than a tiny table can hold
     → drops occur → the tick raises the notifymsg signal."""

@@ -10,6 +10,7 @@ from __future__ import annotations
 import collections
 
 import numpy as np
+import pytest
 
 from gyeeta_tpu import trace as T
 from gyeeta_tpu.engine.aggstate import EngineCfg
@@ -223,6 +224,8 @@ def test_trace_ageing():
     assert int(np.asarray(st.api_tbl.n_live)) == 0
 
 
+@pytest.mark.slow   # 8-device mesh program: shard_map executables must
+#                     stay out of the fast tier's compile cache (conftest)
 def test_sharded_trace_matches_single():
     from gyeeta_tpu.parallel import make_mesh
     from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
