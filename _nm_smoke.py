@@ -1,0 +1,78 @@
+"""CI smoke: boot a server, open a STOCK node-webserver (NM) conn via
+sim/nodeweb.py, run one QUERY_WEB_JSON and one CRUD_ALERT_JSON
+create→list→delete round trip — fail loud on any wire or routing
+breakage.
+
+The protocol-compatibility contract a stock Gyeeta NodeJS webserver
+depends on, checked end-to-end with zero external deps and zero
+GYT-specific frames on the NM conn. Exit code 0 = contract holds.
+Run by ci.sh; standalone: ``JAX_PLATFORMS=cpu python _nm_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+
+async def scenario() -> None:
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.net import GytServer, NetAgent
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.nodeweb import NodeWebSim
+
+    cfg = EngineCfg(n_hosts=4, svc_capacity=64, conn_batch=64,
+                    resp_batch=64, fold_k=2)
+    rt = Runtime(cfg)
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    agent = NetAgent(seed=1)
+    await agent.connect(host, port)
+    await agent.send_sweep(n_conn=128, n_resp=128)
+    await asyncio.sleep(0.05)
+    rt.run_tick()
+
+    nw = NodeWebSim(hostname="ci-nodeweb")
+    hs = await nw.connect(host, port)
+    assert hs["error_code"] == 0, hs
+    assert hs["madhava_name"] == "gyt-tpu", hs
+
+    # one web query: the agent's sweep must be visible over NM
+    out = await nw.query_web("svcstate", maxrecs=100)
+    assert out["nrecs"] > 0, f"no svcstate rows over NM: {out}"
+
+    # one alertdef CRUD round trip: create → list shows it → delete →
+    # list no longer shows it
+    name = "ci-nm-smoke-def"
+    add = await nw.crud_alert({
+        "op": "add", "objtype": "alertdef", "alertname": name,
+        "subsys": "svcstate", "filter": "{ svcstate.state in 'Severe' }"})
+    assert add.get("ok") is True, add
+    lst = await nw.query_web("alertdef")
+    assert any(r.get("alertname") == name for r in lst["recs"]), lst
+    dele = await nw.crud_alert({"op": "delete", "objtype": "alertdef",
+                                "name": name})
+    assert dele.get("ok") is True, dele
+    lst2 = await nw.query_web("alertdef")
+    assert not any(r.get("alertname") == name for r in lst2["recs"]), lst2
+
+    # the edge's own counters made it into the exposition
+    met = await nw.query_web("metrics")
+    assert 'gyt_nm_queries_total{verb="web_json"}' in met["text"]
+    assert 'gyt_nm_queries_total{verb="crud_alert_json"}' in met["text"]
+
+    await nw.close()
+    await agent.close()
+    await srv.stop()
+    print(f"nm smoke: OK — handshake + svcstate query "
+          f"({out['nrecs']} rows) + alertdef CRUD round trip",
+          file=sys.stderr)
+
+
+def main() -> int:
+    asyncio.run(scenario())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

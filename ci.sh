@@ -25,12 +25,30 @@ else
          "tests run on the pure-Python decode path" >&2
 fi
 
+# ABI compile probe: prove the stock-struct transcriptions against the
+# host C++ compiler's layout (offsetof/sizeof for every adapted struct).
+# Skips itself with a reason when no toolchain; any drift fails CI.
+echo "ci: ABI compile probe" >&2
+if ! JAX_PLATFORMS=cpu python -m gyeeta_tpu.ingest.native.abiprobe; then
+    echo "ci: FATAL — ABI probe found layout drift" >&2
+    exit 1
+fi
+
 # /metrics exposition smoke: boot server + gateway, scrape, validate
 # the Prometheus text contract with the built-in minimal parser (no
 # external deps). Catches a broken scraper surface before the suite.
 echo "ci: /metrics exposition smoke" >&2
 if ! JAX_PLATFORMS=cpu python _metrics_smoke.py; then
     echo "ci: FATAL — /metrics smoke failed" >&2
+    exit 1
+fi
+
+# NM query-edge smoke: boot a server, open a STOCK node-webserver conn
+# (sim/nodeweb.py — zero GYT frames on the wire), run one
+# QUERY_WEB_JSON and one CRUD_ALERT_JSON create→list→delete round trip.
+echo "ci: NM query-edge smoke" >&2
+if ! JAX_PLATFORMS=cpu python _nm_smoke.py; then
+    echo "ci: FATAL — NM smoke failed" >&2
     exit 1
 fi
 

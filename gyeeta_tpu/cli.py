@@ -7,6 +7,9 @@
 ``python -m gyeeta_tpu obs top``   — live self-monitor (counters,
 engine health, stage timings, recent pipeline spans); ``obs metrics``
 dumps the raw Prometheus exposition
+``python -m gyeeta_tpu nm probe``  — stock node-webserver (NM conn)
+wire probe: handshake + per-subsystem QUERY_WEB_JSON + optional
+alertdef CRUD round trip (``--crud``); ``nm query`` sends one raw body
 
 The reference splits these across binaries (gymadhava/gyshyama,
 partha, node webserver clients); one Python entry point with
@@ -186,6 +189,86 @@ def _cmd_obs(argv) -> None:
     asyncio.run(run())
 
 
+def _cmd_nm(argv) -> None:
+    ap = argparse.ArgumentParser(
+        prog="gyeeta_tpu nm",
+        description="stock node-webserver (NM conn) clients: 'probe' "
+        "runs the NM_CONNECT handshake plus one QUERY_WEB_JSON per "
+        "subsystem and reports wire-level health; 'query' sends one "
+        "raw QUERY_WEB_JSON/CRUD body over an NM conn")
+    ap.add_argument("what", choices=("probe", "query"))
+    ap.add_argument("request", nargs="?",
+                    help="query: JSON body ({'qtype':..,'options':..} "
+                    "or native {'subsys':..}), or '-' for stdin")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10038)
+    ap.add_argument("--subsys", default="serverstatus,hoststate,"
+                    "svcstate,taskstate,alertdef",
+                    help="probe: comma-separated subsystems to query")
+    ap.add_argument("--crud", action="store_true",
+                    help="probe: also run an alertdef create→list→"
+                    "delete CRUD round trip")
+    args = ap.parse_args(argv)
+
+    async def run():
+        from gyeeta_tpu.sim.nodeweb import NMError, NodeWebSim
+        nw = NodeWebSim(hostname="nm-probe")
+        hs = await nw.connect(args.host, args.port)
+        try:
+            if args.what == "query":
+                body = sys.stdin.read() if args.request == "-" \
+                    else (args.request or "{}")
+                req = json.loads(body)
+                if req.get("op"):
+                    out = await nw.crud_alert(req) \
+                        if req.get("objtype") in ("alertdef", "silence",
+                                                  "inhibit", "action") \
+                        else await nw.crud_generic(req)
+                else:
+                    out = await nw.request(
+                        2, req if "qtype" in req else
+                        {"qtype": req.pop("subsys"), "options": req})
+                json.dump(out, sys.stdout, default=str)
+                sys.stdout.write("\n")
+                return
+            print(f"nm probe: connected — madhava "
+                  f"{hs['madhava_name']!r} id {hs['madhava_id']:#x} "
+                  f"version {hs['madhava_version']:#08x}",
+                  file=sys.stderr)
+            failed = 0
+            for sub in args.subsys.split(","):
+                sub = sub.strip()
+                try:
+                    out = await nw.query_web(sub, maxrecs=1)
+                    print(f"  {sub:<14} ok  nrecs={out.get('nrecs')}",
+                          file=sys.stderr)
+                except NMError as e:
+                    failed += 1
+                    print(f"  {sub:<14} ERR {e}", file=sys.stderr)
+            if args.crud:
+                name = "nm-probe-def"
+                await nw.crud_alert({
+                    "op": "add", "objtype": "alertdef",
+                    "alertname": name, "subsys": "svcstate",
+                    "filter": "{ svcstate.state in 'Severe' }"})
+                lst = await nw.query_web("alertdef")
+                ok = any(r.get("alertname") == name
+                         for r in lst.get("recs", []))
+                await nw.crud_alert({"op": "delete",
+                                     "objtype": "alertdef",
+                                     "name": name})
+                print(f"  alertdef CRUD round-trip "
+                      f"{'ok' if ok else 'FAILED'}", file=sys.stderr)
+                failed += 0 if ok else 1
+            if failed:
+                raise SystemExit(f"nm probe: {failed} check(s) failed")
+            print("nm probe: OK", file=sys.stderr)
+        finally:
+            await nw.close()
+
+    asyncio.run(run())
+
+
 def _cmd_web(argv) -> None:
     ap = argparse.ArgumentParser(prog="gyeeta_tpu web")
     ap.add_argument("--host", default="127.0.0.1",
@@ -212,10 +295,11 @@ def _cmd_web(argv) -> None:
 
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] in ("query", "agent", "replay", "web", "obs"):
+    if argv and argv[0] in ("query", "agent", "replay", "web", "obs",
+                            "nm"):
         return {"query": _cmd_query, "agent": _cmd_agent,
                 "replay": _cmd_replay, "web": _cmd_web,
-                "obs": _cmd_obs}[argv[0]](argv[1:])
+                "obs": _cmd_obs, "nm": _cmd_nm}[argv[0]](argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]
     from gyeeta_tpu.server_main import main as serve_main

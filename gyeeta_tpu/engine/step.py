@@ -348,6 +348,19 @@ def age_apis(cfg: EngineCfg, st: AggState, max_age_ticks: int) -> AggState:
     )
 
 
+def ping_tasks(cfg: EngineCfg, st: AggState, pb) -> AggState:
+    """Fold a PingBatch (process-group keepalives, the ref
+    PING_TASK_AGGR ``gy_comm_proto.h:1384``): refresh ``task_last_tick``
+    for rows that EXIST — lookup, never upsert. A quiet long-lived group
+    keeps its slot (and its learned CPU baseline) without a stats sweep;
+    pings for unknown groups are dropped (the reference asks the partha
+    to re-announce instead of fabricating empty rows)."""
+    rows = table.lookup(st.task_tbl, pb.key_hi, pb.key_lo, pb.valid)
+    lanes = jnp.where(rows >= 0, rows, cfg.task_capacity)
+    last = st.task_last_tick.at[lanes].set(st.resp_win.tick, mode="drop")
+    return st._replace(task_last_tick=last)
+
+
 def age_tasks(cfg: EngineCfg, st: AggState, max_age_ticks: int) -> AggState:
     """Tombstone process groups not seen for ``max_age_ticks`` base ticks
     (the reference ages MAGGR_TASK entries via ping/delete msgs,

@@ -136,6 +136,16 @@ class TraceBatch(NamedTuple):
     valid: np.ndarray
 
 
+class PingBatch(NamedTuple):
+    """Columnar TASK_PING microbatch (process-group keepalives): keys
+    only — the fold refreshes ``task_last_tick`` for EXISTING rows and
+    never inserts (the ref PING_TASK_AGGR ageing refresh)."""
+    key_hi: np.ndarray        # aggr_task_id split
+    key_lo: np.ndarray
+    host_id: np.ndarray       # int32 (shard routing key)
+    valid: np.ndarray
+
+
 class TaskBatch(NamedTuple):
     """Columnar AGGR_TASK_STATE microbatch (process-group 5s sweep)."""
     key_hi: np.ndarray        # aggr_task_id split — process-group key
@@ -578,6 +588,32 @@ def task_batch_fast(recs: np.ndarray,
                      host_id=host_id, valid=valid, **cols)
 
 
+def ping_batch(recs: np.ndarray, size: int = wire.MAX_PINGS_PER_BATCH,
+               stats=None) -> PingBatch:
+    """TASK_PING records → columnar keepalive microbatch (ref
+    PING_TASK_AGGR, gy_comm_proto.h:1384). Key split rides the native
+    helper when available — same numpy fallback discipline as the
+    other builders."""
+    n = _check_fit(recs, size)
+    r = recs[:n]
+    k_hi = np.zeros(size, np.uint32)
+    k_lo = np.zeros(size, np.uint32)
+    host_id = np.zeros(size, np.int32)
+    used_native = (native.available()
+                   and native.split_u64_into(r, "aggr_task_id",
+                                             k_hi, k_lo)
+                   and native.pack_i32_into(r, "host_id", host_id))
+    if not used_native:
+        hi, lo = split_u64(r["aggr_task_id"])
+        k_hi[:n], k_lo[:n] = hi, lo
+        host_id[:n] = r["host_id"].astype(np.int32)
+    _count_path(stats, used_native, n)
+    valid = np.zeros(size, bool)
+    valid[:n] = True
+    return PingBatch(key_hi=k_hi, key_lo=k_lo, host_id=host_id,
+                     valid=valid)
+
+
 def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
                  listener_batch: int):
     """Drained records-by-subtype → a fold plan of lane-sized chunks.
@@ -635,6 +671,10 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
     nif = recs.get(wire.NOTIFY_NETIF_STATE)
     if nif is not None:
         yield ("netif", nif)
+    png = recs.get(wire.NOTIFY_TASK_PING)
+    if png is not None:
+        for i in range(0, len(png), wire.MAX_PINGS_PER_BATCH):
+            yield ("ping", png[i:i + wire.MAX_PINGS_PER_BATCH])
     nm = recs.get(wire.NOTIFY_NAME_INTERN)
     if nm is not None:
         yield ("names", nm)

@@ -46,12 +46,14 @@ REF_COMM_EVENT_NOTIFY = 14
 
 REF_NOTIFY_TASK_TOP_PROCS = 0x303
 REF_NOTIFY_TASK_AGGR = 0x305
+REF_NOTIFY_PING_TASK_AGGR = 0x306
 REF_NOTIFY_NEW_LISTENER = 0x307
 REF_NOTIFY_LISTENER_STATE = 0x309
 REF_NOTIFY_TCP_CONN = 0x30C
 REF_NOTIFY_NAT_TCP = 0x30D
 REF_NOTIFY_CPU_MEM_STATE = 0x30F
 REF_NOTIFY_AGGR_TASK_STATE = 0x310
+REF_NOTIFY_PARTHA_STATUS = 0x311
 REF_NOTIFY_ACTIVE_CONN_STATS = 0x312
 REF_NOTIFY_LISTENER_DOMAIN = 0x313
 REF_NOTIFY_LISTEN_TASKMAP = 0x314
@@ -380,6 +382,24 @@ assert REF_API_TRAN_DT.itemsize == 176
 _REF_PROTO_MAP = {1: 1, 2: 4, 3: 2, 5: 3, 7: 6}   # HTTP1, HTTP2,
 #                 Postgres, Mongo, Sybase; others → 0 (unknown)
 
+# PING_TASK_AGGR (gy_comm_proto.h:1384, 8 bytes): process-group
+# keepalive — long-lived quiet groups refresh their ageing clock
+# without a stats sweep (the madhava refreshes last_tick and never
+# inserts; MAX_NUM_PINGS = 2048)
+REF_PING_TASK_AGGR_DT = np.dtype([
+    ("aggr_task_id", "<u8"),
+])
+assert REF_PING_TASK_AGGR_DT.itemsize == 8
+
+# PARTHA_STATUS (gy_comm_proto.h:1399, 24 bytes, nevents == 1): the
+# partha's liveness ping (is_ok + clock skew sample) — session-level,
+# never engine-fed
+REF_PARTHA_STATUS_DT = np.dtype([
+    ("is_ok", "u1"), ("pad0", "u1", (7,)),
+    ("curr_sec", "<i8"), ("clock_sec", "<i8"),
+])
+assert REF_PARTHA_STATUS_DT.itemsize == 24
+
 # TASK_AGGR_NOTIFY (gy_comm_proto.h:1290, 48 bytes + cmdline/tag):
 # process-group announcements carrying the task→listener linkage
 REF_TASK_AGGR_DT = np.dtype([
@@ -466,6 +486,10 @@ class RefSession:
         self.nat_conns: list = []        # TCP_CONN record arrays (NAT
         #                                  annotations for the VIP
         #                                  registry; never engine-fed)
+        # PARTHA_STATUS liveness: (is_ok, curr_sec) of the newest ping
+        # (the serving edge surfaces not-ok transitions)
+        self.last_status_ok = True
+        self.last_status_sec = 0
 
     # drained by the serving edge after each adapt() run
     MAX_PENDING = 1024
@@ -889,6 +913,40 @@ def decode_req_trace_tran(payload: bytes, nevents: int, host_id: int
     return out, names
 
 
+def decode_ping_task_aggr(payload: bytes, nevents: int, host_id: int
+                          ) -> tuple[np.ndarray, list]:
+    """PING_TASK_AGGR walk → GYT TASK_PING records (fixed size): the
+    keepalive refreshes the group's device-table ageing clock, so
+    long-lived quiet stock task rows stop ageing out between 5s
+    sweeps (``engine/step.ping_tasks``)."""
+    fsz = REF_PING_TASK_AGGR_DT.itemsize
+    _check_nevents(nevents, payload, fsz, wire.MAX_PINGS_PER_BATCH,
+                   "ping_task_aggr")
+    recs = np.frombuffer(payload, REF_PING_TASK_AGGR_DT, count=nevents)
+    out = np.zeros(nevents, wire.TASK_PING_DT)
+    out["aggr_task_id"] = recs["aggr_task_id"]
+    out["host_id"] = host_id
+    return out, []
+
+
+def decode_partha_status(payload: bytes, nevents: int,
+                         session: "RefSession") -> None:
+    """PARTHA_STATUS walk → session liveness (frameless): the newest
+    ping's (is_ok, curr_sec) lands on the session; ok→not-ok
+    transitions raise an operator notification (the reference treats
+    these as host liveness for its parthalist views)."""
+    fsz = REF_PARTHA_STATUS_DT.itemsize
+    _check_nevents(nevents, payload, fsz, 16, "partha_status")
+    recs = np.frombuffer(payload, REF_PARTHA_STATUS_DT, count=nevents)
+    for rec in recs:
+        ok = bool(rec["is_ok"])
+        if session.last_status_ok and not ok:
+            session._push(session.notifications,
+                          ("warn", "partha reports degraded status"))
+        session.last_status_ok = ok
+        session.last_status_sec = int(rec["curr_sec"])
+
+
 def decode_task_aggr(payload: bytes, nevents: int,
                      session: "RefSession") -> None:
     """TASK_AGGR walk → session task→listener linkage (a second
@@ -978,6 +1036,7 @@ _SESSION_DECODERS = {
     REF_NOTIFY_NAT_TCP: decode_nat_tcp,
     REF_NOTIFY_HOST_CPU_MEM_CHANGE: decode_cpu_mem_change,
     REF_NOTIFY_TASK_AGGR: decode_task_aggr,
+    REF_NOTIFY_PARTHA_STATUS: decode_partha_status,
 }
 
 
@@ -1231,6 +1290,8 @@ _DECODER_OF = {
                            wire.NOTIFY_HOST_INFO, True),
     REF_NOTIFY_REQ_TRACE_TRAN: (decode_req_trace_tran,
                                 wire.NOTIFY_REQ_TRACE, False),
+    REF_NOTIFY_PING_TASK_AGGR: (decode_ping_task_aggr,
+                                wire.NOTIFY_TASK_PING, False),
 }
 
 

@@ -59,6 +59,9 @@ NOTIFY_HOST_INFO = 19         # static host inventory (hw/os/cloud)
 NOTIFY_CGROUP_STATE = 20      # 5s per-cgroup stats
 NOTIFY_MOUNT_STATE = 21       # mount/filesystem inventory + freespace
 NOTIFY_NETIF_STATE = 22       # net interface inventory + traffic rates
+NOTIFY_TASK_PING = 23         # process-group keepalive (no stats; the
+#                               ref PING_TASK_AGGR, gy_comm_proto.h:1384
+#                               — refreshes ageing, never inserts)
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -198,6 +201,18 @@ AGGR_TASK_DT = np.dtype([
 ])
 
 MAX_TASKS_PER_BATCH = 1200     # gy_comm_proto.h:2139 MAX_NUM_TASKS
+
+# TASK_PING record — process-group keepalive (the ref PING_TASK_AGGR,
+# gy_comm_proto.h:1384: long-lived quiet groups refresh their ageing
+# clock without a stats sweep; the fold looks the key up and touches
+# task_last_tick, never inserting)
+TASK_PING_DT = np.dtype([
+    ("aggr_task_id", "<u8"),
+    ("host_id", "<u4"),
+    ("pad", "u1", (4,)),
+])
+
+MAX_PINGS_PER_BATCH = 2048     # ref PING_TASK_AGGR::MAX_NUM_PINGS
 
 # CPU_MEM_STATE record — the 2s host cpu/mem path (field content of
 # CPU_MEM_STATE_NOTIFY, gy_comm_proto.h:2024: cpu pcts, context switches,
@@ -401,6 +416,7 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_CGROUP_STATE: CGROUP_DT,
     NOTIFY_MOUNT_STATE: MOUNT_DT,
     NOTIFY_NETIF_STATE: NETIF_DT,
+    NOTIFY_TASK_PING: TASK_PING_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -419,6 +435,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_CGROUP_STATE: MAX_CGROUPS_PER_BATCH,
     NOTIFY_MOUNT_STATE: MAX_MOUNTS_PER_BATCH,
     NOTIFY_NETIF_STATE: MAX_NETIF_PER_BATCH,
+    NOTIFY_TASK_PING: MAX_PINGS_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -432,7 +449,8 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("REQ_TRACE_DT", REQ_TRACE_DT),
                    ("LISTENER_INFO_DT", LISTENER_INFO_DT),
                    ("HOST_INFO_DT", HOST_INFO_DT),
-                   ("CGROUP_DT", CGROUP_DT)]:
+                   ("CGROUP_DT", CGROUP_DT),
+                   ("TASK_PING_DT", TASK_PING_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 

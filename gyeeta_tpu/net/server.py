@@ -32,7 +32,7 @@ from typing import Optional
 import numpy as np
 
 from gyeeta_tpu import version
-from gyeeta_tpu.ingest import refproto, wire
+from gyeeta_tpu.ingest import refproto, refquery, wire
 from gyeeta_tpu.runtime import Runtime
 
 log = logging.getLogger("gyeeta_tpu.net")
@@ -103,6 +103,25 @@ class GytServer:
         # a process run; parthas compare it on reconnect)
         import secrets as _sec
         self._madhava_id = _sec.randbits(63) | 1
+        # NM query edge (node-webserver conns, net/nmhandle.py): sticky
+        # conn identity per (hostname, port) + live-conn gauge
+        self._nm_idents: dict[tuple, object] = {}
+        self._nm_conns_live = 0
+
+    def _nm_register(self, hostname: str, port: int):
+        """Sticky NM conn identity for a node (hostname, port) pair —
+        reconnects get the same conn_id (the reference's per-node conn
+        object). Bounded like the partha ident map."""
+        from gyeeta_tpu.net import nmhandle
+        key = (hostname, port)
+        st = self._nm_idents.get(key)
+        if st is None:
+            if len(self._nm_idents) >= 4 * self.rt.cfg.n_hosts + 64:
+                self._nm_idents.clear()      # epoch reset, re-learns
+            st = nmhandle.NMConnState(hostname, port,
+                                      len(self._nm_idents) + 1)
+            self._nm_idents[key] = st
+        return st
 
     # -------------------------------------------------------- registration
     def _load_hostmap(self) -> dict:
@@ -389,6 +408,13 @@ class GytServer:
                     ref_session=refproto.RefSession(
                         region=req.get("region_name", ""),
                         zone=req.get("zone_name", "")))
+                return
+            elif dtype == refquery.REF_COMM_NM_CONNECT_CMD:
+                # stock node webserver: the query edge (NM_CONNECT_CMD_S
+                # → RESP_S handshake + QUERY_WEB_JSON / CRUD_*_JSON
+                # loop, net/nmhandle.py)
+                from gyeeta_tpu.net import nmhandle
+                await nmhandle.serve_nm_conn(self, reader, writer, body)
                 return
             else:
                 # pre-registration frame of an unhandled type: skip it

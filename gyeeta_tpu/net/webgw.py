@@ -4,7 +4,11 @@ The reference fronts madhava/shyama with a Node.js webserver speaking
 its JSON envelope over NM conns (the repo's out-of-tree web tier; the
 server side is the NM handshake in ``server/gy_mnodehandle.cc``).
 Here the same tier is one asyncio process bridging REST to the GYT
-query conn:
+query conn. A STOCK node webserver needs no gateway at all: the server
+itself speaks the NM conn contract (``net/nmhandle.py``), and both
+surfaces render the same ``Runtime.query`` dict with plain
+``json.dumps`` — NM and REST responses are parity-tested byte-equal
+for identical queries (``tests/test_nmquery.py``):
 
 - ``POST /query``            — raw JSON query/CRUD/multiquery envelope
 - ``GET  /v1/<subsys>``      — convenience: query params ``filter``,

@@ -6,7 +6,10 @@ standard scraper. This renders the SAME registry as exposition format
 0.0.4 text:
 
 - counters        → ``gyt_<name>_total`` (monotone ints: event counts,
-  decode-path counters, drop events, …)
+  decode-path counters, drop events, …); a counter bumped as
+  ``name|k=v`` renders as the labeled sample ``gyt_name_total{k="v"}``
+  — one family, one TYPE line, N label values (the NM edge's
+  ``nm_queries|verb=...`` per-verb counters use this)
 - gauges          → ``gyt_<name>`` (tick, drop totals, and the
   ``engine_*`` device-health gauges from ``obs/health.py``)
 - timing hists    → ``gyt_stage_duration_seconds{stage=...}`` —
@@ -58,11 +61,22 @@ def render(stats, alerts=None) -> str:
     batched readback in before rendering)."""
     out: list[str] = []
 
-    for k in sorted(stats.counters):
-        v = stats.counters[k]
-        n = f"gyt_{_name(k)}_total"
+    # group counters into families: plain names stand alone; "name|k=v"
+    # label-encoded names collapse into one family with labeled samples
+    families: dict[str, list] = {}
+    for k in stats.counters:
+        base, _, labels = k.partition("|")
+        families.setdefault(base, []).append((labels, stats.counters[k]))
+    for base in sorted(families):
+        n = f"gyt_{_name(base)}_total"
         out.append(f"# TYPE {n} counter")
-        out.append(f"{n} {_num(v)}")
+        for labels, v in sorted(families[base]):
+            lab = ""
+            if labels:
+                parts = [f'{_name(kk)}="{vv}"' for kk, _, vv in
+                         (p.partition("=") for p in labels.split(","))]
+                lab = "{" + ",".join(parts) + "}"
+            out.append(f"{n}{lab} {_num(v)}")
 
     if alerts is not None:
         for k in sorted(alerts.stats):

@@ -179,6 +179,15 @@ def ingest_task_sharded(cfg: aggstate.EngineCfg, mesh):
     return jax.jit(_fold, donate_argnums=(0,))
 
 
+def ping_tasks_sharded(cfg: aggstate.EngineCfg, mesh):
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 2,
+             out_specs=P(axes_of(mesh)), check_vma=False)
+    def _fold(st, pb):
+        return _relocal(step.ping_tasks(cfg, _local(st), _local(pb)))
+
+    return jax.jit(_fold, donate_argnums=(0,))
+
+
 def classify_sharded(cfg: aggstate.EngineCfg, mesh):
     """Per-shard 5s classify pass (embarrassingly parallel: each shard
     classifies its own services/hosts — the per-madhava sweep)."""

@@ -119,6 +119,7 @@ class ShardedRuntime:
                                                          self.mesh)
         self._fold_host = sharded.ingest_host_sharded(self.cfg, self.mesh)
         self._fold_task = sharded.ingest_task_sharded(self.cfg, self.mesh)
+        self._fold_ping = sharded.ping_tasks_sharded(self.cfg, self.mesh)
         self._fold_cm = sharded.ingest_cpumem_sharded(self.cfg, self.mesh)
         self._fold_trace = sharded.ingest_trace_sharded(self.cfg,
                                                         self.mesh)
@@ -261,6 +262,12 @@ class ShardedRuntime:
                     decode.task_batch_fast, chunks[0],
                     wire.MAX_TASKS_PER_BATCH))
                 n += len(chunks[0])
+            elif kind == "ping":
+                self.state = self._fold_ping(self.state, self._stack(
+                    decode.ping_batch, chunks[0],
+                    wire.MAX_PINGS_PER_BATCH))
+                n += len(chunks[0])
+                self.stats.bump("task_pings", len(chunks[0]))
             elif kind == "cpumem":
                 self.state = self._fold_cm(self.state, self._stack(
                     decode.cpumem_batch_fast, chunks[0],

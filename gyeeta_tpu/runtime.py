@@ -91,6 +91,9 @@ class Runtime:
         self._fold_task = jax.jit(
             lambda s, b: step.ingest_task(self.cfg, s, b),
             donate_argnums=(0,))
+        self._fold_ping = jax.jit(
+            lambda s, b: step.ping_tasks(self.cfg, s, b),
+            donate_argnums=(0,))
         self._fold_cm = jax.jit(
             lambda s, b: step.ingest_cpumem(self.cfg, s, b),
             donate_argnums=(0,))
@@ -274,6 +277,11 @@ class Runtime:
                 self.state = self._fold_task(self.state, tb)
                 n += len(chunks[0])
                 self.stats.bump("task_records", len(chunks[0]))
+            elif kind == "ping":
+                pb = decode.ping_batch(chunks[0], stats=self.stats)
+                self.state = self._fold_ping(self.state, pb)
+                n += len(chunks[0])
+                self.stats.bump("task_pings", len(chunks[0]))
             elif kind == "cpumem":
                 cmb = decode.cpumem_batch_fast(chunks[0],
                                                stats=self.stats)

@@ -71,7 +71,9 @@ class Daemon:
     async def run(self) -> None:
         host, port = await self.srv.start()
         log.info("gyt-server listening on %s:%d (svc_capacity=%d, "
-                 "n_hosts=%d)", host, port, self.rt.cfg.svc_capacity,
+                 "n_hosts=%d); protocol edges: GYT agent/query, "
+                 "stock partha (PS/PM), stock node webserver (NM)",
+                 host, port, self.rt.cfg.svc_capacity,
                  self.rt.cfg.n_hosts)
         # crash forensics + liveness watchdog (component row 8: the
         # reference's fatal-signal backtraces + scheduler watchdogs)
@@ -126,6 +128,13 @@ class Daemon:
             if eng:
                 log.info("health %s", json.dumps(eng, default=str,
                                                  sort_keys=True))
+            # NM query-edge cadence line: live node conns + per-verb
+            # rates this interval (only when the edge is in use)
+            nm = {k: v for k, v in d.items() if k.startswith("nm_")}
+            if self.srv._nm_conns_live or nm:
+                nm["conns_live"] = self.srv._nm_conns_live
+                log.info("nm %s", json.dumps(nm, default=str,
+                                             sort_keys=True))
             if self._hot:
                 new = self._hot.poll()
                 if new is not self.rt.opts:

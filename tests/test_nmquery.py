@@ -1,0 +1,324 @@
+"""Stock node-webserver (NM conn) query edge: handshake, QUERY_WEB_JSON
+routing, CRUD verbs, chunked streaming, NM/REST JSON parity.
+
+Done-criterion (ISSUE 3): ``sim/nodeweb.py`` completes the NM_CONNECT
+handshake against a booted server with ZERO GYT-specific frames on the
+wire, receives REST-parity JSON for QUERY_WEB_JSON across ≥5
+subsystems, and round-trips a CRUD_ALERT_JSON create→list→delete — on
+both Runtime and ShardedRuntime (the sharded pass compiles mesh
+programs and rides the slow tier).
+Ref: gy_comm_proto.h:887-952 (NM handshake), :246-258 (QUERY_TYPE_E),
+:502,536 (QUERY_CMD/QUERY_RESPONSE), gy_mnodehandle.cc:203 (routing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import refquery as RQ
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
+                conn_batch=128, resp_batch=256, fold_k=2)
+
+# the ≥5 REST-parity subsystems of the acceptance criterion (tcpconn is
+# the node alias for flowstate — exercised separately)
+PARITY_SUBSYS = ("svcstate", "hoststate", "taskstate", "flowstate",
+                 "alerts", "svcsumm")
+
+
+# ------------------------------------------------------- envelope units
+def test_web_json_envelope_translation():
+    q = RQ.web_json_to_query(
+        {"qtype": 3, "options": {"filter": "{ svcstate.nqry5s > 0 }",
+                                 "maxrecs": 7, "sortdir": "asc",
+                                 "sortcol": "qps5s"}})
+    assert q == {"subsys": "svcstate",
+                 "filter": "{ svcstate.nqry5s > 0 }", "maxrecs": 7,
+                 "sortdesc": False, "sortcol": "qps5s"}
+    # string qtypes + node aliases + native pass-through
+    assert RQ.web_json_to_query({"qtype": "tcpconn"})["subsys"] \
+        == "flowstate"
+    assert RQ.web_json_to_query({"subsys": "cpumem"}) \
+        == {"subsys": "cpumem"}
+    with pytest.raises(ValueError):
+        RQ.web_json_to_query({"qtype": 9999})
+    with pytest.raises(ValueError):
+        RQ.web_json_to_query({"qtype": 3, "options": [1]})
+
+
+def test_crud_envelope_family_enforcement():
+    r = RQ.crud_to_request({"optype": "add", "alertname": "x",
+                            "subsys": "svcstate", "filter": "{...}"},
+                           alert=True)
+    assert r["op"] == "add" and r["objtype"] == "alertdef"
+    assert RQ.crud_to_request({"op": "delete", "objtype": "silence",
+                               "name": "s"}, alert=True)["objtype"] \
+        == "silence"
+    with pytest.raises(ValueError):
+        RQ.crud_to_request({"op": "add", "objtype": "tracedef"},
+                           alert=True)
+    with pytest.raises(ValueError):
+        RQ.crud_to_request({"op": "add", "objtype": "alertdef"},
+                           alert=False)
+
+
+def test_query_frame_roundtrip_and_chunking():
+    frame = RQ.encode_query_cmd(41, RQ.REF_QUERY_WEB_JSON,
+                                {"qtype": "svcstate"})
+    hdr = np.frombuffer(frame, RQ.RP.REF_HEADER_DT, count=1)[0]
+    assert int(hdr["magic"]) == RQ.REF_MAGIC_NM
+    assert int(hdr["data_type"]) == RQ.REF_COMM_QUERY_CMD
+    assert int(hdr["total_sz"]) == len(frame)
+    body = frame[RQ._HSZ: len(frame) - int(hdr["padding_sz"])]
+    seqid, qtype, obj = RQ.parse_query_cmd(body)
+    assert (seqid, qtype) == (41, RQ.REF_QUERY_WEB_JSON)
+    assert obj == {"qtype": "svcstate"}
+
+    # a result larger than the chunk size streams as is_completed=0
+    # partials closed by one is_completed=1 frame, re-joining losslessly
+    big = {"recs": [{"x": "y" * 100} for _ in range(100)]}
+    frames = list(RQ.iter_response_frames(7, big, chunk_bytes=1024))
+    assert len(frames) > 3
+    parts, dones = [], []
+    for f in frames:
+        h = np.frombuffer(f, RQ.RP.REF_HEADER_DT, count=1)[0]
+        assert int(h["data_type"]) == RQ.REF_COMM_QUERY_RESP
+        sid, rtyp, done, chunk = RQ.parse_response_chunk(
+            f[RQ._HSZ: len(f) - int(h["padding_sz"])])
+        assert sid == 7 and rtyp == RQ.REF_RESP_WEB_JSON
+        parts.append(chunk)
+        dones.append(done)
+    assert dones == [0] * (len(frames) - 1) + [1]
+    assert json.loads(b"".join(parts)) == big
+
+
+# ------------------------------------------------------------ e2e shared
+def _feed_sim(rt, ticks: int = 2) -> None:
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=7)
+    rt.feed(sim.name_frames())
+    for _ in range(ticks):
+        rt.feed(sim.conn_frames(256) + sim.resp_frames(512)
+                + sim.listener_frames() + sim.task_frames()
+                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                    sim.host_state_records()))
+        rt.run_tick()
+    rt.flush()
+
+
+async def _nm_rest_scenario(rt) -> dict:
+    """Boot server + REST gateway over ``rt``, drive the NM edge via
+    the stock-webserver sim, return everything the assertions need."""
+    from gyeeta_tpu.net import GytServer
+    from gyeeta_tpu.net.webgw import WebGateway
+    from gyeeta_tpu.sim.nodeweb import NMError, NodeWebSim
+
+    srv = GytServer(rt, tick_interval=None)
+    host, port = await srv.start()
+    gw = WebGateway(host, port)
+    gh, gp = await gw.start()
+
+    async def rest_query(req: dict) -> tuple[bytes, dict]:
+        reader, writer = await asyncio.open_connection(gh, gp)
+        body = json.dumps(req).encode()
+        writer.write(
+            b"POST /query HTTP/1.1\r\nHost: t\r\nConnection: close\r\n"
+            + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        await writer.drain()
+        raw = await reader.read(-1)
+        writer.close()
+        head, _, rbody = raw.partition(b"\r\n\r\n")
+        assert b" 200 " in head.splitlines()[0], head
+        return rbody, json.loads(rbody)
+
+    out: dict = {"parity": {}, "raw_equal": {}}
+    nw = NodeWebSim()
+    hs = await nw.connect(host, port)
+    out["handshake"] = hs
+    out["gauge_live"] = rt.stats.gauges.get("nm_conns")
+
+    # REST-parity sweep: identical query dicts down both edges; the NM
+    # side travels the reference envelope (qtype + options)
+    for subsys in PARITY_SUBSYS:
+        req = {"subsys": subsys, "maxrecs": 50}
+        nm_obj = await nw.query_web(subsys, maxrecs=50)
+        rest_raw, rest_obj = await rest_query(req)
+        out["parity"][subsys] = (nm_obj, rest_obj)
+        out["raw_equal"][subsys] = \
+            json.dumps(nm_obj).encode() == rest_raw
+
+    # node qtype codes + the tcpconn alias route to the same engine
+    out["by_code"] = await nw.query_web(QTYPE_SVCSTATE, maxrecs=50)
+    out["tcpconn"] = await nw.query_web("tcpconn", maxrecs=50)
+
+    # CRUD_ALERT_JSON create→list→delete round trip
+    out["crud_add"] = await nw.crud_alert({
+        "op": "add", "objtype": "alertdef", "alertname": "nm-def",
+        "subsys": "svcstate",
+        "filter": "{ svcstate.state in 'Bad','Severe' }"})
+    lst = await nw.query_web("alertdef")
+    out["crud_listed"] = [r["alertname"] for r in lst["recs"]]
+    out["crud_del"] = await nw.crud_alert({
+        "op": "delete", "objtype": "alertdef", "name": "nm-def"})
+    lst2 = await nw.query_web("alertdef")
+    out["crud_after"] = [r["alertname"] for r in lst2["recs"]]
+
+    # CRUD_GENERIC_JSON: tracedef family rides the generic verb
+    out["generic_add"] = await nw.crud_generic({
+        "op": "add", "objtype": "tracedef", "name": "nm-trace",
+        "filter": "{ svcstate.p95resp5s > 1000 }"})
+    out["generic_del"] = await nw.crud_generic({
+        "op": "delete", "objtype": "tracedef", "name": "nm-trace"})
+
+    # error envelope: unknown subsystem comes back as an NM error
+    # response, and the conn SURVIVES it
+    try:
+        await nw.query_web("nosuchsub")
+        out["error"] = None
+    except NMError as e:
+        out["error"] = (str(e), e.errcode)
+    out["after_error"] = await nw.query_web("serverstatus")
+
+    # metrics surface: per-verb labeled counters through the SAME
+    # /metrics exposition both the gateway and query conn serve
+    met = await nw.query_web("metrics")
+    out["metrics_text"] = met["text"]
+
+    await nw.close()
+    await asyncio.sleep(0.05)         # server notices the close
+    out["gauge_after"] = rt.stats.gauges.get("nm_conns")
+    out["counters"] = dict(rt.stats.counters)
+    await gw.stop()
+    await srv.stop()
+    return out
+
+
+QTYPE_SVCSTATE = RQ.QTYPE_OF_SUBSYS["svcstate"]
+
+
+def _assert_scenario(out: dict) -> None:
+    assert out["handshake"]["error_code"] == 0
+    assert out["handshake"]["madhava_name"] == "gyt-tpu"
+    assert out["gauge_live"] == 1
+    # parity: identical JSON down both edges for every subsystem, and
+    # the raw bytes are equal too (same json.dumps of the same dict)
+    for subsys, (nm_obj, rest_obj) in out["parity"].items():
+        assert nm_obj == rest_obj, f"{subsys}: NM != REST"
+        assert out["raw_equal"][subsys], f"{subsys}: bytes differ"
+    assert out["parity"]["svcstate"][0]["nrecs"] == 32   # 8 hosts × 4
+    assert out["parity"]["hoststate"][0]["nrecs"] == 8
+    assert out["parity"]["taskstate"][0]["nrecs"] > 0
+    assert out["parity"]["flowstate"][0]["nrecs"] > 0
+    assert out["by_code"] == out["parity"]["svcstate"][0]
+    assert out["tcpconn"] == out["parity"]["flowstate"][0]
+    # CRUD round trip
+    assert out["crud_add"] == {"ok": True, "objtype": "alertdef",
+                               "name": "nm-def"}
+    assert "nm-def" in out["crud_listed"]
+    assert out["crud_del"]["ok"] is True
+    assert "nm-def" not in out["crud_after"]
+    assert out["generic_add"]["ok"] and out["generic_del"]["ok"]
+    # error envelope carried, conn survived
+    assert out["error"] is not None and out["error"][1] == 400
+    assert out["after_error"]["nrecs"] == 1
+    # observability: labeled per-verb counters + live-conn gauge
+    assert out["counters"]["nm_queries|verb=web_json"] >= 10
+    assert out["counters"]["nm_queries|verb=crud_alert_json"] == 2
+    assert out["counters"]["nm_queries|verb=crud_generic_json"] == 2
+    assert out["counters"]["nm_query_errors"] == 1
+    assert out["gauge_after"] == 0
+    assert 'gyt_nm_queries_total{verb="web_json"}' in out["metrics_text"]
+    assert "gyt_nm_conns 1" in out["metrics_text"]
+
+
+def test_nm_edge_end_to_end_runtime():
+    rt = Runtime(CFG)
+    try:
+        _feed_sim(rt)
+        out = asyncio.run(_nm_rest_scenario(rt))
+        _assert_scenario(out)
+    finally:
+        rt.close()
+
+
+@pytest.mark.slow
+def test_nm_edge_end_to_end_sharded():
+    """The SAME scenario served by a ShardedRuntime behind the same
+    server — the NM edge rides the shared query path, so the mesh tier
+    serves stock node webservers with zero edge-specific code."""
+    from gyeeta_tpu.parallel.mesh import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    srt = ShardedRuntime(CFG, make_mesh(8),
+                         RuntimeOpts(dep_pair_capacity=1024,
+                                     dep_edge_capacity=512))
+    try:
+        _feed_sim(srt)
+        out = asyncio.run(_nm_rest_scenario(srt))
+        _assert_scenario(out)
+    finally:
+        srt.close()
+
+
+def test_nm_handshake_version_gates():
+    """Each gate of the NM handshake rejects with its reference error
+    code; the conn closes after the error response."""
+    from gyeeta_tpu.net import GytServer
+    from gyeeta_tpu.sim.nodeweb import NMError, NodeWebSim
+
+    async def main():
+        rt = Runtime(CFG)
+        srv = GytServer(rt, tick_interval=None)
+        host, port = await srv.start()
+        codes = {}
+        for key, kw in (("comm", dict(comm_version=99)),
+                        ("node", dict(node_version=0x000100)),
+                        ("floor", dict(min_madhava_version=0x990000))):
+            nw = NodeWebSim(**kw)
+            with pytest.raises(NMError) as ei:
+                await nw.connect(host, port)
+            codes[key] = ei.value.errcode
+        assert rt.stats.counters["nm_conns_rejected"] == 3
+        assert "nm_conns_accepted" not in rt.stats.counters
+        await srv.stop()
+        rt.close()
+        return codes
+
+    codes = asyncio.run(main())
+    assert codes == {"comm": 101, "node": 103, "floor": 102}
+
+
+def test_nm_sticky_conn_identity():
+    """Reconnects from the same (hostname, port) node get the same
+    sticky conn id; a different node gets a new one."""
+    from gyeeta_tpu.net import GytServer
+    from gyeeta_tpu.sim.nodeweb import NodeWebSim
+
+    async def main():
+        rt = Runtime(CFG)
+        srv = GytServer(rt, tick_interval=None)
+        host, port = await srv.start()
+        for _ in range(2):                 # same identity twice
+            nw = NodeWebSim(hostname="node-a", node_port=8888)
+            await nw.connect(host, port)
+            await nw.query_web("serverstatus")
+            await nw.close()
+        nw = NodeWebSim(hostname="node-b", node_port=8888)
+        await nw.connect(host, port)
+        await nw.close()
+        ids = {k: st.conn_id for k, st in srv._nm_idents.items()}
+        assert ids[("node-a", 8888)] == 1      # sticky across reconnect
+        assert ids[("node-b", 8888)] == 2
+        assert srv._nm_idents[("node-a", 8888)].n_queries == 2
+        await srv.stop()
+        rt.close()
+
+    asyncio.run(main())

@@ -229,3 +229,117 @@ def test_ref_stream_folds_through_runtime():
     assert {"ref-worker", "ref-batch"} <= comms
     top = rt.query({"subsys": "topcpu"})
     assert top["recs"][0]["comm"] == "ref-worker"
+
+
+# --------------------------------------------------- session lifecycle
+def _task_ping_frame(aggr_ids) -> bytes:
+    recs = np.zeros(len(aggr_ids), RP.REF_PING_TASK_AGGR_DT)
+    recs["aggr_task_id"] = aggr_ids
+    return _ref_frame(RP.REF_NOTIFY_PING_TASK_AGGR, len(aggr_ids),
+                      recs.tobytes())
+
+
+def test_ping_task_aggr_keeps_rows_alive():
+    """Aged-table scenario (the ref PING_TASK_AGGR keepalive,
+    gy_comm_proto.h:1384): a long-lived QUIET group pinged between 5s
+    sweeps survives the ageing sweep; an unpinged group tombstones.
+    Pings for unknown groups never insert."""
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    rt = Runtime(CFG, opts=RuntimeOpts(task_max_age_ticks=3,
+                                       task_age_every_ticks=1))
+    try:
+        buf = _ref_frame(RP.REF_NOTIFY_AGGR_TASK_STATE, 2,
+                         _task_record(0xA1, b"pinged", 5.0)
+                         + _task_record(0xB2, b"quiet", 5.0))
+        gyt, _ = RP.adapt(buf, host_id=1)
+        rt.feed(gyt)
+        out = rt.query({"subsys": "taskstate"})
+        assert {r["comm"] for r in out["recs"]} == {"pinged", "quiet"}
+        n_live0 = int(np.asarray(rt.state.task_tbl.n_live))
+        for _ in range(6):
+            gytp, _ = RP.adapt(
+                _task_ping_frame([0xA1, 0x7777]), host_id=1)
+            rt.feed(gytp)
+            rt.run_tick()
+        out = rt.query({"subsys": "taskstate"})
+        assert [r["comm"] for r in out["recs"]] == ["pinged"]
+        # the unknown-id ping must not have inserted a row
+        assert int(np.asarray(rt.state.task_tbl.n_live)) < n_live0 + 1
+        assert rt.stats.counters.get("task_pings") == 12
+    finally:
+        rt.close()
+
+
+def test_partha_status_liveness_on_session():
+    """PARTHA_STATUS pings are frameless session liveness; an ok→not-ok
+    transition raises exactly one operator notification."""
+    sess = RP.RefSession()
+    st = np.zeros(1, RP.REF_PARTHA_STATUS_DT)
+    st["is_ok"] = 1
+    st["curr_sec"] = 1000
+    gyt, consumed = RP.adapt(
+        _ref_frame(RP.REF_NOTIFY_PARTHA_STATUS, 1, st.tobytes()),
+        host_id=1, session=sess)
+    assert consumed and gyt == b""
+    assert sess.last_status_ok and sess.last_status_sec == 1000
+    assert not sess.notifications
+    st["is_ok"] = 0
+    st["curr_sec"] = 1005
+    for _ in range(2):                 # repeated not-ok: ONE notification
+        RP.adapt(_ref_frame(RP.REF_NOTIFY_PARTHA_STATUS, 1,
+                            st.tobytes()), host_id=1, session=sess)
+    assert not sess.last_status_ok and sess.last_status_sec == 1005
+    assert len([n for n in sess.notifications
+                if "degraded" in n[1]]) == 1
+    assert sess.n_events[RP.REF_NOTIFY_PARTHA_STATUS] == 3
+
+
+# ------------------------------------------------------ ABI compile probe
+def test_abi_compile_probe_offsets_and_sizes():
+    """Every adapted stock struct (ingest + NM query halves) proven
+    against the C++ compiler: offsetof of EVERY field and sizeof of
+    EVERY struct must equal the numpy transcription. Skips with a
+    logged reason when the host has no toolchain."""
+    from gyeeta_tpu.ingest.native import abiprobe
+
+    if abiprobe.toolchain() is None:
+        pytest.skip("abiprobe: no C++ toolchain on this host "
+                    "(GYT_NATIVE_CXX/g++ not found)")
+    structs = abiprobe.probed_structs()
+    layout = abiprobe.run_probe(structs)
+    assert layout is not None
+    bad = abiprobe.compare(layout, structs)
+    assert not bad, "ABI drift:\n  " + "\n  ".join(bad)
+    # the probe covers both protocol halves and is not vacuous
+    assert len(structs) >= 30
+    assert "NM_CONNECT_CMD_S" in layout and "QUERY_CMD_S" in layout
+    nfields = sum(len(dt.names) for dt in structs.values())
+    assert nfields >= 400
+
+
+def test_abi_probe_registry_covers_every_ref_dtype():
+    """Every REF_*_DT dtype defined by the two adapter modules must be
+    registered in the probe table — a new transcription cannot dodge
+    the compile proof."""
+    from gyeeta_tpu.ingest import refquery as RQ
+    from gyeeta_tpu.ingest.native import abiprobe
+
+    probed = {id(dt) for dt in abiprobe.probed_structs().values()}
+    for mod in (RP, RQ):
+        for name in dir(mod):
+            if name.startswith("REF_") and name.endswith("_DT"):
+                dt = getattr(mod, name)
+                assert id(dt) in probed, \
+                    f"{mod.__name__}.{name} missing from abiprobe"
+
+
+def test_nm_layout_sizes_match_reference_abi():
+    from gyeeta_tpu.ingest import refquery as RQ
+
+    assert RQ.REF_NM_CONNECT_CMD_DT.itemsize == 816
+    assert RQ.REF_NM_CONNECT_RESP_DT.itemsize == 880
+    assert RQ.REF_QUERY_CMD_DT.itemsize == 24
+    assert RQ.REF_QUERY_RESPONSE_DT.itemsize == 24
+    assert RP.REF_PING_TASK_AGGR_DT.itemsize == 8
+    assert RP.REF_PARTHA_STATUS_DT.itemsize == 24
