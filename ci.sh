@@ -52,6 +52,16 @@ if ! JAX_PLATFORMS=cpu python _nm_smoke.py; then
     exit 1
 fi
 
+# Chaos smoke: a REAL `serve` subprocess behind the seeded chaos proxy
+# (sim/chaos.py) — corruption/disconnect faults, a slow-loris conn,
+# one SIGTERM kill + --restore-latest restart. Fails on agent exit,
+# non-convergence, an unreaped loris, or unaccounted record loss.
+echo "ci: chaos / fault-injection smoke" >&2
+if ! JAX_PLATFORMS=cpu python _chaos_smoke.py; then
+    echo "ci: FATAL — chaos smoke failed" >&2
+    exit 1
+fi
+
 if [ "$1" = "fast" ]; then
     shift
     exec python -m pytest tests/ -q -m "not slow" "$@"

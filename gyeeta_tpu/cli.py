@@ -10,6 +10,9 @@ dumps the raw Prometheus exposition
 ``python -m gyeeta_tpu nm probe``  — stock node-webserver (NM conn)
 wire probe: handshake + per-subsystem QUERY_WEB_JSON + optional
 alertdef CRUD round trip (``--crud``); ``nm query`` sends one raw body
+``python -m gyeeta_tpu chaos``     — deterministic fault-injection TCP
+proxy between agents and the server (corrupt/truncate/disconnect/stall
++ latency/re-split/kill windows; ``sim/chaos.py``)
 
 The reference splits these across binaries (gymadhava/gyshyama,
 partha, node webserver clients); one Python entry point with
@@ -41,13 +44,15 @@ def _cmd_query(argv) -> None:
                     "stdin")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=10038)
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="per-request deadline (seconds)")
     args = ap.parse_args(argv)
     body = sys.stdin.read() if args.request == "-" else args.request
     req = json.loads(body)
 
     async def run():
         from gyeeta_tpu.net.agent import QueryClient
-        qc = QueryClient()
+        qc = QueryClient(request_timeout=args.timeout)
         await qc.connect(args.host, args.port)
         out = await qc.query(req)
         await qc.close()
@@ -81,23 +86,72 @@ def _cmd_agent(argv) -> None:
     ap.add_argument("--interval", type=float, default=5.0)
     ap.add_argument("--n-conn", type=int, default=256)
     ap.add_argument("--n-resp", type=int, default=512)
+    # supervision knobs (NetAgent.run_forever): the agent process NEVER
+    # exits on a dropped/refused conn — it backs off, keeps producing
+    # sweeps into a bounded spool, and resends on reconnect
+    ap.add_argument("--backoff-base", type=float, default=0.5,
+                    help="first reconnect delay (doubles per failure)")
+    ap.add_argument("--backoff-cap", type=float, default=30.0,
+                    help="max reconnect delay")
+    ap.add_argument("--connect-timeout", type=float, default=15.0,
+                    help="dial deadline per connect attempt")
+    ap.add_argument("--spool-mb", type=float, default=8.0,
+                    help="outage sweep-spool bound (MB, drop-oldest)")
     args = ap.parse_args(argv)
 
     async def run():
         from gyeeta_tpu.net.agent import NetAgent
         agents = [NetAgent(seed=args.seed + i, collect=args.collect,
                            real=args.real, livecap=args.livecap,
-                           cap_ifname=args.cap_ifname)
+                           cap_ifname=args.cap_ifname,
+                           connect_timeout=args.connect_timeout,
+                           spool_max_bytes=int(args.spool_mb * 2**20))
                   for i in range(args.n_agents)]
-        for a in agents:
-            hid = await a.connect(args.host, args.port)
-            print(f"agent {a.seed}: host_id {hid}", file=sys.stderr)
-        while True:
-            for a in agents:
-                await a.send_sweep(args.n_conn, args.n_resp)
-            await asyncio.sleep(args.interval)
+        print(f"supervising {len(agents)} agent(s) -> "
+              f"{args.host}:{args.port}", file=sys.stderr)
+        await asyncio.gather(*(
+            a.run_forever(args.host, args.port,
+                          interval=args.interval, n_conn=args.n_conn,
+                          n_resp=args.n_resp,
+                          backoff_base=args.backoff_base,
+                          backoff_cap=args.backoff_cap)
+            for a in agents))
 
     asyncio.run(run())
+
+
+def _cmd_chaos(argv) -> None:
+    ap = argparse.ArgumentParser(
+        prog="gyeeta_tpu chaos",
+        description="deterministic fault-injection TCP proxy: point "
+        "agents at --listen-port, upstream at the real server; faults "
+        "are seeded + byte-offset keyed (reproducible)")
+    ap.add_argument("--listen-host", default="127.0.0.1")
+    ap.add_argument("--listen-port", type=int, default=10039)
+    ap.add_argument("--upstream-host", default="127.0.0.1")
+    ap.add_argument("--upstream-port", type=int, default=10038)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--faults", default="",
+                    help="comma list of corrupt,truncate,disconnect,"
+                    "stall (empty = pass-through)")
+    ap.add_argument("--mean-fault-kb", type=int, default=256,
+                    help="mean bytes between injected faults (KB)")
+    ap.add_argument("--stall-s", type=float, default=1.0)
+    ap.add_argument("--latency-ms", type=float, default=0.0)
+    ap.add_argument("--jitter-ms", type=float, default=0.0)
+    ap.add_argument("--resplit", type=int, default=0,
+                    help="re-split forwarded chunks to at most this "
+                    "many bytes (0 = off)")
+    ap.add_argument("--kill-at", type=float, default=0.0,
+                    help="seconds after start to open a server-kill "
+                    "window (drop + refuse all conns)")
+    ap.add_argument("--kill-for", type=float, default=0.0,
+                    help="kill-window duration (0 = no window)")
+    ap.add_argument("--report-interval", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    from gyeeta_tpu.sim.chaos import run_proxy
+    asyncio.run(run_proxy(args))
 
 
 def _cmd_replay(argv) -> None:
@@ -296,10 +350,11 @@ def _cmd_web(argv) -> None:
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] in ("query", "agent", "replay", "web", "obs",
-                            "nm"):
+                            "nm", "chaos"):
         return {"query": _cmd_query, "agent": _cmd_agent,
                 "replay": _cmd_replay, "web": _cmd_web,
-                "obs": _cmd_obs, "nm": _cmd_nm}[argv[0]](argv[1:])
+                "obs": _cmd_obs, "nm": _cmd_nm,
+                "chaos": _cmd_chaos}[argv[0]](argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]
     from gyeeta_tpu.server_main import main as serve_main

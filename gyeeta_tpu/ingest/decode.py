@@ -675,6 +675,9 @@ def drain_chunks(recs: dict, conn_batch: int, resp_batch: int,
     if png is not None:
         for i in range(0, len(png), wire.MAX_PINGS_PER_BATCH):
             yield ("ping", png[i:i + wire.MAX_PINGS_PER_BATCH])
+    ast = recs.get(wire.NOTIFY_AGENT_STATS)
+    if ast is not None:
+        yield ("agent_stats", ast)
     nm = recs.get(wire.NOTIFY_NAME_INTERN)
     if nm is not None:
         yield ("names", nm)

@@ -40,8 +40,15 @@ _lib = None
 _load_failed = False
 
 _ERRNAMES = {1: "bad magic", 2: "bad total_sz", 3: "batch cap exceeded",
-             4: "nevents overflows frame", 5: "output buffer full",
-             6: "bad subtype table"}
+             4: "nevents does not fill frame", 5: "output buffer full",
+             6: "bad subtype table",
+             7: "unexpected data_type on event stream",
+             8: "payload checksum mismatch"}
+# rc → FrameError.reason (the frames_rejected|reason=... label values;
+# identical to the labels the pure-Python decoder raises with)
+_ERRREASON = {1: "bad_magic", 2: "bad_size", 3: "bad_size",
+              4: "bad_size", 6: "bad_frame", 7: "bad_dtype",
+              8: "checksum"}
 
 # drain() output ordering; derived from wire.py, never hand-maintained
 _SCAN_ORDER = tuple(sorted(wire.DTYPE_OF_SUBTYPE))
@@ -102,6 +109,12 @@ def _bind_and_handshake(lib):
         ctypes.POINTER(ctypes.c_void_p), i64p, i64p, i64p]
     lib.gyt_scan.restype = ctypes.c_int32
     lib.gyt_scan.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p, i64p]
+    # sizing scan that also counts records in skipped unknown-subtype
+    # frames (chaos-tier loss accounting); a .so predating the symbol
+    # fails the bind here and the loader falls back to pure Python
+    lib.gyt_scan2.restype = ctypes.c_int32
+    lib.gyt_scan2.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p,
+                              i64p, i64p]
     lib.gyt_layout.restype = ctypes.c_int32
     lib.gyt_layout.argtypes = [i64p, ctypes.c_int64]
     # push the subtype table from wire.py (single source of truth) ...
@@ -325,21 +338,35 @@ def decode_conn(recs, size: int):
 
 def drain(buf: bytes) -> tuple[dict, int]:
     """byte stream → ({subtype: structured record array}, consumed).
+    Thin wrapper over :func:`drain2` for callers that don't need the
+    unknown-subtype record count."""
+    out, consumed, _unknown = drain2(buf)
+    return out, consumed
+
+
+def drain2(buf: bytes) -> tuple[dict, int, int]:
+    """byte stream → ({subtype: record array}, consumed, unknown_recs).
 
     Native path when built; identical semantics to the Python decoder
     (validation errors raise wire.FrameError either way). Two passes
     total: one sizing scan, then ONE frame walk that appends every
     subtype's records into its preallocated array (gyt_extract_multi).
+    ``unknown_recs`` counts records claimed by skipped unknown-subtype
+    frames — the feed path attributes them to a counter so a corrupted
+    subtype byte is accounted loss, never silent loss.
     """
     lib = _load()
     if lib is None:
-        return _drain_py(buf)
+        return _drain_py2(buf)
     n = len(_SCAN_ORDER)
     counts = (ctypes.c_int64 * n)()
     consumed = ctypes.c_int64()
-    rc = lib.gyt_scan(buf, len(buf), counts, ctypes.byref(consumed))
+    unknown = ctypes.c_int64()
+    rc = lib.gyt_scan2(buf, len(buf), counts, ctypes.byref(consumed),
+                       ctypes.byref(unknown))
     if rc != 0:
-        raise wire.FrameError(f"native scan: {_ERRNAMES.get(rc, rc)}")
+        raise wire.FrameError(f"native scan: {_ERRNAMES.get(rc, rc)}",
+                              reason=_ERRREASON.get(rc, "bad_frame"))
     out: dict = {}
     outs = (ctypes.c_void_p * n)()
     caps = (ctypes.c_int64 * n)()
@@ -354,20 +381,28 @@ def drain(buf: bytes) -> tuple[dict, int]:
         caps[i] = rec.nbytes
         nonempty = True
     if not nonempty:
-        return out, int(consumed.value)
+        return out, int(consumed.value), int(unknown.value)
     c2 = ctypes.c_int64()
     rc = lib.gyt_extract_multi(buf, len(buf), outs, caps, nrec,
                                ctypes.byref(c2))
     if rc != 0:
-        raise wire.FrameError(f"native extract: {_ERRNAMES.get(rc, rc)}")
+        raise wire.FrameError(f"native extract: {_ERRNAMES.get(rc, rc)}",
+                              reason=_ERRREASON.get(rc, "bad_frame"))
     for i, subtype in enumerate(_SCAN_ORDER):
         if counts[i]:
             assert nrec[i] == counts[i], (subtype, nrec[i], counts[i])
-    return out, int(consumed.value)
+    return out, int(consumed.value), int(unknown.value)
 
 
 def _drain_py(buf: bytes) -> tuple[dict, int]:
-    frames, consumed = wire.decode_frames(buf)
+    out, consumed, _unknown = _drain_py2(buf)
+    return out, consumed
+
+
+def _drain_py2(buf: bytes) -> tuple[dict, int, int]:
+    cnt: dict = {}
+    frames, consumed = wire.decode_frames(buf, counts=cnt,
+                                          event_only=True)
     out: dict = {}
     for subtype, recs in frames:
         if not len(recs):
@@ -376,4 +411,4 @@ def _drain_py(buf: bytes) -> tuple[dict, int]:
             out[subtype] = np.concatenate([out[subtype], recs])
         else:
             out[subtype] = recs.copy()
-    return out, consumed
+    return out, consumed, cnt.get("unknown_records", 0)

@@ -76,7 +76,23 @@ enum GytErr : int32_t {
   GYT_NEV_OVERFLOW = 4,
   GYT_OUT_FULL = 5,
   GYT_BAD_TABLE = 6,
+  GYT_BAD_DTYPE = 7,   // non-EVENT frame on the event stream (the feed
+                       // path carries EVENT_NOTIFY only — anything else
+                       // is a corrupted data_type byte, and skipping it
+                       // would be silent record loss)
+  GYT_BAD_CHECKSUM = 8,  // flagged frame's XOR payload fold mismatched
 };
+
+// padding_sz bit 31 flags a payload checksum in bits 8..15 (wire.py
+// CHK_FLAG): XOR fold of every byte after the 16B header. Verified in
+// the sizing scan (one extra read pass; the extract pass trusts it).
+constexpr uint32_t CHK_FLAG = 0x80000000u;
+
+inline uint8_t xor_fold(const uint8_t* p, int64_t n) {
+  uint8_t x = 0;
+  for (int64_t i = 0; i < n; i++) x ^= p[i];  // -O3 vectorizes this
+  return x;
+}
 
 }  // namespace
 
@@ -142,9 +158,12 @@ int32_t gyt_extract(const uint8_t* buf, int64_t len, uint32_t subtype,
       const SubtypeInfo* si = info_of(ev.subtype);
       if (si != nullptr) {
         if (ev.nevents > si->cap) return GYT_CAP_EXCEEDED;
+        // EXACT sizing: producers frame records tightly, so any slack
+        // or overflow means a corrupted nevents — reject it (counted)
+        // instead of silently decoding fewer records than were sent
         const int64_t need =
             HDR_SZ + EV_SZ + static_cast<int64_t>(ev.nevents) * si->itemsize;
-        if (need > total) return GYT_NEV_OVERFLOW;
+        if (need != total) return GYT_NEV_OVERFLOW;
         if (ev.subtype == subtype && ev.nevents > 0) {
           const int64_t nbytes =
               static_cast<int64_t>(ev.nevents) * si->itemsize;
@@ -162,6 +181,8 @@ int32_t gyt_extract(const uint8_t* buf, int64_t len, uint32_t subtype,
         }
       }
       // unknown subtypes skipped (forward compat)
+    } else {
+      return GYT_BAD_DTYPE;  // event stream carries EVENT_NOTIFY only
     }
     off += total;
   }
@@ -357,7 +378,7 @@ int32_t gyt_extract_multi(const uint8_t* buf, int64_t len,
         if (ev.nevents > si.cap) return GYT_CAP_EXCEEDED;
         const int64_t nbytes =
             static_cast<int64_t>(ev.nevents) * si.itemsize;
-        if (HDR_SZ + EV_SZ + nbytes > total) return GYT_NEV_OVERFLOW;
+        if (HDR_SZ + EV_SZ + nbytes != total) return GYT_NEV_OVERFLOW;
         if (ev.nevents > 0) {
           if (outs[idx] == nullptr ||
               written[idx] + nbytes > out_caps[idx]) {
@@ -371,6 +392,8 @@ int32_t gyt_extract_multi(const uint8_t* buf, int64_t len,
         }
       }
       // unknown subtypes skipped (forward compat)
+    } else {
+      return GYT_BAD_DTYPE;  // event stream carries EVENT_NOTIFY only
     }
     off += total;
   }
@@ -380,11 +403,16 @@ int32_t gyt_extract_multi(const uint8_t* buf, int64_t len,
 
 // Count frames + records per subtype without copying (sizing pass).
 // counts: array of g_ntypes int64, in gyt_set_table order.
-int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
-                 int64_t* consumed) {
+// *unknown_records counts records claimed by EVENT frames of UNKNOWN
+// subtype (forward compat / corrupted subtype byte): they are skipped,
+// but the skip must be COUNTABLE — silent loss breaks the chaos tier's
+// delivery accounting.
+int32_t gyt_scan2(const uint8_t* buf, int64_t len, int64_t* counts,
+                  int64_t* consumed, int64_t* unknown_records) {
   int64_t off = 0;
   for (int32_t i = 0; i < g_ntypes; i++) counts[i] = 0;
   *consumed = 0;
+  *unknown_records = 0;
   while (off + HDR_SZ <= len) {
     Header h;
     std::memcpy(&h, buf + off, sizeof(h));
@@ -394,6 +422,10 @@ int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
     if (total < HDR_SZ + EV_SZ || total >= MAX_COMM_DATA_SZ)
       return GYT_BAD_TOTAL;
     if (off + total > len) break;
+    if ((h.padding_sz & CHK_FLAG) &&
+        xor_fold(buf + off + HDR_SZ, total - HDR_SZ) !=
+            static_cast<uint8_t>(h.padding_sz >> 8))
+      return GYT_BAD_CHECKSUM;
     if (h.data_type == COMM_EVENT_NOTIFY) {
       EventNotify ev;
       std::memcpy(&ev, buf + off + HDR_SZ, sizeof(ev));
@@ -402,14 +434,24 @@ int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
         if (ev.nevents > g_table[idx].cap) return GYT_CAP_EXCEEDED;
         const int64_t need = HDR_SZ + EV_SZ +
             static_cast<int64_t>(ev.nevents) * g_table[idx].itemsize;
-        if (need > total) return GYT_NEV_OVERFLOW;
+        if (need != total) return GYT_NEV_OVERFLOW;
         counts[idx] += ev.nevents;
+      } else {
+        *unknown_records += ev.nevents;
       }
+    } else {
+      return GYT_BAD_DTYPE;  // event stream carries EVENT_NOTIFY only
     }
     off += total;
   }
   *consumed = off;
   return GYT_OK;
+}
+
+int32_t gyt_scan(const uint8_t* buf, int64_t len, int64_t* counts,
+                 int64_t* consumed) {
+  int64_t unknown = 0;
+  return gyt_scan2(buf, len, counts, consumed, &unknown);
 }
 
 }  // extern "C"

@@ -65,11 +65,13 @@ class FeedPipeline:
         t0 = time.perf_counter()
         data = (self._pending + buf) if self._pending else buf
         try:
-            recs, consumed = native.drain(data)
+            recs, consumed, unknown = native.drain2(data)
         except wire.FrameError:
             self._pending = b""          # poison frame: resync
             raise
         self._pending = data[consumed:]
+        if unknown:
+            self._rt.stats.bump("records_unknown_subtype", unknown)
         return buf, recs, (time.perf_counter() - t0) * 1e3
 
     def _fold_one(self) -> int:

@@ -62,6 +62,11 @@ NOTIFY_NETIF_STATE = 22       # net interface inventory + traffic rates
 NOTIFY_TASK_PING = 23         # process-group keepalive (no stats; the
 #                               ref PING_TASK_AGGR, gy_comm_proto.h:1384
 #                               — refreshes ageing, never inserts)
+NOTIFY_AGENT_STATS = 24       # agent self-report: spool drops/resends +
+#                               connect timeouts since the last report —
+#                               delivery-continuity accounting the server
+#                               folds into its own selfstats registry so
+#                               /metrics shows fleet-wide loss counters
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -213,6 +218,22 @@ TASK_PING_DT = np.dtype([
 ])
 
 MAX_PINGS_PER_BATCH = 2048     # ref PING_TASK_AGGR::MAX_NUM_PINGS
+
+# AGENT_STATS record — agent-side delivery-continuity counters reported
+# as DELTAS after each reconnect (the agent is the only process that can
+# see its own spool drops; the server folds the deltas into monotone
+# counters so ``gyt_spool_dropped_total`` renders in /metrics with the
+# rest of the robustness surface).
+AGENT_STATS_DT = np.dtype([
+    ("host_id", "<u4"),
+    ("spool_dropped", "<u4"),          # sweeps evicted from a full spool
+    ("spool_dropped_records", "<u4"),  # records inside those sweeps
+    ("spool_resent", "<u4"),           # spooled sweeps resent on reconnect
+    ("connect_timeouts", "<u4"),       # dial deadlines that fired
+    ("pad", "<u4"),
+])
+
+MAX_AGENT_STATS_PER_BATCH = 64
 
 # CPU_MEM_STATE record — the 2s host cpu/mem path (field content of
 # CPU_MEM_STATE_NOTIFY, gy_comm_proto.h:2024: cpu pcts, context switches,
@@ -417,6 +438,7 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_MOUNT_STATE: MOUNT_DT,
     NOTIFY_NETIF_STATE: NETIF_DT,
     NOTIFY_TASK_PING: TASK_PING_DT,
+    NOTIFY_AGENT_STATS: AGENT_STATS_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -436,6 +458,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_MOUNT_STATE: MAX_MOUNTS_PER_BATCH,
     NOTIFY_NETIF_STATE: MAX_NETIF_PER_BATCH,
     NOTIFY_TASK_PING: MAX_PINGS_PER_BATCH,
+    NOTIFY_AGENT_STATS: MAX_AGENT_STATS_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -450,7 +473,8 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("LISTENER_INFO_DT", LISTENER_INFO_DT),
                    ("HOST_INFO_DT", HOST_INFO_DT),
                    ("CGROUP_DT", CGROUP_DT),
-                   ("TASK_PING_DT", TASK_PING_DT)]:
+                   ("TASK_PING_DT", TASK_PING_DT),
+                   ("AGENT_STATS_DT", AGENT_STATS_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 
@@ -532,6 +556,25 @@ MAX_OUTSTANDING_QUERIES = 64     # per conn (global 4K analogue)
 # (gy_msg_comm.h buffer discipline); 1MB chunks keep frames well under
 # the 16MB frame cap with room for framing
 QUERY_CHUNK_BYTES = 1 << 20
+
+
+# ------------------------------------------------------------ integrity
+# EVENT frames carry an XOR-fold payload checksum riding the unused
+# upper bits of ``padding_sz`` (legit pad is 0..7): bit 31 flags
+# presence, bits 8..15 hold the fold of every byte after the 16B
+# header. TCP guarantees are per-hop, not end-to-end through proxies /
+# buggy middleware — and the chaos tier proves a single flipped payload
+# byte would otherwise fold GARBAGE into the engine silently (phantom
+# hosts from a corrupted host_id). An XOR fold detects every single-byte
+# corruption; flagless frames (old captures, control frames) skip
+# verification, so the format stays backward compatible.
+CHK_FLAG = 0x80000000
+_CHK_SHIFT = 8
+
+
+def _xor8(b) -> int:
+    a = np.frombuffer(b, np.uint8)
+    return int(np.bitwise_xor.reduce(a)) if a.size else 0
 
 
 def _frame(data_type: int, payload: bytes, magic: int) -> bytes:
@@ -646,11 +689,14 @@ def encode_frame(subtype: int, records: np.ndarray,
     hdr["magic"] = magic
     hdr["total_sz"] = total          # records are 8-aligned → no padding
     hdr["data_type"] = COMM_EVENT_NOTIFY
-    hdr["padding_sz"] = 0
     ev = np.zeros((), EVENT_NOTIFY_DT)
     ev["subtype"] = subtype
     ev["nevents"] = len(records)
-    return hdr.tobytes() + ev.tobytes() + payload
+    ev_b = ev.tobytes()
+    # pad 0 + checksum of everything after the header (see CHK_FLAG)
+    hdr["padding_sz"] = CHK_FLAG | (
+        (_xor8(ev_b) ^ _xor8(payload)) << _CHK_SHIFT)
+    return hdr.tobytes() + ev_b + payload
 
 
 def encode_frames_chunked(subtype: int, records: np.ndarray,
@@ -664,7 +710,13 @@ def encode_frames_chunked(subtype: int, records: np.ndarray,
 
 
 class FrameError(ValueError):
-    pass
+    """Corrupt / hostile framing. ``reason`` is a short machine label
+    (``bad_magic`` / ``bad_size`` / ``truncated`` / ``bad_frame``) the
+    server attributes rejects to (``frames_rejected|reason=...``)."""
+
+    def __init__(self, msg: str, reason: str = "bad_frame"):
+        super().__init__(msg)
+        self.reason = reason
 
 
 import struct as _struct  # noqa: E402
@@ -689,24 +741,118 @@ def complete_prefix(buf: bytes) -> int:
     while off + hsz <= n:
         magic, total = unpack(buf, off)
         if magic not in magics:
-            raise FrameError(f"bad magic {magic:#x} at {off}")
+            raise FrameError(f"bad magic {magic:#x} at {off}",
+                             reason="bad_magic")
         # same bound as decode_frames — a frame this walk accepts must
         # never be one the decoders reject at the header
         if total < hsz + esz or total >= MAX_COMM_DATA_SZ:
-            raise FrameError(f"bad total_sz {total} at {off}")
+            raise FrameError(f"bad total_sz {total} at {off}",
+                             reason="bad_size")
         if off + total > n:
             break
         off += total
     return off
 
 
-def decode_frames(buf: bytes):
+def count_events(buf: bytes) -> int:
+    """Total EVENT_NOTIFY records across the complete frames of ``buf``
+    (header walk only — payloads untouched). The spool/loss-accounting
+    helper: agents count what a sweep carries before spooling it, so a
+    dropped sweep's records can be attributed, not silently lost."""
+    n = 0
+    off = 0
+    ln = len(buf)
+    hsz = HEADER_DT.itemsize
+    esz = EVENT_NOTIFY_DT.itemsize
+    while off + hsz <= ln:
+        _magic, total = _HDR_PREFIX_UNPACK(buf, off)
+        if total < hsz or off + total > ln:
+            break
+        dtype = int.from_bytes(buf[off + 8: off + 12], "little")
+        if dtype == COMM_EVENT_NOTIFY and total >= hsz + esz:
+            n += int.from_bytes(buf[off + hsz + 4: off + hsz + 8],
+                                "little")
+        off += total
+    return n
+
+
+async def read_frame(reader, first: bytes = b"",
+                     timeout=None) -> tuple[int, bytes]:
+    """THE validated async frame reader → ``(data_type, payload)``.
+
+    Shared by the agent and the server (one validation discipline on
+    both ends of the wire): magic gate, ``total_sz`` bounds (a corrupt
+    header can neither hang ``readexactly`` on a multi-MB read nor
+    crash it on a short one) and ``padding_sz`` bounds, all checked
+    BEFORE the body read. ``first`` carries bytes already peeked off
+    the stream. Raises :class:`FrameError` (with a ``reason``) on a
+    poison or truncated header, ``asyncio.IncompleteReadError`` on a
+    clean EOF at a frame boundary, and ``asyncio.TimeoutError`` when
+    ``timeout`` (whole-frame deadline, seconds) expires."""
+    if timeout is not None:
+        import asyncio
+        return await asyncio.wait_for(_read_frame(reader, first), timeout)
+    return await _read_frame(reader, first)
+
+
+async def _read_frame(reader, first: bytes = b"") -> tuple[int, bytes]:
+    import asyncio
+    hsz = HEADER_DT.itemsize
+    try:
+        hdr_b = first + await reader.readexactly(hsz - len(first))
+    except asyncio.IncompleteReadError as e:
+        if first or e.partial:
+            raise FrameError(
+                f"truncated header ({len(first) + len(e.partial)}"
+                f"/{hsz} bytes at EOF)", reason="truncated") from e
+        raise                    # clean EOF at a frame boundary
+    hdr = np.frombuffer(hdr_b, HEADER_DT, count=1)[0]
+    magic = int(hdr["magic"])
+    if magic not in (MAGIC_PM, MAGIC_MS, MAGIC_NQ):
+        raise FrameError(f"bad magic {magic:#x}", reason="bad_magic")
+    total = int(hdr["total_sz"])
+    if total < hsz or total >= MAX_COMM_DATA_SZ:
+        raise FrameError(f"bad total_sz {total}", reason="bad_size")
+    padf = int(hdr["padding_sz"])
+    pad = padf & 0xFF                # upper bits carry the checksum
+    if pad > total - hsz:
+        raise FrameError(f"bad padding_sz {pad} (total_sz {total})",
+                         reason="bad_size")
+    try:
+        body = await reader.readexactly(total - hsz)
+    except asyncio.IncompleteReadError as e:
+        raise FrameError(
+            f"truncated frame body ({len(e.partial)}/{total - hsz} "
+            f"bytes at EOF)", reason="truncated") from e
+    if padf & CHK_FLAG and \
+            _xor8(body) != (padf >> _CHK_SHIFT) & 0xFF:
+        raise FrameError("payload checksum mismatch", reason="checksum")
+    return int(hdr["data_type"]), body[: len(body) - pad]
+
+
+def decode_frames(buf: bytes, counts: Optional[dict] = None,
+                  event_only: bool = False):
     """Parse a byte stream of frames → list of (subtype, structured array).
 
     Returns (frames, bytes_consumed): a trailing partial frame is left for
     the caller to resume with more bytes — the batched analogue of the
     partial-read resume in the reference's epoll conntrack
     (``common/gy_epoll_conntrack.h``).
+
+    Hardening (the feed-path contract; mirrored bit-for-bit by the
+    native deframer):
+    - known subtypes enforce EXACT sizing (``nevents·itemsize`` must
+      fill the frame) — slack means a corrupted ``nevents``, rejected
+      loudly rather than silently decoding fewer records than sent;
+    - frames flagged with :data:`CHK_FLAG` verify the XOR payload
+      checksum (a flipped byte in flight is a counted reject, not
+      garbage folded into the engine);
+    - with ``counts`` given, records claimed by skipped
+      unknown-subtype frames accumulate under
+      ``counts["unknown_records"]`` (countable, not silent);
+    - with ``event_only=True`` (the event-conn feed path) a non-EVENT
+      ``data_type`` raises instead of skipping — nothing else belongs
+      on that stream, so it is a corrupted byte.
     """
     frames = []
     off = 0
@@ -716,12 +862,20 @@ def decode_frames(buf: bytes):
     while off + hsz <= n:
         hdr = np.frombuffer(buf, HEADER_DT, count=1, offset=off)[0]
         if hdr["magic"] not in (MAGIC_PM, MAGIC_MS, MAGIC_NQ):
-            raise FrameError(f"bad magic {hdr['magic']:#x} at {off}")
+            raise FrameError(f"bad magic {hdr['magic']:#x} at {off}",
+                             reason="bad_magic")
         total = int(hdr["total_sz"])
         if total < hsz + esz or total >= MAX_COMM_DATA_SZ:
-            raise FrameError(f"bad total_sz {total} at {off}")
+            raise FrameError(f"bad total_sz {total} at {off}",
+                             reason="bad_size")
         if off + total > n:
             break  # partial frame
+        padf = int(hdr["padding_sz"])
+        if padf & CHK_FLAG and \
+                _xor8(buf[off + hsz: off + total]) \
+                != (padf >> _CHK_SHIFT) & 0xFF:
+            raise FrameError(f"payload checksum mismatch at {off}",
+                             reason="checksum")
         if hdr["data_type"] == COMM_EVENT_NOTIFY:
             ev = np.frombuffer(buf, EVENT_NOTIFY_DT, 1, off + hsz)[0]
             subtype = int(ev["subtype"])
@@ -731,13 +885,29 @@ def decode_frames(buf: bytes):
                 if nev > MAX_OF_SUBTYPE[subtype]:
                     raise FrameError(
                         f"nevents {nev} > cap {MAX_OF_SUBTYPE[subtype]} "
-                        f"for subtype {subtype} at {off}")
+                        f"for subtype {subtype} at {off}",
+                        reason="bad_size")
                 need = hsz + esz + nev * dt.itemsize
-                if need > total:
+                if need != total:
                     raise FrameError(
-                        f"nevents {nev} overflows frame at {off}")
+                        f"nevents {nev} does not fill frame at {off} "
+                        f"(need {need}, total {total})",
+                        reason="bad_size")
                 recs = np.frombuffer(buf, dt, nev, off + hsz + esz)
                 frames.append((subtype, recs))
-            # unknown subtypes skipped (forward compat, ref version gates)
+            else:
+                # unknown subtypes skipped (forward compat, ref version
+                # gates) — but COUNTED when the caller asks: a skipped
+                # frame's records must never be silent loss
+                if counts is not None:
+                    counts["unknown_records"] = \
+                        counts.get("unknown_records", 0) + nev
+        elif event_only:
+            # the event stream carries EVENT_NOTIFY frames only — any
+            # other data_type there is a corrupted byte, and skipping
+            # it would silently lose the frame's records
+            raise FrameError(
+                f"unexpected data_type {int(hdr['data_type'])} on the "
+                f"event stream at {off}", reason="bad_dtype")
         off += total
     return frames, off

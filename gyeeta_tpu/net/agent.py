@@ -10,13 +10,26 @@ host_id (sticky placement), and the agent resends its name announcements
 (the resend-inventory-on-reconnect recovery of the reference,
 ``gy_socket_stat.h:1235``).
 
+:meth:`NetAgent.run_forever` is the supervision tier (the parmon
+respawn loop of the reference, ``gypartha.cc:965``, collapsed into the
+agent itself): jittered exponential-backoff reconnects, sweeps KEEP
+being produced on cadence during an outage and buffer in a bounded
+spool (drop-oldest, every drop counted), and the spool resends on
+reconnect — at-least-once delivery of sweeps within the spool bound,
+with agent-side counters (``stats``) reported to the server as
+NOTIFY_AGENT_STATS deltas so fleet-wide loss renders in /metrics.
+
 QueryClient is the Node-webserver peer: a query-role conn multiplexing
-JSON queries by seqid.
+JSON queries by seqid. Both clients dial and read under deadlines — a
+wedged server yields a clear timeout error plus a counter, never an
+infinite hang.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
+import random
 from typing import Optional
 
 import numpy as np
@@ -25,17 +38,19 @@ from gyeeta_tpu import version
 from gyeeta_tpu.ingest import wire
 from gyeeta_tpu.sim.partha import ParthaSim
 from gyeeta_tpu.utils import hashing as H
+from gyeeta_tpu.utils.selfstats import Stats
 
 _HSZ = wire.HEADER_DT.itemsize
 
+# one validated reader on both ends of the wire (ingest/wire.py): magic
+# gate + total_sz/padding bounds before any body read — a corrupt header
+# can neither hang readexactly on a multi-MB read nor crash a short one
+_read_frame = wire.read_frame
 
-async def _read_frame(reader) -> tuple[int, bytes]:
-    hdr_b = await reader.readexactly(_HSZ)
-    hdr = np.frombuffer(hdr_b, wire.HEADER_DT, count=1)[0]
-    total = int(hdr["total_sz"])
-    body = await reader.readexactly(total - _HSZ)
-    pad = int(hdr["padding_sz"])
-    return int(hdr["data_type"]), body[: len(body) - pad]
+# errors that mean "the conn is gone / unusable" to a supervised client
+_CONN_ERRORS = (ConnectionError, OSError, EOFError,
+                asyncio.IncompleteReadError, wire.FrameError,
+                asyncio.TimeoutError, TimeoutError)
 
 
 async def register(host: str, port: int, machine_id: int, conn_type: int,
@@ -43,11 +58,16 @@ async def register(host: str, port: int, machine_id: int, conn_type: int,
                    hostname_id: int = 0):
     """Open + register one conn → (reader, writer, status, host_id)."""
     reader, writer = await asyncio.open_connection(host, port)
-    writer.write(wire.encode_register_req(
-        machine_id, conn_type, wire_version, hostname_id))
-    await writer.drain()
-    dtype, payload = await _read_frame(reader)
+    try:
+        writer.write(wire.encode_register_req(
+            machine_id, conn_type, wire_version, hostname_id))
+        await writer.drain()
+        dtype, payload = await _read_frame(reader)
+    except BaseException:
+        writer.close()
+        raise
     if dtype != wire.COMM_REGISTER_RESP:
+        writer.close()
         raise wire.FrameError(f"expected REGISTER_RESP, got {dtype}")
     resp = np.frombuffer(payload, wire.REGISTER_RESP_DT, count=1)[0]
     return reader, writer, int(resp["status"]), int(resp["host_id"])
@@ -67,7 +87,10 @@ class NetAgent:
                  n_svcs: int = 4, n_groups: int = 6,
                  wire_version: int = version.CURR_WIRE_VERSION,
                  collect: bool = False, real: bool = False,
-                 livecap: bool = False, cap_ifname: str = "lo"):
+                 livecap: bool = False, cap_ifname: str = "lo",
+                 connect_timeout: float = 15.0,
+                 spool_max_bytes: int = 8 << 20,
+                 resend_last: int = 2):
         self.machine_id = machine_id if machine_id is not None \
             else H.hash_bytes_np(f"sim-agent-{seed}".encode())
         self.seed = seed
@@ -105,9 +128,48 @@ class NetAgent:
         # svc glob ids with capture enabled by the server (REQ_TRACE_SET
         # analogue); empty = no tracing
         self.trace_enabled: set = set()
+        # ---- delivery continuity (the supervised-reconnect tier)
+        # dial deadline: a wedged server must yield a clear timeout
+        # error + counter, never an infinite hang
+        self.connect_timeout = connect_timeout
+        # agent-side self-metrics: reconnects, spool drops/resends,
+        # records built/sent — the loss-accounting surface; deltas are
+        # reported to the server as NOTIFY_AGENT_STATS on reconnect
+        self.stats = Stats()
+        # bounded sweep spool: sweeps produced during an outage buffer
+        # here (oldest first) and resend on reconnect; drop-oldest when
+        # past spool_max_bytes, every drop counted (sweeps AND records)
+        self.spool_max_bytes = spool_max_bytes
+        self._spool: collections.deque = collections.deque()
+        self._spool_bytes = 0
+        # recently-sent sweeps held unconfirmed: a write into a dying
+        # socket "succeeds" into the kernel buffer, so the last few
+        # sweeps respool on conn loss (at-least-once; duplicates are
+        # fold noise, silent loss is not)
+        self._unconfirmed: collections.deque = collections.deque(
+            maxlen=max(1, resend_last))
+        self._stats_reported: dict = {}
+        # set by the control-loop reader the moment the conn's read
+        # half hits EOF/reset — the supervisor's fast-fail signal
+        self._conn_dead = False
 
-    async def connect(self, host: str, port: int) -> int:
-        """Register the event conn; returns assigned host_id."""
+    async def connect(self, host: str, port: int,
+                      timeout: Optional[float] = None) -> int:
+        """Register the event conn under a dial deadline; returns the
+        assigned host_id. Raises ``ConnectionError`` with a clear
+        message (and bumps ``connect_timeouts``) when the deadline
+        fires against a wedged server."""
+        t = self.connect_timeout if timeout is None else timeout
+        try:
+            return await asyncio.wait_for(self._connect(host, port), t)
+        except (asyncio.TimeoutError, TimeoutError):
+            self.stats.bump("connect_timeouts")
+            self._drop_conn()     # _connect may have died mid-bring-up
+            raise ConnectionError(
+                f"agent connect to {host}:{port} timed out "
+                f"after {t:.1f}s") from None
+
+    async def _connect(self, host: str, port: int) -> int:
         # the server re-applies capture state from scratch on reconnect
         # (forget_host → full re-push of current targets only); stale
         # local enables from before the drop must not survive it — and
@@ -117,6 +179,7 @@ class NetAgent:
             self._ctrl_task.cancel()
             self._ctrl_task = None
         self.trace_enabled.clear()
+        self._conn_dead = False
         hostname_id = self.machine_id & 0xFFFFFFFF
         reader, writer, status, hid = await register(
             host, port, self.machine_id, wire.CONN_EVENT,
@@ -126,11 +189,15 @@ class NetAgent:
             raise ConnectionRefusedError(f"registration status {status}")
         self.host_id = hid
         self._writer = writer
-        # a fresh 1-host sim rooted at the assigned global host index —
-        # glob_ids/task_ids derive from it, so streams are fleet-unique
-        self.sim = ParthaSim(
-            n_hosts=1, n_svcs=self.n_svcs, n_groups=self.n_groups,
-            seed=1000 + hid, host_base=hid)
+        # a 1-host sim rooted at the assigned global host index —
+        # glob_ids/task_ids derive from it, so streams are fleet-unique.
+        # Sticky reconnects (same hid) KEEP the sim: telemetry produced
+        # during the outage stays continuous instead of replaying from
+        # the seed (the reference agent keeps collecting while down)
+        if self.sim is None or self.sim.host_base != hid:
+            self.sim = ParthaSim(
+                n_hosts=1, n_svcs=self.n_svcs, n_groups=self.n_groups,
+                seed=1000 + hid, host_base=hid)
         if self.collect:
             from gyeeta_tpu.net import collect as C
             self._cpumem = C.CpuMemCollector(host_id=hid)
@@ -154,20 +221,33 @@ class NetAgent:
         return hid
 
     async def _control_loop(self, reader) -> None:
-        """Apply COMM_TRACE_SET capture control from the server."""
-        while True:
-            try:
-                dtype, payload = await _read_frame(reader)
-            except (asyncio.IncompleteReadError, ConnectionError,
-                    wire.FrameError):
-                return
-            if dtype != wire.COMM_TRACE_SET:
-                continue
-            for r in wire.decode_trace_set(payload):
-                if r["enable"]:
-                    self.trace_enabled.add(int(r["svc_glob_id"]))
-                else:
-                    self.trace_enabled.discard(int(r["svc_glob_id"]))
+        """Apply COMM_TRACE_SET capture control from the server.
+
+        Doubles as the conn-death watch: the read half sees the
+        server's FIN/RST immediately, while the write half can keep
+        "succeeding" into kernel buffers for several sweeps — sweeps
+        that would slip past the unconfirmed ring. The ``_conn_dead``
+        flag makes the supervisor stop sending the instant EOF lands."""
+        try:
+            while True:
+                try:
+                    dtype, payload = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        OSError, wire.FrameError):
+                    return
+                if dtype != wire.COMM_TRACE_SET:
+                    continue
+                for r in wire.decode_trace_set(payload):
+                    if r["enable"]:
+                        self.trace_enabled.add(int(r["svc_glob_id"]))
+                    else:
+                        self.trace_enabled.discard(int(r["svc_glob_id"]))
+        finally:
+            # only the CURRENT conn's watcher may flag death — a
+            # cancelled predecessor's late finally must not poison a
+            # freshly established conn
+            if self._ctrl_task is asyncio.current_task():
+                self._conn_dead = True
 
     async def send_names(self) -> None:
         """Announce inventory: names + listener metadata + host info
@@ -201,6 +281,13 @@ class NetAgent:
     async def send_sweep(self, n_conn: int = 256, n_resp: int = 512
                          ) -> None:
         """One 5s-equivalent sweep: flows, resp samples, state records."""
+        buf = self.build_sweep(n_conn, n_resp)
+        self._writer.write(buf)
+        await self._writer.drain()
+
+    def build_sweep(self, n_conn: int = 256, n_resp: int = 512) -> bytes:
+        """Build one sweep's frames WITHOUT sending (the supervisor
+        keeps producing on cadence during an outage and spools these)."""
         s = self.sim
         if self.real:
             buf = self._real_sweep_frames()
@@ -232,8 +319,7 @@ class NetAgent:
             buf += (s.cgroup_frames()
                     + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
                                         s.cpu_mem_records()))
-        self._writer.write(buf)
-        await self._writer.drain()
+        return buf
 
     def _real_sweep_frames(self) -> bytes:
         """One real sock_diag sweep → wire frames (cap-split per type)."""
@@ -316,6 +402,189 @@ class NetAgent:
                                              recs))
         return buf
 
+    # --------------------------------------------------- supervision tier
+    def _spool_push(self, buf: bytes, nrec: int) -> None:
+        """Buffer one undelivered sweep; drop-oldest past the byte
+        bound, every drop counted (sweeps and records — the no-silent-
+        loss accounting)."""
+        self._spool.append((buf, nrec))
+        self._spool_bytes += len(buf)
+        self.stats.bump("sweeps_spooled")
+        while self._spool_bytes > self.spool_max_bytes \
+                and len(self._spool) > 1:
+            old, oldrec = self._spool.popleft()
+            self._spool_bytes -= len(old)
+            self.stats.bump("spool_dropped")
+            self.stats.bump("spool_dropped_records", oldrec)
+
+    def spool_len(self) -> int:
+        return len(self._spool)
+
+    def spool_records(self) -> int:
+        """Records currently buffered (spool + unconfirmed tail)."""
+        return (sum(n for _, n in self._spool)
+                + sum(n for _, n in self._unconfirmed))
+
+    def _respool_unconfirmed(self) -> None:
+        """Conn lost: the last few written sweeps may have died in the
+        kernel buffer — move them to the spool front (oldest first) so
+        the reconnect resends them (at-least-once delivery)."""
+        for buf, nrec in reversed(self._unconfirmed):
+            self._spool.appendleft((buf, nrec))
+            self._spool_bytes += len(buf)
+        self._unconfirmed.clear()
+        # re-apply the bound from the old end
+        while self._spool_bytes > self.spool_max_bytes \
+                and len(self._spool) > 1:
+            old, oldrec = self._spool.popleft()
+            self._spool_bytes -= len(old)
+            self.stats.bump("spool_dropped")
+            self.stats.bump("spool_dropped_records", oldrec)
+
+    async def _send_buf(self, buf: bytes, nrec: int) -> None:
+        """Write one sweep and account it as (tentatively) delivered."""
+        if self._conn_dead or self._writer.is_closing():
+            # the read half already saw the server go away: writing
+            # would "succeed" into a dead socket and overflow the
+            # unconfirmed ring's recovery window
+            raise ConnectionResetError("conn read half saw EOF")
+        self._writer.write(buf)
+        await self._writer.drain()
+        evicted = None
+        if len(self._unconfirmed) == self._unconfirmed.maxlen:
+            evicted = self._unconfirmed[0]
+        self._unconfirmed.append((buf, nrec))
+        if evicted is not None:
+            self.stats.bump("records_sent", evicted[1])
+
+    async def _resend_spool(self) -> None:
+        """Drain the spool over a fresh conn (oldest first)."""
+        while self._spool:
+            buf, nrec = self._spool[0]
+            await self._send_buf(buf, nrec)
+            self._spool.popleft()
+            self._spool_bytes -= len(buf)
+            self.stats.bump("spool_resent")
+
+    def _drop_conn(self) -> None:
+        """Tear down a dead conn quietly (the supervisor's half of
+        ``close()`` — collectors and the sim survive for the retry)."""
+        if self._ctrl_task:
+            self._ctrl_task.cancel()
+            self._ctrl_task = None
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:       # pragma: no cover — already dead
+                pass
+            self._writer = None
+
+    def _stats_report_frame(self) -> bytes:
+        """NOTIFY_AGENT_STATS frame carrying counter DELTAS since the
+        last report (server folds them into monotone counters), or
+        b"" when nothing changed."""
+        rec = np.zeros(1, wire.AGENT_STATS_DT)
+        rec["host_id"] = self.host_id or 0
+        changed = False
+        for fld in ("spool_dropped", "spool_dropped_records",
+                    "spool_resent", "connect_timeouts"):
+            cur = int(self.stats.counters.get(fld, 0))
+            delta = cur - self._stats_reported.get(fld, 0)
+            if delta:
+                rec[fld] = delta
+                self._stats_reported[fld] = cur
+                changed = True
+        return wire.encode_frame(wire.NOTIFY_AGENT_STATS, rec) \
+            if changed else b""
+
+    async def run_forever(self, host: str, port: int, *,
+                          interval: float = 5.0, n_conn: int = 256,
+                          n_resp: int = 512, backoff_base: float = 0.5,
+                          backoff_cap: float = 30.0,
+                          backoff_jitter: float = 0.25,
+                          stop: Optional[asyncio.Event] = None) -> None:
+        """Supervised agent loop: NEVER exits on a connection failure
+        (the parmon respawn discipline, ref ``gypartha.cc:965``).
+
+        Sweeps are produced on ``interval`` cadence whether or not the
+        conn is up — undeliverable ones spool (bounded, drop-oldest
+        counted) and resend on reconnect. Reconnects follow jittered
+        exponential backoff (``backoff_base·2^k`` capped at
+        ``backoff_cap``, +0..``backoff_jitter`` fraction of jitter,
+        deterministic per agent seed). Returns only when ``stop`` is
+        set or the task is cancelled."""
+        rng = random.Random((self.seed << 1) ^ 0x5EED)
+        loop = asyncio.get_running_loop()
+        backoff = backoff_base
+        next_retry = loop.time()          # connect immediately
+        next_sweep: Optional[float] = None
+        while not (stop is not None and stop.is_set()):
+            now = loop.time()
+            # ---- (re)connect phase, backoff-gated
+            if self._writer is None and now >= next_retry:
+                try:
+                    await self.connect(host, port)
+                    if int(self.stats.counters.get("agent_connects", 0)):
+                        self.stats.bump("agent_reconnects")
+                    self.stats.bump("agent_connects")
+                    backoff = backoff_base
+                    await self._resend_spool()
+                    # report AFTER the resend so this reconnect's
+                    # resent/dropped counts ride this report
+                    report = self._stats_report_frame()
+                    if report:
+                        self._writer.write(report)
+                        await self._writer.drain()
+                    if next_sweep is None:
+                        next_sweep = loop.time()
+                except asyncio.CancelledError:
+                    raise
+                except _CONN_ERRORS:
+                    self.stats.bump("connect_failures")
+                    self._drop_conn()
+                    self._respool_unconfirmed()
+                    next_retry = loop.time() + backoff * (
+                        1.0 + backoff_jitter * rng.random())
+                    backoff = min(backoff * 2.0, backoff_cap)
+            # ---- sweep cadence (runs even while disconnected, once
+            # the first registration has given the sim its identity)
+            now = loop.time()
+            if next_sweep is not None and now >= next_sweep:
+                buf = self.build_sweep(n_conn, n_resp)
+                nrec = wire.count_events(buf)
+                self.stats.bump("sweeps_built")
+                self.stats.bump("records_built", nrec)
+                if self._writer is not None:
+                    try:
+                        await self._send_buf(buf, nrec)
+                    except _CONN_ERRORS:
+                        self.stats.bump("agent_disconnects")
+                        self._drop_conn()
+                        self._respool_unconfirmed()
+                        self._spool_push(buf, nrec)
+                        next_retry = loop.time() + backoff * (
+                            1.0 + backoff_jitter * rng.random())
+                        backoff = min(backoff * 2.0, backoff_cap)
+                else:
+                    self._spool_push(buf, nrec)
+                next_sweep += interval
+            # ---- sleep until the next deadline (sweep / retry / stop)
+            deadlines = []
+            if next_sweep is not None:
+                deadlines.append(next_sweep)
+            if self._writer is None:
+                deadlines.append(next_retry)
+            delay = max(0.0, (min(deadlines) if deadlines
+                              else interval) - loop.time())
+            if stop is not None:
+                try:
+                    await asyncio.wait_for(stop.wait(),
+                                           timeout=max(delay, 0.001))
+                except (asyncio.TimeoutError, TimeoutError):
+                    pass
+            else:
+                await asyncio.sleep(max(delay, 0.001))
+
     async def close(self) -> None:
         if self._ctrl_task:
             self._ctrl_task.cancel()
@@ -341,24 +610,60 @@ def wire_name_record(kind: int, name_id: int, name: str) -> np.ndarray:
 
 
 class QueryClient:
-    """Query-role conn: JSON queries multiplexed by seqid."""
+    """Query-role conn: JSON queries multiplexed by seqid.
 
-    def __init__(self, machine_id: Optional[int] = None):
+    Dial and per-request deadlines (``connect_timeout`` /
+    ``request_timeout``) guard against a wedged server: a fired
+    deadline raises a clear error, bumps a counter on ``stats``, and
+    resets the conn (the response stream is desynced once a request
+    is abandoned mid-flight)."""
+
+    def __init__(self, machine_id: Optional[int] = None,
+                 connect_timeout: float = 10.0,
+                 request_timeout: Optional[float] = 60.0):
         self.machine_id = machine_id if machine_id is not None \
             else H.hash_bytes_np(b"query-client")
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+        self.stats = Stats()
         self._reader = None
         self._writer = None
         self._seq = 0
 
-    async def connect(self, host: str, port: int) -> None:
-        reader, writer, status, _ = await register(
-            host, port, self.machine_id, wire.CONN_QUERY)
+    async def connect(self, host: str, port: int,
+                      timeout: Optional[float] = None) -> None:
+        t = self.connect_timeout if timeout is None else timeout
+        try:
+            reader, writer, status, _ = await asyncio.wait_for(
+                register(host, port, self.machine_id, wire.CONN_QUERY),
+                t)
+        except (asyncio.TimeoutError, TimeoutError):
+            self.stats.bump("connect_timeouts")
+            raise ConnectionError(
+                f"query connect to {host}:{port} timed out "
+                f"after {t:.1f}s") from None
         if status != wire.REG_OK:
             writer.close()
             raise ConnectionRefusedError(f"registration status {status}")
         self._reader, self._writer = reader, writer
 
-    async def query(self, req: dict) -> dict:
+    async def query(self, req: dict,
+                    timeout: Optional[float] = None) -> dict:
+        t = self.request_timeout if timeout is None else timeout
+        if t is None:
+            return await self._query(req)
+        try:
+            return await asyncio.wait_for(self._query(req), t)
+        except (asyncio.TimeoutError, TimeoutError):
+            self.stats.bump("query_timeouts")
+            # the conn is desynced (the response may still arrive):
+            # reset it so a retry cannot read a stale tail
+            await self.close()
+            raise TimeoutError(
+                f"query timed out after {t:.1f}s "
+                f"(subsys {req.get('subsys')!r})") from None
+
+    async def _query(self, req: dict) -> dict:
         import json
 
         self._seq += 1

@@ -135,13 +135,31 @@ def _route(rt, qtype: int, obj: dict) -> dict:
 async def _query_loop(server, reader, writer, st: NMConnState) -> None:
     rt = server.rt
     outstanding = 0
+    bad_frames = 0
     while True:
         try:
-            dtype, body = await _read_nm_frame(reader)
+            # idle deadline (server.idle_timeout): a silent NM conn is
+            # reaped on the same labeled counter as every other conn
+            if server.idle_timeout:
+                dtype, body = await asyncio.wait_for(
+                    _read_nm_frame(reader), server.idle_timeout)
+            else:
+                dtype, body = await _read_nm_frame(reader)
         except (asyncio.IncompleteReadError, ConnectionError):
+            return
+        except (asyncio.TimeoutError, TimeoutError):
+            rt.stats.bump("conn_timeouts|kind=idle")
+            log.info("nm conn: node %s:%d idle — reaped", st.hostname,
+                     st.port)
             return
         if dtype != RQ.REF_COMM_QUERY_CMD:
             rt.stats.bump("nm_frames_unknown_type")
+            bad_frames += 1
+            if bad_frames > server.frame_error_budget:
+                # per-conn error budget, same discipline as the GYT
+                # query loop: junk frames must not spin forever
+                rt.stats.bump("frames_rejected|reason=error_budget")
+                return
             continue
         seqid, qtype, obj = RQ.parse_query_cmd(body)
         verb = _VERB_OF_QTYPE.get(qtype, f"qtype_{qtype}")

@@ -41,13 +41,26 @@ _HSZ = wire.HEADER_DT.itemsize
 _READ_SZ = 1 << 20
 
 
+class _ConnReaped(Exception):
+    """A conn deadline fired (handshake / idle / write); the counter
+    was already bumped — callers just unwind and close."""
+
+    def __init__(self, kind: str):
+        super().__init__(f"conn reaped ({kind} deadline)")
+        self.kind = kind
+
+
 class GytServer:
     def __init__(self, rt: Runtime, host: str = "127.0.0.1",
                  port: int = 0, tick_interval: Optional[float] = 5.0,
                  hostmap_path: Optional[str] = None,
                  record_path: Optional[str] = None,
                  advertise_host: Optional[str] = None,
-                 feed_pipeline: bool = False):
+                 feed_pipeline: bool = False,
+                 handshake_timeout: float = 10.0,
+                 idle_timeout: Optional[float] = None,
+                 write_timeout: float = 10.0,
+                 frame_error_budget: int = 8):
         self.rt = rt
         self.host = host
         self.port = port
@@ -60,6 +73,22 @@ class GytServer:
             host if host not in ("", "0.0.0.0", "::") else
             _socket.gethostname())
         self.tick_interval = tick_interval
+        # ---- conn deadlines (the slow-loris / half-open hardening):
+        # handshake_timeout bounds the registration phase (any role);
+        # idle_timeout reaps silent conns — default tied to the
+        # expected sweep cadence (agents sweep every ~tick_interval, so
+        # 12 missed sweeps = dead); write_timeout bounds control pushes
+        # into a non-draining peer; frame_error_budget closes a query
+        # conn after N recoverable frame-level errors. Every reap or
+        # reject lands on a labeled counter (conn_timeouts|kind=...,
+        # frames_rejected|reason=...) rendered in /metrics.
+        self.handshake_timeout = handshake_timeout
+        if idle_timeout is None:
+            idle_timeout = max(30.0, 12.0 * tick_interval) \
+                if tick_interval else 60.0
+        self.idle_timeout = idle_timeout if idle_timeout > 0 else None
+        self.write_timeout = write_timeout
+        self.frame_error_budget = frame_error_budget
         # optional wire capture (utils/replay.py): every complete-frame
         # run fed to the runtime is also appended to the capture file
         self._recorder = None
@@ -151,6 +180,11 @@ class GytServer:
         """Sticky machine-id → dense host_id allocation (shared by the
         GYT and stock-partha registration paths)."""
         hid = self.hostmap.get(mid)
+        if hid is not None:
+            # a known machine re-registering IS a reconnect — the
+            # server-side half of the supervision story (the agent's
+            # spool counters arrive separately as NOTIFY_AGENT_STATS)
+            self.rt.stats.bump("agent_reconnects")
         if hid is None:
             if len(self.hostmap) >= self.rt.cfg.n_hosts:
                 return wire.REG_ERR_CAPACITY, 0
@@ -293,9 +327,20 @@ class GytServer:
             flags = [1] * len(enable) + [0] * len(disable)
             try:
                 w.write(wire.encode_trace_set(ids, flags))
-                await w.drain()
+                # write deadline: a non-draining agent (full socket
+                # buffers, wedged peer) must not stall the tick loop —
+                # reap the conn and re-emit the diff on reconnect
+                if self.write_timeout:
+                    await asyncio.wait_for(w.drain(), self.write_timeout)
+                else:
+                    await w.drain()
                 n += len(ids)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    TimeoutError) as e:
+                if isinstance(e, (asyncio.TimeoutError, TimeoutError)) \
+                        and not isinstance(e, OSError):
+                    self.rt.stats.bump("conn_timeouts|kind=write")
+                    w.close()     # half-dead conn: force the reconnect
                 # the diff was already committed to the applied state;
                 # a failed push that does NOT tear down the reader path
                 # would leave the host silently out of sync. Restore the
@@ -306,21 +351,28 @@ class GytServer:
             self.rt.stats.bump("trace_sets_pushed", n)
         return n
 
+    async def _tread(self, coro, kind: str):
+        """Await ``coro`` under the ``kind`` conn deadline. A fired
+        deadline bumps ``conn_timeouts|kind=...`` and raises
+        :class:`_ConnReaped` so the conn unwinds and closes without
+        ever blocking the tick loop."""
+        t = self.handshake_timeout if kind == "handshake" \
+            else self.idle_timeout
+        if not t:
+            return await coro
+        try:
+            return await asyncio.wait_for(coro, t)
+        except (asyncio.TimeoutError, TimeoutError):
+            self.rt.stats.bump(f"conn_timeouts|kind={kind}")
+            raise _ConnReaped(kind) from None
+
     async def _read_frame(self, reader, first: bytes = b""
                           ) -> tuple[int, bytes]:
-        """→ (data_type, payload_bytes). Raises IncompleteReadError at EOF.
-        ``first`` carries bytes already peeked off the stream."""
-        hdr_b = first + await reader.readexactly(_HSZ - len(first))
-        hdr = np.frombuffer(hdr_b, wire.HEADER_DT, count=1)[0]
-        if hdr["magic"] not in (wire.MAGIC_PM, wire.MAGIC_MS,
-                                wire.MAGIC_NQ):
-            raise wire.FrameError(f"bad magic {int(hdr['magic']):#x}")
-        total = int(hdr["total_sz"])
-        if total < _HSZ or total >= wire.MAX_COMM_DATA_SZ:
-            raise wire.FrameError(f"bad total_sz {total}")
-        body = await reader.readexactly(total - _HSZ)
-        pad = int(hdr["padding_sz"])
-        return int(hdr["data_type"]), body[: len(body) - pad]
+        """→ (data_type, payload_bytes). Raises IncompleteReadError at
+        EOF, FrameError (with reason) on poison headers — the shared
+        validated reader (``ingest/wire.py:read_frame``); ``first``
+        carries bytes already peeked off the stream."""
+        return await wire.read_frame(reader, first)
 
     async def _ref_conn(self, reader, writer, first: bytes) -> None:
         """Stock-partha connection: the gy_comm_proto registration
@@ -339,17 +391,20 @@ class GytServer:
         import time as _time
 
         RP = refproto
-        hdr_b = first + await reader.readexactly(
-            RP.REF_HEADER_DT.itemsize - len(first))
+        hdr_b = first + await self._tread(reader.readexactly(
+            RP.REF_HEADER_DT.itemsize - len(first)), "handshake")
         while True:
             hdr = np.frombuffer(hdr_b, RP.REF_HEADER_DT, count=1)[0]
             if int(hdr["magic"]) not in RP.REF_MAGICS:
                 raise wire.FrameError(
-                    f"bad reference magic 0x{int(hdr['magic']):08x}")
+                    f"bad reference magic 0x{int(hdr['magic']):08x}",
+                    reason="bad_magic")
             total = int(hdr["total_sz"])
             if total < len(hdr_b) or total >= wire.MAX_COMM_DATA_SZ:
-                raise wire.FrameError(f"bad ref total_sz {total}")
-            body = await reader.readexactly(total - len(hdr_b))
+                raise wire.FrameError(f"bad ref total_sz {total}",
+                                      reason="bad_size")
+            body = await self._tread(
+                reader.readexactly(total - len(hdr_b)), "handshake")
             dtype = int(hdr["data_type"])
             now = int(_time.time())
             if dtype == RP.REF_COMM_PS_REGISTER_REQ:
@@ -421,7 +476,9 @@ class GytServer:
                 # whole (the reference's recv loop does the same for
                 # unknown events)
                 self.rt.stats.bump("frames_ref_skipped")
-            hdr_b = await reader.readexactly(RP.REF_HEADER_DT.itemsize)
+            hdr_b = await self._tread(
+                reader.readexactly(RP.REF_HEADER_DT.itemsize),
+                "handshake")
 
     def _ref_gate(self, req: dict, min_field: str) -> tuple[int, str]:
         """Version gates of the reference's validate_fields
@@ -444,21 +501,29 @@ class GytServer:
         try:
             # peek the first header: a reference COMM_HEADER magic means
             # a STOCK PARTHA — route it through the gy_comm_proto
-            # registration handshake instead of GYT registration
+            # registration handshake instead of GYT registration.
+            # The whole pre-registration phase runs under the handshake
+            # deadline: a slow-loris peer (valid magic, header never
+            # completed) is reaped, counted, and cannot pin a handler.
             try:
-                first = await reader.readexactly(4)
-            except (asyncio.IncompleteReadError, ConnectionError):
+                first = await self._tread(reader.readexactly(4),
+                                          "handshake")
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    _ConnReaped):
                 return
             if int.from_bytes(first, "little") in refproto.REF_MAGICS:
                 try:
                     await self._ref_conn(reader, writer, first)
-                except (asyncio.IncompleteReadError, ConnectionError):
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        _ConnReaped):
                     pass
                 return
             # every conn opens with one REGISTER_REQ declaring its role
             try:
-                dtype, payload = await self._read_frame(reader, first)
-            except (asyncio.IncompleteReadError, ConnectionError):
+                dtype, payload = await self._tread(
+                    self._read_frame(reader, first), "handshake")
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    _ConnReaped):
                 return
             if dtype != wire.COMM_REGISTER_REQ:
                 self.rt.stats.bump("conns_unregistered")
@@ -488,6 +553,13 @@ class GytServer:
         except wire.FrameError as e:
             log.warning("conn %s: %s — closing", peer, e)
             self.rt.stats.bump("conns_framing_errors")
+            # attribute the reject (bad_magic / bad_size / truncated /
+            # bad_frame) — the no-silent-loss accounting surface
+            self.rt.stats.bump(
+                "frames_rejected|reason="
+                f"{getattr(e, 'reason', 'bad_frame')}")
+        except _ConnReaped as e:
+            log.info("conn %s: %s", peer, e)
         finally:
             self._open_conns.discard(writer)
             writer.close()
@@ -518,8 +590,15 @@ class GytServer:
         if ref_session is None:               # per-conn adapter state
             ref_session = refproto.RefSession()
         while True:
-            data = await reader.read(_READ_SZ)
+            # idle deadline: an agent conn that stops sweeping (half-
+            # open, wedged peer) is reaped on the sweep-cadence budget
+            data = await self._tread(reader.read(_READ_SZ), "idle")
             if not data:
+                if pending:
+                    # EOF mid-frame: the tail was truncated in flight —
+                    # count it, don't just drop it on the floor
+                    self.rt.stats.bump(
+                        "frames_rejected|reason=truncated")
                 return
             data = pending + data
             if not ref_mode and len(data) >= 4 and int.from_bytes(
@@ -564,15 +643,34 @@ class GytServer:
 
     async def _query_loop(self, reader, writer) -> None:
         outstanding = 0
+        bad_frames = 0
         while True:
             try:
-                dtype, payload = await self._read_frame(reader)
+                dtype, payload = await self._tread(
+                    self._read_frame(reader), "idle")
             except (asyncio.IncompleteReadError, ConnectionError):
                 return
             if dtype != wire.COMM_QUERY_CMD:
                 self.rt.stats.bump("frames_unknown_type")
+                bad_frames += 1
+                if bad_frames > self.frame_error_budget:
+                    # per-conn error budget: N recoverable frame-level
+                    # errors → close (a peer spraying junk that parses
+                    # as frames must not spin the loop forever)
+                    self.rt.stats.bump(
+                        "frames_rejected|reason=error_budget")
+                    return
                 continue
-            seqid, _, req = wire.decode_query_payload(payload)
+            try:
+                seqid, _, req = wire.decode_query_payload(payload)
+            except Exception:
+                self.rt.stats.bump("frames_rejected|reason=bad_query")
+                bad_frames += 1
+                if bad_frames > self.frame_error_budget:
+                    self.rt.stats.bump(
+                        "frames_rejected|reason=error_budget")
+                    return
+                continue
             if outstanding >= wire.MAX_OUTSTANDING_QUERIES:
                 writer.write(wire.encode_query(
                     seqid, {"error": "busy"}, wire.QS_BUSY, resp=True))

@@ -212,12 +212,14 @@ class ShardedRuntime:
                     self.spans.span("deframe", nrec=len(data),
                                     path="native" if native.available()
                                     else "python"):
-                recs, consumed = native.drain(data)
+                recs, consumed, unknown = native.drain2(data)
         except wire.FrameError:
             self.stats.bump("frames_bad")
             self._pending = b""
             raise
         self._pending = data[consumed:]
+        if unknown:
+            self.stats.bump("records_unknown_subtype", unknown)
         n = 0
         self._cols.bump()
         # conn/resp hot path: stage RAW record arrays; a full slab
@@ -313,6 +315,19 @@ class ShardedRuntime:
                 self.stats.bump("cgroup_records",
                                 self.cgroups.update(chunks[0]))
                 n += len(chunks[0])
+            elif kind == "agent_stats":
+                # agent delivery-continuity deltas → server counters
+                # (same fold as Runtime.ingest_records)
+                a = chunks[0]
+                for fld, ctr in (
+                        ("spool_dropped", "spool_dropped"),
+                        ("spool_dropped_records",
+                         "spool_dropped_records"),
+                        ("spool_resent", "spool_resent"),
+                        ("connect_timeouts", "agent_connect_timeouts")):
+                    tot = int(a[fld].sum())
+                    if tot:
+                        self.stats.bump(ctr, tot)
             elif kind == "names":
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))

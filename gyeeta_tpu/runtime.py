@@ -226,12 +226,16 @@ class Runtime:
                     self.spans.span("deframe", nrec=len(data),
                                     path="native" if native.available()
                                     else "python"):
-                recs, consumed = native.drain(data)
+                recs, consumed, unknown = native.drain2(data)
         except wire.FrameError:
             self.stats.bump("frames_bad")
             self._pending = b""       # poison frame: drop buffer, resync
             raise
         self._pending = data[consumed:]
+        if unknown:
+            # skipped unknown-subtype frames (version skew / corrupted
+            # subtype byte): accounted loss, never silent loss
+            self.stats.bump("records_unknown_subtype", unknown)
         return self.ingest_records(recs)
 
     def ingest_records(self, recs: dict) -> int:
@@ -328,6 +332,20 @@ class Runtime:
                 self.stats.bump("netif_records",
                                 self.netifs.update(chunks[0]))
                 n += len(chunks[0])
+            elif kind == "agent_stats":
+                # agent delivery-continuity deltas → server counters
+                # (the only process that can see a spool drop is the
+                # agent; the server is where /metrics renders)
+                a = chunks[0]
+                for fld, ctr in (
+                        ("spool_dropped", "spool_dropped"),
+                        ("spool_dropped_records",
+                         "spool_dropped_records"),
+                        ("spool_resent", "spool_resent"),
+                        ("connect_timeouts", "agent_connect_timeouts")):
+                    tot = int(a[fld].sum())
+                    if tot:
+                        self.stats.bump(ctr, tot)
             elif kind == "names":
                 # names don't count into n (not telemetry events) but
                 # DO invalidate cached columns: resolved name strings

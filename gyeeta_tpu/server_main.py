@@ -44,27 +44,23 @@ class Daemon:
             log.info("restored checkpoint %s (tick %s)", args.restore,
                      extra.get("tick"))
         elif getattr(args, "restore_latest", False):
-            # the respawn path must NEVER crash-loop on a bad
-            # checkpoint: walk newest→oldest, fall back to cold start
-            cands = checkpoint_candidates(opts.checkpoint_dir)
-            for cand in cands:
-                try:
-                    extra = self.rt.restore(cand)
-                    log.info("restored checkpoint %s (tick %s)", cand,
-                             extra.get("tick"))
-                    break
-                except Exception as e:  # noqa: BLE001 — corrupt /
-                    # cfg-mismatched file: try the next-older one
-                    log.warning("checkpoint %s unusable (%s) — "
-                                "trying older", cand, e)
-            else:
+            if restore_latest_checkpoint(
+                    self.rt, opts.checkpoint_dir) is None:
                 log.info("no usable checkpoint (cold start)")
         self.srv = GytServer(self.rt, host=args.host, port=args.port,
                              tick_interval=args.tick_interval,
                              hostmap_path=args.hostmap,
                              record_path=args.record,
                              feed_pipeline=getattr(
-                                 args, "feed_pipeline", False))
+                                 args, "feed_pipeline", False),
+                             handshake_timeout=getattr(
+                                 args, "handshake_timeout", 10.0),
+                             idle_timeout=getattr(
+                                 args, "idle_timeout", None),
+                             write_timeout=getattr(
+                                 args, "write_timeout", 10.0),
+                             frame_error_budget=getattr(
+                                 args, "frame_error_budget", 8))
         self._hot = C.HotReload(args.config, opts) if args.config else None
         self.stop_event = asyncio.Event()
 
@@ -194,6 +190,25 @@ def latest_checkpoint(ckpt_dir: Optional[str]):
     return cands[0] if cands else None
 
 
+def restore_latest_checkpoint(rt, ckpt_dir: Optional[str]):
+    """The ``--restore-latest`` respawn path: walk checkpoints newest→
+    oldest and restore the first usable one into ``rt``. A truncated /
+    corrupt / cfg-mismatched newest file (torn by a crash mid-write)
+    must NEVER crash-loop a supervised restart — it logs and falls
+    through to the next-older candidate. Returns the restored path, or
+    None (cold start)."""
+    for cand in checkpoint_candidates(ckpt_dir):
+        try:
+            extra = rt.restore(cand)
+            log.info("restored checkpoint %s (tick %s)", cand,
+                     extra.get("tick"))
+            return cand
+        except Exception as e:  # noqa: BLE001 — corrupt / mismatched
+            log.warning("checkpoint %s unusable (%s) — trying older",
+                        cand, e)
+    return None
+
+
 def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         prog="gyeeta_tpu",
@@ -220,6 +235,21 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
                     "reference's L1/L2 split; useful on multi-core "
                     "hosts — the native decoders release the GIL)")
     ap.add_argument("--stats-interval", type=float, default=60.0)
+    # conn-hardening deadlines (net/server.py; every reap lands on a
+    # labeled gyt_conn_timeouts_total counter in /metrics)
+    ap.add_argument("--handshake-timeout", type=float, default=10.0,
+                    help="seconds a conn may take to complete "
+                    "registration (slow-loris reap)")
+    ap.add_argument("--idle-timeout", type=float, default=None,
+                    help="seconds of silence before an established "
+                    "conn is reaped (default: 12x tick interval, "
+                    "min 30s; 0 disables)")
+    ap.add_argument("--write-timeout", type=float, default=10.0,
+                    help="seconds a control push may block on a "
+                    "non-draining agent conn")
+    ap.add_argument("--frame-error-budget", type=int, default=8,
+                    help="recoverable frame-level errors per query "
+                    "conn before it is closed")
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
 

@@ -25,7 +25,15 @@ def _cfg_fingerprint(cfg) -> str:
 
 
 def save(path, cfg, state, extra: dict | None = None) -> pathlib.Path:
-    """Write state pytree → ``<path>`` (npz). Atomic via tmp+rename."""
+    """Write state pytree → ``<path>`` (npz). Atomic AND durable:
+    tmp + fsync(file) + rename + fsync(dir). Without the fsyncs a
+    crash (or power loss) shortly after the rename can leave the
+    NEWEST checkpoint torn on disk — exactly the file a supervised
+    ``--restore-latest`` restart reaches for first (the walk-back in
+    ``server_main.checkpoint_candidates`` then lands on the next-older
+    one, but a torn newest should be the rare case, not the norm)."""
+    import os
+
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(state)
@@ -38,7 +46,17 @@ def save(path, cfg, state, extra: dict | None = None) -> pathlib.Path:
     tmp = path.with_suffix(".tmp.npz")
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())      # file contents durable BEFORE rename
     tmp.rename(path)
+    try:                          # …and the rename itself durable
+        dfd = os.open(path.parent, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:               # pragma: no cover — exotic fs
+        pass
     return path
 
 

@@ -106,3 +106,129 @@ def test_oversize_batch_raises():
     recs = sim.resp_records(100)
     with pytest.raises(ValueError):
         decode.resp_batch(recs, size=64)
+
+
+# ------------------------------------------------ validated async reader
+# (ingest/wire.py:read_frame — the ONE frame reader both the agent and
+# the server use; a corrupt header must neither hang readexactly on a
+# multi-MB read nor crash on a short one)
+
+import asyncio  # noqa: E402
+
+
+def _reader(data: bytes, eof: bool = True) -> asyncio.StreamReader:
+    r = asyncio.StreamReader()
+    r.feed_data(data)
+    if eof:
+        r.feed_eof()
+    return r
+
+
+def _read(data: bytes, eof: bool = True, timeout: float = 2.0):
+    async def go():
+        return await asyncio.wait_for(
+            wire.read_frame(_reader(data, eof)), timeout)
+    return asyncio.run(go())
+
+
+def _hdr(magic, total, dtype=wire.COMM_EVENT_NOTIFY, pad=0) -> bytes:
+    import numpy as _np
+    h = _np.zeros((), wire.HEADER_DT)
+    h["magic"], h["total_sz"] = magic, total
+    h["data_type"], h["padding_sz"] = dtype, pad
+    return h.tobytes()
+
+
+def test_read_frame_roundtrip():
+    sim = ParthaSim(n_hosts=2, n_svcs=2, n_clients=64)
+    buf = wire.encode_frame(wire.NOTIFY_RESP_SAMPLE, sim.resp_records(8))
+    dtype, payload = _read(buf)
+    assert dtype == wire.COMM_EVENT_NOTIFY
+    assert len(payload) == len(buf) - wire.HEADER_DT.itemsize
+
+
+def test_read_frame_garbage_magic():
+    with pytest.raises(wire.FrameError) as ei:
+        _read(b"\xde\xad\xbe\xef" + b"\x00" * 32)
+    assert ei.value.reason == "bad_magic"
+
+
+def test_read_frame_oversized_header_no_hang():
+    # total_sz >= the 16MB cap: rejected from the HEADER alone — no
+    # multi-MB readexactly is ever issued (eof=False would hang there)
+    hdr = _hdr(wire.MAGIC_PM, wire.MAX_COMM_DATA_SZ + 8)
+    with pytest.raises(wire.FrameError) as ei:
+        _read(hdr, eof=False, timeout=1.0)
+    assert ei.value.reason == "bad_size"
+
+
+def test_read_frame_undersized_total():
+    hdr = _hdr(wire.MAGIC_PM, wire.HEADER_DT.itemsize - 8)
+    with pytest.raises(wire.FrameError) as ei:
+        _read(hdr + b"\x00" * 64)
+    assert ei.value.reason == "bad_size"
+
+
+def test_read_frame_padding_overflow():
+    # padding_sz larger than the body would slice into nothing sane
+    hdr = _hdr(wire.MAGIC_PM, wire.HEADER_DT.itemsize + 8, pad=64)
+    with pytest.raises(wire.FrameError) as ei:
+        _read(hdr + b"\x00" * 8)
+    assert ei.value.reason == "bad_size"
+
+
+def test_read_frame_truncated_body():
+    sim = ParthaSim(n_hosts=2, n_svcs=2, n_clients=64)
+    buf = wire.encode_frame(wire.NOTIFY_RESP_SAMPLE, sim.resp_records(8))
+    with pytest.raises(wire.FrameError) as ei:
+        _read(buf[:-4])
+    assert ei.value.reason == "truncated"
+
+
+def test_read_frame_truncated_header():
+    with pytest.raises(wire.FrameError) as ei:
+        _read(_hdr(wire.MAGIC_PM, 64)[:7])
+    assert ei.value.reason == "truncated"
+
+
+def test_read_frame_clean_eof():
+    with pytest.raises(asyncio.IncompleteReadError):
+        _read(b"")
+
+
+def test_read_frame_timeout():
+    async def go():
+        r = asyncio.StreamReader()        # no data ever arrives
+        with pytest.raises((asyncio.TimeoutError, TimeoutError)):
+            await wire.read_frame(r, timeout=0.05)
+    asyncio.run(go())
+
+
+def test_read_frame_header_fuzz():
+    # seeded garbage headers: never hangs, never escapes the
+    # FrameError/IncompleteReadError contract
+    import numpy as _np
+    rng = _np.random.default_rng(7)
+    for _ in range(200):
+        blob = rng.integers(0, 256, rng.integers(0, 64),
+                            dtype=_np.uint8).tobytes()
+        try:
+            _read(blob, timeout=1.0)
+        except (wire.FrameError, asyncio.IncompleteReadError):
+            continue
+        # a fuzzed blob that parses must be a genuinely complete frame
+        magic, total = blob[:4], int.from_bytes(blob[4:8], "little")
+        assert len(blob) >= total
+
+
+def test_count_events():
+    sim = ParthaSim(n_hosts=2, n_svcs=2, n_clients=64)
+    buf = (wire.encode_frame(wire.NOTIFY_RESP_SAMPLE,
+                             sim.resp_records(10))
+           + wire.encode_frame(wire.NOTIFY_TCP_CONN,
+                               sim.conn_records(20)))
+    assert wire.count_events(buf) == 30
+    # trailing partial frame: only complete frames count
+    assert wire.count_events(buf[:-8]) == 10
+    # non-EVENT frames (register etc.) contribute zero records
+    assert wire.count_events(wire.encode_register_req(1, 1, 3)) == 0
