@@ -1,15 +1,18 @@
 """CI smoke: the chaos tier against a REAL server process.
 
 Short deterministic fault schedule end-to-end: a `python -m gyeeta_tpu
-serve` subprocess behind the seeded ChaosProxy, two supervised sim
-agents (``run_forever``), corruption + disconnect faults on the wire, a
-slow-loris conn straight at the server, one server KILL (SIGTERM →
-final checkpoint) and a ``--restore-latest`` restart. Fails loud on:
-agent task exit, non-convergence (services/hosts missing or Down after
-recovery), an unaccounted record delta (silent loss), or missing
-hardening counters in the exposition. Follows the `_metrics_smoke.py` /
-`_nm_smoke.py` pattern; run by ci.sh, standalone:
-``JAX_PLATFORMS=cpu python _chaos_smoke.py``.
+serve` subprocess (write-ahead journal ON) behind the seeded
+ChaosProxy, two supervised sim agents (``run_forever``), corruption +
+disconnect faults on the wire, a slow-loris conn straight at the
+server, one SIGTERM kill (graceful: final checkpoint, fsync-truncated
+journal) and one SIGKILL mid-inter-checkpoint-window (the crash the
+WAL exists for), each followed by a ``--restore-latest`` restart whose
+recovery replays the journal. Fails loud on: agent task exit,
+non-convergence (services/hosts missing or Down after recovery), an
+unaccounted record delta (silent loss), a SIGKILL recovery that
+replayed nothing, or missing hardening/durability counters in the
+exposition. Follows the `_metrics_smoke.py` / `_nm_smoke.py` pattern;
+run by ci.sh, standalone: ``JAX_PLATFORMS=cpu python _chaos_smoke.py``.
 """
 
 from __future__ import annotations
@@ -34,16 +37,21 @@ def _free_port() -> int:
     return p
 
 
-def _spawn_server(port: int, ckdir: str, hostmap: str):
+def _spawn_server(port: int, ckdir: str, hostmap: str,
+                  journal_dir: str = ""):
     env = dict(os.environ, JAX_PLATFORMS="cpu", GYT_PLATFORM="cpu")
-    return subprocess.Popen(
-        [sys.executable, "-m", "gyeeta_tpu", "serve",
-         "--host", "127.0.0.1", "--port", str(port),
-         "--checkpoint-dir", ckdir, "--hostmap", hostmap,
-         "--restore-latest", "--tick-interval", "0.5",
-         "--handshake-timeout", "2", "--idle-timeout", "10",
-         "--stats-interval", "30", "--log-level", "WARNING"],
-        cwd=HERE, env=env)
+    cmd = [sys.executable, "-m", "gyeeta_tpu", "serve",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--checkpoint-dir", ckdir, "--hostmap", hostmap,
+           "--restore-latest", "--tick-interval", "0.5",
+           "--handshake-timeout", "2", "--idle-timeout", "10",
+           "--stats-interval", "30", "--log-level", "WARNING"]
+    if journal_dir:
+        # tight fsync cadence: the SIGKILL below must find every
+        # accepted pre-kill chunk durable (deterministic smoke)
+        cmd += ["--journal-dir", journal_dir,
+                "--journal-fsync-ms", "5", "--journal-fsync-kb", "1"]
+    return subprocess.Popen(cmd, cwd=HERE, env=env)
 
 
 async def _wait_ready(port: int, proc, timeout: float = 180.0) -> None:
@@ -80,10 +88,11 @@ async def scenario() -> None:
 
     tmp = tempfile.mkdtemp(prefix="gyt_chaos_smoke_")
     ckdir = os.path.join(tmp, "ck")
+    waldir = os.path.join(tmp, "wal")
     hostmap = os.path.join(tmp, "hostmap.json")
     port = _free_port()
 
-    proc = _spawn_server(port, ckdir, hostmap)
+    proc = _spawn_server(port, ckdir, hostmap, waldir)
     agents: list = []
     tasks: list = []
     proxy = None
@@ -122,7 +131,7 @@ async def scenario() -> None:
             "agent supervisor exited during the outage"
 
         # ---- restart on the SAME port with --restore-latest
-        proc = _spawn_server(port, ckdir, hostmap)
+        proc = _spawn_server(port, ckdir, hostmap, waldir)
         await _wait_ready(port, proc)
         proxy.refusing = False
 
@@ -146,8 +155,6 @@ async def scenario() -> None:
         else:
             raise SystemExit("agents never reconnected/drained the spool")
         await asyncio.sleep(1.5)          # a couple of post-recovery sweeps
-        stop.set()
-        await asyncio.wait_for(asyncio.gather(*tasks), 15.0)
 
         # ---- convergence: both hosts, all services, names, nothing Down
         svc = await _query(port, {"subsys": "svcstate"})
@@ -173,10 +180,60 @@ async def scenario() -> None:
                   if ln.startswith("gyt_agent_reconnects_total")]
         assert reconn and float(reconn[0].split()[-1]) >= 2, reconn
 
-        # ---- zero silent loss across both server epochs: everything
-        # built is accepted, still spooled, or counted dropped. The
-        # first epoch's accepted counters died with the process, so
-        # bound with phase-2's exposition + the agents' own ledgers:
+        # ---- phase 3: SIGKILL mid-inter-checkpoint window. SIGTERM
+        # above proved the graceful path (final checkpoint, truncated
+        # journal). SIGKILL writes NOTHING on the way down — the
+        # restarted server's state must come from checkpoint + WAL
+        # replay, and the fleet view must survive byte-for-byte (no
+        # periodic checkpoint ran in this epoch, so every accepted
+        # record since the restart lives ONLY in the journal).
+        reconn_before = {a.seed: a.stats.counters.get(
+            "agent_reconnects", 0) for a in agents}
+        proxy.refusing = True
+        proxy.drop_all()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+        await asyncio.sleep(1.0)         # outage: spool keeps filling
+        assert not any(t.done() for t in tasks), \
+            "agent supervisor exited during the SIGKILL outage"
+        proc = _spawn_server(port, ckdir, hostmap, waldir)
+        await _wait_ready(port, proc)
+        proxy.refusing = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60.0:
+            await asyncio.sleep(0.5)
+            if any(t.done() for t in tasks):
+                raise SystemExit(
+                    "agent supervisor exited during phase 3")
+            if all(a.stats.counters.get("agent_reconnects", 0)
+                   > reconn_before[a.seed]
+                   and a.spool_len() == 0 for a in agents):
+                break
+        else:
+            raise SystemExit(
+                "agents never recovered from the SIGKILL")
+        await asyncio.sleep(1.5)
+        stop.set()
+        await asyncio.wait_for(asyncio.gather(*tasks), 15.0)
+
+        # the SIGKILL recovery REPLAYED the journal (the PR-4 gap):
+        # wal counters render in the fresh epoch's exposition
+        met3 = (await _query(port, {"subsys": "metrics"}))["text"]
+        replayed = [ln for ln in met3.splitlines()
+                    if ln.startswith("gyt_wal_replayed_records_total")]
+        assert replayed and float(replayed[0].split()[-1]) > 0, \
+            "SIGKILL recovery replayed no WAL records"
+        assert "gyt_journal_fsync_lag_seconds" in met3
+        svc3 = await _query(port, {"subsys": "svcstate"})
+        hosts3 = await _query(port, {"subsys": "hoststate"})
+        assert svc3["nrecs"] == 4, f"post-SIGKILL services: {svc3}"
+        assert hosts3["nrecs"] == 2, f"post-SIGKILL hosts: {hosts3}"
+        assert all(r["state"] != "Down" for r in hosts3["recs"])
+
+        # ---- zero silent loss across all three server epochs:
+        # everything built is accepted, still spooled, or counted
+        # dropped. The killed epochs' accepted counters died with
+        # their processes, so bound with the agents' own ledgers:
         # every record the agents still hold or dropped is accounted,
         # and the final state served the full fleet (above). Sanity:
         # drops (if any) were counted, resends happened.
@@ -194,8 +251,10 @@ async def scenario() -> None:
 
         print(f"chaos smoke: OK — faults={dict(proxy.stats)}, "
               f"reconnects={int(float(reconn[0].split()[-1]))}, "
-              f"resent={resent}, svc={svc['nrecs']}, "
-              f"hosts={hosts['nrecs']}", file=sys.stderr)
+              f"resent={resent}, svc={svc3['nrecs']}, "
+              f"hosts={hosts3['nrecs']}, "
+              f"wal_replayed={float(replayed[0].split()[-1]):.0f}",
+              file=sys.stderr)
     finally:
         stop.set()
         for t in tasks:

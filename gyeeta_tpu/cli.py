@@ -179,10 +179,13 @@ def _cmd_replay(argv) -> None:
         # stream on the event loop with a drain per chunk: captures can
         # be many GB, so transport backpressure must gate the file read,
         # and a dropped conn must fail loudly, not buffer into the void
+        from gyeeta_tpu.utils.selfstats import Stats
+        stats = Stats()
         n = 0
         try:
             for delay, chunk in replay.paced_chunks(
-                    args.capture, args.speed, args.host_offset):
+                    args.capture, args.speed, args.host_offset,
+                    stats=stats):
                 if delay > 0:
                     await asyncio.sleep(delay)
                 writer.write(chunk)
@@ -192,7 +195,10 @@ def _cmd_replay(argv) -> None:
             raise SystemExit(f"server dropped the conn after {n} bytes: "
                              f"{e}")
         writer.close()
-        print(f"replayed {n} bytes", file=sys.stderr)
+        torn = int(stats.counters.get("replay_torn_tail", 0))
+        print(f"replayed {n} bytes"
+              + (" (capture tail torn — final partial chunk skipped)"
+                 if torn else ""), file=sys.stderr)
 
     asyncio.run(run())
 

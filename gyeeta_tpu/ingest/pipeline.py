@@ -75,7 +75,7 @@ class FeedPipeline:
         return buf, recs, (time.perf_counter() - t0) * 1e3
 
     def _fold_one(self) -> int:
-        fut = self._fifo.popleft()
+        fut, hid, conn_id = self._fifo.popleft()
         try:
             buf, recs, dt_ms = fut.result()
         except wire.FrameError:
@@ -88,13 +88,22 @@ class FeedPipeline:
         self._rt.stats.observe_ms("deframe", dt_ms)
         if self._recorder is not None:
             self._recorder.write(buf)    # validated ⇒ replayable
+        # WAL append mirrors the recorder's invariant (validated ⇒
+        # replayable); the direct path appends inside Runtime.feed,
+        # this path feeds records, so the journal hook lives here
+        j = getattr(self._rt, "journal", None)
+        if j is not None and not getattr(self._rt, "_journal_replaying",
+                                         False):
+            j.append(buf, hid=hid, conn_id=conn_id,
+                     tick=getattr(self._rt, "_tick_no", 0))
         return self._rt.ingest_records(recs)
 
-    def feed(self, buf: bytes) -> int:
-        self._fifo.append(self._ex.submit(self._deframe, buf))
+    def feed(self, buf: bytes, hid: int = 0, conn_id: int = 0) -> int:
+        self._fifo.append((self._ex.submit(self._deframe, buf),
+                           hid, conn_id))
         n = 0
         # fold everything already decoded; block only at depth
-        while self._fifo and (self._fifo[0].done()
+        while self._fifo and (self._fifo[0][0].done()
                               or len(self._fifo) > self.depth):
             n += self._fold_one()
         return n

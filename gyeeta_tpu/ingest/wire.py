@@ -44,6 +44,12 @@ COMM_TRACE_SET = 6        # server→agent capture control (ref
 #                           REQ_TRACE_SET, gy_comm_proto.h:3295; rides
 #                           the event conn in reverse — the analogue of
 #                           the reference's CLI_TYPE_RESP_REQ direction)
+COMM_THROTTLE = 7         # server→agent admission control: hold feeds
+#                           in the agent spool for N ms (backpressure —
+#                           server pressure becomes agent-side spooling
+#                           instead of engine-side garbage; versioned
+#                           like NOTIFY_AGENT_STATS — old agents skip
+#                           unknown control dtypes)
 
 # NOTIFY_TYPE (EVENT_NOTIFY subtype_)
 NOTIFY_TCP_CONN = 10          # flow close/open records
@@ -67,6 +73,18 @@ NOTIFY_AGENT_STATS = 24       # agent self-report: spool drops/resends +
 #                               delivery-continuity accounting the server
 #                               folds into its own selfstats registry so
 #                               /metrics shows fleet-wide loss counters
+NOTIFY_SWEEP_SEQ = 25         # agent sweep sequence mark: one record
+#                               prepended to every built sweep carrying
+#                               the agent's monotone sweep counter. The
+#                               WAL dedup contract rides on it: the
+#                               server tracks the per-host high-water
+#                               mark (journaled with checkpoints,
+#                               rebuilt by WAL replay) and echoes it in
+#                               REGISTER_RESP, so a reconnecting agent
+#                               drops already-durable sweeps from its
+#                               resend spool instead of double-folding
+#                               them (checkpoint + replay + resend
+#                               never double-counts)
 
 MAX_CONNS_PER_BATCH = 2048    # gy_comm_proto.h:1711
 MAX_LISTENERS_PER_BATCH = 512  # gy_comm_proto.h:2222
@@ -234,6 +252,15 @@ AGENT_STATS_DT = np.dtype([
 ])
 
 MAX_AGENT_STATS_PER_BATCH = 64
+
+# SWEEP_SEQ record — the per-sweep sequence mark (see NOTIFY_SWEEP_SEQ).
+SWEEP_SEQ_DT = np.dtype([
+    ("host_id", "<u4"),
+    ("pad", "<u4"),
+    ("seq", "<u8"),                    # monotone per agent process
+])
+
+MAX_SWEEP_SEQ_PER_BATCH = 64
 
 # CPU_MEM_STATE record — the 2s host cpu/mem path (field content of
 # CPU_MEM_STATE_NOTIFY, gy_comm_proto.h:2024: cpu pcts, context switches,
@@ -439,6 +466,7 @@ DTYPE_OF_SUBTYPE = {
     NOTIFY_NETIF_STATE: NETIF_DT,
     NOTIFY_TASK_PING: TASK_PING_DT,
     NOTIFY_AGENT_STATS: AGENT_STATS_DT,
+    NOTIFY_SWEEP_SEQ: SWEEP_SEQ_DT,
 }
 
 # per-type batch caps enforced at decode (ref: per-struct MAX_NUM_* +
@@ -459,6 +487,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_NETIF_STATE: MAX_NETIF_PER_BATCH,
     NOTIFY_TASK_PING: MAX_PINGS_PER_BATCH,
     NOTIFY_AGENT_STATS: MAX_AGENT_STATS_PER_BATCH,
+    NOTIFY_SWEEP_SEQ: MAX_SWEEP_SEQ_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -474,7 +503,8 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("HOST_INFO_DT", HOST_INFO_DT),
                    ("CGROUP_DT", CGROUP_DT),
                    ("TASK_PING_DT", TASK_PING_DT),
-                   ("AGENT_STATS_DT", AGENT_STATS_DT)]:
+                   ("AGENT_STATS_DT", AGENT_STATS_DT),
+                   ("SWEEP_SEQ_DT", SWEEP_SEQ_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 
@@ -533,6 +563,43 @@ def encode_trace_set(svc_ids, enable) -> bytes:
 def decode_trace_set(payload: bytes) -> np.ndarray:
     n = len(payload) // TRACE_SET_DT.itemsize
     return np.frombuffer(payload, TRACE_SET_DT, count=n)
+
+
+# Admission control (server→agent backpressure): which feed classes to
+# hold in the agent's spool, for how long. Priority-aware shedding
+# (PSketch, PAPERS.md): trace/pcap feeds throttle BEFORE svc/task
+# state, so health classification degrades last. hold_ms=0 releases a
+# class early. Unknown feed ids are ignored by receivers (forward
+# compatible, the NOTIFY_AGENT_STATS versioning discipline).
+FEED_TRACE = 1            # request-trace / pcap transaction streams
+FEED_ALL = 2              # every sweep (state feeds included)
+
+THROTTLE_DT = np.dtype([
+    ("feed", "<u4"),
+    ("hold_ms", "<u4"),
+])
+
+assert THROTTLE_DT.itemsize % 8 == 0
+
+
+def encode_throttle(feeds, hold_ms: int, magic: int = MAGIC_MS) -> bytes:
+    """(feed classes, hold duration ms) → one COMM_THROTTLE frame."""
+    return encode_throttle_multi([(f, hold_ms) for f in feeds], magic)
+
+
+def encode_throttle_multi(pairs, magic: int = MAGIC_MS) -> bytes:
+    """[(feed, hold_ms), …] → one COMM_THROTTLE frame (hold 0 releases
+    that class early)."""
+    pairs = list(pairs)
+    recs = np.zeros(len(pairs), THROTTLE_DT)
+    recs["feed"] = np.asarray([p[0] for p in pairs], np.uint32)
+    recs["hold_ms"] = np.asarray([p[1] for p in pairs], np.uint32)
+    return _frame(COMM_THROTTLE, recs.tobytes(), magic)
+
+
+def decode_throttle(payload: bytes) -> np.ndarray:
+    n = len(payload) // THROTTLE_DT.itemsize
+    return np.frombuffer(payload, THROTTLE_DT, count=n)
 
 
 # Query multiplexing (ref QUERY_CMD/QUERY_RESPONSE, gy_comm_proto.h:502,
@@ -603,12 +670,31 @@ def encode_register_req(machine_id: int, conn_type: int,
 
 
 def encode_register_resp(status: int, host_id: int,
-                         curr_version: int) -> bytes:
+                         curr_version: int, last_seq: int = 0) -> bytes:
+    """REGISTER_RESP + the v4 trailing extension: the server's durable
+    per-host sweep-seq high-water mark (``last_seq``). Agents built
+    before v4 parse the fixed prefix and ignore the tail; agents that
+    understand it prune already-durable sweeps from their resend spool
+    (the WAL dedup contract, see NOTIFY_SWEEP_SEQ)."""
     r = np.zeros((), REGISTER_RESP_DT)
     r["status"] = status
     r["host_id"] = host_id
     r["curr_version"] = curr_version
-    return _frame(COMM_REGISTER_RESP, r.tobytes(), MAGIC_MS)
+    ext = np.uint64(last_seq).tobytes()
+    return _frame(COMM_REGISTER_RESP, r.tobytes() + ext, MAGIC_MS)
+
+
+def decode_register_resp(payload: bytes) -> tuple[int, int, int, int]:
+    """REGISTER_RESP payload → (status, host_id, curr_version,
+    last_seq). ``last_seq`` is 0 when the server predates the v4
+    extension (16-byte fixed payload only)."""
+    r = np.frombuffer(payload, REGISTER_RESP_DT, count=1)[0]
+    last_seq = 0
+    base = REGISTER_RESP_DT.itemsize
+    if len(payload) >= base + 8:
+        last_seq = int(np.frombuffer(payload, "<u8", 1, base)[0])
+    return (int(r["status"]), int(r["host_id"]),
+            int(r["curr_version"]), last_seq)
 
 
 def encode_query(seqid: int, obj, status: int = QS_OK,

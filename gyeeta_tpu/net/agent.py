@@ -53,10 +53,14 @@ _CONN_ERRORS = (ConnectionError, OSError, EOFError,
                 asyncio.TimeoutError, TimeoutError)
 
 
-async def register(host: str, port: int, machine_id: int, conn_type: int,
-                   wire_version: int = version.CURR_WIRE_VERSION,
-                   hostname_id: int = 0):
-    """Open + register one conn → (reader, writer, status, host_id)."""
+async def register_ex(host: str, port: int, machine_id: int,
+                      conn_type: int,
+                      wire_version: int = version.CURR_WIRE_VERSION,
+                      hostname_id: int = 0):
+    """Open + register one conn → (reader, writer, status, host_id,
+    last_seq). ``last_seq`` is the server's durable sweep-seq
+    high-water mark for this host (0 from pre-v4 servers) — the WAL
+    dedup handshake (see ``wire.NOTIFY_SWEEP_SEQ``)."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
         writer.write(wire.encode_register_req(
@@ -69,8 +73,17 @@ async def register(host: str, port: int, machine_id: int, conn_type: int,
     if dtype != wire.COMM_REGISTER_RESP:
         writer.close()
         raise wire.FrameError(f"expected REGISTER_RESP, got {dtype}")
-    resp = np.frombuffer(payload, wire.REGISTER_RESP_DT, count=1)[0]
-    return reader, writer, int(resp["status"]), int(resp["host_id"])
+    status, host_id, _ver, last_seq = wire.decode_register_resp(payload)
+    return reader, writer, status, host_id, last_seq
+
+
+async def register(host: str, port: int, machine_id: int, conn_type: int,
+                   wire_version: int = version.CURR_WIRE_VERSION,
+                   hostname_id: int = 0):
+    """Open + register one conn → (reader, writer, status, host_id)."""
+    reader, writer, status, host_id, _seq = await register_ex(
+        host, port, machine_id, conn_type, wire_version, hostname_id)
+    return reader, writer, status, host_id
 
 
 class NetAgent:
@@ -152,6 +165,17 @@ class NetAgent:
         # set by the control-loop reader the moment the conn's read
         # half hits EOF/reset — the supervisor's fast-fail signal
         self._conn_dead = False
+        # ---- durable-ingest additions (wire v4)
+        # monotone per-process sweep counter: every built sweep opens
+        # with a NOTIFY_SWEEP_SEQ mark carrying it. The server journals
+        # the high-water mark with its checkpoints and echoes it back
+        # in REGISTER_RESP, so a reconnect prunes already-DURABLE
+        # sweeps from the spool (checkpoint + WAL replay + resend never
+        # double-folds a sweep)
+        self._sweep_seq = 0
+        # server→agent admission control (COMM_THROTTLE): feed class →
+        # monotonic deadline until which that class holds in the spool
+        self._hold_until: dict[int, float] = {}
 
     async def connect(self, host: str, port: int,
                       timeout: Optional[float] = None) -> int:
@@ -181,7 +205,7 @@ class NetAgent:
         self.trace_enabled.clear()
         self._conn_dead = False
         hostname_id = self.machine_id & 0xFFFFFFFF
-        reader, writer, status, hid = await register(
+        reader, writer, status, hid, last_seq = await register_ex(
             host, port, self.machine_id, wire.CONN_EVENT,
             self.wire_version, hostname_id)
         if status != wire.REG_OK:
@@ -189,6 +213,11 @@ class NetAgent:
             raise ConnectionRefusedError(f"registration status {status}")
         self.host_id = hid
         self._writer = writer
+        # the server's durable high-water mark: sweeps at or below it
+        # are already in its checkpoint+WAL — drop them from the resend
+        # surfaces instead of double-folding them (counted)
+        if last_seq:
+            self._prune_acked(last_seq)
         # a 1-host sim rooted at the assigned global host index —
         # glob_ids/task_ids derive from it, so streams are fleet-unique.
         # Sticky reconnects (same hid) KEEP the sim: telemetry produced
@@ -235,6 +264,26 @@ class NetAgent:
                 except (asyncio.IncompleteReadError, ConnectionError,
                         OSError, wire.FrameError):
                     return
+                if dtype == wire.COMM_THROTTLE:
+                    # admission control: hold the named feed classes in
+                    # the spool for hold_ms (0 releases early). Unknown
+                    # feed ids are skipped — forward compatible, the
+                    # NOTIFY_AGENT_STATS versioning discipline
+                    now = asyncio.get_running_loop().time()
+                    for t in wire.decode_throttle(payload):
+                        feed = int(t["feed"])
+                        if feed not in (wire.FEED_TRACE, wire.FEED_ALL):
+                            continue
+                        hold = int(t["hold_ms"])
+                        if hold:
+                            self._hold_until[feed] = now + hold / 1e3
+                            self.stats.bump(
+                                "throttle_held|feed="
+                                + ("all" if feed == wire.FEED_ALL
+                                   else "trace"))
+                        else:
+                            self._hold_until.pop(feed, None)
+                    continue
                 if dtype != wire.COMM_TRACE_SET:
                     continue
                 for r in wire.decode_trace_set(payload):
@@ -285,21 +334,54 @@ class NetAgent:
         self._writer.write(buf)
         await self._writer.drain()
 
+    def _held(self, feed: int) -> bool:
+        """True while the server's COMM_THROTTLE hold on ``feed`` is
+        active (expired holds are dropped lazily)."""
+        until = self._hold_until.get(feed)
+        if until is None:
+            return False
+        try:
+            now = asyncio.get_running_loop().time()
+        except RuntimeError:              # sync caller (tests)
+            import time as _t
+            now = _t.monotonic()
+        if now >= until:
+            del self._hold_until[feed]
+            return False
+        return True
+
+    def _sweep_mark(self) -> bytes:
+        """One NOTIFY_SWEEP_SEQ record opening the sweep (the WAL
+        dedup mark — see ``_connect``)."""
+        self._sweep_seq += 1
+        rec = np.zeros(1, wire.SWEEP_SEQ_DT)
+        rec["host_id"] = self.host_id or 0
+        rec["seq"] = self._sweep_seq
+        return wire.encode_frame(wire.NOTIFY_SWEEP_SEQ, rec)
+
     def build_sweep(self, n_conn: int = 256, n_resp: int = 512) -> bytes:
         """Build one sweep's frames WITHOUT sending (the supervisor
-        keeps producing on cadence during an outage and spools these)."""
+        keeps producing on cadence during an outage and spools these).
+        Opens with a sweep-seq mark (WAL dedup)."""
         s = self.sim
+        mark = self._sweep_mark()
         if self.real:
-            buf = self._real_sweep_frames()
+            buf = mark + self._real_sweep_frames()
         else:
-            buf = (s.conn_frames(n_conn) + s.resp_frames(n_resp)
+            buf = (mark
+                   + s.conn_frames(n_conn) + s.resp_frames(n_resp)
                    + s.listener_frames() + s.task_frames()
                    + wire.encode_frame(wire.NOTIFY_HOST_STATE,
                                        s.host_state_records()))
-            if self.trace_enabled:
+            if self.trace_enabled and not self._held(wire.FEED_TRACE):
                 # capture on for some services: emit their transactions
+                # (priority-aware shedding: a FEED_TRACE hold drops the
+                # trace stream from the sweep FIRST, so svc/task state
+                # — the health classification inputs — degrade last)
                 buf += s.trace_frames(n_resp,
                                       only_svcs=self.trace_enabled)
+            elif self.trace_enabled:
+                self.stats.bump("trace_frames_throttled")
         if self.collect:
             buf += wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
                                      self._cpumem.sample())
@@ -403,16 +485,17 @@ class NetAgent:
         return buf
 
     # --------------------------------------------------- supervision tier
-    def _spool_push(self, buf: bytes, nrec: int) -> None:
+    def _spool_push(self, buf: bytes, nrec: int, seq: int = 0) -> None:
         """Buffer one undelivered sweep; drop-oldest past the byte
         bound, every drop counted (sweeps and records — the no-silent-
-        loss accounting)."""
-        self._spool.append((buf, nrec))
+        loss accounting). ``seq`` is the sweep's dedup mark (0 = not a
+        marked sweep, never pruned by the server ack)."""
+        self._spool.append((buf, nrec, seq))
         self._spool_bytes += len(buf)
         self.stats.bump("sweeps_spooled")
         while self._spool_bytes > self.spool_max_bytes \
                 and len(self._spool) > 1:
-            old, oldrec = self._spool.popleft()
+            old, oldrec, _ = self._spool.popleft()
             self._spool_bytes -= len(old)
             self.stats.bump("spool_dropped")
             self.stats.bump("spool_dropped_records", oldrec)
@@ -422,26 +505,50 @@ class NetAgent:
 
     def spool_records(self) -> int:
         """Records currently buffered (spool + unconfirmed tail)."""
-        return (sum(n for _, n in self._spool)
-                + sum(n for _, n in self._unconfirmed))
+        return (sum(n for _, n, _ in self._spool)
+                + sum(n for _, n, _ in self._unconfirmed))
 
     def _respool_unconfirmed(self) -> None:
         """Conn lost: the last few written sweeps may have died in the
         kernel buffer — move them to the spool front (oldest first) so
         the reconnect resends them (at-least-once delivery)."""
-        for buf, nrec in reversed(self._unconfirmed):
-            self._spool.appendleft((buf, nrec))
+        for buf, nrec, seq in reversed(self._unconfirmed):
+            self._spool.appendleft((buf, nrec, seq))
             self._spool_bytes += len(buf)
         self._unconfirmed.clear()
         # re-apply the bound from the old end
         while self._spool_bytes > self.spool_max_bytes \
                 and len(self._spool) > 1:
-            old, oldrec = self._spool.popleft()
+            old, oldrec, _ = self._spool.popleft()
             self._spool_bytes -= len(old)
             self.stats.bump("spool_dropped")
             self.stats.bump("spool_dropped_records", oldrec)
 
-    async def _send_buf(self, buf: bytes, nrec: int) -> None:
+    def _prune_acked(self, last_seq: int) -> None:
+        """Drop sweeps the server proved DURABLE (seq ≤ its
+        REGISTER_RESP high-water mark) from the spool and the
+        unconfirmed ring: the checkpoint+WAL already hold them, so a
+        resend would double-fold (counted, the dedup half of the WAL
+        contract)."""
+        npruned = nrec_pruned = 0
+        for ring in (self._spool, self._unconfirmed):
+            keep = [e for e in ring
+                    if not (e[2] and e[2] <= last_seq)]
+            npruned += len(ring) - len(keep)
+            for e in ring:
+                if e[2] and e[2] <= last_seq:
+                    nrec_pruned += e[1]
+                    if ring is self._spool:
+                        self._spool_bytes -= len(e[0])
+            ring.clear()
+            ring.extend(keep)
+        if npruned:
+            self.stats.bump("spool_pruned_acked", npruned)
+            self.stats.bump("spool_pruned_records", nrec_pruned)
+            # pruned-from-ring sweeps were delivered AND made durable
+            self.stats.bump("records_sent", nrec_pruned)
+
+    async def _send_buf(self, buf: bytes, nrec: int, seq: int = 0) -> None:
         """Write one sweep and account it as (tentatively) delivered."""
         if self._conn_dead or self._writer.is_closing():
             # the read half already saw the server go away: writing
@@ -453,15 +560,17 @@ class NetAgent:
         evicted = None
         if len(self._unconfirmed) == self._unconfirmed.maxlen:
             evicted = self._unconfirmed[0]
-        self._unconfirmed.append((buf, nrec))
+        self._unconfirmed.append((buf, nrec, seq))
         if evicted is not None:
             self.stats.bump("records_sent", evicted[1])
 
     async def _resend_spool(self) -> None:
-        """Drain the spool over a fresh conn (oldest first)."""
-        while self._spool:
-            buf, nrec = self._spool[0]
-            await self._send_buf(buf, nrec)
+        """Drain the spool over the live conn (oldest first) — on
+        reconnect, and whenever a throttle hold expires with sweeps
+        still buffered."""
+        while self._spool and not self._held(wire.FEED_ALL):
+            buf, nrec, seq = self._spool[0]
+            await self._send_buf(buf, nrec, seq)
             self._spool.popleft()
             self._spool_bytes -= len(buf)
             self.stats.bump("spool_resent")
@@ -551,23 +660,45 @@ class NetAgent:
             now = loop.time()
             if next_sweep is not None and now >= next_sweep:
                 buf = self.build_sweep(n_conn, n_resp)
+                seq = self._sweep_seq
                 nrec = wire.count_events(buf)
                 self.stats.bump("sweeps_built")
                 self.stats.bump("records_built", nrec)
-                if self._writer is not None:
+                if self._writer is not None \
+                        and not self._held(wire.FEED_ALL):
                     try:
-                        await self._send_buf(buf, nrec)
+                        await self._send_buf(buf, nrec, seq)
                     except _CONN_ERRORS:
                         self.stats.bump("agent_disconnects")
                         self._drop_conn()
                         self._respool_unconfirmed()
-                        self._spool_push(buf, nrec)
+                        self._spool_push(buf, nrec, seq)
                         next_retry = loop.time() + backoff * (
                             1.0 + backoff_jitter * rng.random())
                         backoff = min(backoff * 2.0, backoff_cap)
                 else:
-                    self._spool_push(buf, nrec)
+                    # outage OR a server FEED_ALL throttle hold: the
+                    # sweep rides the same bounded spool either way
+                    # (server pressure becomes agent-side spooling)
+                    if self._writer is not None:
+                        self.stats.bump("sweeps_throttled")
+                    self._spool_push(buf, nrec, seq)
                 next_sweep += interval
+            # a throttle hold that expired with sweeps still buffered:
+            # drain them now (the reconnect path drains its own spool)
+            if (self._writer is not None and self._spool
+                    and not self._held(wire.FEED_ALL)):
+                try:
+                    await self._resend_spool()
+                except asyncio.CancelledError:
+                    raise
+                except _CONN_ERRORS:
+                    self.stats.bump("agent_disconnects")
+                    self._drop_conn()
+                    self._respool_unconfirmed()
+                    next_retry = loop.time() + backoff * (
+                        1.0 + backoff_jitter * rng.random())
+                    backoff = min(backoff * 2.0, backoff_cap)
             # ---- sleep until the next deadline (sweep / retry / stop)
             deadlines = []
             if next_sweep is not None:

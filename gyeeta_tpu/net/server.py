@@ -60,7 +60,11 @@ class GytServer:
                  handshake_timeout: float = 10.0,
                  idle_timeout: Optional[float] = None,
                  write_timeout: float = 10.0,
-                 frame_error_budget: int = 8):
+                 frame_error_budget: int = 8,
+                 throttle_hold_ms: int = 1500,
+                 throttle_lag_s: float = 0.75,
+                 throttle_pending_mb: float = 32.0,
+                 throttle_slab_frac: float = 0.85):
         self.rt = rt
         self.host = host
         self.port = port
@@ -83,6 +87,21 @@ class GytServer:
         # reject lands on a labeled counter (conn_timeouts|kind=...,
         # frames_rejected|reason=...) rendered in /metrics.
         self.handshake_timeout = handshake_timeout
+        # ---- admission control (server→agent backpressure): when the
+        # durable-ingest tier falls behind — journal fsync lag past
+        # throttle_lag_s, unsynced WAL bytes past throttle_pending_mb,
+        # staged-slab occupancy past throttle_slab_frac, or the
+        # droppressure vector active — push a COMM_THROTTLE telling
+        # agents to hold feeds in their PR-4 spool for throttle_hold_ms.
+        # Priority-aware (PSketch, PAPERS.md): trace/pcap first
+        # (FEED_TRACE), everything only under engine drop pressure
+        # (FEED_ALL) — health classification degrades last.
+        # throttle_hold_ms=0 disables the controller.
+        self.throttle_hold_ms = int(throttle_hold_ms)
+        self.throttle_lag_s = float(throttle_lag_s)
+        self.throttle_pending_mb = float(throttle_pending_mb)
+        self.throttle_slab_frac = float(throttle_slab_frac)
+        self._throttle_level = 0          # 0=off, 1=trace, 2=all
         if idle_timeout is None:
             idle_timeout = max(30.0, 12.0 * tick_interval) \
                 if tick_interval else 60.0
@@ -110,6 +129,9 @@ class GytServer:
         # reference's CLI_TYPE_RESP_REQ conns carry this, gy_comm_proto.h)
         self._event_writers: dict[int, asyncio.StreamWriter] = {}
         self._open_conns: set = set()      # every live conn's writer
+        self._conn_seq = 0                 # dense conn ids (WAL
+        #                                    attribution: torn tails
+        #                                    name their conn)
         # optional L1/L2 decode pipeline (multi-core hosts): deframe
         # runs on a worker thread; tick/query paths barrier through
         # _feed_barrier so no submitted bytes are invisible at a
@@ -254,12 +276,13 @@ class GytServer:
         self._pending_domains = nxt
 
     # ----------------------------------------------------------- feed path
-    def _feed(self, buf: bytes) -> int:
+    def _feed(self, buf: bytes, hid: int = 0, conn_id: int = 0) -> int:
         """Ingest complete-frame bytes: through the decode pipeline
-        when enabled, else directly."""
+        when enabled, else directly. ``hid``/``conn_id`` attribute the
+        bytes in the write-ahead journal."""
         if self._pipe is not None:
-            return self._pipe.feed(buf)
-        return self.rt.feed(buf)
+            return self._pipe.feed(buf, hid=hid, conn_id=conn_id)
+        return self.rt.feed(buf, hid=hid, conn_id=conn_id)
 
     def _feed_barrier(self) -> None:
         """Make every submitted byte visible (pipeline barrier) before
@@ -307,10 +330,84 @@ class GytServer:
                 self.rt.run_tick()
                 self._resolve_pending_domains()
                 await self.push_trace_control()
+                await self.push_throttle()
                 if self.watchdog is not None:
                     self.watchdog.beat()      # liveness heartbeat
             except Exception:                     # pragma: no cover
                 log.exception("tick failed")
+
+    # ------------------------------------------------- admission control
+    def throttle_level(self) -> int:
+        """Evaluate the durable-ingest pressure signals → 0 (open),
+        1 (hold trace/pcap feeds), 2 (hold every sweep). Reads the
+        gauges ``run_tick``'s one-readback health pass just refreshed
+        — no extra device transfer."""
+        if not self.throttle_hold_ms:
+            return 0
+        g = self.rt.stats.gauges
+        # engine drop pressure: the engine is ALREADY shedding — hold
+        # everything (spooled sweeps beat probe-failure garbage)
+        if g.get("engine_drop_pressure"):
+            return 2
+        lvl = 0
+        if g.get("journal_fsync_lag_seconds", 0.0) > self.throttle_lag_s:
+            lvl = 1
+        if g.get("journal_pending_bytes", 0.0) \
+                > self.throttle_pending_mb * (1 << 20):
+            lvl = 1
+        # staged-slab occupancy: records accepted but not yet folded
+        cap = max(1, (self.rt.cfg.conn_batch + self.rt.cfg.resp_batch)
+                  * self.rt.cfg.fold_k)
+        staged = (getattr(self.rt, "_n_conn_raw", 0)
+                  + getattr(self.rt, "_n_resp_raw", 0))
+        if staged / cap > self.throttle_slab_frac:
+            lvl = 1
+        return lvl
+
+    async def push_throttle(self) -> int:
+        """Admission-control push: (re)issue COMM_THROTTLE holds while
+        pressure persists, release early when it clears. Every
+        transition lands on ``throttle|feed=...`` (rendered as
+        ``gyt_throttle_total{feed=...}``); the current level rides the
+        ``throttle_state`` gauge. Returns frames pushed."""
+        lvl = self.throttle_level()
+        prev = self._throttle_level
+        if lvl != prev:
+            if lvl == 2:
+                self.rt.stats.bump("throttle|feed=all")
+            elif lvl == 1:
+                self.rt.stats.bump("throttle|feed=trace")
+            else:
+                self.rt.stats.bump("throttle_released")
+            self.rt.notifylog.add(
+                f"admission control: throttle level {prev} -> {lvl} "
+                f"(journal lag/pending, slab occupancy, droppressure)",
+                ntype="warn" if lvl else "info", source="selfmon")
+        self._throttle_level = lvl
+        self.rt.stats.gauge("throttle_state", float(lvl))
+        if lvl == 0 and prev == 0:
+            return 0                      # steady open state: no frame
+        # one frame always carries BOTH classes with their hold: a
+        # level drop releases the no-longer-held class early (hold 0)
+        # instead of waiting out its deadline on the agent
+        frame = wire.encode_throttle_multi(
+            ((wire.FEED_TRACE, self.throttle_hold_ms if lvl >= 1 else 0),
+             (wire.FEED_ALL, self.throttle_hold_ms if lvl == 2 else 0)))
+        n = 0
+        for hid, w in list(self._event_writers.items()):
+            try:
+                w.write(frame)
+                if self.write_timeout:
+                    await asyncio.wait_for(w.drain(), self.write_timeout)
+                else:
+                    await w.drain()
+                n += 1
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    TimeoutError):
+                # a dead conn re-learns the hold on reconnect (the
+                # controller re-pushes every tick while pressure holds)
+                continue
+        return n
 
     async def push_trace_control(self) -> int:
         """Evaluate tracedefs and push enable/disable diffs to the
@@ -374,7 +471,8 @@ class GytServer:
         carries bytes already peeked off the stream."""
         return await wire.read_frame(reader, first)
 
-    async def _ref_conn(self, reader, writer, first: bytes) -> None:
+    async def _ref_conn(self, reader, writer, first: bytes,
+                        conn_id: int = 0) -> None:
         """Stock-partha connection: the gy_comm_proto registration
         handshake, then the reference NOTIFY stream via the adapter.
 
@@ -462,7 +560,8 @@ class GytServer:
                     reader, host_id,
                     ref_session=refproto.RefSession(
                         region=req.get("region_name", ""),
-                        zone=req.get("zone_name", "")))
+                        zone=req.get("zone_name", "")),
+                    conn_id=conn_id)
                 return
             elif dtype == refquery.REF_COMM_NM_CONNECT_CMD:
                 # stock node webserver: the query edge (NM_CONNECT_CMD_S
@@ -498,6 +597,8 @@ class GytServer:
     async def _handle_conn(self, reader, writer) -> None:
         peer = writer.get_extra_info("peername")
         self._open_conns.add(writer)
+        self._conn_seq += 1
+        conn_id = self._conn_seq
         try:
             # peek the first header: a reference COMM_HEADER magic means
             # a STOCK PARTHA — route it through the gy_comm_proto
@@ -513,7 +614,7 @@ class GytServer:
                 return
             if int.from_bytes(first, "little") in refproto.REF_MAGICS:
                 try:
-                    await self._ref_conn(reader, writer, first)
+                    await self._ref_conn(reader, writer, first, conn_id)
                 except (asyncio.IncompleteReadError, ConnectionError,
                         _ConnReaped):
                     pass
@@ -530,8 +631,17 @@ class GytServer:
                 return
             req = np.frombuffer(payload, wire.REGISTER_REQ_DT, count=1)[0]
             status, host_id = self._register(req)
+            # v4 tail: the durable sweep-seq high-water mark for this
+            # host — a reconnecting agent prunes already-durable sweeps
+            # from its resend spool (the WAL dedup contract)
+            last_seq = 0
+            if (status == wire.REG_OK
+                    and int(req["conn_type"]) == wire.CONN_EVENT
+                    and host_id != 0xFFFFFFFF):
+                last_seq = int(getattr(self.rt, "_sweep_last_seq",
+                                       {}).get(host_id, 0))
             writer.write(wire.encode_register_resp(
-                status, host_id, version.CURR_WIRE_VERSION))
+                status, host_id, version.CURR_WIRE_VERSION, last_seq))
             await writer.drain()
             if status != wire.REG_OK:
                 return
@@ -541,7 +651,8 @@ class GytServer:
                     # reconnect resync: re-push full capture state
                     self.rt.tracedefs.forget_host(host_id)
                 try:
-                    await self._event_loop(reader, host_id)
+                    await self._event_loop(reader, host_id,
+                                           conn_id=conn_id)
                 finally:
                     if self._event_writers.get(host_id) is writer:
                         del self._event_writers[host_id]
@@ -569,7 +680,7 @@ class GytServer:
                 pass
 
     async def _event_loop(self, reader, host_id: int = 0,
-                          ref_session=None) -> None:
+                          ref_session=None, conn_id: int = 0) -> None:
         """Bulk ingest: socket bytes → Runtime.feed.
 
         Partial-frame reassembly happens HERE, per connection: the
@@ -614,7 +725,7 @@ class GytServer:
                     raise
                 pending = data[k:]
                 if gyt:
-                    self._feed(gyt)
+                    self._feed(gyt, host_id, conn_id)
                     # pipeline mode records inside the pipeline (only
                     # validated buffers)
                     rec = self._recorder
@@ -636,7 +747,7 @@ class GytServer:
                 # feed FIRST: a chunk that fails deep validation
                 # (nevents caps) must not poison the capture file —
                 # recorded bytes are exactly the ingested bytes
-                self._feed(data[:k])
+                self._feed(data[:k], host_id, conn_id)
                 rec = self._recorder   # no await between check & write
                 if rec is not None and self._pipe is None:
                     rec.write(data[:k])

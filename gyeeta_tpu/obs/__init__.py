@@ -44,8 +44,20 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
         for k, v in eng.items():
             lines.append(f"  {k:<36} {v}")
 
+    # durable-ingest surface: WAL fsync lag (the RPO bound), unsynced
+    # bytes, segment footprint, replay/torn-tail counters, and the
+    # admission-control state — the disk half of the health picture
+    dur = {k: v for k, v in sorted(c.items())
+           if str(k).startswith(("journal_", "wal_", "throttle"))}
+    if dur:
+        lines.append("")
+        lines.append("durability / backpressure:")
+        for k, v in dur.items():
+            lines.append(f"  {k:<36} {v}")
+
     plain = {k: v for k, v in sorted(c.items())
-             if not str(k).startswith("engine_")
+             if not str(k).startswith(("engine_", "journal_", "wal_",
+                                       "throttle"))
              and isinstance(v, (int, float))}
     lines.append("")
     hdr = f"  {'counter':<36} {'total':>12}"

@@ -89,6 +89,25 @@ class ShardedRuntime:
         self._t_started = self._clock()
         self._tick_no = 0
         self._pending = b""
+        # write-ahead event journal (utils/journal.py): one ingest-edge
+        # WAL for the whole mesh — the single controller owns every
+        # shard's ingest, so chunks journal once at the wire boundary
+        # (tagged with hid) and replay routes per-shard through the
+        # normal ``feed`` path; a future multi-controller split can
+        # partition segments by the recorded hid
+        self.journal = None
+        if self.opts.journal_dir:
+            from gyeeta_tpu.utils.journal import Journal
+            self.journal = Journal(
+                self.opts.journal_dir,
+                segment_max_bytes=self.opts.journal_segment_mb << 20,
+                fsync_bytes=self.opts.journal_fsync_kb << 10,
+                fsync_ms=self.opts.journal_fsync_ms,
+                backlog_max_bytes=self.opts.journal_backlog_mb << 20,
+                stats=self.stats, clock=clock)
+        self._journal_replaying = False
+        # per-host sweep-seq high-water marks (the WAL dedup state)
+        self._sweep_last_seq: dict = {}
         # conn/resp slab staging (same discipline as the single-node
         # runtime): raw record arrays accumulate and route+decode+fold
         # as ONE wide per-shard dispatch per fold_k·B records
@@ -204,7 +223,7 @@ class ShardedRuntime:
         return sharded.put_sharded(self.mesh, sharded.shard_batches(
             self.cfg, self.mesh, (b, lanes), recs, recs["host_id"]))
 
-    def feed(self, buf: bytes) -> int:
+    def feed(self, buf: bytes, hid: int = 0, conn_id: int = 0) -> int:
         """Byte stream → routed stacked batches → sharded folds."""
         data = (self._pending + buf) if self._pending else buf
         try:
@@ -218,10 +237,23 @@ class ShardedRuntime:
             self._pending = b""
             raise
         self._pending = data[consumed:]
+        # WAL append post-validation / pre-fold (see Runtime.feed)
+        if (consumed and self.journal is not None
+                and not self._journal_replaying):
+            self.journal.append(data[:consumed], hid=hid,
+                                conn_id=conn_id, tick=self._tick_no)
         if unknown:
             self.stats.bump("records_unknown_subtype", unknown)
         n = 0
         self._cols.bump()
+        # sweep-seq marks → per-host high-water mark (WAL dedup)
+        sw = recs.pop(wire.NOTIFY_SWEEP_SEQ, None)
+        if sw is not None and len(sw):
+            for h, s in zip(sw["host_id"].tolist(), sw["seq"].tolist()):
+                if s > self._sweep_last_seq.get(h, 0):
+                    self._sweep_last_seq[h] = s
+            self.stats.bump("sweep_marks", len(sw))
+            n += len(sw)
         # conn/resp hot path: stage RAW record arrays; a full slab
         # (fold_k microbatches' worth) routes + decodes + folds as ONE
         # wide per-shard dispatch (the single-node slab discipline)
@@ -699,6 +731,8 @@ class ShardedRuntime:
                                        n_shards=self.n))
         gauges["native_decode_available"] = \
             1.0 if native.available() else 0.0
+        if self.journal is not None:
+            gauges.update(self.journal.gauges())
         for k, v in gauges.items():
             self.stats.gauge(k, v)
         return gauges
@@ -746,6 +780,25 @@ class ShardedRuntime:
         self.netifs.age()
         self.natclusters.age()
         self.traceconns.age()
+        # journal fsync cadence backstop + checkpoint-with-WAL-position
+        # (same durability contract as the single-node Runtime: the
+        # checkpoint records the fsynced journal position and
+        # supersedes older segments)
+        if self.journal is not None:
+            self.journal.poll()
+        if (self.opts.checkpoint_dir
+                and self._tick_no % self.opts.checkpoint_every_ticks
+                == 0):
+            from gyeeta_tpu.utils import checkpoint as ckpt
+            from gyeeta_tpu.utils import journal as J
+            extra = J.checkpoint_extra(self, self._tick_no)
+            path = ckpt.save(
+                f"{self.opts.checkpoint_dir}/"
+                f"gyt_ckpt_{self._tick_no:08d}.npz",
+                self.cfg, self.state, extra=extra)
+            J.post_checkpoint_truncate(self, extra)
+            report["checkpoint"] = str(path)
+            self.stats.bump("checkpoints")
         # the window tick / ageing above changed every view
         self._cols.bump()
         return report
@@ -779,6 +832,54 @@ class ShardedRuntime:
         self._profiler.close()
         self.alerts.close()
         self.dns.close()
+        if self.journal is not None:
+            self.journal.close()      # fsync + close (idempotent)
+
+    # -------------------------------------------------- restore/recovery
+    def restore(self, path) -> dict:
+        """Restore a checkpoint saved by a SAME-GEOMETRY mesh run (the
+        stacked ``(n_shards, …)`` leaves re-shard onto this mesh).
+        Mirrors ``Runtime.restore``: staged records and partial-frame
+        bytes from before the restore are dropped (folding them into
+        checkpointed state would double-count)."""
+        from gyeeta_tpu.utils import checkpoint as ckpt
+
+        self._conn_raw, self._resp_raw = [], []
+        self._n_conn_raw = self._n_resp_raw = 0
+        self._pending = b""
+        self._cols.bump()
+        self._cols.clear()
+        self._td_dirty = True
+        self._pressure = None
+        state_np, extra = ckpt.restore(path, self.cfg, self.state)
+        # re-shard every leaf with its live counterpart's sharding (the
+        # checkpoint stores gathered host arrays; shapes were already
+        # validated against this mesh's stacked geometry)
+        self.state = jax.tree_util.tree_map(
+            lambda a, ref: jax.device_put(a, ref.sharding),
+            state_np, self.state)
+        # the dep graph is not checkpointed: reset (edges rebuild from
+        # live traffic), replicated-per-shard like __init__
+        shd = leading_sharding(self.mesh)
+        self.dep = jax.device_put(
+            jax.tree.map(
+                lambda x: np.broadcast_to(
+                    np.asarray(x)[None], (self.n,) + np.asarray(x).shape),
+                dg.init(self.opts.dep_pair_capacity,
+                        self.opts.dep_edge_capacity)), shd)
+        self._tick_no = int(extra.get("tick", 0))
+        self._sweep_last_seq = {
+            int(k): int(v)
+            for k, v in extra.get("sweep_seq", {}).items()}
+        return extra
+
+    def replay_journal(self, pos=None) -> dict:
+        """Re-fold WAL chunks from ``pos`` through the normal
+        decode/fold path (chunks journal once at the mesh's single
+        ingest edge; ``feed`` routes records per-shard by host_id, so
+        replay is per-shard by construction)."""
+        from gyeeta_tpu.utils import journal as J
+        return J.replay_journal(self, pos)
 
     def rollup_stats(self) -> dict:
         """Replicated cluster totals (the MS_CLUSTER_STATE analogue)."""

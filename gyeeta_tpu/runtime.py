@@ -55,6 +55,25 @@ class Runtime:
         self.history = (open_store(self.opts.history_db)
                         if self.opts.history_db else None)
         self._clock = clock or time.time
+        # write-ahead event journal (utils/journal.py): every accepted
+        # event-stream chunk appends post-validation/pre-fold; recovery
+        # re-folds from the checkpoint's recorded position (bounds data
+        # loss to the last group fsync, not the last checkpoint)
+        self.journal = None
+        if self.opts.journal_dir:
+            from gyeeta_tpu.utils.journal import Journal
+            self.journal = Journal(
+                self.opts.journal_dir,
+                segment_max_bytes=self.opts.journal_segment_mb << 20,
+                fsync_bytes=self.opts.journal_fsync_kb << 10,
+                fsync_ms=self.opts.journal_fsync_ms,
+                backlog_max_bytes=self.opts.journal_backlog_mb << 20,
+                stats=self.stats, clock=clock)
+        self._journal_replaying = False
+        # per-host sweep-seq high-water marks (NOTIFY_SWEEP_SEQ): the
+        # WAL dedup state — checkpointed, rebuilt by replay, echoed to
+        # reconnecting agents so resend + replay never double-counts
+        self._sweep_last_seq: dict[int, int] = {}
         self._tick_no = 0             # host-side mirror of the window tick
         self._pending = b""           # partial-frame resume buffer
         # conn/resp hot path stages RAW record arrays; decode happens
@@ -202,11 +221,13 @@ class Runtime:
         self._classify = derive.jit_classify_pass(self.cfg)
 
     # ------------------------------------------------------------- ingest
-    def feed(self, buf: bytes) -> int:
+    def feed(self, buf: bytes, hid: int = 0, conn_id: int = 0) -> int:
         """Ingest a byte stream (any number of frames, any mix of types).
 
         Returns records accepted. Trailing partial frames are buffered for
-        the next call (epoll partial-read resume semantics).
+        the next call (epoll partial-read resume semantics). ``hid`` /
+        ``conn_id`` attribute the bytes in the write-ahead journal (the
+        serving edge passes them; direct feeds default to 0).
 
         Hot-path discipline (the DB_WRITE_ARR batching of the reference,
         ``server/gy_mconnhdlr.h:350``): raw conn/resp record arrays are
@@ -232,6 +253,14 @@ class Runtime:
             self._pending = b""       # poison frame: drop buffer, resync
             raise
         self._pending = data[consumed:]
+        # WAL append AFTER validation, BEFORE the fold: exactly the
+        # bytes drain2 accepted (a pending partial frame journals in
+        # the call that completes it — each byte exactly once). Replay
+        # suppresses the append (chunks are already in the WAL).
+        if (consumed and self.journal is not None
+                and not self._journal_replaying):
+            self.journal.append(data[:consumed], hid=hid,
+                                conn_id=conn_id, tick=self._tick_no)
         if unknown:
             # skipped unknown-subtype frames (version skew / corrupted
             # subtype byte): accounted loss, never silent loss
@@ -243,6 +272,15 @@ class Runtime:
         deframe half of :meth:`feed` — the feed pipeline's decode
         worker hands these over, ``ingest/pipeline.py``)."""
         n = 0
+        # sweep-seq marks: advance the per-host high-water mark (max is
+        # order-insensitive, so the concatenated drain order is fine)
+        sw = recs.pop(wire.NOTIFY_SWEEP_SEQ, None)
+        if sw is not None and len(sw):
+            for h, s in zip(sw["host_id"].tolist(), sw["seq"].tolist()):
+                if s > self._sweep_last_seq.get(h, 0):
+                    self._sweep_last_seq[h] = s
+            self.stats.bump("sweep_marks", len(sw))
+            n += len(sw)
         # conn/resp hot path: stage the raw record arrays as-is — the
         # per-slab decode in _dispatch_slab is the only decode they get
         conn = recs.pop(wire.NOTIFY_TCP_CONN, None)
@@ -474,6 +512,10 @@ class Runtime:
         # scrape-level signal, not just a growing fallback counter
         gauges["native_decode_available"] = \
             1.0 if native.available() else 0.0
+        # WAL health rides the same one-readback report path: fsync lag
+        # (the RPO bound), pending bytes, segment footprint
+        if self.journal is not None:
+            gauges.update(self.journal.gauges())
         for k, v in gauges.items():
             self.stats.gauge(k, v)
         return gauges
@@ -584,11 +626,21 @@ class Runtime:
             self.stats.bump("task_compactions")
             report["task_compacted"] = True
 
+        # journal fsync cadence backstop: appends check the ms budget
+        # themselves, but a quiet wire must not hold bytes unsynced
+        # past a tick
+        if self.journal is not None:
+            self.journal.poll()
         if (self.opts.checkpoint_dir
                 and tick % self.opts.checkpoint_every_ticks == 0):
+            from gyeeta_tpu.utils import journal as J
+            extra = J.checkpoint_extra(self, tick)
             path = ckpt.save(
                 f"{self.opts.checkpoint_dir}/gyt_ckpt_{tick:08d}.npz",
-                self.cfg, self.state, extra={"tick": tick})
+                self.cfg, self.state, extra=extra)
+            # the checkpoint supersedes older WAL segments: drop them
+            # (bounds journal disk to ~one checkpoint interval)
+            J.post_checkpoint_truncate(self, extra)
             report["checkpoint"] = str(path)
             self.stats.bump("checkpoints")
         # the window tick / aging / compaction above changed every view
@@ -759,6 +811,8 @@ class Runtime:
         self._profiler.close()        # flush a short-lived jax trace
         self.alerts.close()
         self.dns.close()
+        if self.journal is not None:
+            self.journal.close()      # fsync + close (idempotent)
         if self.history is not None:
             try:
                 self.history.db.close()
@@ -784,4 +838,16 @@ class Runtime:
         self.dep = dg.init(self.opts.dep_pair_capacity,
                            self.opts.dep_edge_capacity)
         self._tick_no = int(extra.get("tick", 0))
+        # sweep-seq high-water marks through checkpoint time; WAL
+        # replay advances them for the post-checkpoint window
+        self._sweep_last_seq = {
+            int(k): int(v)
+            for k, v in extra.get("sweep_seq", {}).items()}
         return extra
+
+    def replay_journal(self, pos=None) -> dict:
+        """Re-fold WAL chunks from ``pos`` (a checkpoint's recorded
+        position; None = journal start) through the normal decode/fold
+        path — the recovery phase of ``--restore-latest``."""
+        from gyeeta_tpu.utils import journal as J
+        return J.replay_journal(self, pos)

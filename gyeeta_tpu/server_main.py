@@ -37,12 +37,31 @@ class Daemon:
             args.config,
             **({"history_db": args.history_db} if args.history_db else {}),
             **({"checkpoint_dir": args.checkpoint_dir}
-               if args.checkpoint_dir else {}))
+               if args.checkpoint_dir else {}),
+            **({"journal_dir": args.journal_dir}
+               if getattr(args, "journal_dir", None) else {}),
+            **({"journal_fsync_ms": args.journal_fsync_ms}
+               if getattr(args, "journal_fsync_ms", None) is not None
+               else {}),
+            **({"journal_fsync_kb": args.journal_fsync_kb}
+               if getattr(args, "journal_fsync_kb", None) is not None
+               else {}),
+            **({"journal_segment_mb": args.journal_segment_mb}
+               if getattr(args, "journal_segment_mb", None) is not None
+               else {}))
+        # a crash mid-`checkpoint.save` leaves .tmp.npz staging files
+        # behind; without a start-time sweep they accumulate forever
+        if opts.checkpoint_dir:
+            from gyeeta_tpu.utils import checkpoint as _ck
+            n = _ck.sweep_stale_tmp(opts.checkpoint_dir)
+            if n:
+                log.info("swept %d stale .tmp.npz staging file(s)", n)
         self.rt = Runtime(cfg, opts)
         if args.restore:
             extra = self.rt.restore(args.restore)
             log.info("restored checkpoint %s (tick %s)", args.restore,
                      extra.get("tick"))
+            _replay_wal(self.rt, extra)
         elif getattr(args, "restore_latest", False):
             if restore_latest_checkpoint(
                     self.rt, opts.checkpoint_dir) is None:
@@ -60,7 +79,13 @@ class Daemon:
                              write_timeout=getattr(
                                  args, "write_timeout", 10.0),
                              frame_error_budget=getattr(
-                                 args, "frame_error_budget", 8))
+                                 args, "frame_error_budget", 8),
+                             throttle_hold_ms=getattr(
+                                 args, "throttle_hold_ms", 1500),
+                             throttle_lag_s=getattr(
+                                 args, "throttle_lag_s", 0.75),
+                             throttle_pending_mb=getattr(
+                                 args, "throttle_pending_mb", 32.0))
         self._hot = C.HotReload(args.config, opts) if args.config else None
         self.stop_event = asyncio.Event()
 
@@ -118,9 +143,13 @@ class Daemon:
                     "gyeeta_tpu.ingest.native.build`)",
                     d["ref_fallback_decoded"])
             # engine device-health gauges (refreshed each tick by the
-            # batched readback) — the print_stats() cadence analogue
+            # batched readback) — the print_stats() cadence analogue;
+            # the durable-ingest gauges (journal fsync lag = the RPO
+            # bound, unsynced WAL bytes, throttle state) ride the same
+            # line: one glance covers device AND disk pressure
             eng = {k: v for k, v in self.rt.stats.gauges.items()
-                   if k.startswith("engine_")}
+                   if k.startswith(("engine_", "journal_",
+                                    "throttle_state"))}
             if eng:
                 log.info("health %s", json.dumps(eng, default=str,
                                                  sort_keys=True))
@@ -139,16 +168,22 @@ class Daemon:
 
     async def shutdown(self) -> None:
         """Graceful stop: stop accepting, drain staged folds, final
-        checkpoint (the SIGTERM path of the reference's init proc)."""
+        checkpoint recording the fsynced journal position, then drop
+        the WAL segments that checkpoint supersedes (the SIGTERM path
+        of the reference's init proc). A clean shutdown therefore
+        leaves an EMPTY WAL window: the respawn replays zero chunks."""
         log.info("shutting down: draining staged slabs")
-        await self.srv.stop()
+        await self.srv.stop()          # closes rt (journal fsync+close)
         self.rt.flush()
         if self.rt.opts.checkpoint_dir:
             from gyeeta_tpu.utils import checkpoint as ckpt
+            from gyeeta_tpu.utils import journal as J
             tick = self.rt._tick_no
+            extra = J.checkpoint_extra(self.rt, tick)
             path = ckpt.save(
                 f"{self.rt.opts.checkpoint_dir}/gyt_final_{tick:08d}.npz",
-                self.rt.cfg, self.rt.state, extra={"tick": tick})
+                self.rt.cfg, self.rt.state, extra=extra)
+            J.post_checkpoint_truncate(self.rt, extra)
             log.info("final checkpoint: %s", path)
         log.info("bye")
 
@@ -190,22 +225,46 @@ def latest_checkpoint(ckpt_dir: Optional[str]):
     return cands[0] if cands else None
 
 
+def _replay_wal(rt, extra: Optional[dict]) -> dict:
+    """Recovery phase 2: re-fold write-ahead-journal chunks from the
+    checkpoint's recorded position (``extra["wal"]``; a cold start
+    replays the whole journal) through the normal decode/fold path.
+    No-op without a journal. Returns the replay report."""
+    if getattr(rt, "journal", None) is None:
+        return {"chunks": 0, "records": 0}
+    pos = (extra or {}).get("wal")
+    rep = rt.replay_journal(tuple(pos) if pos else None)
+    if rep["chunks"]:
+        log.info("WAL replay: %d chunk(s) / %d record(s) re-folded "
+                 "(from %s)", rep["chunks"], rep["records"],
+                 "checkpoint position" if pos else "journal start")
+    else:
+        log.info("WAL replay: empty window (clean shutdown or no "
+                 "post-checkpoint traffic)")
+    return rep
+
+
 def restore_latest_checkpoint(rt, ckpt_dir: Optional[str]):
     """The ``--restore-latest`` respawn path: walk checkpoints newest→
-    oldest and restore the first usable one into ``rt``. A truncated /
-    corrupt / cfg-mismatched newest file (torn by a crash mid-write)
-    must NEVER crash-loop a supervised restart — it logs and falls
-    through to the next-older candidate. Returns the restored path, or
-    None (cold start)."""
+    oldest and restore the first usable one into ``rt``, then replay
+    the write-ahead journal from that checkpoint's recorded position
+    (when ``rt`` has one — the crash-window recovery that bounds data
+    loss to the last fsync). A truncated / corrupt / cfg-mismatched
+    newest file (torn by a crash mid-write) must NEVER crash-loop a
+    supervised restart — it logs and falls through to the next-older
+    candidate. Returns the restored path, or None (cold start; a cold
+    start with a non-empty journal still replays it)."""
     for cand in checkpoint_candidates(ckpt_dir):
         try:
             extra = rt.restore(cand)
             log.info("restored checkpoint %s (tick %s)", cand,
                      extra.get("tick"))
+            _replay_wal(rt, extra)
             return cand
         except Exception as e:  # noqa: BLE001 — corrupt / mismatched
             log.warning("checkpoint %s unusable (%s) — trying older",
                         cand, e)
+    _replay_wal(rt, None)
     return None
 
 
@@ -250,6 +309,32 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--frame-error-budget", type=int, default=8,
                     help="recoverable frame-level errors per query "
                     "conn before it is closed")
+    # durable-ingest tier: write-ahead journal + admission control
+    # (utils/journal.py; OPERATIONS.md "Durability & recovery")
+    ap.add_argument("--journal-dir",
+                    help="write-ahead event journal directory: every "
+                    "accepted event chunk is appended pre-fold and "
+                    "replayed on --restore-latest, bounding data loss "
+                    "to the last group fsync (unset = journaling off)")
+    ap.add_argument("--journal-fsync-ms", type=float, default=None,
+                    help="group-fsync time cadence in ms (the RPO "
+                    "bound; default 50)")
+    ap.add_argument("--journal-fsync-kb", type=int, default=None,
+                    help="group-fsync byte cadence in KiB (default "
+                    "1024; whichever cadence trips first syncs)")
+    ap.add_argument("--journal-segment-mb", type=int, default=None,
+                    help="journal segment rotation size in MiB "
+                    "(default 64)")
+    ap.add_argument("--throttle-hold-ms", type=int, default=1500,
+                    help="admission control: how long a COMM_THROTTLE "
+                    "tells agents to hold feeds in their spool when "
+                    "ingest pressure trips (0 disables the controller)")
+    ap.add_argument("--throttle-lag-s", type=float, default=0.75,
+                    help="journal fsync lag that trips the trace-feed "
+                    "throttle")
+    ap.add_argument("--throttle-pending-mb", type=float, default=32.0,
+                    help="unsynced WAL bytes that trip the trace-feed "
+                    "throttle")
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
 

@@ -57,7 +57,33 @@ def save(path, cfg, state, extra: dict | None = None) -> pathlib.Path:
             os.close(dfd)
     except OSError:               # pragma: no cover — exotic fs
         pass
+    # a crash between open(tmp) and the rename strands a .tmp.npz; left
+    # alone they accumulate forever in the checkpoint dir. Each
+    # SUCCESSFUL save sweeps siblings (its own tmp was just renamed
+    # away, so anything still matching is a previous crash's orphan).
+    sweep_stale_tmp(path.parent)
     return path
+
+
+def sweep_stale_tmp(ckpt_dir) -> int:
+    """Delete ``*.tmp.npz`` staging orphans left by a crash
+    mid-:func:`save`. Called on daemon start and after each successful
+    save; never touches completed checkpoints (the
+    ``checkpoint_candidates`` walk already excludes tmp files, so this
+    is disk hygiene, not correctness). Returns files removed."""
+    import os as _os
+
+    n = 0
+    d = pathlib.Path(ckpt_dir)
+    if not d.is_dir():
+        return 0
+    for p in d.glob("*.tmp.npz"):
+        try:
+            _os.unlink(p)
+            n += 1
+        except OSError:           # pragma: no cover — already gone
+            pass
+    return n
 
 
 def restore(path, cfg, like):

@@ -64,6 +64,21 @@ class RuntimeOpts(NamedTuple):
     dep_edge_capacity: int = 16384          # dependency edges tracked
     dep_pair_ttl_ticks: int = 24            # unpaired halves expire (2 min)
     dep_edge_ttl_ticks: int = 720           # idle edges expire (1 h)
+    # write-ahead event journal (utils/journal.py): bounds data loss to
+    # the last group fsync instead of the last checkpoint. None = off.
+    journal_dir: Optional[str] = None
+    journal_segment_mb: int = 64            # segment rotation size
+    journal_fsync_kb: int = 1024            # group-fsync byte cadence
+    journal_fsync_ms: float = 50.0          # …or ms cadence (first wins);
+    #                                         RPO ≈ max pending bytes age
+    #                                         — see OPERATIONS.md
+    #                                         "Durability & recovery"
+    journal_backlog_mb: int = 64            # writer-thread backlog bound:
+    #                                         past it the oldest queued
+    #                                         chunks drop COUNTED (the
+    #                                         wire outran the disk; the
+    #                                         admission controller
+    #                                         throttles before this)
 
 
 def _coerce(key: str, v: Any):

@@ -32,11 +32,18 @@ _CHDR = struct.Struct("<QII")
 
 
 class StreamRecorder:
-    """Append-only capture file; one ``write`` per byte run."""
+    """Append-only capture file; one ``write`` per byte run.
 
-    def __init__(self, path, clock=None):
+    ``fsync=True`` makes every chunk durable before ``write`` returns
+    (power-loss-proof captures — the flush alone only survives a
+    process crash, not a host crash)."""
+
+    def __init__(self, path, clock=None, fsync: bool = False):
+        import os as _os
         self.path = pathlib.Path(path)
         self._clock = clock or time.time
+        self._fsync = fsync
+        self._os_fsync = _os.fsync
         self._f = open(self.path, "ab")
         if self._f.tell() == 0:
             self._f.write(MAGIC)
@@ -51,6 +58,8 @@ class StreamRecorder:
         # each so a server crash loses at most the OS buffer, and never
         # a chunk header without its payload
         self._f.flush()
+        if self._fsync:
+            self._os_fsync(self._f.fileno())
 
     def flush(self) -> None:
         self._f.flush()
@@ -59,20 +68,30 @@ class StreamRecorder:
         self._f.close()
 
 
-def read_chunks(path) -> Iterator[tuple[int, bytes]]:
+def read_chunks(path, stats=None) -> Iterator[tuple[int, bytes]]:
     """Yield (t_usec, chunk_bytes); validates the magic. Streams —
-    captures can reach many GB at product ingest rates."""
+    captures can reach many GB at product ingest rates.
+
+    A byte-chopped final chunk (crash mid-write / torn copy) ends the
+    walk CLEANLY: counted on ``stats`` as ``replay_torn_tail`` when a
+    registry is passed, never a struct error or a partial-payload
+    yield."""
     with open(path, "rb") as f:
         if f.read(len(MAGIC)) != MAGIC:
             raise ValueError(f"{path}: not a GYTREC capture")
         while True:
             hdr = f.read(_CHDR.size)
             if len(hdr) < _CHDR.size:
+                if hdr and stats is not None:
+                    stats.bump("replay_torn_tail")
                 return
             tus, n, _pad = _CHDR.unpack(hdr)
             chunk = f.read(n)
             if len(chunk) < n:
-                return                 # truncated tail (crash mid-write)
+                # truncated tail (crash mid-write): counted, clean stop
+                if stats is not None:
+                    stats.bump("replay_torn_tail")
+                return
             yield tus, chunk
 
 
@@ -116,8 +135,8 @@ def remap_host_ids(buf: bytes, offset: int) -> bytes:
     return b"".join(out)
 
 
-def paced_chunks(path, speed: float = 0.0,
-                 host_id_offset: int = 0) -> Iterator[tuple[float, bytes]]:
+def paced_chunks(path, speed: float = 0.0, host_id_offset: int = 0,
+                 stats=None) -> Iterator[tuple[float, bytes]]:
     """Yield (delay_seconds, ready-to-feed bytes) for a capture — the
     ONE implementation of pacing, partial-frame reassembly, and host-id
     remapping, shared by the sync :func:`play` and the async CLI (which
@@ -126,7 +145,7 @@ def paced_chunks(path, speed: float = 0.0,
     t0: Optional[int] = None
     w0 = time.monotonic()
     pending = b""
-    for tus, chunk in read_chunks(path):
+    for tus, chunk in read_chunks(path, stats=stats):
         delay = 0.0
         if speed > 0:
             if t0 is None:
@@ -145,16 +164,17 @@ def paced_chunks(path, speed: float = 0.0,
 
 
 def play(path, feed_fn, speed: float = 0.0,
-         host_id_offset: int = 0, sleep=time.sleep) -> int:
+         host_id_offset: int = 0, sleep=time.sleep, stats=None) -> int:
     """Replay a capture through ``feed_fn(bytes)``.
 
     ``speed``: 0 = as fast as possible; N = N× recorded pace (1 = real
     time). Returns bytes fed. With ``host_id_offset``, frames that span
     chunk boundaries reassemble before remapping (the file format
     permits arbitrary chunking even though the server records
-    complete-frame runs)."""
+    complete-frame runs). A torn capture tail stops cleanly (counted on
+    ``stats`` as ``replay_torn_tail``)."""
     n = 0
-    for delay, chunk in paced_chunks(path, speed, host_id_offset):
+    for delay, chunk in paced_chunks(path, speed, host_id_offset, stats):
         if delay > 0:
             sleep(delay)
         feed_fn(chunk)

@@ -5,7 +5,12 @@ __version__ = "0.1.0"
 
 # Minimum wire-format version this build accepts from agents/simulators.
 MIN_WIRE_VERSION = 3   # v2: AGGR_TASK_DT grew forks_sec (TOPFORK);
-CURR_WIRE_VERSION = 3  # v3: REQ_TRACE_DT grew conn_id/cli ids
+CURR_WIRE_VERSION = 4  # v3: REQ_TRACE_DT grew conn_id/cli ids
 #                        (TRACECONN) — older record layouts cannot be
 #                        decoded, so the registration gate must reject
-#                        older producers outright
+#                        older producers outright.
+#                        v4: durable-ingest additions only (SWEEP_SEQ
+#                        marks, COMM_THROTTLE control, REGISTER_RESP
+#                        last_seq tail) — no existing layout changed,
+#                        so v3 producers stay accepted (MIN stays 3);
+#                        v3 peers skip the new subtype/control frames

@@ -279,7 +279,7 @@ def test_spool_bounded_drop_oldest_counted():
     assert a.stats.counters["spool_dropped"] == 3
     assert a.stats.counters["spool_dropped_records"] == 30
     # drop-OLDEST: the newest two survive
-    assert [buf[0] for buf, _ in a._spool] == [3, 4]
+    assert [buf[0] for buf, _, _ in a._spool] == [3, 4]
 
 
 def test_agent_stats_frame_folds_into_server_counters(rt):
@@ -480,19 +480,40 @@ def _prewarm(rt, tmp_path, tag: str) -> None:
     tracing blocks the shared asyncio loop for seconds per program,
     which would stall the supervisors' timers mid-scenario. State is
     snapshotted and restored, so the warmup leaves no records behind
-    (host-side registries are not fed — device slabs only)."""
+    (host-side registries are not fed — device slabs only).
+
+    Durability-NEUTRAL: warmup records must not reach the write-ahead
+    journal (``_journal_replaying`` suppresses appends) and the warmup
+    tick must not write a checkpoint into the scenario's checkpoint
+    dir — a prewarm checkpoint would otherwise record a WAL position
+    PAST the crash window and recovery would replay nothing.
+
+    Counter-NEUTRAL: the SIGKILL e2e accounts every built record
+    against the accepted-kind counters across both server epochs, so
+    the warmup feed must not inflate them — counters are snapshotted
+    with the state and restored after."""
     snap = tmp_path / f"warm_{tag}.npz"
     ckpt.save(str(snap), CFG, rt.state)
+    base_counters = dict(rt.stats.counters)
     sim = ParthaSim(n_hosts=4, n_svcs=2, n_groups=3, seed=77)
-    rt.feed(sim.conn_frames(256) + sim.resp_frames(256)
-            + sim.listener_frames() + sim.task_frames()
-            + wire.encode_frame(wire.NOTIFY_HOST_STATE,
-                                sim.host_state_records())
-            + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
-                                sim.cpu_mem_records()))
-    rt.flush()
-    rt.run_tick()
-    rt.restore(str(snap))
+    old_opts = rt.opts
+    rt.opts = old_opts._replace(checkpoint_dir=None)
+    rt._journal_replaying = True
+    try:
+        rt.feed(sim.conn_frames(256) + sim.resp_frames(256)
+                + sim.listener_frames() + sim.task_frames()
+                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                    sim.host_state_records())
+                + wire.encode_frame(wire.NOTIFY_CPU_MEM_STATE,
+                                    sim.cpu_mem_records()))
+        rt.flush()
+        rt.run_tick()
+        rt.restore(str(snap))
+    finally:
+        rt._journal_replaying = False
+        rt.opts = old_opts
+        rt.stats.counters.clear()
+        rt.stats.counters.update(base_counters)
     snap.unlink()
 
 
@@ -611,3 +632,227 @@ async def _e2e(tmp_path):
     await srv2.stop()
     return ((c_svc, c_hosts), (x_svc, x_hosts), agents,
             (built, dropped, remaining, accepted))
+
+
+# --------------------------------------------- SIGKILL + WAL e2e (slow)
+# PR-4 proved CONVERGENCE after a kill (fresh sweeps rebuild the view);
+# the inter-checkpoint window itself was lost. The WAL closes that gap:
+# a kill mid-window + --restore-latest must yield a fleet view
+# IDENTICAL to the fault-free control run, with every record accounted
+# exactly once (checkpoint + journal replay + seq-pruned agent resend).
+
+_ACCEPT_KINDS = ("conn_events", "resp_events", "listener_records",
+                 "host_records", "task_records", "cpumem_records",
+                 "cgroup_records", "task_pings", "sweep_marks",
+                 "records_unknown_subtype")
+
+
+def _accepted(rt) -> int:
+    return sum(int(rt.stats.counters.get(k, 0)) for k in _ACCEPT_KINDS)
+
+
+def _views(rt):
+    """Canonical fleet view: svcstate + hoststate rows, key-sorted —
+    the byte-identity surface (row order inside a window is the only
+    legal divergence between the runs, so sort by the entity key)."""
+    import json as _json
+    svc = rt.query({"subsys": "svcstate", "sortcol": "svcid",
+                    "maxrecs": 64})
+    hosts = rt.query({"subsys": "hoststate", "maxrecs": 16})
+    return (_json.dumps(sorted(svc["recs"],
+                               key=lambda r: r["svcid"]),
+                        sort_keys=True),
+            _json.dumps(sorted(hosts["recs"],
+                               key=lambda r: r["hostid"]),
+                        sort_keys=True))
+
+
+async def _send_counted(a, n_conn=32, n_resp=32) -> int:
+    buf = a.build_sweep(n_conn, n_resp)
+    a._writer.write(buf)
+    await a._writer.drain()
+    return wire.count_events(buf)
+
+
+async def _sigkill_e2e(tmp_path):
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    # ---------------- control: no journal, no kill — the ground truth
+    rt_c = Runtime(CFG)
+    _prewarm(rt_c, tmp_path, "kc")
+    srv_c = GytServer(rt_c, tick_interval=None)
+    host, port = await srv_c.start()
+    ctl = [NetAgent(seed=300 + i, n_svcs=2, n_groups=3)
+           for i in range(2)]
+    built_c = 0
+    for a in ctl:
+        await a.connect(host, port)
+    for _ in range(3):                              # window 1
+        for a in ctl:
+            built_c += await _send_counted(a)
+    await asyncio.sleep(0.15)
+    rt_c.flush()
+    rt_c.run_tick()
+    for _ in range(3):                              # window 2
+        for a in ctl:
+            built_c += await _send_counted(a)
+    await asyncio.sleep(0.15)
+    rt_c.flush()
+    rt_c.run_tick()
+    c_views = _views(rt_c)
+    for a in ctl:
+        await a.close()
+    await srv_c.stop()
+
+    # ---------------- chaos: journal on, SIGKILL mid-window 2
+    hostmap = str(tmp_path / "khostmap.json")
+    ckdir = tmp_path / "kck"
+    wal = tmp_path / "kwal"
+    opts = RuntimeOpts(journal_dir=str(wal), checkpoint_dir=str(ckdir),
+                       checkpoint_every_ticks=1)
+    rt1 = Runtime(CFG, opts)
+    _prewarm(rt1, tmp_path, "k1")
+    srv1 = GytServer(rt1, tick_interval=None, hostmap_path=hostmap)
+    h1, p1 = await srv1.start()
+    agents = [NetAgent(seed=300 + i, n_svcs=2, n_groups=3)
+              for i in range(2)]
+    built = 0
+    for a in agents:
+        await a.connect(h1, p1)
+    for _ in range(3):                              # window 1
+        for a in agents:
+            built += await _send_counted(a)
+    await asyncio.sleep(0.15)
+    rt1.flush()
+    rt1.run_tick()          # checkpoint @ tick 1: hwm=3, WAL truncated
+    assert rt1._sweep_last_seq == {0: 3, 1: 3}
+    # window 2 opens: two more sweeps per agent reach the server…
+    for _ in range(2):
+        for a in agents:
+            built += await _send_counted(a)
+    await asyncio.sleep(0.15)
+    # …and are DURABLE only in the journal (mid-inter-checkpoint kill:
+    # no graceful drain, no final checkpoint, no truncation)
+    rt1_accepted = _accepted(rt1)
+    await srv1.stop()
+    for a in agents:
+        a._drop_conn()
+    # the 6th sweep is produced during the outage → the PR-4 spool
+    for a in agents:
+        buf = a.build_sweep(32, 32)
+        built += wire.count_events(buf)
+        a._spool_push(buf, wire.count_events(buf), a._sweep_seq)
+
+    # ---------------- respawn: restore + WAL replay + pruned resend
+    rt2 = Runtime(CFG, opts)
+    _prewarm(rt2, tmp_path, "k2")
+    assert restore_latest_checkpoint(rt2, str(ckdir)) is not None
+    replayed = int(rt2.stats.counters.get("wal_replayed_records", 0))
+    assert rt2.stats.counters["wal_replayed_chunks"] > 0
+    # the replay advanced the dedup high-water mark past the window
+    assert rt2._sweep_last_seq == {0: 5, 1: 5}
+    srv2 = GytServer(rt2, tick_interval=None, hostmap_path=hostmap)
+    h2, p2 = await srv2.start()
+    for a in agents:
+        hid = a.host_id
+        assert await a.connect(h2, p2) == hid       # sticky placement
+        # REGISTER_RESP pruned nothing (sweep 6 postdates the mark)
+        assert a.spool_len() == 1
+        await a._resend_spool()
+        assert a.spool_len() == 0
+    await asyncio.sleep(0.15)
+    rt2.flush()
+    rt2.run_tick()                                  # window 2 closes
+    x_views = _views(rt2)
+    rt2_accepted = _accepted(rt2)
+
+    for a in agents:
+        await a.close()
+    await srv2.stop()
+    return (c_views, x_views, built, built_c,
+            rt1_accepted, rt2_accepted, replayed, rt2)
+
+
+@pytest.mark.slow
+def test_chaos_e2e_sigkill_wal_byte_identical(tmp_path,
+                                              no_xla_disk_cache):
+    (c_views, x_views, built, built_c, rt1_acc, rt2_acc, replayed,
+     rt2) = asyncio.run(_sigkill_e2e(tmp_path))
+    # the two runs really built the same stream
+    assert built == built_c
+    # ---- byte-identical fleet view vs the fault-free control
+    assert x_views[0] == c_views[0]                 # svcstate
+    assert x_views[1] == c_views[1]                 # hoststate
+    # ---- exactly-once accounting: every record the agents built is
+    # accepted by exactly one epoch-fold (replayed records were
+    # accepted twice — once live in epoch 1, once by the replay — and
+    # nothing else overlaps; the seq-pruned resend contributes the
+    # crash-window spool exactly once)
+    assert replayed > 0
+    assert built == rt1_acc + rt2_acc - replayed, \
+        (built, rt1_acc, rt2_acc, replayed)
+    # the dedup mark tracked the full stream
+    assert rt2._sweep_last_seq == {0: 6, 1: 6}
+
+
+@pytest.mark.slow
+def test_sharded_sigkill_wal_replay(tmp_path, no_xla_disk_cache):
+    """The same durability contract on the mesh tier: per-shard state
+    restores from the stacked checkpoint and the WAL replays through
+    the sharded ingest routing — the final cluster view is byte-equal
+    to a fault-free control run."""
+    import json as _json
+
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    SCFG = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
+                     conn_batch=32, resp_batch=32, listener_batch=16,
+                     fold_k=2)
+    sim = ParthaSim(n_hosts=4, n_svcs=2, n_groups=3, seed=21)
+    feeds = [sim.conn_frames(64) + sim.resp_frames(64)
+             + sim.listener_frames() + sim.task_frames()
+             + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                 sim.host_state_records())
+             for _ in range(3)]
+
+    def view(rt):
+        out = rt.query({"subsys": "svcstate", "sortcol": "svcid",
+                        "maxrecs": 64})
+        return (_json.dumps(out["recs"], sort_keys=True),
+                rt.rollup_stats())
+
+    # control: fault-free, same feeds, same tick boundaries
+    ctl = ShardedRuntime(SCFG)
+    ctl.feed(feeds[0], hid=0, conn_id=1)
+    ctl.flush()
+    ctl.run_tick()
+    ctl.feed(feeds[1], hid=1, conn_id=1)
+    ctl.feed(feeds[2], hid=2, conn_id=2)
+    ctl.flush()
+    ctl.run_tick()
+    want = view(ctl)
+
+    # chaos: checkpoint after window 1, SIGKILL mid-window 2
+    opts = RuntimeOpts(journal_dir=str(tmp_path / "swal"),
+                       checkpoint_dir=str(tmp_path / "sck"),
+                       checkpoint_every_ticks=1)
+    rt1 = ShardedRuntime(SCFG, opts=opts)
+    rt1.feed(feeds[0], hid=0, conn_id=1)
+    rt1.flush()
+    rep = rt1.run_tick()
+    assert "checkpoint" in rep
+    rt1.feed(feeds[1], hid=1, conn_id=1)
+    rt1.feed(feeds[2], hid=2, conn_id=2)
+    rt1.journal.fsync()          # the group-fsync cadence's job live
+    # …no flush, no tick, no close: the process is gone
+
+    rt2 = ShardedRuntime(SCFG, opts=opts)
+    assert restore_latest_checkpoint(rt2, str(tmp_path / "sck")) \
+        is not None
+    assert rt2.stats.counters["wal_replayed_chunks"] == 2
+    rt2.flush()
+    rt2.run_tick()
+    got = view(rt2)
+    assert got[0] == want[0]
+    assert got[1] == want[1]
