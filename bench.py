@@ -134,13 +134,16 @@ def _bench_fold(cfg, sim, dev, label: str, dep_pairs: int,
     n_distinct = 2  # cycle staged slabs so inputs aren't degenerate
     slabs = [stage() for _ in range(n_distinct)]
 
+    # the PRODUCTION fused megakernel (engine fold + dep fold +
+    # pressure scalar as a graph OUTPUT — Runtime._dispatch_fused's
+    # connresp-only variant): one device dispatch per slab, no
+    # observation dispatch
     fold = jax.jit(
-        lambda s, d, c, r: (step.fold_many(cfg, s, c, r),
-                            dg.dep_fold_many(d, c, 0)),
+        lambda s, d, c, r: step.fold_all(cfg, s, d, 0,
+                                         connresp=(c, r)),
         donate_argnums=(0, 1))
     flushp = jax.jit(lambda s: step.td_flush_partial(cfg, s),
                      donate_argnums=(0,))
-    pressure_of = jax.jit(step.stage_pressure)
     # state materializes ON the device (jnp zeros) — no host-side
     # multi-GiB buffer rides the tunnel
     st = jax.device_put(aggstate.init(cfg), dev)
@@ -150,7 +153,7 @@ def _bench_fold(cfg, sim, dev, label: str, dep_pairs: int,
     # the measured loop runs the steady-state upsert fast path
     t0 = time.perf_counter()
     for i in range(2 * n_distinct):
-        st, dep = fold(st, dep, *slabs[i % n_distinct])
+        st, dep, _p = fold(st, dep, *slabs[i % n_distinct])
     st = flushp(st)
     jax.block_until_ready(st)
     print(f"bench[{label}]: warmup+compile {time.perf_counter() - t0:.1f}s",
@@ -160,14 +163,14 @@ def _bench_fold(cfg, sim, dev, label: str, dep_pairs: int,
     # calibrate call count for ~2s of measurement, bounded for slow hosts
     t0 = time.perf_counter()
     for i in range(4):
-        st, dep = fold(st, dep, *slabs[i % n_distinct])
+        st, dep, _p = fold(st, dep, *slabs[i % n_distinct])
     jax.block_until_ready(st)
     per_call = (time.perf_counter() - t0) / 4
     calls = max(4, min(500, int(2.0 / max(per_call, 1e-6))))
 
     # production flush policy: check the pressure scalar from two
-    # dispatches back (materialized — no pipeline sync) and flush the
-    # fullest stages when headroom is low
+    # dispatches back (a fold OUTPUT, materialized — no pipeline sync)
+    # and flush the fullest stages when headroom is low
     from collections import deque
     pressures: deque = deque()
     n_flushes = 0
@@ -177,19 +180,24 @@ def _bench_fold(cfg, sim, dev, label: str, dep_pairs: int,
                 int(pressures.popleft()) > cfg.td_stage_cap // 2:
             st = flushp(st)
             n_flushes += 1
-        st, dep = fold(st, dep, *slabs[i % n_distinct])
-        pressures.append(pressure_of(st))
+        st, dep, press = fold(st, dep, *slabs[i % n_distinct])
+        pressures.append(press)
     jax.block_until_ready(st)
     elapsed = time.perf_counter() - t0
 
     rate = calls * events_per_call / elapsed
+    # device dispatches per fed slab batch: the fused fold + the
+    # amortized share of td_flush_partial dispatches (contract: ≤ 2)
+    dpb = (calls + n_flushes) / calls
     print(f"bench[{label}]: {calls} calls x {K} microbatches in "
           f"{elapsed:.2f}s ({elapsed / calls * 1e3:.2f}ms/dispatch, "
-          f"{n_flushes} partial flushes, {rate:,.0f} ev/s)",
+          f"{n_flushes} partial flushes, {dpb:.3f} dispatches/batch, "
+          f"{rate:,.0f} ev/s)",
           file=sys.stderr, flush=True)
     del st, dep, slabs
     return {"rate": rate, "ms_per_dispatch": elapsed / calls * 1e3,
-            "n_flushes": n_flushes, "per_call_s": per_call}
+            "n_flushes": n_flushes, "per_call_s": per_call,
+            "dispatches_per_batch": round(dpb, 4)}
 
 
 def _stage_rates(cfg, bufs, ev_per_buf: int) -> dict:
@@ -271,16 +279,45 @@ def _bench_feed(cfg, sim, label: str, dep_pairs: int,
     jax.block_until_ready(rt.state)
     per_call = max(time.perf_counter() - t0, 1e-6)
     feed_calls = max(2, min(100, int(1.5 / per_call)))
+    c0 = dict(rt.stats.counters)
     t0 = time.perf_counter()
     for i in range(feed_calls):
         rt.feed(bufs[i % n_bufs])
     rt.flush()
     jax.block_until_ready(rt.state)
     feed_rate = feed_calls * ev_per_buf / (time.perf_counter() - t0)
+    # device dispatches per feed batch over the measured loop: the
+    # fused fold_all calls + digest partial flushes (contract ≤ 2; the
+    # legacy path issued 2+ per batch before counting per-subsystem
+    # folds)
+    c1 = rt.stats.counters
+    delta = lambda k: c1.get(k, 0) - c0.get(k, 0)   # noqa: E731
+    if getattr(rt, "_fused", False):
+        disp = delta("fold_dispatches") + delta("td_partial_flushes")
+    else:   # legacy: every slab fold issues a pressure dispatch too
+        disp = 2 * delta("slab_dispatches") + delta("td_partial_flushes")
+    dispatches_per_batch = round(disp / max(feed_calls, 1), 4)
+    # overlap win, measured directly: the same feed loop with a
+    # block_until_ready barrier after every batch — the host can never
+    # decode batch N+1 while the device folds batch N (async dispatch +
+    # the double-buffered staging slabs disabled in effect). The ratio
+    # async/synced is the wall-clock the overlap actually buys; ~1.0
+    # means the host or the device fully dominates.
+    sync_calls = max(2, feed_calls // 2)
+    t0 = time.perf_counter()
+    for i in range(sync_calls):
+        rt.feed(bufs[i % n_bufs])
+        jax.block_until_ready(rt.state)
+    rt.flush()
+    jax.block_until_ready(rt.state)
+    synced_rate = sync_calls * ev_per_buf / (time.perf_counter() - t0)
+    overlap_ratio = round(feed_rate / max(synced_rate, 1e-9), 4)
     stages = _stage_rates(cfg, bufs, ev_per_buf)
     print(f"bench[{label}]: feed path {feed_rate:,.0f} ev/s "
           f"(deframe {stages['deframe_ev_per_sec']:,.0f}, "
-          f"decode {stages['decode_ev_per_sec']:,.0f})",
+          f"decode {stages['decode_ev_per_sec']:,.0f}, "
+          f"{dispatches_per_batch} dispatches/batch, "
+          f"overlap {overlap_ratio}x)",
           file=sys.stderr, flush=True)
     # embed the run's own telemetry (obs tier): counters incl. the
     # native-vs-fallback decode path, per-stage latency histograms, and
@@ -300,6 +337,8 @@ def _bench_feed(cfg, sim, label: str, dep_pairs: int,
                  if r["stage"].startswith("journal_")]
         c = selfstats["counters"]
         return {"rate": round(feed_rate, 1), **stages,
+                "dispatches_per_batch": dispatches_per_batch,
+                "overlap_ratio": overlap_ratio,
                 "selfstats": selfstats, "journal_timings": jrows,
                 # hot-loop honesty: the toy loop generates wire bytes
                 # far past disk bandwidth, so the bounded WAL backlog
@@ -308,6 +347,8 @@ def _bench_feed(cfg, sim, label: str, dep_pairs: int,
                 "wal_appended_chunks": c.get("wal_appended_chunks", 0),
                 "wal_backlog_dropped": c.get("wal_backlog_dropped", 0)}
     return {"rate": round(feed_rate, 1), **stages,
+            "dispatches_per_batch": dispatches_per_batch,
+            "overlap_ratio": overlap_ratio,
             "selfstats": selfstats}
 
 
@@ -323,12 +364,14 @@ def _run_phase(phase: str) -> dict:
         r = _bench_fold(cfg, sim, dev, "northstar", dp, de)
         return {"rate": round(r["rate"], 1),
                 "ms_per_dispatch": round(r["ms_per_dispatch"], 3),
+                "dispatches_per_batch": r.get("dispatches_per_batch"),
                 "device": f"{dev.platform}:{dev.device_kind}"}
     if phase == "fold_toy":
         cfg, sim, dp, de = _geometry("toy")
         r = _bench_fold(cfg, sim, dev, "toy", dp, de)
         return {"rate": round(r["rate"], 1),
                 "ms_per_dispatch": round(r["ms_per_dispatch"], 3),
+                "dispatches_per_batch": r.get("dispatches_per_batch"),
                 "device": f"{dev.platform}:{dev.device_kind}"}
     if phase == "feed_ns":
         cfg, sim, dp, de = _geometry("ns")
@@ -430,19 +473,29 @@ def _orchestrate(platform: str | None, degraded: bool,
         # per-stage breakdown (ISSUE 1): attribute future feed-path
         # regressions to deframe / decode / fold instead of one blended
         # number
-        for k in ("deframe_ev_per_sec", "decode_ev_per_sec"):
+        for k in ("deframe_ev_per_sec", "decode_ev_per_sec",
+                  "dispatches_per_batch", "overlap_ratio"):
             if k in fns:
                 result[k] = fns[k]
         if "rate" in ns:
             result["fold_ev_per_sec"] = ns["rate"]
+            result["fold_ms_per_dispatch"] = ns.get("ms_per_dispatch")
+            result["fold_dispatches_per_batch"] = \
+                ns.get("dispatches_per_batch")
     if "rate" in ftoy:
         result["toy_feed_path_events_per_sec"] = ftoy["rate"]
         if "rate" in toy:
             result["toy_feed_vs_fold"] = round(
                 ftoy["rate"] / toy["rate"], 3)
-        for k in ("deframe_ev_per_sec", "decode_ev_per_sec"):
+        for k in ("deframe_ev_per_sec", "decode_ev_per_sec",
+                  "dispatches_per_batch", "overlap_ratio"):
             if k in ftoy:
                 result["toy_" + k] = ftoy[k]
+        if "rate" in toy:
+            result["toy_fold_ms_per_dispatch"] = \
+                toy.get("ms_per_dispatch")
+            result["toy_fold_dispatches_per_batch"] = \
+                toy.get("dispatches_per_batch")
     fwal = phases.get("feed_toy_wal", {})
     if "rate" in fwal:
         # WAL overhead contract (ISSUE 5): journaling within 5% of

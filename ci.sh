@@ -62,6 +62,64 @@ if ! JAX_PLATFORMS=cpu python _chaos_smoke.py; then
     exit 1
 fi
 
+# Fused fold-path smoke: (a) the fused megakernel is the DEFAULT fold
+# path (a regression to the legacy per-subsystem dispatch sequence
+# would silently cost 2-6x fold throughput); (b) GYT_PALLAS=1 on a
+# backend without a usable Pallas lowering falls back to the XLA
+# scatter path cleanly — same folded state, no error on the hot path.
+echo "ci: fused fold-path / pallas fallback smoke" >&2
+if ! JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os
+import subprocess
+import sys
+
+from gyeeta_tpu.runtime import fused_fold_enabled
+
+assert fused_fold_enabled(env={}), "fused fold must be the default"
+assert not fused_fold_enabled(env={"GYT_FUSED_FOLD": "0"})
+
+# One leg per PROCESS: GYT_PALLAS is read at trace time and compiled
+# fold variants are process-memoized, so an in-process env toggle
+# would silently reuse the XLA-scatter executables.
+LEG = r"""
+import hashlib
+import numpy as np
+import jax
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+rt = Runtime()
+assert rt._fused, "fused fold path not active by default"
+sim = ParthaSim(n_hosts=4, n_svcs=4, seed=3)
+rt.feed(sim.listener_frames())
+rt.feed(sim.conn_frames(4096))
+rt.feed(sim.resp_frames(4096))
+rt.flush()
+assert rt.stats.counters.get("fold_dispatches", 0) > 0
+h = hashlib.sha256()
+for x in jax.tree.leaves(rt.state):
+    h.update(np.asarray(x).tobytes())
+print("DIGEST", h.hexdigest())
+rt.close()
+"""
+
+def leg(extra_env):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
+    p = subprocess.run([sys.executable, "-c", LEG], env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    return [ln for ln in p.stdout.splitlines()
+            if ln.startswith("DIGEST")][0]
+
+base = leg({})
+pall = leg({"GYT_PALLAS": "1"})  # interpret mode or clean XLA fallback
+assert base == pall, "GYT_PALLAS path diverged from the XLA scatters"
+print("ci: fused fold default + pallas fallback OK")
+PYEOF
+then
+    echo "ci: FATAL — fused fold-path smoke failed" >&2
+    exit 1
+fi
+
 if [ "$1" = "fast" ]; then
     shift
     exec python -m pytest tests/ -q -m "not slow" "$@"

@@ -63,9 +63,36 @@ class EngineCfg(NamedTuple):
         vmin=0.1, vmax=1e4, nbuckets=32)
     hll_p_svc: int = 10               # per-svc distinct clients (±3.2%)
     hll_p_global: int = 14            # global distinct endpoints (±0.8%)
-    cms_depth: int = 4
-    cms_width: int = 1 << 16
+    cms_depth: int = 2                # fold cost is depth-linear (one
+    #                                   scatter lane per row per event —
+    #                                   the 2nd-largest fold op); depth 2
+    #                                   at DOUBLE width spends the same
+    #                                   memory on halved per-row
+    #                                   collision rates. Estimates stay
+    #                                   strict upper bounds (the top-K
+    #                                   candidate filter depends on
+    #                                   that); the weaker tail bound
+    #                                   (err ≤ e·N/width w.p. 1-e⁻²) is
+    #                                   a documented CPU-geometry
+    #                                   tradeoff — raise GYT_CMS_DEPTH
+    #                                   back on accelerators with
+    #                                   scatter headroom (OPERATIONS.md
+    #                                   "Fold-path tuning")
+    cms_width: int = 1 << 17
     topk_capacity: int = 512
+    topk_budget: int = 2048           # sketch-assisted top-K candidate
+    #                                   compaction: only the budget
+    #                                   highest-CMS-estimate lanes of a
+    #                                   fold dispatch enter the O(n
+    #                                   log n) grouping sort (the
+    #                                   dominant fold op at slab width;
+    #                                   33k→2.6k lanes ≈ 11.6→2 ms per
+    #                                   dispatch on one core; 4x the
+    #                                   top-K capacity). 0 = every
+    #                                   lane (exact truncation). Mass
+    #                                   excluded by the budget is
+    #                                   accounted in ``evicted`` —
+    #                                   see sketch/topk.py:update
     td_capacity: int = 64             # per-svc t-digest centroids
     # staged-digest buffer: samples accumulate here across a fold_many
     # dispatch (K microbatches) and compress ONCE at its end — the
@@ -73,13 +100,35 @@ class EngineCfg(NamedTuple):
     td_stage_cap: int = 512           # per-svc staged samples (flush at
     #                                   half-full: size ≥4× the expected
     #                                   per-svc fill per dispatch)
-    td_sample_stride: int = 2         # digest duty-cycle: stage 1-in-N
-    #                                   resp samples (loghist folds all;
-    #                                   ref RESP_SAMPLING ~50% default)
-    td_flush_m: int = 4096            # entities compressed per partial
+    td_sample_stride: int = 16        # digest duty-cycle: stage 1-in-N
+    #                                   resp samples. The loghist folds
+    #                                   EVERY sample and stays the
+    #                                   lossless estimator behind the
+    #                                   windowed resp_p* columns; the
+    #                                   digest is the ALL-TIME tail
+    #                                   refinement (td_p*), where the
+    #                                   duty cycle only slows
+    #                                   convergence (samples accumulate
+    #                                   unboundedly). Its staging sort +
+    #                                   flush compression scale ~1/N:
+    #                                   16 vs the old 2 is ~45% of the
+    #                                   whole toy fold cost (r07). The
+    #                                   reference samples resp events
+    #                                   ~50% at the SOURCE (gy_ebpf.h:29)
+    #                                   — here the full stream still
+    #                                   reaches the loghist. GYT_TD_
+    #                                   SAMPLE_STRIDE tunes it; see
+    #                                   OPERATIONS.md "Fold-path tuning"
+    td_flush_m: int = 256             # entities compressed per partial
     #                                   flush — flush cost is O(m), not
     #                                   O(capacity); the runtime drains
-    #                                   iteratively under pressure
+    #                                   iteratively under pressure.
+    #                                   Small m beats m≈S under skewed
+    #                                   load: pressure is driven by the
+    #                                   few HOT stages, and sorting the
+    #                                   mostly-empty rest was ~2/3 of
+    #                                   the flush cost (107→27 ms per
+    #                                   flush on the toy geometry, r07)
     conn_batch: int = 2048            # static microbatch lanes
     resp_batch: int = 4096
     listener_batch: int = 512

@@ -168,18 +168,39 @@ def upsert_fast(tbl: Table, khi, klo, valid=None):
     klo = klo.astype(jnp.uint32)
     if valid is None:
         valid = jnp.ones((khi.shape[0],), bool)
+    tbl, rows, _ = upsert_fast2(tbl, khi, klo, valid)
+    return tbl, rows
+
+
+def upsert_fast2(tbl: Table, khi, klo, valid=None):
+    """:func:`upsert_fast` that also returns the ``any_miss`` () bool —
+    True when this batch carried at least one key that was not already
+    resolvable (i.e. the insert machinery ran). Callers use it to
+    cond-skip work that only matters for NEW rows (e.g. the dep-graph
+    edge identity columns, which existing rows already hold)."""
+    khi = khi.astype(jnp.uint32)
+    klo = klo.astype(jnp.uint32)
+    if valid is None:
+        valid = jnp.ones((khi.shape[0],), bool)
     rows0 = lookup(tbl, khi, klo, valid)
     any_miss = jnp.any(valid & (rows0 < 0)
                        & ~_is_empty(khi, klo) & ~_is_tomb(khi, klo))
-    return jax.lax.cond(
+    tbl, rows = jax.lax.cond(
         any_miss,
         lambda t: upsert(t, khi, klo, valid),
         lambda t: (t, rows0),
         tbl)
+    return tbl, rows, any_miss
 
 
 def lookup(tbl: Table, khi, klo, valid=None):
-    """Find rows for a batch of keys without inserting. -1 = absent."""
+    """Find rows for a batch of keys without inserting. -1 = absent.
+
+    The two (B, PROBES) key-half gathers share one index array, so XLA
+    fuses them into a single gather loop — a measured attempt to halve
+    them via a derived-fingerprint probe (one fp gather + per-lane
+    verify) was NOT faster on CPU and cost an extra ~2.5 ms per 65k
+    lanes in verify/cond overhead. Don't re-split this."""
     capacity = tbl.key_hi.shape[0]
     khi = khi.astype(jnp.uint32)
     klo = klo.astype(jnp.uint32)

@@ -369,18 +369,45 @@ def _concat_chunks(chunks: list, dtype) -> np.ndarray:
     return chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
 
 
-def conn_batch_parts(chunks: list, size: int, stats=None) -> ConnBatch:
+def alloc_resp_cols(size: int) -> dict:
+    """Zeroed flat RespBatch columns (everything but ``valid``) — the
+    resp half of the preallocated staging-slab buffers."""
+    return dict(svc_hi=np.zeros(size, np.uint32),
+                svc_lo=np.zeros(size, np.uint32),
+                resp_us=np.zeros(size, np.float32),
+                host_id=np.zeros(size, np.int32))
+
+
+def _reuse_cols(cols: dict, n: int, clear_to: int) -> None:
+    """Reset a REUSED staging buffer to the all-zero-padding state the
+    fresh allocators produce: lanes [n, clear_to) may hold stale values
+    from a previous (larger) fill — zero them so a recycled slab is
+    bit-identical to a freshly allocated one (every fold op masks by
+    ``valid``, but determinism of the device INPUT is part of the
+    replay/parity contract)."""
+    if clear_to > n:
+        for a in cols.values():
+            a[n:clear_to] = 0
+
+
+def conn_batch_parts(chunks: list, size: int, stats=None, out=None,
+                     clear_to: int = 0) -> ConnBatch:
     """A LIST of raw TCP_CONN chunks (total ≤ size) → one flat padded
     ConnBatch: each chunk decodes straight into the preallocated column
     buffers at its lane offset (native path; no staging concatenate, no
-    per-chunk pad+stack). Fallback: the NumPy reference decoder over
-    the concatenated chunks — bit-identical output either way."""
+    per-chunk pad+stack). ``out``: caller-owned column dict from
+    :func:`alloc_conn_cols` (the double-buffered staging slabs) —
+    reused across dispatches, with lanes [n, clear_to) re-zeroed.
+    Fallback: the NumPy reference decoder over the concatenated chunks
+    — bit-identical output either way."""
     n = sum(len(c) for c in chunks)
     if n > size:
         raise ValueError(
             f"{n} records exceed batch size {size}; split upstream")
     if native.available():
-        cols = alloc_conn_cols(size)
+        cols = out if out is not None else alloc_conn_cols(size)
+        if out is not None:
+            _reuse_cols(cols, n, clear_to)
         off = 0
         ok = True
         for c in chunks:
@@ -398,18 +425,21 @@ def conn_batch_parts(chunks: list, size: int, stats=None) -> ConnBatch:
     return conn_batch(_concat_chunks(chunks, wire.TCP_CONN_DT), size)
 
 
-def resp_batch_parts(chunks: list, size: int, stats=None) -> RespBatch:
+def resp_batch_parts(chunks: list, size: int, stats=None, out=None,
+                     clear_to: int = 0) -> RespBatch:
     """A LIST of raw RESP_SAMPLE chunks (total ≤ size) → one flat
-    padded RespBatch (see :func:`conn_batch_parts`)."""
+    padded RespBatch (see :func:`conn_batch_parts`; ``out`` is an
+    :func:`alloc_resp_cols` dict)."""
     n = sum(len(c) for c in chunks)
     if n > size:
         raise ValueError(
             f"{n} records exceed batch size {size}; split upstream")
     if native.available():
-        svc_hi = np.zeros(size, np.uint32)
-        svc_lo = np.zeros(size, np.uint32)
-        resp_us = np.zeros(size, np.float32)
-        host_id = np.zeros(size, np.int32)
+        cols = out if out is not None else alloc_resp_cols(size)
+        if out is not None:
+            _reuse_cols(cols, n, clear_to)
+        svc_hi, svc_lo = cols["svc_hi"], cols["svc_lo"]
+        resp_us, host_id = cols["resp_us"], cols["host_id"]
         off = 0
         ok = True
         for c in chunks:
@@ -446,24 +476,30 @@ def resp_batch_fast(recs: np.ndarray,
     return resp_batch_parts([recs], size, stats=stats)
 
 
-def conn_slab(recs, k: int, b: int, stats=None) -> ConnBatch:
+def conn_slab(recs, k: int, b: int, stats=None, out=None,
+              clear_to: int = 0) -> ConnBatch:
     """TCP_CONN records (n ≤ k·b; an array or a list of chunk arrays)
     → ConnBatch with (k, b) stacked columns: ONE flat columnar decode
     + a free reshape, replacing k per-chunk decodes plus a tree-wide
     ``np.stack`` (the r3 feed-path hot spot). Record i lands in
     flattened lane i; padding collects at the slab tail — lane
     placement is only ever consumed through the ``valid`` mask, so
-    tail-padding and per-chunk padding are equivalent to the fold."""
+    tail-padding and per-chunk padding are equivalent to the fold.
+    ``out``/``clear_to``: reuse a preallocated staging buffer (see
+    :func:`conn_batch_parts`)."""
     chunks = recs if isinstance(recs, list) else [recs]
-    cb = conn_batch_parts(chunks, k * b, stats=stats)
+    cb = conn_batch_parts(chunks, k * b, stats=stats, out=out,
+                          clear_to=clear_to)
     return ConnBatch(*(x.reshape(k, b) for x in cb))
 
 
-def resp_slab(recs, k: int, b: int, stats=None) -> RespBatch:
+def resp_slab(recs, k: int, b: int, stats=None, out=None,
+              clear_to: int = 0) -> RespBatch:
     """RESP_SAMPLE records (n ≤ k·b; array or chunk list) → RespBatch
     with (k, b) stacked columns (see :func:`conn_slab`)."""
     chunks = recs if isinstance(recs, list) else [recs]
-    rb = resp_batch_parts(chunks, k * b, stats=stats)
+    rb = resp_batch_parts(chunks, k * b, stats=stats, out=out,
+                          clear_to=clear_to)
     return RespBatch(*(x.reshape(k, b) for x in rb))
 
 

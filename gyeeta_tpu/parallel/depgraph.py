@@ -70,13 +70,28 @@ class DepGraph(NamedTuple):
     e_cli_svc: jnp.ndarray   # (E,) bool — svc→svc edge (mesh member)
     e_ser_hi: jnp.ndarray
     e_ser_lo: jnp.ndarray
-    e_nconn: jnp.ndarray     # (E,) f32 — flows folded into this edge
-    e_bytes: jnp.ndarray     # (E,) f32
+    e_ctr: jnp.ndarray       # (E, 2) f32 — [:, 0] nconn (flows folded
+    #                           into this edge), [:, 1] bytes. ONE
+    #                           column block so the per-dispatch
+    #                           accumulate is ONE row scatter-add (two
+    #                           per-column scatters pay the 32k-lane
+    #                           index resolution twice — the ctr_win
+    #                           lesson, engine/step.py:ingest_conn)
     e_last_tick: jnp.ndarray  # (E,) i32
     # ---- counters ----
     n_paired: jnp.ndarray    # () f32 — halves joined into an edge
     n_expired: jnp.ndarray   # () f32 — halves evicted unpaired (TTL)
     n_dropped: jnp.ndarray   # () f32 — dispatch/table overflow drops
+
+    @property
+    def e_nconn(self):
+        """(E,) flows-per-edge view of ``e_ctr`` (read path)."""
+        return self.e_ctr[:, 0]
+
+    @property
+    def e_bytes(self):
+        """(E,) bytes-per-edge view of ``e_ctr`` (read path)."""
+        return self.e_ctr[:, 1]
 
 
 def init(pair_capacity: int = 4096, edge_capacity: int = 2048) -> DepGraph:
@@ -95,8 +110,7 @@ def init(pair_capacity: int = 4096, edge_capacity: int = 2048) -> DepGraph:
         e_cli_hi=z32(E), e_cli_lo=z32(E),
         e_cli_svc=jnp.zeros((E,), bool),
         e_ser_hi=z32(E), e_ser_lo=z32(E),
-        e_nconn=jnp.zeros((E,), jnp.float32),
-        e_bytes=jnp.zeros((E,), jnp.float32),
+        e_ctr=jnp.zeros((E, 2), jnp.float32),
         e_last_tick=jnp.full((E,), -1, jnp.int32),
         n_paired=jnp.zeros((), jnp.float32),
         n_expired=jnp.zeros((), jnp.float32),
@@ -120,22 +134,41 @@ def fold_edges(dep: DepGraph, cli_hi, cli_lo, cli_svc, ser_hi, ser_lo,
     row per cli→ser dependency), so after warmup every batch is all-hit
     and the insert rounds are skipped entirely (``lax.cond``)."""
     khi, klo = edge_key(cli_hi, cli_lo, ser_hi, ser_lo)
-    tbl, rows = table.upsert_fast(dep.edge_tbl, khi, klo, valid=valid)
+    tbl, rows, any_new = table.upsert_fast2(dep.edge_tbl, khi, klo,
+                                            valid=valid)
     ok = valid & (rows >= 0)
     E = dep.e_nconn.shape[0]
     lanes = jnp.where(ok, rows, E)
     set_ = lambda col, v: col.at[lanes].set(v, mode="drop")  # noqa: E731
+
+    # Identity columns only change when a NEW row is claimed — an
+    # existing row already holds its (cli, ser) endpoint ids, and every
+    # lane of a resolved key writes the values the row already has. In
+    # steady state (all-hit, the hot loop) the five scatter-sets below
+    # are pure redundancy at ~2 ms each per 32k-lane dispatch on one
+    # core, so they ride the SAME miss signal the upsert's insert
+    # machinery keys on. The carried operands are the five small (E,)
+    # identity columns — nothing slab-sized crosses the cond boundary.
+    def _write_ids(cols):
+        chi, clo, csvc, shi, slo = cols
+        return (set_(chi, cli_hi.astype(jnp.uint32)),
+                set_(clo, cli_lo.astype(jnp.uint32)),
+                set_(csvc, cli_svc),
+                set_(shi, ser_hi.astype(jnp.uint32)),
+                set_(slo, ser_lo.astype(jnp.uint32)))
+
+    e_cli_hi, e_cli_lo, e_cli_svc, e_ser_hi, e_ser_lo = lax.cond(
+        any_new, _write_ids, lambda cols: cols,
+        (dep.e_cli_hi, dep.e_cli_lo, dep.e_cli_svc, dep.e_ser_hi,
+         dep.e_ser_lo))
     return dep._replace(
         edge_tbl=tbl,
-        e_cli_hi=set_(dep.e_cli_hi, cli_hi.astype(jnp.uint32)),
-        e_cli_lo=set_(dep.e_cli_lo, cli_lo.astype(jnp.uint32)),
-        e_cli_svc=set_(dep.e_cli_svc, cli_svc),
-        e_ser_hi=set_(dep.e_ser_hi, ser_hi.astype(jnp.uint32)),
-        e_ser_lo=set_(dep.e_ser_lo, ser_lo.astype(jnp.uint32)),
-        e_nconn=dep.e_nconn.at[lanes].add(
-            jnp.where(ok, 1.0, 0.0), mode="drop"),
-        e_bytes=dep.e_bytes.at[lanes].add(
-            jnp.where(ok, byts, 0.0), mode="drop"),
+        e_cli_hi=e_cli_hi, e_cli_lo=e_cli_lo, e_cli_svc=e_cli_svc,
+        e_ser_hi=e_ser_hi, e_ser_lo=e_ser_lo,
+        e_ctr=dep.e_ctr.at[lanes].add(
+            jnp.stack([jnp.where(ok, 1.0, 0.0),
+                       jnp.where(ok, byts, 0.0)], axis=1),
+            mode="drop"),
         e_last_tick=set_(dep.e_last_tick, jnp.int32(tick)),
         n_dropped=dep.n_dropped
         + jnp.sum(valid & (rows < 0)).astype(jnp.float32),
@@ -287,8 +320,7 @@ def age(dep: DepGraph, tick, pair_ttl_ticks: int,
         e_cli_svc=jnp.where(ekilled, False, dep.e_cli_svc),
         e_ser_hi=jnp.where(ekilled, z, dep.e_ser_hi),
         e_ser_lo=jnp.where(ekilled, z, dep.e_ser_lo),
-        e_nconn=jnp.where(ekilled, 0.0, dep.e_nconn),
-        e_bytes=jnp.where(ekilled, 0.0, dep.e_bytes),
+        e_ctr=jnp.where(ekilled[:, None], 0.0, dep.e_ctr),
         e_last_tick=jnp.where(ekilled, -1, dep.e_last_tick),
     )
 
@@ -330,16 +362,24 @@ def dep_fold_many(dep: DepGraph, cbs, tick) -> DepGraph:
     nfull = n // chunk
     if nfull == 1 and n % chunk == 0:
         return pair_halves_cond(dep, hv, tick)
-    if nfull:
-        grouped = jax.tree.map(
-            lambda x: x[: nfull * chunk].reshape(
-                (nfull, chunk) + x.shape[1:]), hv)
-        dep, _ = lax.scan(body, dep, grouped)
-    rem = n % chunk
-    if rem:      # remainder lanes get their own bounded chunk
-        tail = jax.tree.map(lambda x: x[nfull * chunk:], hv)
-        dep = pair_halves_cond(dep, tail, tick)
-    return dep
+
+    def _pair_all(dep):
+        if nfull:
+            grouped = jax.tree.map(
+                lambda x: x[: nfull * chunk].reshape(
+                    (nfull, chunk) + x.shape[1:]), hv)
+            dep, _ = lax.scan(body, dep, grouped)
+        rem = n % chunk
+        if rem:      # remainder lanes get their own bounded chunk
+            tail = jax.tree.map(lambda x: x[nfull * chunk:], hv)
+            dep = pair_halves_cond(dep, tail, tick)
+        return dep
+
+    # local/two-sided traffic (no one-sided half anywhere in the slab —
+    # the common hot-path case) skips the whole chunked pairing scan
+    # with ONE cond instead of paying K per-chunk cond evaluations; the
+    # per-chunk conds still bound insert load when the outer is taken
+    return lax.cond(jnp.any(hv.valid), _pair_all, lambda d: d, dep)
 
 
 # ------------------------------------------------------------ sharded step

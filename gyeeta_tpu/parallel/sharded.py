@@ -93,6 +93,47 @@ def fold_step_sharded(cfg: aggstate.EngineCfg, mesh):
     return jax.jit(_step, donate_argnums=(0,))
 
 
+def fold_step_dep_sharded(cfg: aggstate.EngineCfg, mesh,
+                          cap_per_dest: int):
+    """The sharded fused slab dispatch: engine fold + dependency-graph
+    fold (incl. the cross-shard pairing ``all_to_all``) + the global
+    digest-stage pressure scalar in ONE shard_map'd jit with state AND
+    dep donation — replacing the legacy three-dispatch sequence
+    (``fold_step_sharded`` + ``td_pressure_sharded`` + ``dep_step_fn``)
+    with one jit-call overhead per slab. The pressure scalar is a graph
+    OUTPUT (replicated ()), so the hot loop never issues a dispatch
+    just to observe it. ``cap_per_dest`` is the pairing dispatch
+    capacity — instantiate once per slab width (chunk vs fold_k-deep),
+    like the legacy ``dep_step_fn`` pair."""
+    from gyeeta_tpu.parallel import depgraph as dg
+
+    n = mesh.devices.size
+    axes = axes_of(mesh)
+    sizes = tuple(mesh.shape[a] for a in axes)
+    spec = P(axes)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec, spec, P()),
+             out_specs=(spec, spec, P()), check_vma=False)
+    def _step(st, dep, cb, rb, tick):
+        local = step.ingest_conn(cfg, _local(st), _local(cb))
+        local = step.ingest_resp_flat(cfg, local, _local(rb))
+        dloc = _local(dep)
+        cbl = _local(cb)
+        direct, hv = dg.halves_from_conn(cbl)
+        dloc = dg.fold_edges(dloc, *direct, tick)
+        routed, o_drop = dg._dispatch_halves(hv, axes, sizes, n,
+                                             cap_per_dest)
+        dloc = dloc._replace(n_dropped=dloc.n_dropped + o_drop)
+        dloc = dg.pair_halves_cond(dloc, routed, tick)
+        press = jnp.max(local.td_stage_n)
+        for ax in axes:
+            press = jax.lax.pmax(press, ax)
+        return _relocal(local), _relocal(dloc), press
+
+    return jax.jit(_step, donate_argnums=(0, 1))
+
+
 def td_flush_sharded(cfg: aggstate.EngineCfg, mesh):
     """Per-shard partial digest-stage flush (query/tick readiness).
 

@@ -132,6 +132,16 @@ class ShardedRuntime:
         self._fold = sharded.fold_step_sharded(self.cfg, self.mesh)
         self._td_flush = sharded.td_flush_sharded(self.cfg, self.mesh)
         self._td_pressure = sharded.td_pressure_sharded(self.mesh)
+        # fused slab dispatch (default): engine fold + dep fold +
+        # pressure scalar in ONE shard_map'd jit — the legacy three-
+        # dispatch sequence stays selectable via GYT_FUSED_FOLD=0
+        from gyeeta_tpu.runtime import fused_fold_enabled
+        self._fused = fused_fold_enabled()
+        self._fold_dep_slab = sharded.fold_step_dep_sharded(
+            self.cfg, self.mesh,
+            cap_per_dest=self.cfg.conn_batch * self.cfg.fold_k)
+        self._fold_dep_chunk = sharded.fold_step_dep_sharded(
+            self.cfg, self.mesh, cap_per_dest=self.cfg.conn_batch)
         self._td_dirty = False
         self._pressure = None         # device scalar from last dispatch
         self._fold_lst = sharded.ingest_listener_sharded(self.cfg,
@@ -389,13 +399,24 @@ class ShardedRuntime:
                     and int(self._pressure) > self.cfg.td_stage_cap // 2):
                 self.state = self._td_flush(self.state)
                 self.stats.bump("td_partial_flushes")
-            self.state = self._fold(self.state, cbs, rbs)
+            if self._fused:
+                # ONE fused dispatch: fold + dep (a2a pairing) +
+                # pressure output — no observation dispatch
+                fn = self._fold_dep_slab if lanes_c > self.cfg.conn_batch \
+                    else self._fold_dep_chunk
+                self.state, self.dep, self._pressure = fn(
+                    self.state, self.dep, cbs, rbs,
+                    np.int32(self._tick_no))
+                self.stats.bump("fold_dispatches")
+            else:
+                self.state = self._fold(self.state, cbs, rbs)
         self._profiler.on_fold()      # GYT_JAX_PROFILE bracket (opt-in)
-        self._pressure = self._td_pressure(self.state)
         self._td_dirty = True
-        dep_fn = self._dep_slab if lanes_c > self.cfg.conn_batch \
-            else self._dep_step
-        self.dep = dep_fn(self.dep, cbs, np.int32(self._tick_no))
+        if not self._fused:
+            self._pressure = self._td_pressure(self.state)
+            dep_fn = self._dep_slab if lanes_c > self.cfg.conn_batch \
+                else self._dep_step
+            self.dep = dep_fn(self.dep, cbs, np.int32(self._tick_no))
 
     def flush(self) -> int:
         """Fold staged raw leftovers (chunk-width dispatches) — state

@@ -12,6 +12,7 @@ pool — the device pipeline is the concurrency.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -38,6 +39,80 @@ from gyeeta_tpu.utils.selfstats import Stats
 # a native resp stream is "live" for bridge-suppression purposes if it
 # reported within this many base ticks (2 min at 5s)
 _RESP_FRESH_TICKS = 24
+
+
+_JIT_MEMO: dict = {}
+
+
+def _memo_jit(key: tuple, make):
+    """Process-wide compiled-function memo. Every Runtime used to
+    build its own ``jax.jit`` wrappers (fresh lambdas → zero cache
+    reuse), so each construction re-traced AND re-compiled the whole
+    fold family — seconds per instance, minutes across a test suite
+    that builds dozens of runtimes with identical geometry. The
+    compiled functions are pure (donation included — they never hold
+    instance state), so instances with the same key share them. Every
+    value the jitted closure captures MUST be part of the key (the
+    EngineCfg tuple, relevant RuntimeOpts fields, section-presence
+    names)."""
+    fn = _JIT_MEMO.get(key)
+    if fn is None:
+        fn = make()
+        _JIT_MEMO[key] = fn
+    return fn
+
+
+def fused_fold_enabled(env=None) -> bool:
+    """The fused ``fold_all`` megakernel is the default fold path;
+    ``GYT_FUSED_FOLD=0`` selects the legacy per-subsystem dispatch
+    sequence (the escape hatch — kept selectable and parity-tested,
+    tests/test_fusedfold.py)."""
+    env = os.environ if env is None else env
+    return str(env.get("GYT_FUSED_FOLD", "1")).strip().lower() \
+        not in ("0", "false", "no")
+
+
+def _slab_lanes(env=None) -> dict:
+    """Per-subsystem staging-slab lane capacities of the fused fold
+    slab (fixed → one compiled shape per presence combination). Sized
+    at 1-2 wire-max batches per section: sweep subsystems arrive at 5s
+    cadence, so a deeper slab only adds padding cost to the fused
+    dispatch. ``GYT_SLAB_<KIND>_LANES`` overrides (OPERATIONS.md
+    "Fold-path tuning")."""
+    env = os.environ if env is None else env
+    base = {
+        "listener": 2 * wire.MAX_LISTENERS_PER_BATCH,
+        "host": wire.MAX_HOSTS_PER_BATCH,
+        "task": 2 * wire.MAX_TASKS_PER_BATCH,
+        "cpumem": wire.MAX_CPUMEM_PER_BATCH,
+        "trace": wire.MAX_TRACE_PER_BATCH,
+        "ping": wire.MAX_PINGS_PER_BATCH,
+    }
+    return {k: int(env.get(f"GYT_SLAB_{k.upper()}_LANES", v))
+            for k, v in base.items()}
+
+
+# fused-slab section plumbing: selfstats counter, wire subtype (for the
+# raw-backlog concat dtype) and columnar builder per device-fold kind
+_SECTION_COUNTERS = {
+    "listener": "listener_records", "host": "host_records",
+    "task": "task_records", "ping": "task_pings",
+    "cpumem": "cpumem_records", "trace": "trace_records",
+}
+_SECTION_SUBTYPES = {
+    "listener": wire.NOTIFY_LISTENER_STATE, "host": wire.NOTIFY_HOST_STATE,
+    "task": wire.NOTIFY_AGGR_TASK_STATE, "ping": wire.NOTIFY_TASK_PING,
+    "cpumem": wire.NOTIFY_CPU_MEM_STATE, "trace": wire.NOTIFY_REQ_TRACE,
+}
+_SECTION_BUILDERS = {
+    "listener": lambda r, sz, st: decode.listener_batch_fast(r, sz,
+                                                             stats=st),
+    "host": lambda r, sz, st: decode.host_batch_fast(r, sz, stats=st),
+    "task": lambda r, sz, st: decode.task_batch_fast(r, sz, stats=st),
+    "ping": lambda r, sz, st: decode.ping_batch(r, sz, stats=st),
+    "cpumem": lambda r, sz, st: decode.cpumem_batch_fast(r, sz, stats=st),
+    "trace": lambda r, sz, st: decode.trace_batch(r, sz),
+}
 
 
 class Runtime:
@@ -100,53 +175,57 @@ class Runtime:
         # north-star geometry (the r4 listener-sweep cost was exactly
         # this). self.state is always rebound to the result, so the
         # donated buffers are never read again.
-        self._fold = step.jit_fold_step(self.cfg)
-        self._fold_lst = jax.jit(
-            lambda s, b: step.ingest_listener(self.cfg, s, b),
-            donate_argnums=(0,))
-        self._fold_host = jax.jit(
-            lambda s, b: step.ingest_host(self.cfg, s, b),
-            donate_argnums=(0,))
-        self._fold_task = jax.jit(
-            lambda s, b: step.ingest_task(self.cfg, s, b),
-            donate_argnums=(0,))
-        self._fold_ping = jax.jit(
-            lambda s, b: step.ping_tasks(self.cfg, s, b),
-            donate_argnums=(0,))
-        self._fold_cm = jax.jit(
-            lambda s, b: step.ingest_cpumem(self.cfg, s, b),
-            donate_argnums=(0,))
-        self._fold_trace = jax.jit(
-            lambda s, b: step.ingest_trace(self.cfg, s, b),
-            donate_argnums=(0,))
-        self._age_apis = jax.jit(
-            lambda s: step.age_apis(self.cfg, s,
-                                    self.opts.api_max_age_ticks),
-            donate_argnums=(0,))
-        self._age_tasks = jax.jit(
-            lambda s: step.age_tasks(self.cfg, s,
-                                     self.opts.task_max_age_ticks),
-            donate_argnums=(0,))
-        self._compact_tasks = jax.jit(
-            lambda s: step.compact_tasks(self.cfg, s),
-            donate_argnums=(0,))
-        self._tick = jax.jit(lambda s: step.tick_5s(self.cfg, s),
-                             donate_argnums=(0,))
+        cfg = self.cfg
+        mj = lambda tag, make, *extra: _memo_jit(  # noqa: E731
+            (tag, cfg, *extra), make)
+        self._fold = mj("fold", lambda: step.jit_fold_step(cfg))
+        self._fold_lst = mj("lst", lambda: jax.jit(
+            lambda s, b: step.ingest_listener(cfg, s, b),
+            donate_argnums=(0,)))
+        self._fold_host = mj("host", lambda: jax.jit(
+            lambda s, b: step.ingest_host(cfg, s, b),
+            donate_argnums=(0,)))
+        self._fold_task = mj("task", lambda: jax.jit(
+            lambda s, b: step.ingest_task(cfg, s, b),
+            donate_argnums=(0,)))
+        self._fold_ping = mj("ping", lambda: jax.jit(
+            lambda s, b: step.ping_tasks(cfg, s, b),
+            donate_argnums=(0,)))
+        self._fold_cm = mj("cm", lambda: jax.jit(
+            lambda s, b: step.ingest_cpumem(cfg, s, b),
+            donate_argnums=(0,)))
+        self._fold_trace = mj("trace", lambda: jax.jit(
+            lambda s, b: step.ingest_trace(cfg, s, b),
+            donate_argnums=(0,)))
+        _api_age = self.opts.api_max_age_ticks
+        self._age_apis = mj("age_apis", lambda: jax.jit(
+            lambda s: step.age_apis(cfg, s, _api_age),
+            donate_argnums=(0,)), _api_age)
+        _task_age = self.opts.task_max_age_ticks
+        self._age_tasks = mj("age_tasks", lambda: jax.jit(
+            lambda s: step.age_tasks(cfg, s, _task_age),
+            donate_argnums=(0,)), _task_age)
+        self._compact_tasks = mj("compact_tasks", lambda: jax.jit(
+            lambda s: step.compact_tasks(cfg, s),
+            donate_argnums=(0,)))
+        self._tick = mj("tick", lambda: jax.jit(
+            lambda s: step.tick_5s(cfg, s), donate_argnums=(0,)))
         # device-health readback: every health scalar packed into ONE
         # small vector (no donation — it only reads), transferred once
         # per report cadence (tick / metrics scrape), never per event
-        self._engine_health = jax.jit(
-            lambda s, d: step.engine_health_vec(self.cfg, s, d))
+        self._engine_health = mj("health", lambda: jax.jit(
+            lambda s, d: step.engine_health_vec(cfg, s, d)))
         # digest flush: host-side pressure trigger + O(m) partial flush.
         # An in-graph lax.cond flush cost 110 ms/dispatch UNTAKEN at 65k
         # capacity (whole-stage copies at the cond boundary); the full
         # O(capacity) flush cost 6.2 s there. The pressure scalar from
         # dispatch N is checked (already materialized) before dispatch
         # N+1 — no pipeline sync on the hot path.
-        self._td_flush_partial = jax.jit(
-            lambda s: step.td_flush_partial(self.cfg, s),
-            donate_argnums=(0,))
-        self._stage_pressure = jax.jit(step.stage_pressure)
+        self._td_flush_partial = mj("td_flush_partial", lambda: jax.jit(
+            lambda s: step.td_flush_partial(cfg, s),
+            donate_argnums=(0,)))
+        self._stage_pressure = mj("stage_pressure", lambda: jax.jit(
+            step.stage_pressure))
         from collections import deque
         # pressure scalars from recent dispatches: checked at lag 2 so
         # the int() readback never blocks on an in-flight fold (lag 1
@@ -156,19 +235,45 @@ class Runtime:
         # own stacked DepGraph — see parallel/depgraph.py)
         self.dep = dg.init(self.opts.dep_pair_capacity,
                            self.opts.dep_edge_capacity)
-        self._dep_step = jax.jit(dg.dep_step, donate_argnums=(0,))
+        self._dep_step = mj("dep_step", lambda: jax.jit(
+            dg.dep_step, donate_argnums=(0,)))
         # slab hot path: engine fold + dep fold in ONE dispatch — one
         # host→device transfer of the slab tree, one jit-call overhead,
         # and XLA can schedule the two independent folds together
-        self._fold_many_dep = jax.jit(
+        self._fold_many_dep = mj("fold_many_dep", lambda: jax.jit(
             lambda st, dep, cbs, rbs, tick: (
-                step.fold_many(self.cfg, st, cbs, rbs),
+                step.fold_many(cfg, st, cbs, rbs),
                 dg.dep_fold_many(dep, cbs, tick)),
-            donate_argnums=(0, 1))
-        self._dep_age = jax.jit(
-            lambda d, t: dg.age(d, t, self.opts.dep_pair_ttl_ticks,
-                                self.opts.dep_edge_ttl_ticks),
-            donate_argnums=(0,))
+            donate_argnums=(0, 1)))
+        _pttl = self.opts.dep_pair_ttl_ticks
+        _ettl = self.opts.dep_edge_ttl_ticks
+        self._dep_age = mj("dep_age", lambda: jax.jit(
+            lambda d, t: dg.age(d, t, _pttl, _ettl),
+            donate_argnums=(0,)), _pttl, _ettl)
+        # ---- fused fold path (the default; GYT_FUSED_FOLD=0 keeps the
+        # legacy per-subsystem dispatch sequence above selectable) ----
+        self._fused = fused_fold_enabled()
+        self._slab_lanes_cfg = _slab_lanes()
+        # per-subsystem staging sections: raw record-array backlogs that
+        # ride the NEXT fold_all dispatch (drained at the end of every
+        # ingest_records call, so they never outlive a feed batch)
+        self._stage_recs = {k: [] for k in self._slab_lanes_cfg}
+        self._stage_n = {k: 0 for k in self._slab_lanes_cfg}
+        # double-buffered conn/resp decode slabs: the idle buffer is
+        # decoded into while the in-flight fold still owns (device
+        # copies of) the other — host decode of batch N+1 overlaps
+        # device fold of batch N (async dispatch + buffer flip)
+        K = self.cfg.fold_k
+        self._slab_bufs = [
+            {"conn": decode.alloc_conn_cols(K * self.cfg.conn_batch),
+             "resp": decode.alloc_resp_cols(K * self.cfg.resp_batch),
+             "hw_conn": 0, "hw_resp": 0}
+            for _ in range(2)]
+        self._slab_active = 0
+        # fold_all jit cache: one compiled variant per section-presence
+        # combination (hot path = connresp-only; a 5s sweep batch adds
+        # one "everything" variant)
+        self._fold_all_jits: dict = {}
         self.names = InternTable()
         from gyeeta_tpu.utils.svcreg import SvcInfoRegistry
         from gyeeta_tpu.utils.hostreg import CgroupRegistry, \
@@ -302,7 +407,9 @@ class Runtime:
         for kind, *chunks in decode.drain_chunks(
                 recs, self.cfg.conn_batch, self.cfg.resp_batch,
                 self.cfg.listener_batch):
-            if kind == "listener":
+            if self._fused and kind in _SECTION_COUNTERS:
+                n += self._stage_section(kind, chunks[0])
+            elif kind == "listener":
                 lb = decode.listener_batch_fast(chunks[0],
                                                 self.cfg.listener_batch,
                                                 stats=self.stats)
@@ -331,25 +438,11 @@ class Runtime:
                 n += len(chunks[0])
                 self.stats.bump("cpumem_records", len(chunks[0]))
             elif kind == "trace":
-                self.traceconns.observe(chunks[0])
+                self._observe_trace(chunks[0])
                 trb = decode.trace_batch(chunks[0])
                 self.state = self._fold_trace(self.state, trb)
                 n += len(chunks[0])
                 self.stats.bump("trace_records", len(chunks[0]))
-                if self.opts.trace_resp_bridge:
-                    rs = decode.resp_from_trace(chunks[0])
-                    # per-host precedence: hosts with a RECENT native
-                    # resp stream are not bridged (no double counting;
-                    # a dead native stream un-suppresses)
-                    hid = rs["host_id"]
-                    fresh = (self._tick_no - self._host_resp_tick[
-                        np.minimum(hid, self.cfg.n_hosts - 1)]
-                        <= _RESP_FRESH_TICKS)
-                    rs = rs[(hid >= self.cfg.n_hosts) | ~fresh]
-                    if len(rs):
-                        self._resp_raw.append(rs)
-                        self._n_resp_raw += len(rs)
-                        self.stats.bump("resp_from_trace", len(rs))
             elif kind == "listener_info":
                 self.stats.bump("listener_infos",
                                 self.svcreg.update(chunks[0]))
@@ -391,10 +484,171 @@ class Runtime:
                 self.stats.bump("names_interned",
                                 self.names.update(chunks[0]))
                 self._cols.bump()
-        self._dispatch_full_slabs()
+        if self._fused:
+            self._dispatch_fused_pending()
+        else:
+            self._dispatch_full_slabs()
         if n:
             self._cols.bump()
         return n
+
+    def _observe_trace(self, recs) -> None:
+        """Host-side half of the trace fold (registry observe + the
+        trace→resp bridge with per-host native-stream precedence) —
+        shared by the fused staging path and the legacy dispatch."""
+        self.traceconns.observe(recs)
+        if self.opts.trace_resp_bridge:
+            rs = decode.resp_from_trace(recs)
+            # per-host precedence: hosts with a RECENT native resp
+            # stream are not bridged (no double counting; a dead
+            # native stream un-suppresses)
+            hid = rs["host_id"]
+            fresh = (self._tick_no - self._host_resp_tick[
+                np.minimum(hid, self.cfg.n_hosts - 1)]
+                <= _RESP_FRESH_TICKS)
+            rs = rs[(hid >= self.cfg.n_hosts) | ~fresh]
+            if len(rs):
+                self._resp_raw.append(rs)
+                self._n_resp_raw += len(rs)
+                self.stats.bump("resp_from_trace", len(rs))
+
+    # ------------------------------------------------- fused fold path
+    def _stage_section(self, kind: str, recs) -> int:
+        """Stage one drained subsystem chunk into the fused-fold slab
+        section; dispatches the pending slab first when the section
+        would overflow its fixed lane capacity."""
+        if kind == "trace":
+            self._observe_trace(recs)
+        if self._stage_n[kind] + len(recs) > self._slab_lanes_cfg[kind]:
+            self._dispatch_fused()
+        self._stage_recs[kind].append(recs)
+        self._stage_n[kind] += len(recs)
+        self.stats.bump(_SECTION_COUNTERS[kind], len(recs))
+        return len(recs)
+
+    def _dispatch_fused_pending(self) -> None:
+        """End-of-ingest fold boundary: one fused dispatch folds every
+        staged subsystem section plus (when full) the conn/resp K-slab;
+        extra full K-slabs drain in follow-up connresp-only dispatches.
+        Same fold boundaries as the legacy sequence — grouped into one
+        device dispatch per boundary instead of one per subsystem."""
+        K = self.cfg.fold_k
+        nc, nr = K * self.cfg.conn_batch, K * self.cfg.resp_batch
+        while (any(self._stage_n.values())
+               or self._n_conn_raw >= nc or self._n_resp_raw >= nr):
+            self._dispatch_fused(
+                connresp="slab" if (self._n_conn_raw >= nc
+                                    or self._n_resp_raw >= nr)
+                else None)
+
+    def _get_fold_all(self, names: tuple):
+        """Compiled fold_all variant for one section-presence tuple
+        (process-wide memo — every Runtime with the same geometry
+        shares the compiled variants)."""
+        jitted = self._fold_all_jits.get(names)
+        if jitted is None:
+            cfg = self.cfg
+
+            def make():
+                def fn(st, dep, tick, *secs, _names=names):
+                    return step.fold_all(cfg, st, dep, tick,
+                                         **dict(zip(_names, secs)))
+                return jax.jit(fn, donate_argnums=(0, 1))
+
+            jitted = _memo_jit(("fold_all", cfg, names), make)
+            self._fold_all_jits[names] = jitted
+        return jitted
+
+    def _dispatch_fused(self, connresp=None) -> None:
+        """ONE fused device dispatch: staged subsystem sections (in the
+        legacy drain order) + optionally the conn/resp slab + the dep
+        fold + the digest-stage pressure scalar, with full state
+        donation. ``connresp``: None (sections only), "slab" (a (K, B)
+        double-buffered slab take) or "single" (one (1, B) microbatch —
+        the flush/boundary shape).
+
+        The per-batch device dispatch count of the hot path is exactly
+        ONE (plus the occasional ``td_flush_partial``): the pressure
+        scalar rides the fold's own outputs, so no second dispatch ever
+        runs just to observe it."""
+        sections = {}
+        for kind in self._slab_lanes_cfg:
+            if self._stage_n[kind]:
+                recs = decode._concat_chunks(
+                    self._stage_recs[kind],
+                    wire.DTYPE_OF_SUBTYPE[_SECTION_SUBTYPES[kind]])
+                sections[kind] = _SECTION_BUILDERS[kind](
+                    recs, self._slab_lanes_cfg[kind], self.stats)
+                self._stage_recs[kind] = []
+                self._stage_n[kind] = 0
+        nrec = 0
+        if connresp == "slab":
+            K = self.cfg.fold_k
+            buf = self._slab_bufs[self._slab_active]
+            self._slab_active ^= 1          # flip: next decode goes to
+            self.stats.bump("stage_slab_flips")  # the idle buffer
+            crecs, nc = decode.take_raw_chunks(
+                self._conn_raw, K * self.cfg.conn_batch)
+            rrecs, nr = decode.take_raw_chunks(
+                self._resp_raw, K * self.cfg.resp_batch)
+            self._n_conn_raw -= nc
+            self._n_resp_raw -= nr
+            nrec = nc + nr
+            # host-side staging gauges (no device readback): slab fill
+            # at dispatch + the buffer flip counter; the engine_ prefix
+            # rides the `health {...}` cadence line and /metrics
+            self.stats.gauge("engine_stage_slab_conn_occupancy",
+                             round(nc / (K * self.cfg.conn_batch), 4))
+            self.stats.gauge("engine_stage_slab_resp_occupancy",
+                             round(nr / (K * self.cfg.resp_batch), 4))
+            cbs = decode.conn_slab(crecs, K, self.cfg.conn_batch,
+                                   stats=self.stats, out=buf["conn"],
+                                   clear_to=buf["hw_conn"])
+            rbs = decode.resp_slab(rrecs, K, self.cfg.resp_batch,
+                                   stats=self.stats, out=buf["resp"],
+                                   clear_to=buf["hw_resp"])
+            buf["hw_conn"], buf["hw_resp"] = nc, nr
+            sections["connresp"] = (cbs, rbs)
+            self.stats.bump("slab_dispatches")
+        elif connresp == "single":
+            crecs, nc = decode.take_raw_chunks(self._conn_raw,
+                                               self.cfg.conn_batch)
+            rrecs, nr = decode.take_raw_chunks(self._resp_raw,
+                                               self.cfg.resp_batch)
+            self._n_conn_raw -= nc
+            self._n_resp_raw -= nr
+            nrec = nc + nr
+            cbs = decode.conn_slab(crecs, 1, self.cfg.conn_batch,
+                                   stats=self.stats)
+            rbs = decode.resp_slab(rrecs, 1, self.cfg.resp_batch,
+                                   stats=self.stats)
+            sections["connresp"] = (cbs, rbs)
+        if not sections:
+            return
+        # lag-2 pressure scalar (a fold_all OUTPUT — materialized by
+        # now): flush the fullest digest stages BEFORE this dispatch
+        # when headroom is low
+        if (len(self._pressures) >= 2
+                and int(self._pressures.popleft())
+                > self.cfg.td_stage_cap // 2):
+            self.state = self._td_flush_partial(self.state)
+            self.stats.bump("td_partial_flushes")
+        names = tuple(k for k in step.FOLD_ALL_ORDER if k in sections)
+        with self.stats.timeit("fold_dispatch"), \
+                self.spans.span("decode_fold", nrec=nrec,
+                                path="native" if native.available()
+                                else "python"):
+            # the staged (idle-buffer) columns transfer while the
+            # previous fold may still be in flight; the jit call below
+            # never blocks on it (async dispatch)
+            secs = jax.device_put(tuple(sections[k] for k in names))
+            self.state, self.dep, pressure = self._get_fold_all(names)(
+                self.state, self.dep, np.int32(self._tick_no), *secs)
+        self._profiler.on_fold()      # GYT_JAX_PROFILE bracket (opt-in)
+        self._pressures.append(pressure)
+        if "connresp" in sections:
+            self._td_dirty = True
+        self.stats.bump("fold_dispatches")
 
     def _dispatch_full_slabs(self) -> None:
         """Fold every full K-slab of staged raw records. JAX dispatch is
@@ -449,23 +703,36 @@ class Runtime:
         on tick cadence / ``td_drain``, off the <1s query path).
         Returns records folded."""
         n = self._n_conn_raw + self._n_resp_raw
-        while self._n_conn_raw or self._n_resp_raw:
-            if (self._n_conn_raw <= self.cfg.conn_batch
+        while (self._n_conn_raw or self._n_resp_raw
+               or (self._fused and any(self._stage_n.values()))):
+            if not self._fused:
+                if (self._n_conn_raw <= self.cfg.conn_batch
+                        and self._n_resp_raw <= self.cfg.resp_batch):
+                    crecs, _ = decode.take_raw_chunks(
+                        self._conn_raw, self.cfg.conn_batch)
+                    rrecs, _ = decode.take_raw_chunks(
+                        self._resp_raw, self.cfg.resp_batch)
+                    self._n_conn_raw = self._n_resp_raw = 0
+                    cb = decode.conn_batch_parts(
+                        crecs, self.cfg.conn_batch, stats=self.stats)
+                    rb = decode.resp_batch_parts(
+                        rrecs, self.cfg.resp_batch, stats=self.stats)
+                    self.state = self._fold(self.state, cb, rb)
+                    self.dep = self._dep_step(self.dep, cb,
+                                              self._tick_no)
+                    self._td_dirty = True     # resp samples staged
+                else:
+                    self._dispatch_slab()
+            elif (self._n_conn_raw <= self.cfg.conn_batch
                     and self._n_resp_raw <= self.cfg.resp_batch):
-                crecs, _ = decode.take_raw_chunks(self._conn_raw,
-                                                  self.cfg.conn_batch)
-                rrecs, _ = decode.take_raw_chunks(self._resp_raw,
-                                                  self.cfg.resp_batch)
-                self._n_conn_raw = self._n_resp_raw = 0
-                cb = decode.conn_batch_parts(crecs, self.cfg.conn_batch,
-                                             stats=self.stats)
-                rb = decode.resp_batch_parts(rrecs, self.cfg.resp_batch,
-                                             stats=self.stats)
-                self.state = self._fold(self.state, cb, rb)
-                self.dep = self._dep_step(self.dep, cb, self._tick_no)
-                self._td_dirty = True     # resp samples staged
+                # boundary leftovers: one fused (1, B) dispatch — the
+                # same single-microbatch shape the legacy flush uses,
+                # with dep fold + pressure riding the same graph
+                self._dispatch_fused(
+                    connresp="single"
+                    if (self._n_conn_raw or self._n_resp_raw) else None)
             else:
-                self._dispatch_slab()
+                self._dispatch_fused(connresp="slab")
         if n:
             self._cols.bump()
         return n
@@ -824,6 +1091,8 @@ class Runtime:
         # restore: folding them into checkpointed state would double-count
         self._conn_raw, self._resp_raw = [], []
         self._n_conn_raw = self._n_resp_raw = 0
+        self._stage_recs = {k: [] for k in self._slab_lanes_cfg}
+        self._stage_n = {k: 0 for k in self._slab_lanes_cfg}
         self._pending = b""
         self._cols.bump()
         self._cols.clear()

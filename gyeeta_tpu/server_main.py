@@ -150,6 +150,14 @@ class Daemon:
             eng = {k: v for k, v in self.rt.stats.gauges.items()
                    if k.startswith(("engine_", "journal_",
                                     "throttle_state"))}
+            # fused fold-path cadence: device dispatches + staging-slab
+            # buffer flips + digest flushes this interval (the fold
+            # half of the overlap win; gyt_fold_dispatches_total etc
+            # ride /metrics from the same counters)
+            for k in ("fold_dispatches", "stage_slab_flips",
+                      "td_partial_flushes"):
+                if d.get(k):
+                    eng[k + "_delta"] = d[k]
             if eng:
                 log.info("health %s", json.dumps(eng, default=str,
                                                  sort_keys=True))

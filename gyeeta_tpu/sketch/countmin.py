@@ -5,9 +5,13 @@ Replaces the reference's per-flow exact counters kept in RCU hash tables
 unbounded-key regime: per-5-tuple bytes/sec, per-endpoint event counts.
 Point-update pointer chasing becomes one batched scatter-add per microbatch.
 
-State is ``(depth, width)``; each row uses an independent hash stream (salt =
-row index). Estimates are upper bounds; error ≤ e·N/width with prob 1-e^-depth.
-Merge is elementwise ``+`` → roll-up over shards is a plain ``psum``.
+State is ``(depth, width)``; row streams derive from TWO independent
+hashes via Kirsch-Mitzenmacher double hashing (``bucket_r = h1 + r·h2``
+— provably preserves the CMS error bounds, *Less Hashing, Same
+Performance*, and costs 2 key mixes instead of ``depth``; the fold-path
+hash work is ~depth/2 cheaper). Estimates are upper bounds; error ≤
+e·N/width with prob 1-e^-depth. Merge is elementwise ``+`` → roll-up
+over shards is a plain ``psum``.
 """
 
 from __future__ import annotations
@@ -38,11 +42,17 @@ def update(sk: CMS, key_hi, key_lo, values, valid=None) -> CMS:
     if valid is not None:
         vals = jnp.where(valid, vals, jnp.zeros_like(vals))
     # One fused scatter over all rows: flatten (row, bucket) into row*width+idx.
-    rows = []
-    for r in range(depth):
-        rows.append(H.bucket_index(key_hi, key_lo, r, width) + r * width)
+    buckets = H.bucket_indices_km(key_hi, key_lo, depth, width)
+    rows = [b + r * width for r, b in enumerate(buckets)]
     flat_idx = jnp.concatenate(rows)
     flat_vals = jnp.tile(vals, depth)
+    # GYT_PALLAS=1: the hash→bucket→add inner loop as a hand kernel
+    # (sketch/pallas_scatter.py prototype); vals are pre-masked, so
+    # both paths apply identical updates
+    from gyeeta_tpu.sketch import pallas_scatter as _ps
+    if _ps.enabled():
+        return CMS(counts=_ps.scatter_add(sk.counts, flat_idx,
+                                          flat_vals))
     counts = sk.counts.reshape(-1).at[flat_idx].add(flat_vals)
     return CMS(counts=counts.reshape(depth, width))
 
@@ -51,8 +61,24 @@ def query(sk: CMS, key_hi, key_lo):
     """Point estimate (min over rows) for a batch of keys."""
     depth, width = sk.counts.shape
     est = None
-    for r in range(depth):
-        idx = H.bucket_index(key_hi, key_lo, r, width)
+    for r, idx in enumerate(H.bucket_indices_km(key_hi, key_lo, depth,
+                                                width)):
+        v = sk.counts[r, idx]
+        est = v if est is None else jnp.minimum(est, v)
+    return est
+
+
+def upper_bound(sk: CMS, key_hi, key_lo, rows: int = 1):
+    """Looser point estimate using only the first ``rows`` hash rows —
+    still a valid upper bound (every row receives all mass), at 1/depth
+    the gather cost. Candidate filters (top-K compaction) want exactly
+    this: cheap, safe-side, ranking quality degrades gracefully with
+    collisions."""
+    depth, width = sk.counts.shape
+    rows = min(rows, depth)
+    est = None
+    for r, idx in enumerate(H.bucket_indices_km(key_hi, key_lo, rows,
+                                                width)):
         v = sk.counts[r, idx]
         est = v if est is None else jnp.minimum(est, v)
     return est
@@ -70,17 +96,19 @@ def total(sk: CMS):
 # ---------------------------------------------------------------- numpy ref
 def np_update(counts: np.ndarray, key_hi, key_lo, values):
     depth, width = counts.shape
-    for r in range(depth):
-        idx = H.bucket_index(np.asarray(key_hi), np.asarray(key_lo), r, width)
+    buckets = H.bucket_indices_km(np.asarray(key_hi), np.asarray(key_lo),
+                                  depth, width)
+    for r, idx in enumerate(buckets):
         np.add.at(counts[r], idx, values)
     return counts
 
 
 def np_query(counts: np.ndarray, key_hi, key_lo):
     depth, width = counts.shape
+    buckets = H.bucket_indices_km(np.asarray(key_hi), np.asarray(key_lo),
+                                  depth, width)
     est = None
-    for r in range(depth):
-        idx = H.bucket_index(np.asarray(key_hi), np.asarray(key_lo), r, width)
+    for r, idx in enumerate(buckets):
         v = counts[r][idx]
         est = v if est is None else np.minimum(est, v)
     return est

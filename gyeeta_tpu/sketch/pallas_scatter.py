@@ -1,0 +1,200 @@
+"""Pallas prototype for the sketch-update inner loop (GYT_PALLAS=1).
+
+The FPGA sketch-acceleration literature (PAPERS.md: "Memory-efficient
+Sketch Acceleration for Large Network Flows", "HyperLogLog Sketch
+Acceleration on FPGA") shows the per-event sketch update is a pure
+``hash → bucket → max/add`` pattern that fuses into a single pipeline
+pass. The XLA path expresses it as one scatter op per sketch; this
+module is the hand-kernel prototype of the same inner loop as a Pallas
+``pallas_call`` — a read-modify-write sweep over the batch lanes:
+
+- :func:`scatter_max` — the HLL register update (per-entity and global
+  registers flatten to one 1-D register file; lanes carry a
+  pre-masked rank, so padding lanes are max-with-0 no-ops),
+- :func:`scatter_add` — the CMS row update (the ``depth`` rows flatten
+  to one buffer with per-row lane offsets, exactly like the XLA path;
+  padding lanes add 0.0).
+
+Status: PROTOTYPE, off by default. ``GYT_PALLAS=1`` routes
+``hyperloglog.update/update_entities`` and ``countmin.update`` through
+these kernels; on non-TPU backends the kernels run in Pallas
+INTERPRET mode (correct, slow — CI exercises numeric equality with the
+XLA scatters there), and any import/lowering failure falls back to the
+XLA path with a one-time warning (never an error on the hot path).
+``python -m gyeeta_tpu.sketch.pallas_scatter`` benchmarks both paths
+and prints one JSON line — the honest comparison the flag is gated on.
+
+The flag is read once per process (the fold graphs trace once); set it
+before start, like GYT_BENCH_ABLATE.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_log = logging.getLogger("gyeeta_tpu.sketch.pallas")
+_warned = False
+
+
+def enabled() -> bool:
+    """True when GYT_PALLAS=1 and the Pallas import works. Read at
+    trace time (once per compiled fold variant)."""
+    if os.environ.get("GYT_PALLAS", "0").strip() not in ("1", "true"):
+        return False
+    return _import_ok()
+
+
+def _import_ok() -> bool:
+    global _warned
+    try:
+        from jax.experimental import pallas as pl  # noqa: F401
+        return True
+    except Exception as e:  # noqa: BLE001 — any import failure → XLA
+        if not _warned:
+            _warned = True
+            _log.warning("GYT_PALLAS=1 but Pallas is unavailable "
+                         "(%s) — XLA scatter path in use", e)
+        return False
+
+
+def _interpret() -> bool:
+    """Interpret mode everywhere but real TPU backends — the CPU/GPU
+    fallback contract of the prototype."""
+    return jax.default_backend() != "tpu"
+
+
+def _scatter_max_call(regs_flat, idx, val):
+    from jax.experimental import pallas as pl
+
+    def kernel(idx_ref, val_ref, regs_ref, out_ref):
+        def body(i, carry):
+            j = idx_ref[i]
+            out_ref[j] = jnp.maximum(out_ref[j], val_ref[i])
+            return carry
+        jax.lax.fori_loop(0, idx_ref.shape[0], body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(regs_flat.shape, regs_flat.dtype),
+        input_output_aliases={2: 0},
+        interpret=_interpret(),
+    )(idx, val, regs_flat)
+
+
+def _scatter_add_call(counts_flat, idx, val):
+    from jax.experimental import pallas as pl
+
+    def kernel(idx_ref, val_ref, counts_ref, out_ref):
+        def body(i, carry):
+            j = idx_ref[i]
+            out_ref[j] = out_ref[j] + val_ref[i]
+            return carry
+        jax.lax.fori_loop(0, idx_ref.shape[0], body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(counts_flat.shape,
+                                       counts_flat.dtype),
+        input_output_aliases={2: 0},
+        interpret=_interpret(),
+    )(idx, val, counts_flat)
+
+
+def scatter_max(regs, flat_idx, val):
+    """``regs.flat[idx] = max(regs.flat[idx], val)`` per lane, in lane
+    order — the HLL register update. ``regs`` may carry leading entity
+    axes (flattened and restored here); ``flat_idx`` indexes the
+    flattened register file; ``val`` must be pre-masked (0 on padding
+    lanes). Falls back to the XLA scatter on any kernel failure."""
+    shape = regs.shape
+    flat = regs.reshape(-1)
+    try:
+        out = _scatter_max_call(flat, flat_idx.astype(jnp.int32),
+                                val.astype(regs.dtype))
+    except Exception as e:  # noqa: BLE001 — lowering failure → XLA
+        _fallback_warn(e)
+        out = flat.at[flat_idx].max(val.astype(regs.dtype))
+    return out.reshape(shape)
+
+
+def scatter_add(counts, flat_idx, val):
+    """``counts.flat[idx] += val`` per lane — the CMS row update (val
+    pre-masked to 0 on padding lanes). Fallback: XLA scatter-add."""
+    shape = counts.shape
+    flat = counts.reshape(-1)
+    try:
+        out = _scatter_add_call(flat, flat_idx.astype(jnp.int32),
+                                val.astype(counts.dtype))
+    except Exception as e:  # noqa: BLE001 — lowering failure → XLA
+        _fallback_warn(e)
+        out = flat.at[flat_idx].add(val.astype(counts.dtype))
+    return out.reshape(shape)
+
+
+def _fallback_warn(e) -> None:
+    global _warned
+    if not _warned:
+        _warned = True
+        _log.warning("Pallas sketch kernel failed (%s) — XLA scatter "
+                     "fallback in use", e)
+
+
+# ------------------------------------------------------------- benchmark
+def _bench(n_lanes: int = 4096, m: int = 1 << 14, iters: int = 20):
+    """Pallas vs XLA scatter on one (idx, val) workload; asserts
+    numeric equality, times both, returns a result dict."""
+    import time
+
+    rng = np.random.default_rng(7)
+    idx = jnp.asarray(rng.integers(0, m, n_lanes), jnp.int32)
+    rank = jnp.asarray(rng.integers(0, 23, n_lanes), jnp.int32)
+    vals = jnp.asarray(rng.random(n_lanes), jnp.float32)
+    regs = jnp.zeros((m,), jnp.int32)
+    counts = jnp.zeros((m,), jnp.float32)
+
+    xla_max = jax.jit(lambda r: r.at[idx].max(rank))
+    xla_add = jax.jit(lambda c: c.at[idx].add(vals))
+    pls_max = jax.jit(lambda r: _scatter_max_call(r, idx, rank))
+    pls_add = jax.jit(lambda c: _scatter_add_call(c, idx, vals))
+
+    def rate(f, x):
+        out = f(x)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(x)
+        jax.block_until_ready(out)
+        return n_lanes * iters / (time.perf_counter() - t0)
+
+    res = {"backend": jax.default_backend(),
+           "interpret": _interpret(), "n_lanes": n_lanes, "m": m}
+    np.testing.assert_array_equal(np.asarray(xla_max(regs)),
+                                  np.asarray(pls_max(regs)))
+    np.testing.assert_allclose(np.asarray(xla_add(counts)),
+                               np.asarray(pls_add(counts)), rtol=1e-6)
+    res["equal"] = True
+    res["xla_scatter_max_lanes_per_sec"] = round(rate(xla_max, regs), 1)
+    res["pallas_scatter_max_lanes_per_sec"] = round(rate(pls_max, regs),
+                                                    1)
+    res["xla_scatter_add_lanes_per_sec"] = round(rate(xla_add, counts),
+                                                 1)
+    res["pallas_scatter_add_lanes_per_sec"] = round(rate(pls_add,
+                                                         counts), 1)
+    return res
+
+
+def main() -> None:
+    import json
+    if not _import_ok():
+        print(json.dumps({"pallas_available": False}))
+        return
+    print(json.dumps({"pallas_available": True, **_bench()}))
+
+
+if __name__ == "__main__":
+    main()

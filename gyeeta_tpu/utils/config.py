@@ -23,7 +23,11 @@ ENV_PREFIX = "GYT_"
 _INT_FIELDS = {"svc_capacity", "n_hosts", "hll_p_svc", "hll_p_global",
                "cms_depth", "cms_width", "topk_capacity", "td_capacity",
                "conn_batch", "resp_batch",
-               "listener_batch", "fold_k", "task_capacity"}
+               "listener_batch", "fold_k", "task_capacity",
+               # fold-path tuning knobs (OPERATIONS.md "Fold-path
+               # tuning"): digest duty cycle + staging geometry
+               "td_sample_stride", "td_stage_cap", "td_flush_m",
+               "topk_budget"}
 
 
 class RuntimeOpts(NamedTuple):

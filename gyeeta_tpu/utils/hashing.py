@@ -86,6 +86,41 @@ def mix64(hi, lo, salt: int = 0):
     return h
 
 
+def bucket_indices_km(hi, lo, depth: int, nbuckets: int) -> list:
+    """Per-row buckets for a ``depth``-row sketch via Kirsch-Mitzenmacher
+    double hashing: ``bucket_r = range_map(h1 + r·h2)`` from TWO key
+    mixes instead of one per row (*Less Hashing, Same Performance* —
+    the derived streams preserve Count-Min/Bloom error bounds). ``h2``
+    is forced odd so consecutive streams never collapse onto each other
+    even for adversarial h2 = 0. Identical semantics in numpy and jax
+    (same wrap-around uint32 arithmetic)."""
+    h1 = mix64(hi, lo, 0xC035)
+    h2 = mix64(hi, lo, 0x51ED)
+    if _is_np(h1):
+        with np.errstate(over="ignore"):
+            h2 = h2 | np.uint32(1)
+            return [_range_map(h1 + np.uint32(r) * h2, nbuckets)
+                    for r in range(depth)]
+    h2 = h2 | jnp.uint32(1)
+    return [_range_map(h1 + jnp.uint32(r) * h2, nbuckets)
+            for r in range(depth)]
+
+
+def _range_map(h, nbuckets: int):
+    """Uniform u32 → [0, nbuckets) (Lemire high-multiply; np + jnp)."""
+    if _is_np(h):
+        return ((h.astype(np.uint64) * np.uint64(nbuckets))
+                >> np.uint64(32)).astype(np.int32)
+    n = jnp.uint32(nbuckets)
+    a_hi, a_lo = h >> 16, h & jnp.uint32(0xFFFF)
+    b_hi, b_lo = n >> 16, n & jnp.uint32(0xFFFF)
+    lo_lo = a_lo * b_lo
+    t = a_hi * b_lo + (lo_lo >> 16)
+    w1 = (t & jnp.uint32(0xFFFF)) + a_lo * b_hi
+    res = a_hi * b_hi + (t >> 16) + (w1 >> 16)
+    return res.astype(jnp.int32)
+
+
 def bucket_index(hi, lo, salt: int, nbuckets: int):
     """Map a 64-bit key to a bucket in [0, nbuckets) for hash stream ``salt``.
 
