@@ -7,9 +7,10 @@ import os
 import subprocess
 import sys
 
-combos = ["", "topk", "tdigest", "topk,tdigest", "upsert",
+combos = ["", "topk", "hh", "topk,hh", "tdigest", "topk,tdigest",
+          "upsert",
           "svchll", "globhll", "cms", "loghist", "ctr",
-          "topk,tdigest,svchll,globhll,cms,loghist,ctr,upsert"]
+          "topk,hh,tdigest,svchll,globhll,cms,loghist,ctr,upsert"]
 for ab in combos:
     ms = []
     for phase in ("fold_ns", "fold_toy"):

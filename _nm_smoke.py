@@ -15,9 +15,29 @@ import asyncio
 import sys
 
 
+async def _rest_query(gh, gp, req: dict) -> tuple:
+    """POST /query against the web gateway → (raw body, parsed)."""
+    import json
+
+    reader, writer = await asyncio.open_connection(gh, gp)
+    body = json.dumps(req).encode()
+    writer.write(
+        b"POST /query HTTP/1.1\r\nHost: s\r\nConnection: close\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, rbody = raw.partition(b"\r\n\r\n")
+    assert b" 200 " in head.splitlines()[0], head
+    return rbody, json.loads(rbody)
+
+
 async def scenario() -> None:
+    import json
+
     from gyeeta_tpu.engine.aggstate import EngineCfg
     from gyeeta_tpu.net import GytServer, NetAgent
+    from gyeeta_tpu.net.webgw import WebGateway
     from gyeeta_tpu.runtime import Runtime
     from gyeeta_tpu.sim.nodeweb import NodeWebSim
 
@@ -40,6 +60,21 @@ async def scenario() -> None:
     # one web query: the agent's sweep must be visible over NM
     out = await nw.query_web("svcstate", maxrecs=100)
     assert out["nrecs"] > 0, f"no svcstate rows over NM: {out}"
+
+    # heavy-hitter subsystem on BOTH query edges against the live
+    # serve (ISSUE 7): non-empty, every row bound-annotated, and the
+    # NM and REST renderings byte-equal
+    gw = WebGateway(host, port)
+    gh, gp = await gw.start()
+    topk_req = {"subsys": "topk", "maxrecs": 50}
+    nm_topk = await nw.query_web("topk", maxrecs=50)
+    rest_raw, rest_topk = await _rest_query(gh, gp, topk_req)
+    assert nm_topk["nrecs"] > 0, f"no topk rows over NM: {nm_topk}"
+    assert all("errbound" in r and "source" in r
+               for r in nm_topk["recs"]), "topk rows not bound-annotated"
+    assert json.dumps(nm_topk).encode() == rest_raw, \
+        "topk NM vs REST bytes differ"
+    await gw.stop()
 
     # one alertdef CRUD round trip: create → list shows it → delete →
     # list no longer shows it
@@ -65,7 +100,9 @@ async def scenario() -> None:
     await agent.close()
     await srv.stop()
     print(f"nm smoke: OK — handshake + svcstate query "
-          f"({out['nrecs']} rows) + alertdef CRUD round trip",
+          f"({out['nrecs']} rows) + topk NM/REST parity "
+          f"({nm_topk['nrecs']} bound-annotated rows) "
+          f"+ alertdef CRUD round trip",
           file=sys.stderr)
 
 

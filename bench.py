@@ -43,9 +43,9 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 # per-phase subprocess timeouts (seconds); generous for tunnel compiles
 PHASE_TIMEOUT = {"fold_toy": 1500, "fold_ns": 2700,
                  "feed_toy": 900, "feed_ns": 1500,
-                 "feed_toy_wal": 900}
+                 "feed_toy_wal": 900, "topk_recover": 900}
 PHASE_ORDER = ("fold_toy", "fold_ns", "feed_ns", "feed_toy",
-               "feed_toy_wal")
+               "feed_toy_wal", "topk_recover")
 
 
 def _geometry(which: str):
@@ -352,6 +352,96 @@ def _bench_feed(cfg, sim, label: str, dep_pairs: int,
             "selfstats": selfstats}
 
 
+def _bench_topk_recover(cfg, sim, dep_pairs: int, dep_edges: int) -> dict:
+    """Heavy-hitter recovery cost + accuracy (ISSUE 7): the per-tick
+    invertible-sketch decode readback, measured three ways — wall ms
+    per recovery, measured top-32 weighted error vs the exact offline
+    reference (``sketch/exact.py:StreamTopK``, the same truth the fuzz
+    test asserts ≤2% against), and the feed-path ev/s impact when a
+    recovery runs after EVERY feed batch (worst-case cadence; the
+    product runs one per 5s tick)."""
+    import jax
+
+    from gyeeta_tpu.ingest import decode, wire
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sketch import exact
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    rt = Runtime(cfg, RuntimeOpts(dep_pair_capacity=dep_pairs,
+                                  dep_edge_capacity=dep_edges))
+    K = cfg.fold_k
+    truth = exact.StreamTopK()
+    n_bufs = 6
+    ev_per_buf = K * (cfg.conn_batch + cfg.resp_batch)
+    bufs = []
+    for i in range(n_bufs):
+        # one flow universe per buffer (distinct sim seeds): the union
+        # of heavy keys exceeds the exact tier's capacity, so the
+        # invertible recovery actually contributes rows — the regime
+        # the tier exists for, not the one the exact lanes already own
+        s = ParthaSim(n_hosts=sim.n_hosts, n_svcs=sim.n_svcs,
+                      n_clients=sim.n_clients, seed=1000 + i)
+        conns = s.conn_records(K * cfg.conn_batch)
+        truth.add_conn_batch(decode.conn_batch(conns, len(conns)))
+        bufs.append(wire.encode_frames_chunked(wire.NOTIFY_TCP_CONN,
+                                               conns)
+                    + s.resp_frames(K * cfg.resp_batch))
+    # accuracy leg: each buffer folds exactly ONCE (the engine and the
+    # exact reference must see the same stream), then one recovery
+    for b in bufs:
+        rt.feed(b)
+    rt.flush()
+    rec = rt.heavy_recover()            # compiles the decode program
+    by_id = {r[0]: r[1] for r in rec["flows"]}
+    err = mass = 0.0
+    for key_hex, exact_v in truth.topk_hex(32):
+        err += abs(by_id.get(key_hex, 0.0) - exact_v)
+        mass += exact_v
+    top32_err = err / max(mass, 1e-9)
+
+    # recovery wall time (cache-busted so every call decodes)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rt._cols.bump()
+        rt.heavy_recover()
+    recover_ms = (time.perf_counter() - t0) / iters * 1e3
+
+    # feed impact: same loop ± one recovery per feed batch
+    def feed_rate(with_recovery: bool, calls: int = 12) -> float:
+        t0 = time.perf_counter()
+        for i in range(calls):
+            rt.feed(bufs[i % n_bufs])
+            if with_recovery:
+                rt.heavy_recover()
+        rt.flush()
+        jax.block_until_ready(rt.state)
+        return calls * ev_per_buf / (time.perf_counter() - t0)
+
+    feed_rate(False, 4)                 # warm both loop shapes
+    r0 = feed_rate(False)
+    r1 = feed_rate(True)
+    out = {
+        "recover_ms_per_tick": round(recover_ms, 3),
+        "recovered_keys": rec["recovered_keys"],
+        "evicted_mass": rec["evicted"],
+        "top32_weighted_err": round(top32_err, 5),
+        "err_bound_met": top32_err <= 0.02,
+        "feed_ev_per_sec": round(r0, 1),
+        "feed_ev_per_sec_with_recovery": round(r1, 1),
+        "recover_feed_impact_ratio": round(r1 / max(r0, 1e-9), 4),
+        "tick_budget_frac": round(recover_ms / 5000.0, 5),
+    }
+    print(f"bench[topk_recover]: {recover_ms:.2f} ms/recovery, "
+          f"{rec['recovered_keys']} keys, top32 err "
+          f"{top32_err:.4f}, feed impact x{out['recover_feed_impact_ratio']}",
+          file=sys.stderr, flush=True)
+    rt.close()
+    return out
+
+
 def _run_phase(phase: str) -> dict:
     """Leaf mode: run ONE phase in-process and return its fields."""
     import jax
@@ -382,6 +472,9 @@ def _run_phase(phase: str) -> dict:
     if phase == "feed_toy_wal":
         cfg, sim, dp, de = _geometry("toy")
         return _bench_feed(cfg, sim, "toy+wal", dp, de, journal=True)
+    if phase == "topk_recover":
+        cfg, sim, dp, de = _geometry("toy")
+        return _bench_topk_recover(cfg, sim, dp, de)
     raise SystemExit(f"unknown phase {phase!r}")
 
 
@@ -506,7 +599,13 @@ def _orchestrate(platform: str | None, degraded: bool,
                 fwal["rate"] / ftoy["rate"], 4)
         if fwal.get("journal_timings"):
             result["journal_stage_timings"] = fwal["journal_timings"]
-    failed = [p for p, v in phases.items() if "rate" not in v]
+    hh = phases.get("topk_recover", {})
+    if "recover_ms_per_tick" in hh:
+        # heavy-hitter recovery row (ISSUE 7): per-tick decode cost,
+        # measured accuracy vs the exact offline count, feed impact
+        result["topk_recover"] = hh
+    failed = [p for p, v in phases.items()
+              if "rate" not in v and "recover_ms_per_tick" not in v]
     if failed:
         result["phases_failed"] = failed
     print(json.dumps(result))

@@ -45,7 +45,9 @@ fi
 
 # NM query-edge smoke: boot a server, open a STOCK node-webserver conn
 # (sim/nodeweb.py — zero GYT frames on the wire), run one
-# QUERY_WEB_JSON and one CRUD_ALERT_JSON create→list→delete round trip.
+# QUERY_WEB_JSON and one CRUD_ALERT_JSON create→list→delete round trip,
+# and query the `topk` heavy-hitter subsystem over BOTH the NM conn and
+# the REST gateway — non-empty, bound-annotated, byte-equal renderings.
 echo "ci: NM query-edge smoke" >&2
 if ! JAX_PLATFORMS=cpu python _nm_smoke.py; then
     echo "ci: FATAL — NM smoke failed" >&2

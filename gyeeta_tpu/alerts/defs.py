@@ -41,33 +41,38 @@ class AlertDef(NamedTuple):
     # group-wait windows, server/gy_alertmgr.h:574). 0 = immediate.
     groupwaitsec: float = 0.0
 
+    def validate(self) -> "AlertDef":
+        """Definition-time checks shared by the JSON and direct-
+        instance paths (``AlertManager.add_def`` runs this for BOTH):
+        a typo'd subsys fails here with the valid-subsystem list, and
+        a filter whose criteria target a different subsystem fails
+        here too — at evaluation such criteria are skipped (all-pass),
+        so the def would otherwise match every row, surfacing only at
+        the first fold-time check."""
+        fieldmaps.check_subsys(self.subsys)
+        tree = criteria.parse(self.filter)
+        if tree is None:
+            raise ValueError("alertdef filter must be non-empty")
+        criteria.check_filter_subsys(tree, self.subsys,
+                                     what=f"alertdef {self.name!r}")
+        return self
+
     @classmethod
     def from_json(cls, d: dict) -> "AlertDef":
         if "alertname" not in d or "subsys" not in d or "filter" not in d:
             raise ValueError("alertdef needs alertname/subsys/filter")
-        if d["subsys"] not in fieldmaps.FIELDS_OF_SUBSYS:
-            raise ValueError(f"unknown subsys {d['subsys']!r}")
         sev = d.get("severity", "warning")
         if sev not in SEVERITIES:
             raise ValueError(f"severity must be one of {SEVERITIES}")
         mode = d.get("mode", "realtime")
         if mode not in ALERT_MODES:
             raise ValueError(f"mode must be one of {ALERT_MODES}")
-        tree = criteria.parse(d["filter"])     # validate at definition time
-        if tree is None:
-            raise ValueError("alertdef filter must be non-empty")
-        # 'action'/'actions', string or list — a bare string must wrap,
-        # never iterate into per-character "names"
-
-        def _actions_of(dd):
-            acts = dd.get("action", dd.get("actions", ("log",)))
-            return (acts,) if isinstance(acts, str) else tuple(acts)
         return cls(
             name=d["alertname"], subsys=d["subsys"], filter=d["filter"],
             severity=sev,
             numcheckfor=max(1, int(d.get("numcheckfor", 1))),
             repeataftersec=float(d.get("repeataftersec", 300.0)),
-            actions=_actions_of(d),
+            actions=cls._actions_of_json(d),
             labels=tuple(sorted(dict(d.get("labels", {})).items())),
             annotations=tuple(sorted(dict(d.get("annotations", {}))
                                      .items())),
@@ -75,7 +80,14 @@ class AlertDef(NamedTuple):
             mode=mode,
             querysec=max(1.0, float(d.get("querysec", 300.0))),
             groupwaitsec=max(0.0, float(d.get("groupwaitsec", 0.0))),
-        )
+        ).validate()
+
+    @staticmethod
+    def _actions_of_json(d: dict) -> tuple:
+        # 'action'/'actions', string or list — a bare string must wrap,
+        # never iterate into per-character "names"
+        acts = d.get("action", d.get("actions", ("log",)))
+        return (acts,) if isinstance(acts, str) else tuple(acts)
 
 
 class Silence(NamedTuple):

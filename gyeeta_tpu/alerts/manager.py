@@ -62,7 +62,11 @@ class _EntityState(NamedTuple):
 # once per matching row per check and distinct entities suppress each
 # other through repeataftersec.
 _ENTITY_KEYS = ("svcid", "taskid", "cgid", "cliid", "serid", "api",
-                "flowid", "alertname", "hostid")
+                "flowid", "alertname", "hostid",
+                # topk rows: one entity per (metric, entity id) — so
+                # "new flow enters the top-10" fires once per flow, not
+                # once per rank shuffle
+                "metric", "id")
 
 
 def _entity_key_of(subsys: str, cols: dict, i: int) -> str:
@@ -111,7 +115,12 @@ class AlertManager:
 
     # ------------------------------------------------------------- CRUD
     def add_def(self, d: dict | AlertDef) -> AlertDef:
-        ad = d if isinstance(d, AlertDef) else AlertDef.from_json(d)
+        # BOTH paths validate at definition time: a typo'd subsys (or a
+        # filter whose criteria target another subsystem) fails the
+        # CRUD request with the valid-subsystem list instead of
+        # erroring on every subsequent fold-time check
+        ad = (d.validate() if isinstance(d, AlertDef)
+              else AlertDef.from_json(d))
         self.defs[ad.name] = ad
         self._trees[f"def:{ad.name}"] = criteria.parse(ad.filter)
         return ad

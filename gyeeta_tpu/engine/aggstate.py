@@ -20,8 +20,8 @@ import jax.numpy as jnp
 
 from gyeeta_tpu.engine import table
 from gyeeta_tpu.ingest import decode
-from gyeeta_tpu.sketch import countmin, hyperloglog as hll, loghist, \
-    tdigest, topk, windows
+from gyeeta_tpu.sketch import countmin, hyperloglog as hll, invertible, \
+    loghist, tdigest, topk, windows
 
 # conn-counter columns (windowed, per service)
 CTR_BYTES_SENT = 0
@@ -93,6 +93,26 @@ class EngineCfg(NamedTuple):
     #                                   excluded by the budget is
     #                                   accounted in ``evicted`` —
     #                                   see sketch/topk.py:update
+    hh_depth: int = 2                 # invertible heavy-hitter tier
+    #                                   (sketch/invertible.py): rows of
+    #                                   candidate buckets; a heavy key
+    #                                   is missed only if it loses its
+    #                                   bucket argmax in EVERY row
+    hh_width: int = 4096              # buckets per row; d·w candidate
+    #                                   slots ≈ 8k (160 KB of state, a
+    #                                   ~128 KB readback per tick). 0
+    #                                   disables the tier entirely.
+    hh_hot_frac: float = 1e-5         # PSketch hot-admission floor: a
+    #                                   lane enters the exact top-K
+    #                                   merge only when its CMS
+    #                                   estimate ≥ hh_hot_frac × total
+    #                                   folded mass (on TOP of the
+    #                                   topk_budget relative ranking);
+    #                                   colder lanes stay in the
+    #                                   invertible array + CMS, their
+    #                                   mass lands in ``evicted``. 0
+    #                                   disables the absolute floor
+    #                                   (budget-only admission).
     td_capacity: int = 64             # per-svc t-digest centroids
     # staged-digest buffer: samples accumulate here across a fold_many
     # dispatch (K microbatches) and compress ONCE at its end — the
@@ -190,6 +210,9 @@ class AggState(NamedTuple):
     glob_hll: hll.HLL                 # distinct flow endpoints global
     cms: countmin.CMS                 # flow-key → bytes
     flow_topk: topk.TopK              # heavy-hitter flows by bytes
+    inv: invertible.InvSketch         # invertible candidate buckets —
+    #                                   per-tick key recovery decodes
+    #                                   heavy keys straight from here
     n_conn: jnp.ndarray               # () f32 counters
     n_resp: jnp.ndarray
     n_td_overflow: jnp.ndarray        # samples that missed the digest path
@@ -249,6 +272,7 @@ def init(cfg: EngineCfg) -> AggState:
         glob_hll=hll.init(p=cfg.hll_p_global),
         cms=countmin.init(cfg.cms_depth, cfg.cms_width),
         flow_topk=topk.init(cfg.topk_capacity),
+        inv=invertible.init(cfg.hh_depth, max(cfg.hh_width, 1)),
         n_conn=jnp.zeros((), jnp.float32),
         n_resp=jnp.zeros((), jnp.float32),
         n_td_overflow=jnp.zeros((), jnp.float32),

@@ -32,8 +32,8 @@ from gyeeta_tpu.engine.aggstate import (
     AggState, EngineCfg, CTR_BYTES_SENT, CTR_BYTES_RCVD, CTR_NCONN_CLOSED,
     CTR_DUR_SUM_US,
 )
-from gyeeta_tpu.sketch import countmin, hyperloglog as hll, loghist, \
-    tdigest, topk, windows
+from gyeeta_tpu.sketch import countmin, hyperloglog as hll, invertible, \
+    loghist, tdigest, topk, windows
 
 
 # Bench-only ablation switch: GYT_BENCH_ABLATE="topk,tdigest" compiles the
@@ -107,24 +107,80 @@ def ingest_conn(cfg: EngineCfg, st: AggState, cb) -> AggState:
     tot_bytes = cb.bytes_sent + cb.bytes_rcvd
     cms = st.cms if "cms" in _ABLATE else countmin.update(
         st.cms, cb.flow_hi, cb.flow_lo, tot_bytes, valid=svc_side)
+    # sketch-assisted candidate compaction (CMS+heap, the shape of
+    # the FPGA sketch-acceleration papers): the CMS — queried AFTER
+    # this batch folded into it — upper-bounds every flow's
+    # cumulative mass, so only the topk_budget best lanes enter the
+    # grouping sort. One hash row is enough for a safe-side
+    # ranking signal (sketch/countmin.py:upper_bound).
+    est = None
+    if "cms" not in _ABLATE and 0 < cfg.topk_budget:
+        est = countmin.upper_bound(cms, cb.flow_hi, cb.flow_lo)
+    # priority-aware hot admission (PSketch): on top of the budget's
+    # relative ranking, a lane enters the exact top-K merge only when
+    # its estimate clears an absolute floor of the total folded mass —
+    # colder lanes keep their mass in the CMS and their excluded mass
+    # lands in ``evicted`` (the bound stays honest because a floored
+    # lane scores −1, same as padding, and unselected valid mass is
+    # always accounted).
+    hot = None
+    if est is not None and cfg.hh_hot_frac > 0:
+        thresh = jnp.float32(cfg.hh_hot_frac) * countmin.total(cms)
+        hot = est >= thresh
+    n = cb.flow_hi.shape[0]
+    sel = None
+    if est is not None and 0 < cfg.topk_budget < n:
+        # ONE shared candidate selection feeds BOTH heavy-hitter
+        # structures (the exact merge's grouping sort and the
+        # invertible bucket-ownership writes): score = estimate on
+        # admitted lanes, −1 on padding/cold lanes. Mass excluded by
+        # the selection is charged to ``evicted`` here, so the
+        # undercount bound stays exactly as honest as the in-update
+        # compaction it replaces.
+        score = jnp.where(svc_side, est.astype(jnp.float32), -1.0)
+        if hot is not None:
+            score = jnp.where(hot, score, -1.0)
+        _, sel = jax.lax.top_k(score, cfg.topk_budget)
+        sel_ok = score[sel] >= 0.0
+        c_hi, c_lo = cb.flow_hi[sel], cb.flow_lo[sel]
+        c_vals = jnp.where(sel_ok, tot_bytes[sel].astype(jnp.float32),
+                           0.0)
+        c_prio = jnp.where(sel_ok, est[sel].astype(jnp.float32), 0.0)
+        extra_evicted = (jnp.sum(jnp.where(svc_side, tot_bytes, 0.0))
+                         - jnp.sum(c_vals))
     if "topk" in _ABLATE:
         flow_topk = st.flow_topk
+    elif sel is not None:
+        ftk = st.flow_topk._replace(
+            evicted=st.flow_topk.evicted + extra_evicted)
+        flow_topk = topk.update(ftk, c_hi, c_lo, c_vals, valid=sel_ok)
     else:
-        # sketch-assisted candidate compaction (CMS+heap, the shape of
-        # the FPGA sketch-acceleration papers): the CMS — queried AFTER
-        # this batch folded into it — upper-bounds every flow's
-        # cumulative mass, so only the topk_budget best lanes enter the
-        # grouping sort. One hash row is enough for a safe-side
-        # ranking signal (sketch/countmin.py:upper_bound).
-        est = None
-        if "cms" not in _ABLATE and 0 < cfg.topk_budget:
-            est = countmin.upper_bound(cms, cb.flow_hi, cb.flow_lo)
         flow_topk = topk.update(
             st.flow_topk, cb.flow_hi, cb.flow_lo, tot_bytes,
             valid=svc_side, est=est, budget=cfg.topk_budget)
+    if "hh" in _ABLATE or cfg.hh_width <= 0:
+        inv = st.inv
+    else:
+        # invertible candidate buckets (sketch/invertible.py): the
+        # selected (admitted) lanes compete for bucket ownership with
+        # their estimate as priority — per-tick decoding recovers
+        # heavy keys straight from this state, no candidate list.
+        # Falls back to every accept-side lane with its own mass as
+        # priority when the CMS is ablated.
+        if sel is not None:
+            inv = invertible.update(st.inv, c_hi, c_lo, c_prio,
+                                    valid=sel_ok)
+        else:
+            inv_prio = est if est is not None else tot_bytes
+            inv = invertible.update(st.inv, cb.flow_hi, cb.flow_lo,
+                                    inv_prio, valid=svc_side,
+                                    budget=cfg.topk_budget)
+        if hot is not None:
+            inv = inv._replace(n_hot=inv.n_hot + jnp.sum(
+                svc_side & hot).astype(jnp.float32))
     return st._replace(
         tbl=tbl, ctr_win=ctr_win, svc_host=svc_host, svc_hll=svc_hll,
-        glob_hll=glob_hll, cms=cms, flow_topk=flow_topk,
+        glob_hll=glob_hll, cms=cms, flow_topk=flow_topk, inv=inv,
         n_conn=st.n_conn + jnp.sum(valid).astype(jnp.float32),
     )
 
@@ -475,6 +531,10 @@ HEALTH_KEYS = (
     "n_conn", "n_resp", "n_resp_unknown", "n_td_overflow",
     "dep_half_live", "dep_edge_live", "dep_edge_drop",
     "dep_paired", "dep_expired", "dep_dropped",
+    # heavy-hitter tier: the top-K undercount bound (mass truncation
+    # ever dropped — the per-key error bar every flow row reports),
+    # invertible-bucket fill, and hot-admission lane count
+    "topk_evicted", "hh_occupied", "hh_hot_lanes",
 )
 
 
@@ -505,8 +565,34 @@ def engine_health_vec(cfg: EngineCfg, st: AggState, dep) -> jnp.ndarray:
         s(dep.half_tbl.n_live), s(dep.edge_tbl.n_live),
         s(dep.edge_tbl.n_drop),
         s(dep.n_paired), s(dep.n_expired), s(dep.n_dropped),
+        s(st.flow_topk.evicted), s(st.inv.prio > 0), s(st.inv.n_hot),
     )
     return jnp.stack(vals)
+
+
+def heavy_recover(cfg: EngineCfg, st: AggState) -> dict:
+    """Per-tick heavy-hitter recovery: decode the invertible buckets
+    (verify fingerprints + bucket positions, point-query the CMS for
+    every candidate) and read the exact top-K lanes alongside — ONE
+    read-only dispatch whose outputs are the whole recovery readback
+    (the acceptance contract: recovery adds at most one readback per
+    tick; the fold path itself never pays a single op for it)."""
+    out = invertible.decode(st.inv, st.cms)
+    k = cfg.topk_capacity
+    t_hi, t_lo, t_counts = topk.query(st.flow_topk, k)
+    # CMS estimate for the exact lanes too: truth ∈ [count, est], so
+    # the merge reports est (never undercounts) with errbound est−count
+    # — the exact lane's job is TIGHTENING the bound, and the window
+    # shrinks the longer a key stays admitted
+    t_est = countmin.query(st.cms, t_hi, t_lo).astype(jnp.float32)
+    out.update({
+        "topk_hi": t_hi, "topk_lo": t_lo, "topk_counts": t_counts,
+        "topk_est": jnp.where(t_counts > 0, t_est, 0.0),
+        "evicted": st.flow_topk.evicted,
+        "total_mass": countmin.total(st.cms),
+        "n_hot": st.inv.n_hot,
+    })
+    return out
 
 
 def fold_step(cfg: EngineCfg, st: AggState, cb, rb) -> AggState:

@@ -137,6 +137,9 @@ SUBSYS_OF_QTYPE = {
     21: "clusterstate", 22: "svcmesh", 23: "svcipclust",
     24: "tracestatus", 25: "hostlist", 26: "svcprocmap",
     27: "traceuniq", 28: "cgroupstate",
+    # GYT extension beyond the stock table: the heavy-hitter union view
+    # (string subsys names always work; the code is for symmetry)
+    29: "topk",
 }
 QTYPE_OF_SUBSYS = {v: k for k, v in SUBSYS_OF_QTYPE.items()}
 

@@ -31,6 +31,7 @@ def capacities(cfg, opts, n_shards: int = 1) -> dict:
         "td_stage": cfg.td_stage_cap,      # per-entity; max, not summed
         "dep_pair": opts.dep_pair_capacity * n_shards,
         "dep_edge": opts.dep_edge_capacity * n_shards,
+        "hh": cfg.hh_depth * max(cfg.hh_width, 1) * n_shards,
     }
 
 
@@ -73,6 +74,12 @@ def gauges_from_vec(vec, caps: dict) -> dict:
         "engine_dep_paired": h["dep_paired"],
         "engine_dep_expired": h["dep_expired"],
         "engine_dep_dropped": h["dep_dropped"],
+        # heavy-hitter tier: the top-K undercount bound operators size
+        # alerts against, invertible-bucket fill, hot-admission lanes
+        "topk_evicted_mass": h["topk_evicted"],
+        "engine_hh_occupancy_ratio": round(
+            h["hh_occupied"] / max(caps["hh"], 1), 4),
+        "engine_hh_hot_lanes": h["hh_hot_lanes"],
     }
 
 

@@ -27,7 +27,8 @@ from jax.sharding import PartitionSpec as P
 
 from gyeeta_tpu.engine import aggstate, table
 from gyeeta_tpu.parallel.mesh import HOST_AXIS
-from gyeeta_tpu.sketch import countmin, hyperloglog as hll, topk
+from gyeeta_tpu.sketch import countmin, hyperloglog as hll, invertible, \
+    topk
 
 
 class GlobalRollup(NamedTuple):
@@ -41,6 +42,19 @@ class GlobalRollup(NamedTuple):
     host_totals: jnp.ndarray   # (NHOSTCOL,) summed host panel (ntasks,
     #                             nlisten, issue counts — cluster state)
     n_hosts_up: jnp.ndarray    # () hosts that have reported
+    # invertible heavy-hitter recovery, cluster-wide: every shard
+    # decodes its own buckets (fingerprint + position verification is
+    # local geometry), the candidates gather across shards, and each
+    # one is point-queried against the GLOBALLY-merged CMS — the
+    # madhava→shyama candidate pull as one collective program
+    hh_hi: jnp.ndarray         # (n·d·w,) uint32 candidate key halves
+    hh_lo: jnp.ndarray
+    hh_ok: jnp.ndarray         # (n·d·w,) bool decode verification
+    hh_est: jnp.ndarray        # (n·d·w,) f32 global CMS estimate
+    hh_topk_est: jnp.ndarray   # (cap,) f32 global CMS estimate of the
+    #                             merged exact lanes (bound tightening)
+    hh_n_hot: jnp.ndarray      # () hot-admission lanes, summed
+    hh_total_mass: jnp.ndarray  # () total folded flow mass (global)
 
 
 from gyeeta_tpu.parallel.mesh import gather_all as _gather_all  # noqa: E402
@@ -62,12 +76,32 @@ def _rollup_local(st: aggstate.AggState,
     cap = st.flow_topk.counts.shape[0]
     merged_topk = topk._combine(hi, lo, cnt, cap, evicted)
 
+    # invertible-tier recovery: decode locally (bucket-position checks
+    # are per-shard geometry), gather candidates, estimate against the
+    # merged CMS so recovered counts are CLUSTER totals
+    khi, klo, ok = invertible.decode_keys(st.inv)
+    hh_hi = _gather_all(khi.reshape(-1), axes)
+    hh_lo = _gather_all(klo.reshape(-1), axes)
+    hh_ok = _gather_all(ok.reshape(-1), axes)
+    gcms = countmin.CMS(counts=cms_counts)
+    hh_est = jnp.where(hh_ok,
+                       countmin.query(gcms, hh_hi, hh_lo)
+                       .astype(jnp.float32), 0.0)
+    hh_topk_est = jnp.where(
+        merged_topk.counts > 0,
+        countmin.query(gcms, merged_topk.key_hi, merged_topk.key_lo)
+        .astype(jnp.float32), 0.0)
+
     live = jnp.sum(table.live_mask(st.tbl)).astype(jnp.float32)
     reported = st.host_panel[:, aggstate.HOST_NTASKS] > 0
     return GlobalRollup(
         glob_hll=hll.HLL(regs=regs),
-        cms=countmin.CMS(counts=cms_counts),
+        cms=gcms,
         flow_topk=merged_topk,
+        hh_hi=hh_hi, hh_lo=hh_lo, hh_ok=hh_ok, hh_est=hh_est,
+        hh_topk_est=hh_topk_est,
+        hh_n_hot=lax.psum(st.inv.n_hot, axes),
+        hh_total_mass=countmin.total(gcms),
         n_conn=lax.psum(st.n_conn, axes),
         n_resp=lax.psum(st.n_resp, axes),
         n_svc_live=lax.psum(live, axes),

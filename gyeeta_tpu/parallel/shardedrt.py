@@ -195,8 +195,13 @@ class ShardedRuntime:
         self._engine_health = jax.jit(
             lambda s, d: _step.engine_health_vec(self.cfg, s, d))
 
+        # recovered-hot key set from the previous recovery (promotion
+        # edge detection — see Runtime.heavy_recover)
+        self._hh_prev_hot: set = set()
+
         from gyeeta_tpu.alerts import columns as AC
         self._aux = {
+            "topk": self._topk_columns,
             "hostinfo": lambda: self.hostinfo.columns(self.names),
             "cgroupstate": lambda: self.cgroups.columns(self.names),
             "mountstate": lambda: self.mounts.columns(self.names),
@@ -619,6 +624,57 @@ class ShardedRuntime:
         }
         return cols, live
 
+    # -------------------------------------------------- heavy hitters
+    def heavy_recover(self) -> dict:
+        """Cluster-wide heavy-hitter recovery: the rollup collective
+        decodes every shard's invertible buckets, gathers the
+        candidates across shards (`all_gather`, the madhava→shyama
+        candidate pull) and estimates each against the globally-merged
+        CMS; the host merges with the merged exact top-K lanes. One
+        collective dispatch + one small readback per tick."""
+        from gyeeta_tpu.sketch import invertible
+
+        self.flush()
+        with self.stats.timeit("topk_recover"):
+            ru = self._rollup(self.state)
+            rec = {
+                "topk_hi": np.asarray(ru.flow_topk.key_hi),
+                "topk_lo": np.asarray(ru.flow_topk.key_lo),
+                "topk_counts": np.asarray(ru.flow_topk.counts),
+                "topk_est": np.asarray(ru.hh_topk_est),
+                "hh_hi": np.asarray(ru.hh_hi),
+                "hh_lo": np.asarray(ru.hh_lo),
+                "hh_ok": np.asarray(ru.hh_ok),
+                "hh_est": np.asarray(ru.hh_est),
+            }
+            evicted = float(np.asarray(ru.flow_topk.evicted))
+            total = float(np.asarray(ru.hh_total_mass))
+        self.stats.bump("topk_recover_readbacks")
+        err_term = invertible.cms_error_term(total, self.cfg.cms_width)
+        hot_thresh = (self.cfg.hh_hot_frac * total
+                      if self.cfg.hh_hot_frac > 0 else 0.0)
+        flows, recovered, hot = invertible.merge_recovered_np(
+            rec, err_term, hot_thresh)
+        new_hot = hot - self._hh_prev_hot
+        if new_hot:
+            self.stats.bump("topk_hot_promotions", len(new_hot))
+        self._hh_prev_hot = hot
+        self.stats.gauge("topk_recovered_keys", float(len(recovered)))
+        self.stats.gauge("topk_evicted_mass", evicted)
+        return {"flows": flows, "recovered_keys": len(recovered),
+                "evicted": evicted, "err_term": err_term,
+                "total_mass": total, "new_hot": len(new_hot)}
+
+    def _topk_columns(self):
+        """topk subsystem over the mesh: cluster-wide heavy flows
+        (rollup recovery) + dense rankings over the MERGED svc/api
+        columns — the same union builder as the single-node runtime."""
+        rec = self._cols.get("__hh_recover", self.heavy_recover)
+        return api.heavy_topk_columns(
+            rec["flows"],
+            svc=self._merged_columns(fieldmaps.SUBSYS_SVCSTATE),
+            trace=self._merged_columns(fieldmaps.SUBSYS_TRACEREQ))
+
     def _hostlist_columns(self):
         """hostlist over the mesh: each shard's host panel holds only
         its routed hosts (global ids), so concatenating the seen rows
@@ -772,6 +828,13 @@ class ShardedRuntime:
             self.td_drain(max_iters=self.opts.td_drain_iters_per_tick)
         self.state = self._classify(self.state)
         self._cols.bump()
+        # per-tick heavy-hitter recovery (memoized — an alertdef on
+        # `topk` and queries until the next feed reuse the readback)
+        ev = self.opts.hh_recover_every_ticks
+        if ev and self.cfg.hh_width > 0 \
+                and (self._tick_no + 1) % ev == 0:
+            report["topk_recovered"] = self._cols.get(
+                "__hh_recover", self.heavy_recover)["recovered_keys"]
         fired = self.alerts.check(None, columns_fn=self._merged_columns)
         report["alerts_fired"] = len(fired)
         for a in fired:

@@ -270,6 +270,106 @@ def flow_columns(cfg: EngineCfg, st: AggState, k: int = 128,
     return cols, valid
 
 
+# rows emitted per topk metric before maxrecs/filters apply — the
+# union view stays bounded no matter the slab geometry (the reference
+# caps its TOP_N walks the same way, gy_comm_proto.h:1415)
+TOPK_PER_METRIC = 64
+
+
+def heavy_topk_columns(flow_rows, svc=None, trace=None,
+                       per_metric: int = TOPK_PER_METRIC):
+    """The ``topk`` subsystem's union columns — shared by Runtime and
+    ShardedRuntime so the three query edges render identical rows.
+
+    ``flow_rows``: pre-merged heavy flows as ``(id_hex, value,
+    errbound, source)`` tuples sorted heaviest-first (exact top-K lanes
+    ∪ invertible-sketch recoveries — see ``Runtime.heavy_recover``).
+    ``svc``/``trace``: the subsystem's (cols, live) snapshots for the
+    dense rankings (top services by conns / error rate, top APIs by
+    p99). Every row carries its error bound: exact lanes undercount by
+    ≤ errbound, recovered rows are upper bounds overcounting by ≤
+    errbound, dense rows are exact (0).
+    """
+    metric, rank, ids, names_, value, errb, source = \
+        [], [], [], [], [], [], []
+
+    def emit(m, rows):
+        for i, (rid, rname, val, eb, src) in enumerate(
+                rows[:per_metric]):
+            metric.append(m)
+            rank.append(float(i + 1))
+            ids.append(rid)
+            names_.append(rname)
+            value.append(float(val))
+            errb.append(float(eb))
+            source.append(src)
+
+    emit("bytes", [(rid, "", val, eb, src)
+                   for rid, val, eb, src in flow_rows])
+
+    def dense(cols, live, valcol, idcol, namecol, valfn=None):
+        from gyeeta_tpu.query.lazycols import rows_of
+
+        idx = np.nonzero(np.asarray(live, bool))[0]
+        if len(idx) == 0:
+            return []
+        vals = (valfn(cols, idx) if valfn is not None
+                else np.asarray(cols[valcol], np.float64)[idx])
+        order = np.argsort(vals, kind="stable")[::-1]
+        keep = order[: per_metric]
+        keep = keep[vals[keep] > 0]
+        # id/name projection over just the kept rows (LazyCols row
+        # path — the string groups never format at slab width here)
+        got = rows_of(cols, [idcol, namecol], idx[keep])
+        return [(got[idcol][j], got[namecol][j], vals[keep[j]], 0.0,
+                 "dense") for j in range(len(keep))]
+
+    if svc is not None:
+        scols, slive = svc
+        emit("conns", dense(scols, slive, "nconns", "svcid", "svcname"))
+
+        def errrate(cols, idx):
+            err = np.asarray(cols["sererr"], np.float64)[idx]
+            nq = np.asarray(cols["nqry5s"], np.float64)[idx]
+            return err / np.maximum(nq, 1.0)
+
+        emit("errrate", dense(scols, slive, None, "svcid", "svcname",
+                              valfn=errrate))
+    if trace is not None:
+        from gyeeta_tpu.query.lazycols import rows_of
+
+        tcols, tlive = trace
+        idx = np.nonzero(np.asarray(tlive, bool))[0]
+        rows = []
+        if len(idx):
+            p99 = np.asarray(tcols["p99resp"], np.float64)[idx]
+            keep = np.argsort(p99, kind="stable")[::-1][: per_metric]
+            keep = keep[p99[keep] > 0]
+            got = rows_of(tcols, ["svcid", "svcname", "api"], idx[keep])
+            rows = [(got["svcid"][j],
+                     f"{got['svcname'][j]}:{got['api'][j]}",
+                     p99[keep[j]], 0.0, "dense")
+                    for j in range(len(keep))]
+        emit("p99resp", rows)
+
+    n = len(metric)
+    obj = lambda vals: _obj_col(vals)  # noqa: E731
+    cols = {
+        "metric": obj(metric), "rank": np.asarray(rank, np.float64),
+        "id": obj(ids), "name": obj(names_),
+        "value": np.asarray(value, np.float64),
+        "errbound": np.asarray(errb, np.float64),
+        "source": obj(source),
+    }
+    return cols, np.ones(n, bool)
+
+
+def _obj_col(vals) -> np.ndarray:
+    out = np.empty(len(vals), object)
+    out[:] = [str(v) for v in vals]
+    return out
+
+
 def _host_name_cols(n: int, names):
     """(hostids, hostnames) shared by every host-axis subsystem."""
     from gyeeta_tpu.ingest import wire

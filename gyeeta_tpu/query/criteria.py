@@ -187,6 +187,24 @@ def subsystems_of(tree) -> set:
     return set().union(*(subsystems_of(c) for c in tree.children))
 
 
+def check_filter_subsys(tree, subsys: str, what: str = "filter") -> None:
+    """Definition-time guard: every criterion in ``tree`` must target
+    ``subsys``. Evaluation treats foreign-subsystem criteria as
+    all-pass (the CRIT_SKIP join semantics queries want), which turns a
+    typo'd/mismatched subsys in an alertdef filter into a def that
+    silently matches EVERY row — and that only surfaces at the first
+    fold-time check. Fail it where the definition is created instead.
+    """
+    foreign = subsystems_of(tree) - {subsys}
+    if foreign:
+        raise ValueError(
+            f"{what} criteria reference subsystem"
+            f"{'s' if len(foreign) > 1 else ''} {sorted(foreign)} but "
+            f"the definition targets {subsys!r}; foreign criteria are "
+            f"skipped (all-pass) at evaluation, so this definition "
+            f"would match every row")
+
+
 def _eval_criterion(c: Criterion, columns: dict, subsys: str, n: int):
     if c.subsys != subsys:
         # criteria for other subsystems pass (multi-subsystem filters are

@@ -59,6 +59,11 @@ SUBSYS_SHARDLIST = "shardlist"      # mesh-native: per-shard stats (the
 #                                     shard instead of per madhava)
 SUBSYS_CGROUPSTATE = "cgroupstate"  # ref cgroupstate
 SUBSYS_SVCIPCLUST = "svcipclust"    # ref NAT-IP / VIP clusters
+SUBSYS_TOPK = "topk"                # heavy hitters (TPU-first): exact
+#                                     top-K lanes ∪ keys recovered from
+#                                     the invertible sketch + dense
+#                                     svc/api rankings, every row bound-
+#                                     annotated (sketch/invertible.py)
 SUBSYS_ALERTS = "alerts"            # ref alerts (fired alert log)
 SUBSYS_ALERTDEF = "alertdef"        # ref alertdef
 SUBSYS_SILENCES = "silences"        # ref silences
@@ -369,6 +374,28 @@ FLOWSTATE_FIELDS = (
     num("evictedbytes", "evictedbytes", "Undercount bound (evicted mass)"),
 )
 
+# ------------------------------------------------------------------- topk
+# Heavy-hitter rankings as one queryable union (ROADMAP "heavy-hitter
+# detection as a first-class subsystem"): per-metric ranked rows from
+# the exact top-K lanes, the invertible-sketch recovery, and the dense
+# svc/api slabs. Flow-row ``value`` is an UPPER bound on the true
+# total (never undercounts); its overcount is ≤ ``errbound`` — exact
+# lanes tighten it to est − count (truth ∈ [count, est]), recovered
+# rows carry the invertible-array term (2·N/width w.p. 1−2^−depth);
+# dense rows are exact slab gauges (errbound 0).
+TOPK_FIELDS = (
+    string("metric", "metric",
+           "Ranking: bytes | conns | errrate | p99resp"),
+    num("rank", "rank", "1-based rank within the metric"),
+    string("id", "id", "Entity id (hex): flow key / svcid / api key"),
+    string("name", "name", "Entity name ('' for raw flows)"),
+    num("value", "value", "Ranked stat value"),
+    num("errbound", "errbound",
+        "Error bound on value (evicted mass + invertible-array term)"),
+    string("source", "source",
+           "Row provenance: exact | recovered | dense"),
+)
+
 # ---------------------------------------------------------------- svcsumm
 # ref SUBSYS_SVCSUMM (LISTEN_SUMM_STATS, server/gy_msocket.h:841):
 # per-host service summary counts
@@ -662,12 +689,25 @@ FIELDS_OF_SUBSYS = {
     SUBSYS_EXTTRACEREQ: EXTTRACEREQ_FIELDS,
     SUBSYS_SHARDLIST: SHARDLIST_FIELDS,
     SUBSYS_SVCIPCLUST: SVCIPCLUST_FIELDS,
+    SUBSYS_TOPK: TOPK_FIELDS,
     SUBSYS_ALERTS: ALERTS_FIELDS,
     SUBSYS_ALERTDEF: ALERTDEF_FIELDS,
     SUBSYS_SILENCES: SILENCES_FIELDS,
     SUBSYS_INHIBITS: INHIBITS_FIELDS,
     SUBSYS_ACTIONS: ACTIONS_FIELDS,
 }
+
+
+def check_subsys(subsys: str) -> str:
+    """Validate a subsystem NAME at definition time → the name, or a
+    ValueError that lists every valid subsystem. Alert/trace defs call
+    this when they are CREATED so a typo'd subsys fails the CRUD
+    request with an actionable message instead of surfacing as a
+    fold-time evaluation error on every subsequent tick."""
+    if subsys not in FIELDS_OF_SUBSYS:
+        raise ValueError(f"unknown subsystem {subsys!r}; "
+                         f"one of {sorted(FIELDS_OF_SUBSYS)}")
+    return subsys
 
 
 def field_map(subsys: str) -> dict[str, FieldDef]:

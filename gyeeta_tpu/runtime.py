@@ -226,6 +226,16 @@ class Runtime:
             donate_argnums=(0,)))
         self._stage_pressure = mj("stage_pressure", lambda: jax.jit(
             step.stage_pressure))
+        # heavy-hitter recovery: decode the invertible buckets + exact
+        # top-K lanes in ONE read-only dispatch (no donation — the
+        # readback must not invalidate live state); memoized like every
+        # other compiled program
+        self._hh_recover = mj("hh_recover", lambda: jax.jit(
+            lambda s: step.heavy_recover(cfg, s)))
+        # recovered-hot key set from the previous recovery: promotions
+        # count keys NEWLY recovered at/above the hot threshold, so the
+        # counter tracks churn into the top view, not steady residency
+        self._hh_prev_hot: set = set()
         from collections import deque
         # pressure scalars from recent dispatches: checked at lag 2 so
         # the int() readback never blocks on an in-flight fold (lag 1
@@ -298,6 +308,7 @@ class Runtime:
         self.tracedefs = TraceDefs(clock=clock)
         self._t_started = self._clock()
         self._aux = {
+            "topk": self._topk_columns,
             "tracedef": lambda: self.tracedefs.columns(),
             "tracestatus": lambda: self.tracedefs.columns(),
             "traceuniq": self._traceuniq_columns,
@@ -787,6 +798,52 @@ class Runtime:
             self.stats.gauge(k, v)
         return gauges
 
+    # -------------------------------------------------- heavy hitters
+    def heavy_recover(self) -> dict:
+        """Per-tick heavy-hitter key recovery: ONE read-only device
+        dispatch decodes the invertible buckets (fingerprint + bucket-
+        position verification), point-queries the CMS for every
+        candidate and reads the exact top-K lanes alongside; the host
+        merges them into the bound-annotated heavy-flow view the
+        ``topk`` subsystem serves. Counted in /metrics
+        (``gyt_topk_recover_readbacks_total``) — the fold path itself
+        never pays an op for recovery."""
+        from gyeeta_tpu.sketch import invertible
+
+        self.flush()
+        with self.stats.timeit("topk_recover"):
+            out = {k: np.asarray(v) for k, v in
+                   self._hh_recover(self.state).items()}
+        self.stats.bump("topk_recover_readbacks")
+        evicted = float(out["evicted"])
+        total = float(out["total_mass"])
+        err_term = invertible.cms_error_term(total, self.cfg.cms_width)
+        hot_thresh = (self.cfg.hh_hot_frac * total
+                      if self.cfg.hh_hot_frac > 0 else 0.0)
+        flows, recovered, hot = invertible.merge_recovered_np(
+            out, err_term, hot_thresh)
+        # promotions: recovered-hot keys that were NOT hot at the
+        # previous recovery — the "new flow entered the top view" edge
+        new_hot = hot - self._hh_prev_hot
+        if new_hot:
+            self.stats.bump("topk_hot_promotions", len(new_hot))
+        self._hh_prev_hot = hot
+        self.stats.gauge("topk_recovered_keys", float(len(recovered)))
+        self.stats.gauge("topk_evicted_mass", evicted)
+        return {"flows": flows, "recovered_keys": len(recovered),
+                "evicted": evicted, "err_term": err_term,
+                "total_mass": total, "new_hot": len(new_hot)}
+
+    def _topk_columns(self):
+        """topk subsystem columns: heavy flows (exact ∪ recovered) +
+        dense svc/api rankings. Recovery memoizes per state version —
+        between folds every query (and the alert check) reuses one
+        readback."""
+        rec = self._cols.get("__hh_recover", self.heavy_recover)
+        return api.heavy_topk_columns(
+            rec["flows"], svc=self._cached_columns("svcstate"),
+            trace=self._cached_columns("tracereq"))
+
     # ------------------------------------------------------------ cadence
     def run_tick(self) -> dict:
         with self.stats.timeit("tick"), self.spans.span(
@@ -802,6 +859,15 @@ class Runtime:
         report = {}
         self.state = self._classify(self.state)
         self._cols.bump()             # classify + tick mutate views
+        # per-tick heavy-hitter recovery (one read-only readback,
+        # memoized per state version — an alertdef on `topk` and every
+        # query until the next fold reuse it). 0 disables the cadence;
+        # queries still recover on demand.
+        ev = self.opts.hh_recover_every_ticks
+        if ev and self.cfg.hh_width > 0 \
+                and (self._tick_no + 1) % ev == 0:
+            report["topk_recovered"] = self._cols.get(
+                "__hh_recover", self.heavy_recover)["recovered_keys"]
         fired = self.alerts.check(self.state,
                                   columns_fn=self._alert_columns)
         # history snapshots BEFORE the window tick: the closing 5s slab is

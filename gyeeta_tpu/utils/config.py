@@ -27,7 +27,12 @@ _INT_FIELDS = {"svc_capacity", "n_hosts", "hll_p_svc", "hll_p_global",
                # fold-path tuning knobs (OPERATIONS.md "Fold-path
                # tuning"): digest duty cycle + staging geometry
                "td_sample_stride", "td_stage_cap", "td_flush_m",
-               "topk_budget"}
+               "topk_budget",
+               # heavy-hitter tier geometry (sketch/invertible.py)
+               "hh_depth", "hh_width"}
+
+# EngineCfg floats settable via cfg file/env (hot-admission floor)
+_FLOAT_FIELDS = {"hh_hot_frac"}
 
 
 class RuntimeOpts(NamedTuple):
@@ -53,6 +58,13 @@ class RuntimeOpts(NamedTuple):
     #                                         stream is never bridged, so
     #                                         dual-stream hosts don't
     #                                         double-count transactions.
+    hh_recover_every_ticks: int = 1         # heavy-hitter key-recovery
+    #                                         cadence (one read-only
+    #                                         readback per N ticks,
+    #                                         memoized per state
+    #                                         version; 0 = on-demand
+    #                                         only — `topk` queries and
+    #                                         alertdefs still recover)
     td_drain_iters_per_tick: int = 2        # bounded digest compression
     #                                         per tick (O(td_flush_m)
     #                                         each); overflow drops are
@@ -88,6 +100,8 @@ class RuntimeOpts(NamedTuple):
 def _coerce(key: str, v: Any):
     if key in _INT_FIELDS:
         return int(v)
+    if key in _FLOAT_FIELDS:
+        return float(v)
     return v
 
 

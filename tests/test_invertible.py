@@ -1,0 +1,355 @@
+"""Invertible heavy-hitter tier: sketch units, fold integration,
+measured error bounds, the `topk` query subsystem, and alertdefs on it.
+
+The subsystem contract (ISSUE 7): recovered top-K vs an exact offline
+count stays within the measured ≤2% error bound on a mixed-subsystem
+fuzz workload; every result row is bound-annotated; an alertdef on
+`topk` fires end to end through alerts/manager.py; and the invertible
+update rides the fused fold (its state is part of AggState, so the
+fused-vs-legacy parity fuzz in test_fusedfold.py covers it
+bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import decode, wire
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.sketch import exact, invertible, loghist
+
+
+def _cfg(**over) -> EngineCfg:
+    base = dict(
+        svc_capacity=64, n_hosts=8,
+        resp_spec=loghist.LogHistSpec(vmin=1.0, vmax=1e8, nbuckets=32),
+        hll_p_svc=4, hll_p_global=8, cms_depth=2, cms_width=1 << 16,
+        topk_capacity=32, topk_budget=96, td_capacity=16,
+        hh_depth=2, hh_width=1024,
+        conn_batch=64, resp_batch=128, listener_batch=32, fold_k=4)
+    base.update(over)
+    return EngineCfg(**base)
+
+
+# ------------------------------------------------------------ sketch units
+def test_update_matches_numpy_reference():
+    """The vectorized scatter update == the per-bucket host reference
+    (winner = lexicographic (prio, key) max, replace only on a strict
+    priority raise) — order-insensitive within a batch by design."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    d, w, n = 2, 64, 500
+    sk = invertible.init(d, w)
+    hi = rng.integers(0, 40, n).astype(np.uint32) * 7919 + 3
+    lo = rng.integers(0, 40, n).astype(np.uint32) * 104729 + 11
+    prios = rng.integers(1, 50, n).astype(np.float32)
+    valid = rng.random(n) > 0.1
+
+    got = invertible.update(sk, jnp.asarray(hi), jnp.asarray(lo),
+                            jnp.asarray(prios), jnp.asarray(valid))
+    prio = np.zeros((d, w), np.float32)
+    ehi = np.zeros((d, w), np.uint32)
+    elo = np.zeros((d, w), np.uint32)
+    fp = np.zeros((d, w), np.uint32)
+    m = valid
+    invertible.np_update(prio, ehi, elo, fp, hi[m], lo[m], prios[m])
+    np.testing.assert_allclose(np.asarray(got.prio), prio, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got.enc_hi), ehi)
+    np.testing.assert_array_equal(np.asarray(got.enc_lo), elo)
+    np.testing.assert_array_equal(np.asarray(got.fp), fp)
+
+
+def test_update_batch_split_invariance():
+    """Folding one batch vs the same lanes split in two reaches the
+    same candidates for keys whose priority is cumulative-consistent
+    (the monotone-priority property the CMS estimate provides)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n = 200
+    hi = rng.integers(1, 30, n).astype(np.uint32)
+    lo = (hi * 7 + 1).astype(np.uint32)
+    vals = rng.random(n).astype(np.float32)
+    # monotone priorities: later duplicates carry ≥ priority, like a
+    # growing CMS estimate
+    prios = np.zeros(n, np.float32)
+    seen: dict = {}
+    for i in range(n):
+        seen[hi[i]] = seen.get(hi[i], 0.0) + float(vals[i])
+        prios[i] = seen[hi[i]]
+    valid = np.ones(n, bool)
+
+    one = invertible.update(invertible.init(2, 32), jnp.asarray(hi),
+                            jnp.asarray(lo), jnp.asarray(prios),
+                            jnp.asarray(valid))
+    half = invertible.update(invertible.init(2, 32),
+                             jnp.asarray(hi[:100]), jnp.asarray(lo[:100]),
+                             jnp.asarray(prios[:100]),
+                             jnp.asarray(valid[:100]))
+    two = invertible.update(half, jnp.asarray(hi[100:]),
+                            jnp.asarray(lo[100:]),
+                            jnp.asarray(prios[100:]),
+                            jnp.asarray(valid[100:]))
+    np.testing.assert_allclose(np.asarray(one.prio), np.asarray(two.prio),
+                               rtol=1e-6)
+    # the occupied buckets decode to the same keys
+    h1, l1, ok1 = invertible.decode_keys(one)
+    h2, l2, ok2 = invertible.decode_keys(two)
+    np.testing.assert_array_equal(np.asarray(ok1), np.asarray(ok2))
+    m = np.asarray(ok1)
+    np.testing.assert_array_equal(np.asarray(h1)[m], np.asarray(h2)[m])
+    np.testing.assert_array_equal(np.asarray(l1)[m], np.asarray(l2)[m])
+
+
+def test_decode_verifies_fingerprint_and_position():
+    """decode_keys recovers exactly the written keys; corrupted encoded
+    buckets fail verification instead of yielding garbage keys."""
+    import jax.numpy as jnp
+
+    hi = np.asarray([7, 1234567, 999], np.uint32)
+    lo = np.asarray([13, 7654321, 111], np.uint32)
+    sk = invertible.update(
+        invertible.init(2, 128), jnp.asarray(hi), jnp.asarray(lo),
+        jnp.asarray([5.0, 9.0, 2.0], np.float32),
+        jnp.asarray([True, True, True]))
+    khi, klo, ok = invertible.decode_keys(sk)
+    got = set()
+    okn = np.asarray(ok)
+    for r in range(2):
+        for j in np.nonzero(okn[r])[0]:
+            got.add((int(np.asarray(khi)[r, j]),
+                     int(np.asarray(klo)[r, j])))
+    assert got == set(zip(hi.tolist(), lo.tolist()))
+    # flip a bit in one occupied bucket's encoded key → that bucket
+    # must decode as NOT ok (fingerprint/position check)
+    enc = np.asarray(sk.enc_hi).copy()
+    r, j = [(int(a), int(b)) for a, b in zip(*np.nonzero(okn))][0]
+    enc[r, j] ^= 0x4
+    bad = sk._replace(enc_hi=jnp.asarray(enc))
+    _, _, ok2 = invertible.decode_keys(bad)
+    assert not bool(np.asarray(ok2)[r, j])
+    assert int(np.asarray(ok2).sum()) == int(okn.sum()) - 1
+
+
+def test_merge_prefers_higher_priority():
+    import jax.numpy as jnp
+
+    t = jnp.asarray([True])
+    a = invertible.update(invertible.init(1, 16), jnp.asarray([3], np.uint32),
+                          jnp.asarray([4], np.uint32),
+                          jnp.asarray([10.0]), t)
+    b = invertible.update(invertible.init(1, 16), jnp.asarray([3], np.uint32),
+                          jnp.asarray([4], np.uint32),
+                          jnp.asarray([20.0]), t)
+    m = invertible.merge(a, b)
+    assert float(np.asarray(m.prio).max()) == 20.0
+    hi2, lo2, ok2 = invertible.decode_keys(m)
+    assert bool(np.asarray(ok2).any())
+
+
+# ------------------------------------------- fuzz: recovery vs exact truth
+def _feed_streams(rt, track: exact.StreamTopK, n_streams: int,
+                  seed0: int = 0, conn_lo: int = 32, conn_hi: int = 128):
+    """Mixed-subsystem fuzz streams (the test_fusedfold shape) with the
+    conn records ALSO folded into the exact offline reference."""
+    for s in range(n_streams):
+        sim = ParthaSim(n_hosts=8, n_svcs=4, seed=seed0 + s)
+        rng = np.random.default_rng(seed0 + s)
+        conns = sim.conn_records(int(rng.integers(conn_lo, conn_hi)))
+        track.add_conn_batch(decode.conn_batch(conns, len(conns)))
+        parts = [
+            sim.listener_frames(),
+            wire.encode_frames_chunked(wire.NOTIFY_TCP_CONN, conns),
+            sim.resp_frames(int(rng.integers(48, 120))),
+            sim.task_frames(),
+            wire.encode_frames_chunked(wire.NOTIFY_HOST_STATE,
+                                       sim.host_state_records()),
+        ]
+        rng.shuffle(parts)
+        rt.feed(b"".join(parts))
+    rt.flush()
+
+
+def _measured_error(rows, truth: exact.StreamTopK, k: int) -> float:
+    """Weighted relative error of the served top-k vs the exact top-k:
+    sum |reported − exact| over the exact top-k keys / exact mass.
+    A key the device view misses contributes its full exact count."""
+    by_id = {r[0]: r[1] for r in rows}
+    err = 0.0
+    mass = 0.0
+    for key_hex, exact_v in truth.topk_hex(k):
+        got = by_id.get(key_hex)
+        err += abs((got if got is not None else 0.0) - exact_v)
+        mass += exact_v
+    return err / max(mass, 1e-9)
+
+
+def test_recovered_topk_error_bound_fuzz():
+    """500-stream mixed-subsystem fuzz: the merged heavy-flow view
+    (exact lanes ∪ invertible recovery) stays within 2% weighted error
+    of the exact offline top-32, and every row's bound annotation
+    actually bounds its own error."""
+    rt = Runtime(_cfg())
+    truth = exact.StreamTopK()
+    try:
+        _feed_streams(rt, truth, n_streams=500)
+        rec = rt.heavy_recover()
+        assert rec["recovered_keys"] > 0
+        are = _measured_error(rec["flows"], truth, 32)
+        assert are <= 0.02, f"measured top-32 error {are:.4f} > 2%"
+        # per-row bound honesty on the seeded workload: every flow
+        # row's value is an UPPER bound on the true total, and its
+        # overcount stays within the row's own errbound (exact lanes
+        # tighten it to est − count; recovered rows carry the
+        # invertible-array term). f32 accumulation slack is ~1e-7·value.
+        for key_hex, value, errbound, source in rec["flows"]:
+            tv = truth.acc.get(int(key_hex, 16))
+            if tv is None:
+                continue
+            slack = 1e-5 * max(tv, 1.0)
+            assert value + slack >= tv, (key_hex, "not an upper bound")
+            if source == "exact":
+                assert value - tv <= errbound + slack, (key_hex, source)
+    finally:
+        rt.close()
+
+
+# NOTE fused-vs-legacy parity for the invertible state needs no test of
+# its own: ``inv`` is part of AggState, so test_fusedfold's digest
+# (every state leaf, bit-for-bit, 500-stream fuzz) covers it already.
+
+
+# ---------------------------------------------------- query + alert edges
+def test_topk_subsystem_query_rows():
+    rt = Runtime(_cfg())
+    truth = exact.StreamTopK()
+    try:
+        _feed_streams(rt, truth, n_streams=10)
+        rt.run_tick()
+        out = rt.query({"subsys": "topk", "maxrecs": 200})
+        assert out["nrecs"] > 0
+        metrics = {r["metric"] for r in out["recs"]}
+        assert "bytes" in metrics
+        assert "conns" in metrics          # dense svc ranking present
+        byrows = [r for r in out["recs"] if r["metric"] == "bytes"]
+        assert byrows[0]["rank"] == 1
+        assert all("errbound" in r and "source" in r for r in byrows)
+        assert {r["source"] for r in byrows} <= {"exact", "recovered"}
+        # ranked descending within the metric
+        vals = [r["value"] for r in byrows]
+        assert vals == sorted(vals, reverse=True)
+        # filters work through the ordinary criteria engine
+        flt = rt.query({"subsys": "topk", "maxrecs": 500,
+                        "filter": "{ topk.metric = 'bytes' } and "
+                                  "{ topk.rank <= 10 }"})
+        assert 0 < flt["nrecs"] <= 10
+        # recovery was counted (one readback, memoized across queries)
+        assert rt.stats.counters.get("topk_recover_readbacks", 0) >= 1
+        assert rt.stats.gauges.get("topk_recovered_keys", 0) > 0
+    finally:
+        rt.close()
+
+
+def test_topk_alertdef_fires_end_to_end():
+    """'Alert when a new flow enters the top-10' — an alertdef on the
+    topk subsystem evaluates against the recovered view and fires
+    through alerts/manager.py with the flow id in the entity key."""
+    rt = Runtime(_cfg())
+    truth = exact.StreamTopK()
+    try:
+        rt.alerts.add_def({
+            "alertname": "hh-top10", "subsys": "topk",
+            "filter": "{ topk.metric = 'bytes' } and "
+                      "{ topk.rank <= 10 }",
+            "severity": "warning", "numcheckfor": 1})
+        _feed_streams(rt, truth, n_streams=6)
+        rep = rt.run_tick()
+        assert rep["alerts_fired"] > 0
+        fired = [a for a in rt.alerts.alert_log
+                 if a.alertname == "hh-top10"]
+        assert fired and fired[0].subsys == "topk"
+        assert "metric=bytes" in fired[0].entity
+        assert "id=" in fired[0].entity
+        assert fired[0].row["errbound"] >= 0
+        # a second tick re-evaluates without refiring (holdoff), and
+        # the same entities stay firing
+        n0 = len([a for a in rt.alerts.alert_log
+                  if a.alertname == "hh-top10"])
+        rt.run_tick()
+        assert len([a for a in rt.alerts.alert_log
+                    if a.alertname == "hh-top10"]) == n0
+        assert any(k[0] == "hh-top10" for k in rt.alerts.firing())
+    finally:
+        rt.close()
+
+
+def test_alertdef_subsys_fails_at_definition_time():
+    """A typo'd subsys (or a filter targeting another subsystem) fails
+    at CRUD time with the valid-subsystem list — never at the first
+    fold-time evaluation (ISSUE 7 small fix)."""
+    from gyeeta_tpu.alerts.defs import AlertDef
+    from gyeeta_tpu.alerts.manager import AlertManager
+
+    m = AlertManager(_cfg())
+    with pytest.raises(ValueError, match="one of .*'svcstate'"):
+        m.add_def({"alertname": "x", "subsys": "topkk",
+                   "filter": "{ topk.rank <= 10 }"})
+    # filter criteria referencing a DIFFERENT (valid) subsystem than
+    # the def's subsys would evaluate all-pass — rejected up front
+    with pytest.raises(ValueError, match="foreign criteria"):
+        m.add_def({"alertname": "x", "subsys": "topk",
+                   "filter": "{ svcstate.qps5s > 1 }"})
+    # the direct-instance path validates too (it used to skip from_json)
+    with pytest.raises(ValueError, match="one of "):
+        m.add_def(AlertDef(name="y", subsys="nope",
+                           filter="{ svcstate.qps5s > 1 }"))
+    assert not m.defs
+
+
+def test_hot_promotions_counter():
+    """gyt_topk_hot_promotions_total counts NEW recovered-hot keys per
+    recovery, not steady residency."""
+    rt = Runtime(_cfg())
+    truth = exact.StreamTopK()
+    try:
+        _feed_streams(rt, truth, n_streams=6, seed0=3)
+        rt.heavy_recover()
+        c1 = rt.stats.counters.get("topk_hot_promotions", 0)
+        assert c1 > 0
+        # recover again with no new traffic: no new promotions
+        rt._cols.bump()
+        rt.heavy_recover()
+        assert rt.stats.counters.get("topk_hot_promotions", 0) == c1
+    finally:
+        rt.close()
+
+
+# --------------------------------------------------------- sharded (slow)
+@pytest.mark.slow
+def test_sharded_topk_rollup_and_parity():
+    """ShardedRuntime: cluster-wide recovery via the rollup collective;
+    the topk subsystem serves merged rows and the recovered view covers
+    the exact offline top keys within the same bound."""
+    from gyeeta_tpu.parallel.mesh import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    srt = ShardedRuntime(_cfg(), make_mesh(4),
+                         RuntimeOpts(dep_pair_capacity=1024,
+                                     dep_edge_capacity=512))
+    truth = exact.StreamTopK()
+    try:
+        _feed_streams(srt, truth, n_streams=40)
+        rec = srt.heavy_recover()
+        assert rec["recovered_keys"] > 0
+        are = _measured_error(rec["flows"], truth, 32)
+        assert are <= 0.02, f"sharded top-32 error {are:.4f} > 2%"
+        out = srt.query({"subsys": "topk", "maxrecs": 100})
+        assert out["nrecs"] > 0
+        assert {r["metric"] for r in out["recs"]} >= {"bytes", "conns"}
+    finally:
+        srt.close()

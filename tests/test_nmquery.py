@@ -29,9 +29,11 @@ CFG = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
                 conn_batch=128, resp_batch=256, fold_k=2)
 
 # the ≥5 REST-parity subsystems of the acceptance criterion (tcpconn is
-# the node alias for flowstate — exercised separately)
+# the node alias for flowstate — exercised separately); topk is the
+# heavy-hitter union view (ISSUE 7: byte-equal on both edges, both
+# runtimes)
 PARITY_SUBSYS = ("svcstate", "hoststate", "taskstate", "flowstate",
-                 "alerts", "svcsumm")
+                 "alerts", "svcsumm", "topk")
 
 
 # ------------------------------------------------------- envelope units
@@ -216,6 +218,10 @@ def _assert_scenario(out: dict) -> None:
     assert out["parity"]["hoststate"][0]["nrecs"] == 8
     assert out["parity"]["taskstate"][0]["nrecs"] > 0
     assert out["parity"]["flowstate"][0]["nrecs"] > 0
+    # heavy hitters served on both edges, every row bound-annotated
+    topk_recs = out["parity"]["topk"][0]["recs"]
+    assert topk_recs and all("errbound" in r and "source" in r
+                             for r in topk_recs)
     assert out["by_code"] == out["parity"]["svcstate"][0]
     assert out["tcpconn"] == out["parity"]["flowstate"][0]
     # CRUD round trip
