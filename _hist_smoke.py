@@ -1,0 +1,151 @@
+"""CI smoke: the time-travel history tier end to end.
+
+Feeds a runtime (journaling every accepted chunk), SEALS the WAL,
+COMPACTS sealed segments into columnar snapshot shards (with a
+retention geometry tight enough that the pass also DOWNSAMPLES raw →
+mid — the retention sweep demonstrated live), RESTARTS (a fresh
+process-equivalent Runtime over the same shard dir — no live engine
+state survives), then queries ``svcstate?at=`` and ``topk?window=``
+over BOTH the REST gateway and a stock NM conn, asserting non-empty,
+bound-annotated, byte-equal rows. Exit code 0 = the history tier's
+serving contract holds. Run by ci.sh; standalone:
+``JAX_PLATFORMS=cpu python _hist_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import tempfile
+
+
+async def _rest_query(gh, gp, req: dict) -> tuple:
+    """POST /query against the web gateway → (raw body, parsed)."""
+    import json
+
+    reader, writer = await asyncio.open_connection(gh, gp)
+    body = json.dumps(req).encode()
+    writer.write(
+        b"POST /query HTTP/1.1\r\nHost: s\r\nConnection: close\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, rbody = raw.partition(b"\r\n\r\n")
+    assert b" 200 " in head.splitlines()[0], head
+    return rbody, json.loads(rbody)
+
+
+async def scenario(tmp: str) -> None:
+    import json
+    import os
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.history.compactor import Compactor
+    from gyeeta_tpu.history.shards import ShardStore
+    from gyeeta_tpu.net import GytServer
+    from gyeeta_tpu.net.webgw import WebGateway
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.nodeweb import NodeWebSim
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
+                    conn_batch=128, resp_batch=256, fold_k=2)
+    opts = RuntimeOpts(
+        journal_dir=os.path.join(tmp, "wal"),
+        hist_shard_dir=os.path.join(tmp, "shards"),
+        # 1-tick raw windows + tight retention so this very pass
+        # exercises the raw→mid downsample sweep
+        hist_window_ticks=1, hist_retain_raw=2, hist_mid_every=2,
+        dep_pair_capacity=1024, dep_edge_capacity=512)
+
+    # ---- phase 1: feed + tick (every accepted chunk lands in the WAL)
+    rt = Runtime(cfg, opts)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=5)
+    rt.feed(sim.name_frames())
+    for _ in range(6):
+        rt.feed(sim.conn_frames(256) + sim.resp_frames(512)
+                + sim.listener_frames() + sim.task_frames())
+        rt.run_tick()
+    final_tick = rt._tick_no
+
+    # ---- phase 2: seal + compact (+ retention downsample)
+    comp = Compactor(cfg, opts, journal=rt.journal, stats=rt.stats)
+    rep = comp.compact_once(seal=True, upto_tick=final_tick)
+    assert rep["windows"] == 6, rep
+    assert rep["records"] > 0 and rep["ev_per_sec"] > 0, rep
+    store = comp.store
+    mids = store.shards("mid")
+    raws = store.shards("raw")
+    assert mids, "retention must have downsampled raw shards to mid"
+    assert len(raws) <= opts.hist_retain_raw + opts.hist_mid_every
+    assert rt.stats.counters["compact_shards"] >= 6
+    assert rt.stats.counters["compact_downsampled"] >= 1
+    named = {e["file"] for e in store.shards()}
+    on_disk = {p.name for p in store.dir.glob("gyt_shard_*.npz")}
+    assert named == on_disk, "manifest/file mismatch after retention"
+    print(f"hist smoke: compacted {rep['windows']} windows "
+          f"({rep['records']} records, {rep['ev_per_sec']:.0f} ev/s), "
+          f"{len(raws)} raw + {len(mids)} mid shard(s)",
+          file=sys.stderr)
+    comp.close()
+    rt.close()
+
+    # ---- phase 3: RESTART — a fresh runtime over the same shard dir;
+    # no live state, every answer must come from the shards
+    rt2 = Runtime(cfg, opts)
+    srv = GytServer(rt2, tick_interval=None)
+    host, port = await srv.start()
+    gw = WebGateway(host, port)
+    gh, gp = await gw.start()
+    nw = NodeWebSim(hostname="ci-hist")
+    hs = await nw.connect(host, port)
+    assert hs["error_code"] == 0, hs
+
+    reqs = (
+        {"subsys": "svcstate", "at": f"tick:{final_tick}",
+         "maxrecs": 50},
+        {"subsys": "topk", "window": "1h", "maxrecs": 50},
+    )
+    for req in reqs:
+        nm_obj = await nw.request(
+            2, {"qtype": req["subsys"],
+                "options": {k: v for k, v in req.items()
+                            if k != "subsys"}})
+        rest_raw, rest_obj = await _rest_query(gh, gp, req)
+        assert json.dumps(nm_obj).encode() == rest_raw, \
+            f"NM vs REST bytes differ for {req}"
+        assert nm_obj["nrecs"] > 0, (req, nm_obj)
+    at_sv = await nw.request(2, {"qtype": "svcstate", "options": {
+        "at": f"tick:{final_tick}", "maxrecs": 50}})
+    assert at_sv["tick"] == final_tick
+    win_tk = (await _rest_query(gh, gp, reqs[1]))[1]
+    assert all("errbound" in r and "source" in r
+               for r in win_tk["recs"]), win_tk["recs"][:3]
+    # /metrics carries the compaction rows (written into the live
+    # registry by the compactor pass above — scrape the NEW server's
+    # exposition for the shard-store gauges at least)
+    met = await nw.query_web("metrics")
+    assert "gyt_stage_duration_seconds" in met["text"]
+    print("hist smoke: at=/window= byte-equal on NM + REST, "
+          f"{win_tk['nrecs']} bound-annotated topk row(s)",
+          file=sys.stderr)
+
+    await nw.close()
+    await gw.stop()
+    await srv.stop()
+    rt2.close()
+    store2 = ShardStore(opts.hist_shard_dir)
+    assert store2.position() is not None
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="gyt_hist_smoke_") as tmp:
+        asyncio.run(scenario(tmp))
+    print("hist smoke: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

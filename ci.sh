@@ -54,6 +54,17 @@ if ! JAX_PLATFORMS=cpu python _nm_smoke.py; then
     exit 1
 fi
 
+# History / time-travel smoke: feed → seal WAL → compact into columnar
+# snapshot shards (retention downsample demonstrated) → RESTART a fresh
+# runtime over the shard dir → query svcstate?at= + topk?window= over
+# REST and a stock NM conn, asserting non-empty bound-annotated rows
+# rendered byte-equal on both edges.
+echo "ci: history time-travel smoke" >&2
+if ! JAX_PLATFORMS=cpu python _hist_smoke.py; then
+    echo "ci: FATAL — history smoke failed" >&2
+    exit 1
+fi
+
 # Chaos smoke: a REAL `serve` subprocess behind the seeded chaos proxy
 # (sim/chaos.py) — corruption/disconnect faults, a slow-loris conn,
 # one SIGTERM kill + --restore-latest restart. Fails on agent exit,

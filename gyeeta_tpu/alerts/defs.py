@@ -40,6 +40,12 @@ class AlertDef(NamedTuple):
     # the group opens, then emit as one batch (ref ALERT_GROUP
     # group-wait windows, server/gy_alertmgr.h:574). 0 = immediate.
     groupwaitsec: float = 0.0
+    # realtime defs only: evaluate against the time-travel tier's
+    # WINDOWED per-entity aggregate over this duration ("15m", "1h",
+    # seconds) instead of the live snapshot — "alert when the 15m mean
+    # error rate exceeds X". Needs history shards (hist_shard_dir);
+    # checks are skipped (counted) until the first window exists.
+    window: str = ""
 
     def validate(self) -> "AlertDef":
         """Definition-time checks shared by the JSON and direct-
@@ -55,6 +61,21 @@ class AlertDef(NamedTuple):
             raise ValueError("alertdef filter must be non-empty")
         criteria.check_filter_subsys(tree, self.subsys,
                                      what=f"alertdef {self.name!r}")
+        if self.window:
+            from gyeeta_tpu.history.timeview import parse_dur
+            try:
+                dur = parse_dur(self.window)
+            except ValueError:
+                raise ValueError(
+                    f"alertdef {self.name!r}: bad window "
+                    f"{self.window!r} (use seconds or 15m/2h/1d)")
+            if dur <= 0:
+                raise ValueError(
+                    f"alertdef {self.name!r}: window must be positive")
+            if self.mode != "realtime":
+                raise ValueError(
+                    f"alertdef {self.name!r}: window applies to "
+                    "realtime defs (db defs window via querysec)")
         return self
 
     @classmethod
@@ -80,6 +101,7 @@ class AlertDef(NamedTuple):
             mode=mode,
             querysec=max(1.0, float(d.get("querysec", 300.0))),
             groupwaitsec=max(0.0, float(d.get("groupwaitsec", 0.0))),
+            window=str(d.get("window", "") or ""),
         ).validate()
 
     @staticmethod

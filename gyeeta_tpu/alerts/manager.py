@@ -218,11 +218,25 @@ class AlertManager:
         for ad in self.defs.values():
             if not ad.enabled or ad.mode != "realtime":
                 continue
-            if ad.subsys not in cols_cache:
-                cols_cache[ad.subsys] = (
-                    columns_fn(ad.subsys) if columns_fn is not None
-                    else api._COLUMNS_OF[ad.subsys](self.cfg, st))
-            cols, base = cols_cache[ad.subsys]
+            # a windowed def evaluates against the time-travel tier's
+            # aggregate: the column source is addressed "subsys@window"
+            # (both runtimes route the suffix to timeview); before the
+            # first window exists the check skips, counted
+            ckey = f"{ad.subsys}@{ad.window}" if ad.window \
+                else ad.subsys
+            if ckey not in cols_cache:
+                try:
+                    cols_cache[ckey] = (
+                        columns_fn(ckey) if columns_fn is not None
+                        else api._COLUMNS_OF[ad.subsys](self.cfg, st))
+                except ValueError:
+                    if not ad.window:
+                        raise
+                    self.stats["nwindow_skipped"] += 1
+                    cols_cache[ckey] = None
+            if cols_cache[ckey] is None:
+                continue
+            cols, base = cols_cache[ckey]
             tree = self._trees.get(f"def:{ad.name}") \
                 or criteria.parse(ad.filter)
             mask = base & criteria.evaluate(tree, cols, ad.subsys)

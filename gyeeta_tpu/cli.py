@@ -13,6 +13,8 @@ alertdef CRUD round trip (``--crud``); ``nm query`` sends one raw body
 ``python -m gyeeta_tpu chaos``     — deterministic fault-injection TCP
 proxy between agents and the server (corrupt/truncate/disconnect/stall
 + latency/re-split/kill windows; ``sim/chaos.py``)
+``python -m gyeeta_tpu compact``   — offline WAL→shard compaction for
+the time-travel history tier (``compact list`` prints the manifest)
 
 The reference splits these across binaries (gymadhava/gyshyama,
 partha, node webserver clients); one Python entry point with
@@ -329,6 +331,55 @@ def _cmd_nm(argv) -> None:
     asyncio.run(run())
 
 
+def _cmd_compact(argv) -> None:
+    ap = argparse.ArgumentParser(
+        prog="gyeeta_tpu compact",
+        description="offline WAL compaction: re-fold a journal dir "
+        "through the engine and emit columnar snapshot shards "
+        "(history/compactor.py) — the batch form of the serve "
+        "daemon's in-process compactor. 'list' prints the shard "
+        "manifest of a shard dir.")
+    ap.add_argument("what", nargs="?", default="run",
+                    choices=("run", "list"))
+    ap.add_argument("--journal-dir", help="WAL source (run)")
+    ap.add_argument("--shard-dir", required=True)
+    ap.add_argument("--config", help="JSON config ({engine:…, "
+                    "runtime:…}) — geometry MUST match the serving "
+                    "process that wrote the WAL")
+    ap.add_argument("--window-ticks", type=int, default=None)
+    ap.add_argument("--upto-tick", type=int, default=None,
+                    help="also tick past the last chunk's stamp (only "
+                    "sound when the producer is stopped)")
+    args = ap.parse_args(argv)
+
+    from gyeeta_tpu.utils import config as C
+    if args.what == "list":
+        from gyeeta_tpu.history.shards import ShardStore
+        store = ShardStore(args.shard_dir)
+        out = {"pos": store.position(), "tick": store.tick(),
+               "shards": store.shards()}
+        json.dump(out, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return
+    if not args.journal_dir:
+        raise SystemExit("compact run needs --journal-dir")
+    cfg = C.load_engine_cfg(args.config)
+    opts = C.load_runtime_opts(
+        args.config, hist_shard_dir=args.shard_dir,
+        **({"hist_window_ticks": args.window_ticks}
+           if args.window_ticks is not None else {}))
+    from gyeeta_tpu.history.compactor import Compactor
+    from gyeeta_tpu.utils.selfstats import Stats
+    c = Compactor(cfg, opts, journal_dir=args.journal_dir,
+                  shard_dir=args.shard_dir, stats=Stats())
+    try:
+        rep = c.compact_once(upto_tick=args.upto_tick)
+    finally:
+        c.close()
+    json.dump(rep, sys.stdout)
+    sys.stdout.write("\n")
+
+
 def _cmd_web(argv) -> None:
     ap = argparse.ArgumentParser(prog="gyeeta_tpu web")
     ap.add_argument("--host", default="127.0.0.1",
@@ -356,11 +407,12 @@ def _cmd_web(argv) -> None:
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] in ("query", "agent", "replay", "web", "obs",
-                            "nm", "chaos"):
+                            "nm", "chaos", "compact"):
         return {"query": _cmd_query, "agent": _cmd_agent,
                 "replay": _cmd_replay, "web": _cmd_web,
                 "obs": _cmd_obs, "nm": _cmd_nm,
-                "chaos": _cmd_chaos}[argv[0]](argv[1:])
+                "chaos": _cmd_chaos,
+                "compact": _cmd_compact}[argv[0]](argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]
     from gyeeta_tpu.server_main import main as serve_main

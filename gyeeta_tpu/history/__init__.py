@@ -17,3 +17,11 @@ from gyeeta_tpu.history.store import HistoryStore, to_sql
 from gyeeta_tpu.history.pgstore import PgHistoryStore, open_store
 
 __all__ = ["HistoryStore", "PgHistoryStore", "open_store", "to_sql"]
+
+# The time-travel tier (WAL compaction → columnar snapshot shards →
+# windowed queries) lives beside the relational store:
+#   history/shards.py    — shard files + manifest (ShardStore)
+#   history/compactor.py — sealed-WAL → shard compaction daemon
+#   history/timeview.py  — at=/window= query materialization
+#   history/histwriter.py — batched single-writer thread for this store
+# (imported lazily by the runtimes to keep cold-start imports light)

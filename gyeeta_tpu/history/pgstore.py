@@ -110,9 +110,13 @@ class PgHistoryStore(HistoryStore):
     SUBSTR_SQL = "strpos({col}, ?) > 0"
 
     def __init__(self, dsn: str):
+        import threading
         # deliberately NOT calling super().__init__ (it opens sqlite)
         self.db = _PgDb(_connect(dsn))
         self._known: set = set()
+        # serializes the history writer thread against fold-thread
+        # readers (one psycopg connection is not thread-safe)
+        self._dblock = threading.RLock()
 
     # ---------------------------------------------------- overrides
     def _ensure(self, subsys: str, day: str) -> str:
@@ -166,16 +170,18 @@ class PgHistoryStore(HistoryStore):
     def cleanup(self, keep_days: int, now: float) -> int:
         cutoff = _day_of(now - keep_days * 86400.0)
         dropped = 0
-        for name, day in self._own_partitions():
-            if day < cutoff:
-                self.db.execute(f"DROP TABLE {name}")
-                self._known.discard(name)
-                dropped += 1
-        self.db.commit()
+        with self._dblock:
+            for name, day in self._own_partitions():
+                if day < cutoff:
+                    self.db.execute(f"DROP TABLE {name}")
+                    self._known.discard(name)
+                    dropped += 1
+            self.db.commit()
         return dropped
 
     def days(self) -> list:
-        return sorted({day for _, day in self._own_partitions()})
+        with self._dblock:
+            return sorted({day for _, day in self._own_partitions()})
 
 
 def open_store(path_or_dsn: str) -> HistoryStore:

@@ -131,9 +131,15 @@ class HistoryStore:
     SUBSTR_SQL = _SUBSTR_SQLITE
 
     def __init__(self, path: str = ":memory:"):
-        self.db = sqlite3.connect(path)
+        import threading
+        # one connection shared between the fold thread's readers
+        # (historical queries, db-mode alertdefs) and the history
+        # writer thread (history/histwriter.py) — every access is
+        # serialized by self._dblock, so check_same_thread can be off
+        self.db = sqlite3.connect(path, check_same_thread=False)
         self.db.execute("PRAGMA journal_mode=WAL")
         self._known: set = set()
+        self._dblock = threading.RLock()
 
     def _ensure(self, subsys: str, day: str) -> str:
         t = _table(subsys, day)
@@ -150,17 +156,18 @@ class HistoryStore:
         """Persist one snapshot sweep (rows from query.api.execute)."""
         if subsys not in _TABLES:
             raise ValueError(f"no history table for {subsys!r}")
-        tab = self._ensure(subsys, _day_of(t))
-        cols = _TABLES[subsys]
-        q = (f"INSERT INTO {tab} (time, {', '.join(cols)}) VALUES "
-             f"({', '.join('?' * (len(cols) + 1))})")
-        params = [[t] + [r.get(c) for c in cols] for r in rows]
-        with self.db:
-            # one executemany per sweep: at snapshot scale (50k hosts ×
-            # 1/min) row-at-a-time commits are the write-amplification
-            # bug VERDICT r2 flagged (the reference batches via
-            # DB_WRITE_ARR, server/gy_mconnhdlr.h:350)
-            self.db.executemany(q, params)
+        with self._dblock:
+            tab = self._ensure(subsys, _day_of(t))
+            cols = _TABLES[subsys]
+            q = (f"INSERT INTO {tab} (time, {', '.join(cols)}) VALUES "
+                 f"({', '.join('?' * (len(cols) + 1))})")
+            params = [[t] + [r.get(c) for c in cols] for r in rows]
+            with self.db:
+                # one executemany per sweep: at snapshot scale (50k
+                # hosts × 1/min) row-at-a-time commits are the write-
+                # amplification bug VERDICT r2 flagged (the reference
+                # batches via DB_WRITE_ARR, server/gy_mconnhdlr.h:350)
+                self.db.executemany(q, params)
         return len(params)
 
     def _partition(self, subsys: str, day: str):
@@ -193,23 +200,24 @@ class HistoryStore:
                                       substr_fmt=self.SUBSTR_SQL)
         cols = ["time"] + _TABLES[subsys]
         out = []
-        for day in self._days_between(tstart, tend):
-            t = self._partition(subsys, day)
-            if t is None:
-                continue
-            # with an inexact WHERE, LIMIT must count post-filtered rows:
-            # stream unlimited and post-filter as we go
-            q = (f"SELECT {', '.join(cols)} FROM {t} "
-                 f"WHERE time >= ? AND time <= ? AND ({where}) "
-                 f"ORDER BY time")
-            for rec in self.db.execute(q, [tstart, tend] + params):
-                row = dict(zip(cols, rec))
-                if not exact and tree is not None \
-                        and not self._match(tree, subsys, row):
+        with self._dblock:
+            for day in self._days_between(tstart, tend):
+                t = self._partition(subsys, day)
+                if t is None:
                     continue
-                out.append(row)
-                if len(out) >= maxrecs:
-                    return out
+                # with an inexact WHERE, LIMIT must count post-filtered
+                # rows: stream unlimited and post-filter as we go
+                q = (f"SELECT {', '.join(cols)} FROM {t} "
+                     f"WHERE time >= ? AND time <= ? AND ({where}) "
+                     f"ORDER BY time")
+                for rec in self.db.execute(q, [tstart, tend] + params):
+                    row = dict(zip(cols, rec))
+                    if not exact and tree is not None \
+                            and not self._match(tree, subsys, row):
+                        continue
+                    out.append(row)
+                    if len(out) >= maxrecs:
+                        return out
         return out
 
     @staticmethod
@@ -303,6 +311,31 @@ class HistoryStore:
                 sel2.append(sel[len(grp) + i])
                 post.append((s.op, s.alias, s.alias, None))
         acc: dict = {}
+        self._dblock.acquire()
+        try:
+            self._aggr_scan(subsys, tstart, tend, sel, sel2, grp, gb,
+                            where, params, post, acc)
+        finally:
+            self._dblock.release()
+        out = []
+        for key, row in acc.items():
+            rec = dict(zip(gb, key))
+            for op, alias, scol, ccol in post:
+                if op == "avg":
+                    c = row.get(ccol) or 0
+                    rec[alias] = (row.get(scol) or 0) / c if c else 0.0
+                else:
+                    # NULL (zero matching rows) → 0.0, matching the numpy
+                    # path's _apply-on-empty so both execution paths agree
+                    v = row.get(scol)
+                    rec[alias] = 0.0 if v is None else v
+            out.append(rec)
+            if len(out) >= maxrecs:
+                break
+        return out
+
+    def _aggr_scan(self, subsys, tstart, tend, sel, sel2, grp, gb,
+                   where, params, post, acc) -> None:
         for day in self._days_between(tstart, tend):
             t = self._partition(subsys, day)
             if t is None:
@@ -331,42 +364,28 @@ class HistoryStore:
                     elif op == "avg":
                         cur[scol] = (cur[scol] or 0) + (row[scol] or 0)
                         cur[ccol] = (cur[ccol] or 0) + (row[ccol] or 0)
-        out = []
-        for key, row in acc.items():
-            rec = dict(zip(gb, key))
-            for op, alias, scol, ccol in post:
-                if op == "avg":
-                    c = row.get(ccol) or 0
-                    rec[alias] = (row.get(scol) or 0) / c if c else 0.0
-                else:
-                    # NULL (zero matching rows) → 0.0, matching the numpy
-                    # path's _apply-on-empty so both execution paths agree
-                    v = row.get(scol)
-                    rec[alias] = 0.0 if v is None else v
-            out.append(rec)
-            if len(out) >= maxrecs:
-                break
-        return out
 
     def cleanup(self, keep_days: int, now: float) -> int:
         """Drop partitions older than keep_days (partition maintenance,
         ref gy_mdb_schema.cc partition cleanup functions)."""
         cutoff = _day_of(now - keep_days * 86400.0)
         dropped = 0
-        rows = self.db.execute(
-            "SELECT name FROM sqlite_master WHERE type='table' "
-            "AND name LIKE '%tbl_%'").fetchall()
-        for (name,) in rows:
-            day = name.rsplit("_", 1)[-1]
-            if day.isdigit() and day < cutoff:
-                self.db.execute(f"DROP TABLE {name}")
-                self._known.discard(name)
-                dropped += 1
-        self.db.commit()
+        with self._dblock:
+            rows = self.db.execute(
+                "SELECT name FROM sqlite_master WHERE type='table' "
+                "AND name LIKE '%tbl_%'").fetchall()
+            for (name,) in rows:
+                day = name.rsplit("_", 1)[-1]
+                if day.isdigit() and day < cutoff:
+                    self.db.execute(f"DROP TABLE {name}")
+                    self._known.discard(name)
+                    dropped += 1
+            self.db.commit()
         return dropped
 
     def days(self) -> list:
-        rows = self.db.execute(
-            "SELECT name FROM sqlite_master WHERE type='table' "
-            "AND name LIKE '%tbl_%'").fetchall()
+        with self._dblock:
+            rows = self.db.execute(
+                "SELECT name FROM sqlite_master WHERE type='table' "
+                "AND name LIKE '%tbl_%'").fetchall()
         return sorted({r[0].rsplit("_", 1)[-1] for r in rows})

@@ -1,0 +1,420 @@
+"""Time-travel queries: ``at=``/``window=`` served from snapshot shards.
+
+The read half of the history tier (``history/compactor.py`` writes the
+shards): an ``at=<ts>`` request materializes the shard covering that
+instant into a TRANSIENT engine snapshot — the serialized state leaves
+re-enter the same pytree shape the live engine uses, so every
+state-backed subsystem (including ``topk`` heavy-hitter recovery with
+its honest error bounds, ``flowstate``, and the dep-graph views) is
+served by the UNCHANGED ``query/api.py`` pipeline; relational
+subsystems read the shard's stored column panels directly. A
+``window=<dur>`` request aggregates per-entity across every shard
+sampling the range (mean for numeric fields, last observation
+otherwise), and ``topk`` becomes a windowed DIFF: value = est(end) −
+est(baseline), errbound = eb(end) + eb(baseline) — both ends are CMS
+upper bounds, so the window count lies within ±errbound of the
+reported value (bounds stay honest through subtraction).
+
+Snapshots are ColumnCache-compatible: each materialized shard carries
+its own version-keyed column memo, so repeated queries against the
+same instant pay the state readbacks once. All three query edges (GYT
+binary, REST ``?at=``/``?window=``, stock NM ``tstart``/``tend``
+options) route here through ``Runtime.query`` — byte-equal responses
+by construction.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+import numpy as np
+
+from gyeeta_tpu.query import api, fieldmaps
+
+# suffix durations accepted by at=/window= ("90" = seconds)
+_DUR_UNIT = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_dur(v) -> float:
+    """Duration → seconds: 900, "900", "15m", "2h", "1d"."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip().lower()
+    if s and s[-1] in _DUR_UNIT:
+        return float(s[:-1]) * _DUR_UNIT[s[-1]]
+    return float(s)
+
+
+def parse_when(v, now: float):
+    """``at=`` value → epoch seconds, or ``("tick", N)``.
+
+    Accepts epoch numbers, ``-15m`` (relative to now) and ``tick:N``
+    (window-tick pinned — what tests and the smoke use for exact shard
+    addressing)."""
+    if isinstance(v, str):
+        s = v.strip()
+        if s.startswith("tick:"):
+            return ("tick", int(s[5:]))
+        if s.startswith("-"):
+            return now - parse_dur(s[1:])
+        return float(s)
+    return float(v)
+
+
+def hist_recover(rt, state) -> dict:
+    """Heavy-hitter key recovery from an ARBITRARY state pytree (the
+    shard-materialized snapshot) — the same decode + merge math as
+    ``Runtime.heavy_recover``/``ShardedRuntime.heavy_recover`` without
+    the live-runtime side effects (no stats, no promotion edge)."""
+    from gyeeta_tpu.sketch import invertible
+
+    cfg = rt.cfg
+    if hasattr(rt, "_rollup"):           # ShardedRuntime: collectives
+        ru = rt._rollup(state)
+        out = {
+            "topk_hi": np.asarray(ru.flow_topk.key_hi),
+            "topk_lo": np.asarray(ru.flow_topk.key_lo),
+            "topk_counts": np.asarray(ru.flow_topk.counts),
+            "topk_est": np.asarray(ru.hh_topk_est),
+            "hh_hi": np.asarray(ru.hh_hi),
+            "hh_lo": np.asarray(ru.hh_lo),
+            "hh_ok": np.asarray(ru.hh_ok),
+            "hh_est": np.asarray(ru.hh_est),
+        }
+        evicted = float(np.asarray(ru.flow_topk.evicted))
+        total = float(np.asarray(ru.hh_total_mass))
+    else:
+        out = {k: np.asarray(v)
+               for k, v in rt._hh_recover(state).items()}
+        evicted = float(out["evicted"])
+        total = float(out["total_mass"])
+    err_term = invertible.cms_error_term(total, cfg.cms_width)
+    hot_thresh = (cfg.hh_hot_frac * total
+                  if cfg.hh_hot_frac > 0 else 0.0)
+    flows, recovered, _hot = invertible.merge_recovered_np(
+        out, err_term, hot_thresh)
+    return {"flows": flows, "err_term": err_term, "total_mass": total,
+            "evicted": evicted, "recovered_keys": len(recovered)}
+
+
+def aggregate_window_columns(subsys: str, parts: list):
+    """Per-entity aggregate of column snapshots (oldest→newest):
+    numeric fields average across the samples an entity appears in;
+    string/enum/bool fields keep the LAST observation; the mask is the
+    union of liveness. Entities are keyed by the subsystem's string
+    identity columns; subsystems without one (clusterstate) aggregate
+    positionally."""
+    fmap = fieldmaps.field_map(subsys)
+    kind_of = {fd.col: fd.kind for fd in fmap.values()}
+    cols_last = parts[-1][0]
+    names = [c for c in cols_last]
+    keycols = [c for c in names if kind_of.get(c) == "str"]
+
+    if not keycols:
+        L = min(len(np.asarray(p[1])) for p in parts)
+        out = {}
+        for c in names:
+            if kind_of.get(c) == "num":
+                out[c] = np.mean(
+                    [np.asarray(p[0][c][:L], np.float64)
+                     for p in parts], axis=0)
+            else:
+                out[c] = np.asarray(cols_last[c][:L])
+        mask = np.zeros(L, bool)
+        for p in parts:
+            mask |= np.asarray(p[1][:L], bool)
+        return out, mask
+
+    numcols = [c for c in names
+               if c not in keycols and kind_of.get(c) == "num"]
+    othcols = [c for c in names
+               if c not in keycols and kind_of.get(c) != "num"]
+    order: list = []
+    acc: dict = {}
+    for cols, mask in parts:
+        mask = np.asarray(mask, bool)
+        idx = np.nonzero(mask)[0]
+        keys = list(zip(*(np.asarray(cols[c])[idx] for c in keycols))) \
+            if len(idx) else []
+        nums = {c: np.asarray(cols[c], np.float64)[idx]
+                for c in numcols}
+        oth = {c: np.asarray(cols[c])[idx] for c in othcols}
+        for j, k in enumerate(keys):
+            a = acc.get(k)
+            if a is None:
+                a = acc[k] = {"n": 0,
+                              "sum": dict.fromkeys(numcols, 0.0),
+                              "last": {}}
+                order.append(k)
+            a["n"] += 1
+            for c in numcols:
+                a["sum"][c] += float(nums[c][j])
+            for c in othcols:
+                a["last"][c] = oth[c][j]
+    n = len(order)
+    out = {}
+    for ki, c in enumerate(keycols):
+        col = np.empty(n, object)
+        col[:] = [k[ki] for k in order]
+        out[c] = col
+    for c in numcols:
+        out[c] = np.array([acc[k]["sum"][c] / acc[k]["n"]
+                           for k in order], np.float64)
+    for c in othcols:
+        ref = np.asarray(cols_last[c])
+        vals = [acc[k]["last"][c] for k in order]
+        if ref.dtype == object or ref.dtype.kind in "US":
+            col = np.empty(n, object)
+            col[:] = vals
+            out[c] = col
+        else:
+            out[c] = np.array(vals, ref.dtype)
+    # restore original column order
+    out = {c: out[c] for c in names if c in out}
+    return out, np.ones(n, bool)
+
+
+class HistSnapshot:
+    """One shard materialized as a transient, ColumnCache-compatible
+    engine snapshot: stored column panels serve the relational
+    subsystems directly; everything state-backed (``topk``,
+    ``flowstate``, the dep views, …) re-enters the live pytree shape
+    and is produced by the unchanged column providers."""
+
+    def __init__(self, rt, store, ent: dict):
+        self.rt = rt
+        self.store = store
+        self.ent = ent
+        self._data = None
+        self._state = None
+        self._dep = None
+        from gyeeta_tpu.utils.colcache import ColumnCache
+        self._cols = ColumnCache()        # per-snapshot memo (immutable
+        #                                   shard → version never bumps)
+
+    def _load(self) -> dict:
+        if self._data is None:
+            self._data = self.store.load(self.ent)
+        return self._data
+
+    def _unflatten(self, leaves, like):
+        ref_leaves, treedef = jax.tree_util.tree_flatten(like)
+        if len(leaves) != len(ref_leaves):
+            raise ValueError(
+                f"shard {self.ent['file']} has {len(leaves)} leaves, "
+                f"engine expects {len(ref_leaves)} — incompatible "
+                "geometry/version")
+        fixed = []
+        for arr, ref in zip(leaves, ref_leaves):
+            ref = np.asarray(ref)
+            if arr.shape != ref.shape:
+                raise ValueError(
+                    f"shard {self.ent['file']}: leaf shape {arr.shape} "
+                    f"!= engine {ref.shape}")
+            fixed.append(arr.astype(ref.dtype, copy=False))
+        return jax.tree_util.tree_unflatten(treedef, fixed)
+
+    @property
+    def state(self):
+        if self._state is None:
+            self._state = self._unflatten(self._load()["state"],
+                                          self.rt.state)
+        return self._state
+
+    @property
+    def dep(self):
+        if self._dep is None:
+            self._dep = self._unflatten(self._load()["dep"],
+                                        self.rt.dep)
+        return self._dep
+
+    def columns(self, subsys: str):
+        """The ``columns_fn`` contract of ``api.execute``."""
+        return self._cols.get(subsys, lambda: self._columns(subsys))
+
+    def _columns(self, subsys: str):
+        stored = self._load()["columns"]
+        if subsys in stored:
+            return stored[subsys]
+        if subsys == "svcsumm":
+            cols, live = self.columns("svcstate")
+            return api.svcsumm_from_svc(cols, live, self.rt.names)
+        if subsys == "topk":
+            rec = hist_recover(self.rt, self.state)
+            return api.heavy_topk_columns(
+                rec["flows"], svc=self.columns("svcstate"),
+                trace=self.columns("tracereq"))
+        rt = self.rt
+        if hasattr(rt, "_merged_columns_state"):   # ShardedRuntime
+            return rt._merged_columns_state(subsys, self.state,
+                                            self.dep, self._cols)
+        if subsys in api._COLUMNS_OF or subsys in api._DEP_COLUMNS_OF:
+            return api.columns_for(rt.cfg, self.state, subsys,
+                                   names=rt.names, dep=self.dep)
+        raise ValueError(
+            f"subsystem {subsys!r} is not available historically "
+            "(registry/CRUD-backed views are not shard-persisted)")
+
+
+class _WindowColumns:
+    """``columns_fn`` over a shard RANGE: per-entity aggregation for
+    relational subsystems, baseline-diffed recovery for ``topk``."""
+
+    def __init__(self, tv: "TimeView", ents: list, start: float,
+                 end: float):
+        self.tv = tv
+        self.ents = ents
+        self.start, self.end = start, end
+        self._memo: dict = {}
+
+    def columns(self, subsys: str):
+        got = self._memo.get(subsys)
+        if got is None:
+            got = self._memo[subsys] = self._columns(subsys)
+        return got
+
+    def _columns(self, subsys: str):
+        if subsys == "topk":
+            return self._topk_window()
+        parts = [self.tv.snap(e).columns(subsys) for e in self.ents]
+        return aggregate_window_columns(subsys, parts)
+
+    def _topk_window(self):
+        rt = self.tv.rt
+        end_snap = self.tv.snap(self.ents[-1])
+        rec_end = hist_recover(rt, end_snap.state)
+        base_ent = self.tv.store.resolve_at(self.start)
+        rows = [(rid, v, eb, "window")
+                for rid, v, eb, _src in rec_end["flows"]]
+        if base_ent is not None \
+                and base_ent["t1"] <= self.start \
+                and base_ent["tick1"] < self.ents[-1]["tick1"]:
+            rec_base = hist_recover(rt, self.tv.snap(base_ent).state)
+            base = {rid: (v, eb)
+                    for rid, v, eb, _s in rec_base["flows"]}
+            rows = []
+            for rid, v, eb, _src in rec_end["flows"]:
+                v0, eb0 = base.get(rid, (0.0, rec_base["err_term"]))
+                dv = v - v0
+                if dv <= 0:
+                    continue
+                rows.append((rid, dv, eb + eb0, "window"))
+            rows.sort(key=lambda r: (-r[1], r[0]))
+        # dense rankings (conns / errrate / p99resp) report the
+        # window-END snapshot — they are point-in-time gauges, not
+        # accumulating counts
+        return api.heavy_topk_columns(
+            rows, svc=end_snap.columns("svcstate"),
+            trace=end_snap.columns("tracereq"))
+
+
+class TimeView:
+    """``at=``/``window=`` request router bound to one runtime + shard
+    store. Materialized snapshots ride a small LRU so dashboard bursts
+    against the same instant pay the load once."""
+
+    MAX_SNAPS = 4
+
+    def __init__(self, rt, store, clock=None):
+        import time as _time
+        self.rt = rt
+        self.store = store
+        self._clock = clock or _time.time
+        self._snaps: collections.OrderedDict = collections.OrderedDict()
+
+    def snap(self, ent: dict) -> HistSnapshot:
+        key = ent["file"]
+        s = self._snaps.get(key)
+        if s is None:
+            s = HistSnapshot(self.rt, self.store, ent)
+            self._snaps[key] = s
+            while len(self._snaps) > self.MAX_SNAPS:
+                self._snaps.popitem(last=False)
+        else:
+            self._snaps.move_to_end(key)
+        return s
+
+    # ------------------------------------------------------------ query
+    def query(self, req: dict) -> dict:
+        req = dict(req)
+        at = req.pop("at", None)
+        window = req.pop("window", None)
+        tstart = req.pop("tstart", None)
+        tend = req.pop("tend", None)
+        opts = api.QueryOptions.from_json(req)
+        rt = self.rt
+        if at is not None:
+            ent = self.store.resolve_at(parse_when(at, self._clock()))
+            if ent is None:
+                raise ValueError("no history shards yet (compaction "
+                                 "has not emitted a window)")
+            snap = self.snap(ent)
+            out = api.execute(rt.cfg, None, opts, names=rt.names,
+                              columns_fn=snap.columns)
+            out["at"] = ent["t1"]
+            out["tick"] = ent["tick1"]
+            return out
+        newest = self.store.newest("raw") or (
+            self.store.shards()[-1] if self.store.shards() else None)
+        if newest is None:
+            raise ValueError("no history shards yet (compaction has "
+                             "not emitted a window)")
+        end = float(tend) if tend is not None else float(newest["t1"])
+        if window is not None:
+            start = end - parse_dur(window)
+        elif tstart is not None:
+            start = float(tstart)
+        else:
+            raise ValueError("historical query needs at=, window= or "
+                             "tstart/tend")
+        ents = self.store.resolve_window(start, end)
+        if not ents:
+            raise ValueError(
+                f"no history shards sample [{start}, {end}]")
+        win = _WindowColumns(self, ents, start, end)
+        out = api.execute(rt.cfg, None, opts, names=rt.names,
+                          columns_fn=win.columns)
+        out["window"] = [start, end]
+        out["shards"] = len(ents)
+        return out
+
+    def window_columns_for(self, subsys: str, window) -> tuple:
+        """Windowed (cols, mask) for alertdef evaluation — the
+        ``subsys@window`` column source realtime defs with a
+        ``window`` field reference (windowed aggregates as alert
+        criteria)."""
+        newest = self.store.newest("raw") or (
+            self.store.shards()[-1] if self.store.shards() else None)
+        if newest is None:
+            raise ValueError("no history shards yet")
+        end = float(newest["t1"])
+        start = end - parse_dur(window)
+        ents = self.store.resolve_window(start, end)
+        if not ents:
+            raise ValueError(
+                f"no history shards sample [{start}, {end}]")
+        return _WindowColumns(self, ents, start, end).columns(subsys)
+
+
+def route_historical(rt, req: dict) -> Optional[dict]:
+    """Shared three-edge routing (GYT binary, REST, stock NM): a
+    request carrying ``at``/``window`` goes to the shard tier; a
+    ``tstart``/``tend`` range goes to the relational history store
+    when one is configured (back-compat SQL semantics), else to the
+    shard tier. Returns None for live queries."""
+    historical = ("at" in req or "window" in req
+                  or "tstart" in req or "tend" in req)
+    if not historical:
+        return None
+    tv = getattr(rt, "timeview", None)
+    sql = getattr(rt, "history", None)
+    if "at" not in req and "window" not in req and sql is not None:
+        return None                   # caller's relational path serves it
+    if tv is None:
+        raise ValueError(
+            "time-travel query needs history shards (run with "
+            "--shard-dir / hist_shard_dir)")
+    with rt.stats.timeit("timeview_query"):
+        return tv.query(req)

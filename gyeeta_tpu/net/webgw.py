@@ -12,7 +12,10 @@ for identical queries (``tests/test_nmquery.py``):
 
 - ``POST /query``            — raw JSON query/CRUD/multiquery envelope
 - ``GET  /v1/<subsys>``      — convenience: query params ``filter``,
-  ``maxrecs``, ``sortcol``, ``sortdesc``, ``tstart``, ``tend``
+  ``maxrecs``, ``sortcol``, ``sortdesc``, ``tstart``, ``tend``, plus
+  the time-travel params ``at`` (pin a snapshot instant) and
+  ``window`` (trailing-duration aggregate) served from compaction
+  shards (``history/timeview.py``)
 - ``GET  /healthz``          — gateway + upstream liveness
 - ``GET  /metrics``          — Prometheus text-format exposition of the
   upstream server's self-metrics (the ``metrics`` query subsystem,
@@ -174,6 +177,12 @@ class WebGateway:
                 for k in ("tstart", "tend"):
                     if k in q:
                         req[k] = float(q[k][0])
+                # time-travel params (history/timeview.py): at= pins a
+                # snapshot instant ("1712000000", "-15m", "tick:24");
+                # window= aggregates a trailing duration ("15m", 900)
+                for k in ("at", "window"):
+                    if k in q:
+                        req[k] = q[k][0]
                 if "sortdesc" in q:
                     req["sortdesc"] = q["sortdesc"][0].lower() in (
                         "1", "true")

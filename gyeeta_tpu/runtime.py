@@ -129,6 +129,17 @@ class Runtime:
         self.alerts = AlertManager(self.cfg, clock=clock)
         self.history = (open_store(self.opts.history_db)
                         if self.opts.history_db else None)
+        # batched single-writer thread: run_tick renders snapshot rows
+        # (device readbacks stay on the fold thread) and ENQUEUES; a
+        # slow sqlite/pg write can no longer stall the tick loop.
+        # Read paths that need read-your-writes (db-mode alertdefs,
+        # historical SQL queries) call barrier() first.
+        self._histwriter = None
+        if self.history is not None:
+            from gyeeta_tpu.history.histwriter import HistoryWriter
+            self._histwriter = HistoryWriter(
+                self.history, stats=self.stats,
+                max_queue=self.opts.history_queue_max)
         self._clock = clock or time.time
         # write-ahead event journal (utils/journal.py): every accepted
         # event-stream chunk appends post-validation/pre-fold; recovery
@@ -145,6 +156,22 @@ class Runtime:
                 backlog_max_bytes=self.opts.journal_backlog_mb << 20,
                 stats=self.stats, clock=clock)
         self._journal_replaying = False
+        # time-travel query tier (history/timeview.py): at=/window=
+        # requests materialize compaction shards into transient engine
+        # snapshots served through the unchanged query path. The
+        # journal truncate floor starts at the compactor's durable
+        # position so checkpoints never delete unconsumed segments.
+        self.timeview = None
+        if self.opts.hist_shard_dir:
+            from gyeeta_tpu.history.shards import ShardStore
+            from gyeeta_tpu.history.timeview import TimeView
+            store = ShardStore(self.opts.hist_shard_dir,
+                               stats=self.stats)
+            self.timeview = TimeView(self, store, clock=clock)
+            if self.journal is not None:
+                pos = store.position()
+                self.journal.set_truncate_floor(
+                    int(pos[0]) if pos else 0)
         # per-host sweep-seq high-water marks (NOTIFY_SWEEP_SEQ): the
         # WAL dedup state — checkpointed, rebuilt by replay, echoed to
         # reconnecting agents so resend + replay never double-counts
@@ -885,44 +912,53 @@ class Runtime:
 
         if self.history and tick % self.opts.history_every_ticks == 0:
             now = self._clock()
+            # render on the fold thread (device readbacks), WRITE on
+            # the history writer thread (bounded queue, drop-oldest
+            # counted) — a slow sqlite/pg write can no longer stall
+            # run_tick (it used to be synchronous SQL in this loop)
             out = api.execute(self.cfg, self.state, api.QueryOptions(
                 subsys="svcstate", maxrecs=self.cfg.svc_capacity),
                 names=self.names)
-            self.history.write("svcstate", now, out["recs"])
             hout = api.execute(self.cfg, self.state, api.QueryOptions(
                 subsys="hoststate", maxrecs=self.cfg.n_hosts),
                 names=self.names)
-            self.history.write("hoststate", now, hout["recs"])
             cout = api.execute(self.cfg, self.state, api.QueryOptions(
                 subsys="clusterstate"))
-            self.history.write("clusterstate", now, cout["recs"])
             tout = api.execute(self.cfg, self.state, api.QueryOptions(
                 subsys="taskstate", maxrecs=self.cfg.task_capacity),
                 names=self.names)
-            self.history.write("taskstate", now, tout["recs"])
             mout = api.execute(self.cfg, self.state, api.QueryOptions(
                 subsys="cpumem", maxrecs=self.cfg.n_hosts),
                 names=self.names)
-            self.history.write("cpumem", now, mout["recs"])
             trout = api.execute(self.cfg, self.state, api.QueryOptions(
                 subsys="tracereq", maxrecs=self.cfg.api_capacity),
                 names=self.names)
-            self.history.write("tracereq", now, trout["recs"])
+            sweep = [("svcstate", now, out["recs"]),
+                     ("hoststate", now, hout["recs"]),
+                     ("clusterstate", now, cout["recs"]),
+                     ("taskstate", now, tout["recs"]),
+                     ("cpumem", now, mout["recs"]),
+                     ("tracereq", now, trout["recs"])]
             ncg = 0
             if len(self.cgroups):
                 cgout = api.execute(self.cfg, self.state, api.QueryOptions(
                     subsys="cgroupstate", maxrecs=100_000),
                     names=self.names, aux=self._aux)
-                self.history.write("cgroupstate", now, cgout["recs"])
+                sweep.append(("cgroupstate", now, cgout["recs"]))
                 ncg = cgout["nrecs"]
+            self._histwriter.write_sweep(sweep)
             report["history_rows"] = (
                 out["nrecs"] + hout["nrecs"] + tout["nrecs"]
                 + mout["nrecs"] + trout["nrecs"] + ncg + 1)
 
         # db-mode alertdefs run AFTER the history write so a due def sees
         # the snapshot from this very tick (ref: MDB alerts query the DB
-        # the madhava just wrote, server/gy_malerts.cc)
-        if self.history:
+        # the madhava just wrote, server/gy_malerts.cc). Only defs that
+        # actually read the store pay the writer-queue barrier.
+        if self.history and any(
+                ad.enabled and ad.mode == "db"
+                for ad in self.alerts.defs.values()):
+            self._histwriter.barrier()
             fired += self.alerts.check_db(self.history)
         report["alerts_fired"] = len(fired)
         for a in fired:
@@ -1027,7 +1063,17 @@ class Runtime:
         dispatch as api.execute so defs can target ANY live subsystem
         (device slabs, dep graph, or host-side registries). Routed
         through the snapshot cache: alert evaluation at tick time
-        PRE-WARMS the columns queries then reuse."""
+        PRE-WARMS the columns queries then reuse. A ``subsys@window``
+        name (an alertdef with a ``window`` field) evaluates against
+        the time-travel tier's windowed aggregate instead of the live
+        snapshot."""
+        if "@" in subsys:
+            base, _, win = subsys.partition("@")
+            if self.timeview is None:
+                raise ValueError(
+                    "windowed alertdef needs history shards "
+                    "(hist_shard_dir)")
+            return self.timeview.window_columns_for(base, win)
         return self._cached_columns(subsys)
 
     def _cached_columns(self, subsys: str):
@@ -1114,9 +1160,19 @@ class Runtime:
             return self._query(req)
 
     def _query(self, req: dict) -> dict:
+        # time-travel tier: at=/window= materialize snapshot shards
+        # (tstart/tend also route there when no relational store is
+        # configured) — shared three-edge routing, so GYT binary, REST
+        # and stock NM requests land on identical code paths
+        from gyeeta_tpu.history.timeview import route_historical
+        out = route_historical(self, req)
+        if out is not None:
+            return out
         if "tstart" in req or "tend" in req:
             if not self.history:
                 raise ValueError("no history store configured")
+            if self._histwriter is not None:
+                self._histwriter.barrier()   # read-your-writes
             now = self._clock()
             if req.get("aggr"):
                 recs = self.history.aggr_query(
@@ -1146,6 +1202,8 @@ class Runtime:
         self.dns.close()
         if self.journal is not None:
             self.journal.close()      # fsync + close (idempotent)
+        if self._histwriter is not None:
+            self._histwriter.close()  # drain queued sweeps first
         if self.history is not None:
             try:
                 self.history.db.close()

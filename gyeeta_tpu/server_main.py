@@ -48,6 +48,14 @@ class Daemon:
                else {}),
             **({"journal_segment_mb": args.journal_segment_mb}
                if getattr(args, "journal_segment_mb", None) is not None
+               else {}),
+            **({"hist_shard_dir": args.shard_dir}
+               if getattr(args, "shard_dir", None) else {}),
+            **({"hist_window_ticks": args.hist_window_ticks}
+               if getattr(args, "hist_window_ticks", None) is not None
+               else {}),
+            **({"hist_compact_interval_s": args.compact_interval}
+               if getattr(args, "compact_interval", None) is not None
                else {}))
         # a crash mid-`checkpoint.save` leaves .tmp.npz staging files
         # behind; without a start-time sweep they accumulate forever
@@ -87,6 +95,19 @@ class Daemon:
                              throttle_pending_mb=getattr(
                                  args, "throttle_pending_mb", 32.0))
         self._hot = C.HotReload(args.config, opts) if args.config else None
+        # history compaction daemon: sealed WAL segments → columnar
+        # snapshot shards (the time-travel tier's writer). Runs only
+        # with BOTH a journal (the source) and a shard dir (the sink).
+        self.compactor = None
+        if opts.hist_shard_dir and self.rt.journal is not None:
+            from gyeeta_tpu.history.compactor import Compactor
+            self.compactor = Compactor(self.rt.cfg, opts,
+                                       journal=self.rt.journal,
+                                       stats=self.rt.stats)
+        elif opts.hist_shard_dir:
+            log.warning("--shard-dir set without --journal-dir: the "
+                        "WAL is the history source — time-travel "
+                        "queries will serve existing shards only")
         self.stop_event = asyncio.Event()
 
     async def run(self) -> None:
@@ -116,6 +137,12 @@ class Daemon:
             watchdog.beat()
             watchdog.start()
             self.srv.watchdog = watchdog
+        if self.compactor is not None:
+            self.compactor.start()
+            log.info("history compactor: window=%d ticks, every %.0fs "
+                     "-> %s", self.rt.opts.hist_window_ticks,
+                     self.rt.opts.hist_compact_interval_s,
+                     self.rt.opts.hist_shard_dir)
         stats_task = asyncio.create_task(self._stats_loop())
         try:
             await self.stop_event.wait()
@@ -181,6 +208,14 @@ class Daemon:
         of the reference's init proc). A clean shutdown therefore
         leaves an EMPTY WAL window: the respawn replays zero chunks."""
         log.info("shutting down: draining staged slabs")
+        if self.compactor is not None:
+            # final pass BEFORE the journal closes: seal + compact the
+            # shutdown window so a clean stop leaves history current
+            try:
+                self.compactor.compact_once(seal=True)
+            except Exception:     # noqa: BLE001 — never block shutdown
+                log.exception("final compaction pass failed")
+            self.compactor.close()
         await self.srv.stop()          # closes rt (journal fsync+close)
         self.rt.flush()
         if self.rt.opts.checkpoint_dir:
@@ -343,6 +378,20 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--throttle-pending-mb", type=float, default=32.0,
                     help="unsynced WAL bytes that trip the trace-feed "
                     "throttle")
+    # time-travel history tier: WAL compaction → columnar snapshot
+    # shards + at=/window= queries (OPERATIONS.md "History & time
+    # travel"; GYT_HIST_* env knobs cover the rest)
+    ap.add_argument("--shard-dir",
+                    help="snapshot-shard directory: enables the "
+                    "time-travel query tier; with --journal-dir a "
+                    "compaction daemon rolls sealed WAL segments into "
+                    "per-window columnar shards")
+    ap.add_argument("--hist-window-ticks", type=int, default=None,
+                    help="raw shard window in 5s ticks (default 12 = "
+                    "1m time-travel resolution)")
+    ap.add_argument("--compact-interval", type=float, default=None,
+                    help="compaction daemon cadence in seconds "
+                    "(default 30)")
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
 

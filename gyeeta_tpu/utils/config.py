@@ -95,6 +95,30 @@ class RuntimeOpts(NamedTuple):
     #                                         wire outran the disk; the
     #                                         admission controller
     #                                         throttles before this)
+    # ---- history tier: relational-writer offload + time-travel shards
+    # (OPERATIONS.md "History & time travel"; env knobs GYT_HIST_*)
+    history_queue_max: int = 64             # bounded sweep queue of the
+    #                                         single-writer history
+    #                                         thread; overflow drops the
+    #                                         OLDEST sweep, counted —
+    #                                         a slow DB can no longer
+    #                                         stall run_tick
+    hist_shard_dir: Optional[str] = None    # snapshot-shard directory:
+    #                                         enables the time-travel
+    #                                         query tier (at=/window=
+    #                                         on every edge). None=off.
+    hist_window_ticks: int = 12             # raw shard window (1m at 5s
+    #                                         ticks) — the time-travel
+    #                                         resolution of the raw tier
+    hist_mid_every: int = 15                # raws per mid shard (15m)
+    hist_hour_every: int = 4                # mids per hour shard (1h)
+    hist_retain_raw: int = 60               # raw shards kept before
+    #                                         downsampling to mid (1h
+    #                                         of 1m windows by default)
+    hist_retain_mid: int = 96               # mid shards kept (24h)
+    hist_retain_hour: int = 168             # hour shards kept (7d),
+    #                                         older DROP
+    hist_compact_interval_s: float = 30.0   # compaction daemon cadence
 
 
 def _coerce(key: str, v: Any):

@@ -113,6 +113,12 @@ class Journal:
         self._oldest_unsynced_t: Optional[float] = None
         self._closing = False
         self._sync_req = False            # a blocking fsync() waits on it
+        self._seal_req = False            # a blocking seal_active() waits
+        # compaction handoff: segments >= this floor are NEVER truncated
+        # even when a checkpoint supersedes them — the history compactor
+        # still has to consume them into snapshot shards. None = no
+        # compactor registered (the pre-history behavior).
+        self._truncate_floor: Optional[int] = None
         self._worker = threading.Thread(
             target=self._writer_loop, name="gyt-wal-writer", daemon=True)
         self._worker.start()
@@ -226,19 +232,29 @@ class Journal:
                                   - (self._clock()
                                      - (self._oldest_unsynced_t or 0)))
                 if not self._q and not self._closing \
-                        and not self._sync_req and not self._sync_due():
+                        and not self._sync_req and not self._seal_req \
+                        and not self._sync_due():
                     self._cv.wait(timeout=timeout)
                 batch = list(self._q)
                 self._q.clear()
                 self._q_bytes = 0
                 closing = self._closing
                 sync_req = self._sync_req
+                seal_req = self._seal_req
             for t, hid, tick, cid, buf in batch:
                 self._write_one(t, hid, tick, cid, buf)
+            if seal_req and self._off > len(MAGIC):
+                # compaction handoff: rotate so the current segment
+                # becomes sealed (immutable) and readable by the
+                # compactor; an empty active segment needs no rotation
+                self._rotate()
             if (sync_req or closing or self._sync_due()) \
                     and self._unsynced_bytes:
                 self._sync_now()
             with self._cv:
+                if seal_req and not self._q:
+                    self._seal_req = False
+                    self._cv.notify_all()
                 if sync_req and not self._q:
                     self._sync_req = False
                     self._cv.notify_all()
@@ -309,6 +325,38 @@ class Journal:
             while self._sync_req and self._worker.is_alive():
                 self._cv.wait(timeout=0.05)
 
+    def seal_active(self) -> int:
+        """Rotate the active segment so every byte appended so far sits
+        in a SEALED (immutable) segment the history compactor can
+        consume (``history/compactor.py``). Blocking, like
+        :meth:`fsync`. No-op on an empty active segment or a closed
+        journal. Returns the first sealed-segment bound afterwards
+        (the new active seq — sealed segments are all ``< seq``)."""
+        if self._f is None:
+            return self._seq
+        with self._cv:
+            if not self._worker.is_alive():       # pragma: no cover
+                return self._seq
+            self._seal_req = True
+            self._cv.notify_all()
+            while self._seal_req and self._worker.is_alive():
+                self._cv.wait(timeout=0.05)
+        return self._seq
+
+    def sealed_upto(self) -> int:
+        """Exclusive upper bound of sealed segments (the active seq);
+        the compactor never reads at/after it while the writer lives."""
+        return self._seq
+
+    def set_truncate_floor(self, seq: int) -> None:
+        """Register the compactor's position: segments >= ``seq`` are
+        held back from checkpoint truncation until the compactor has
+        rolled them into snapshot shards (the seal/handoff half of the
+        history tier). Monotone — a floor never moves backwards."""
+        cur = self._truncate_floor
+        self._truncate_floor = int(seq) if cur is None \
+            else max(cur, int(seq))
+
     # ----------------------------------------------------------- position
     def position(self) -> tuple[int, int]:
         """(segment_seq, byte_offset) of the DURABLE end. Call
@@ -344,10 +392,18 @@ class Journal:
     # ----------------------------------------------------------- truncate
     def truncate_upto(self, seg_seq: int) -> int:
         """Delete segments wholly older than ``seg_seq`` (the newest
-        durable checkpoint's segment). Returns segments deleted."""
+        durable checkpoint's segment). When a history compactor has
+        registered a truncate floor, segments it has not consumed yet
+        are held back regardless of checkpoint position (otherwise a
+        checkpoint cadence faster than the compaction cadence would
+        silently punch holes in the history). Returns segments
+        deleted."""
+        bound = int(seg_seq)
+        if self._truncate_floor is not None:
+            bound = min(bound, self._truncate_floor)
         n = 0
         for s in self.segments():
-            if s >= int(seg_seq) or s == self._seq:
+            if s >= bound or s == self._seq:
                 continue
             try:
                 self._segpath(s).unlink()
@@ -388,22 +444,9 @@ class Journal:
 
     def _read_segment(self, path: pathlib.Path, off: int
                       ) -> Iterator[tuple[int, int, int, bytes]]:
-        with open(path, "rb") as f:
-            if f.read(len(MAGIC)) != MAGIC:
-                raise ValueError(f"{path}: not a GYTWAL01 journal")
-            f.seek(off)
-            while True:
-                hdr = f.read(_WHDR.size)
-                if len(hdr) < _WHDR.size:
-                    if hdr:
-                        self.stats.bump("wal_torn_tail_read")
-                    return
-                _t, n, hid, tick, cid = _WHDR.unpack(hdr)
-                chunk = f.read(n)
-                if len(chunk) < n:          # torn mid-payload
-                    self.stats.bump("wal_torn_tail_read")
-                    return
-                yield hid, tick, cid, chunk
+        for _nxt, _t, hid, tick, cid, chunk in read_entries(
+                path, off, self.stats):
+            yield hid, tick, cid, chunk
 
     # -------------------------------------------------------------- close
     def close(self) -> None:
@@ -433,6 +476,89 @@ class Journal:
         self._worker.join(timeout=10.0)
         self._f.close()
         self._f = None
+
+
+# ---------------------------------------------------- sealed-segment read
+# Position-yielding walkers over WAL segment FILES, usable without a
+# live Journal instance (the history compactor reads sealed segments of
+# the serving process's journal dir, and the offline `gyeeta_tpu
+# compact` CLI reads a dir no process owns). Sealed segments are
+# immutable, so no locking against the writer thread is needed.
+
+def dir_segments(path) -> list[int]:
+    """Segment sequence numbers in a journal dir, ascending."""
+    out = []
+    for p in pathlib.Path(path).glob(_SEG_GLOB):
+        try:
+            out.append(int(p.stem.split("_")[-1]))
+        except ValueError:
+            continue
+    return sorted(out)
+
+
+def read_entries(path, off: int = len(MAGIC), stats=None
+                 ) -> Iterator[tuple[int, float, int, int, int, bytes]]:
+    """Walk one segment file from byte ``off``, yielding
+    ``(next_off, t_epoch, hid, tick, conn_id, chunk)`` — the
+    position-carrying form the compactor needs to record a resumable
+    manifest position (and the append timestamps that become shard
+    wall-time ranges). A torn tail ends the walk cleanly (counted when
+    ``stats``)."""
+    path = pathlib.Path(path)
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not a GYTWAL01 journal")
+        f.seek(off)
+        while True:
+            hdr = f.read(_WHDR.size)
+            if len(hdr) < _WHDR.size:
+                if hdr and stats is not None:
+                    stats.bump("wal_torn_tail_read")
+                return
+            t, n, hid, tick, cid = _WHDR.unpack(hdr)
+            chunk = f.read(n)
+            if len(chunk) < n:          # torn mid-payload
+                if stats is not None:
+                    stats.bump("wal_torn_tail_read")
+                return
+            off += _WHDR.size + n
+            yield off, t / 1e6, hid, tick, cid, chunk
+
+
+def read_sealed(path, pos: Optional[tuple] = None,
+                upto_seq: Optional[int] = None, stats=None
+                ) -> Iterator[tuple]:
+    """Walk a journal dir's SEALED segments from ``pos``
+    (``(seg_seq, byte_off)``; None = the very beginning), yielding
+    ``(seg_seq, next_off, t_epoch, hid, tick, conn_id, chunk)``.
+
+    ``upto_seq`` excludes the live writer's active segment (pass
+    ``journal.sealed_upto()``); None reads every segment — only safe
+    when no writer owns the dir (offline compaction / closed journal).
+    A position whose segment was truncated away resumes at the oldest
+    surviving segment, counted (``wal_position_gap``)."""
+    segs = dir_segments(path)
+    if upto_seq is not None:
+        segs = [s for s in segs if s < int(upto_seq)]
+    if not segs:
+        return
+    if pos is None:
+        start_seq, start_off = segs[0], len(MAGIC)
+    else:
+        start_seq, start_off = int(pos[0]), int(pos[1])
+    if start_seq not in segs and segs[0] > start_seq:
+        if stats is not None:
+            stats.bump("wal_position_gap")
+        start_seq, start_off = segs[0], len(MAGIC)
+    d = pathlib.Path(path)
+    for s in segs:
+        if s < start_seq:
+            continue
+        off = start_off if s == start_seq else len(MAGIC)
+        seg = d / _SEG_FMT.format(s)
+        for nxt, t, hid, tick, cid, chunk in read_entries(seg, off,
+                                                          stats):
+            yield s, nxt, t, hid, tick, cid, chunk
 
 
 # ------------------------------------------------------- runtime helpers

@@ -87,7 +87,10 @@ def test_webhook_delivery_end_to_end(hook_server):
     assert obj["alerts"] and obj["alerts"][0]["subsys"] == "svcstate"
     # the row travelled as JSON-safe values
     assert isinstance(obj["alerts"][0]["row"], dict)
-    assert rt.alerts.dispatcher.stats["delivered"] >= 1
+    # the handler records the payload BEFORE its 200 reaches the
+    # dispatcher, which bumps `delivered` only after the POST returns
+    # — poll, don't race it on a loaded box
+    assert _wait(lambda: rt.alerts.dispatcher.stats["delivered"] >= 1)
 
 
 def test_retry_then_success(hook_server):

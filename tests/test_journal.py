@@ -112,6 +112,43 @@ def test_journal_rotation_and_truncate_upto(tmp_path):
     j.close()
 
 
+def test_journal_seal_floor_and_sealed_reads(tmp_path):
+    """The compaction handoff (ISSUE 8): seal_active rotates so every
+    appended byte sits in an immutable segment; a registered truncate
+    floor holds unconsumed segments back from checkpoint truncation;
+    read_sealed walks positions resumably and never touches the active
+    segment."""
+    st = Stats()
+    j = Journal(tmp_path / "wal", fsync_bytes=1 << 30, stats=st)
+    j.append(b"a" * 100, hid=1, tick=1)
+    j.append(b"b" * 100, hid=2, tick=2)
+    assert j.seal_active() == 1            # rotated: 0 is sealed now
+    assert j.sealed_upto() == 1
+    j.append(b"c" * 100, hid=3, tick=3)    # lands in the ACTIVE segment
+    j.fsync()
+    got = list(J.read_sealed(tmp_path / "wal", None, j.sealed_upto()))
+    assert [g[3] for g in got] == [1, 2]   # hid; active seg excluded
+    assert got[0][0] == 0 and got[1][1] > got[0][1]   # seq + offsets
+    # resume from the recorded mid-segment position → only chunk 2
+    pos = (got[0][0], got[0][1])
+    rest = list(J.read_sealed(tmp_path / "wal", pos, j.sealed_upto()))
+    assert [g[3] for g in rest] == [2]
+    # floor: a checkpoint "past" the sealed segment cannot delete it
+    # until the compactor has consumed it
+    j.set_truncate_floor(0)
+    assert j.truncate_upto(j.position()[0]) == 0
+    assert 0 in j.segments()
+    j.set_truncate_floor(1)                # compactor consumed seg 0
+    assert j.truncate_upto(j.position()[0]) == 1
+    assert 0 not in j.segments()
+    j.set_truncate_floor(0)                # floors never move backward
+    assert j._truncate_floor == 1
+    # sealing an empty active segment is a no-op (no rotation storm)
+    seq = j.seal_active()
+    assert j.seal_active() == seq
+    j.close()
+
+
 # ---------------------------------------------- Runtime feed → WAL → replay
 def test_runtime_wal_replay_equals_direct_fold(tmp_path):
     sim = ParthaSim(n_hosts=2, n_svcs=2, seed=3)

@@ -35,6 +35,16 @@ CFG = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
 PARITY_SUBSYS = ("svcstate", "hoststate", "taskstate", "flowstate",
                  "alerts", "svcsumm", "topk")
 
+# time-travel parity requests (ISSUE 8): an at=-pinned svcstate and a
+# windowed topk must render byte-equal on the NM and REST edges —
+# tstart/tend on QUERY_WEB_JSON rides the same time-windowed path
+PARITY_HIST = (
+    {"subsys": "svcstate", "at": "tick:4", "maxrecs": 50},
+    {"subsys": "topk", "window": "1h", "maxrecs": 50},
+    {"subsys": "hoststate", "tstart": 0.0, "tend": 4.0e9,
+     "maxrecs": 50},
+)
+
 
 # ------------------------------------------------------- envelope units
 def test_web_json_envelope_translation():
@@ -272,6 +282,86 @@ def test_nm_edge_end_to_end_sharded():
         _assert_scenario(out)
     finally:
         srt.close()
+
+
+def test_nm_rest_time_travel_parity(tmp_path):
+    """ISSUE 8 satellite: QUERY_WEB_JSON requests carrying at=/window=
+    (and stock tstart/tend) route through the same time-windowed shard
+    path as REST — byte-equal responses for an at=-pinned svcstate and
+    a windowed topk, every topk row bound-annotated."""
+    from gyeeta_tpu.history.compactor import Compactor
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    opts = RuntimeOpts(journal_dir=str(tmp_path / "wal"),
+                       hist_shard_dir=str(tmp_path / "shards"),
+                       hist_window_ticks=2,
+                       dep_pair_capacity=1024, dep_edge_capacity=512)
+    rt = Runtime(CFG, opts)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=7)
+    rt.feed(sim.name_frames())
+    for _ in range(4):
+        rt.feed(sim.conn_frames(256) + sim.resp_frames(512)
+                + sim.listener_frames() + sim.task_frames()
+                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                    sim.host_state_records()))
+        rt.run_tick()
+    comp = Compactor(CFG, opts, journal=rt.journal, stats=rt.stats)
+    rep = comp.compact_once(seal=True, upto_tick=rt._tick_no)
+    assert rep["windows"] == 2
+
+    async def scenario():
+        from gyeeta_tpu.net import GytServer
+        from gyeeta_tpu.net.webgw import WebGateway
+        from gyeeta_tpu.sim.nodeweb import NodeWebSim
+
+        srv = GytServer(rt, tick_interval=None)
+        host, port = await srv.start()
+        gw = WebGateway(host, port)
+        gh, gp = await gw.start()
+
+        async def rest_query(req: dict) -> bytes:
+            reader, writer = await asyncio.open_connection(gh, gp)
+            body = json.dumps(req).encode()
+            writer.write(
+                b"POST /query HTTP/1.1\r\nHost: t\r\n"
+                b"Connection: close\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body)
+            await writer.drain()
+            raw = await reader.read(-1)
+            writer.close()
+            head, _, rbody = raw.partition(b"\r\n\r\n")
+            assert b" 200 " in head.splitlines()[0], head
+            return rbody
+
+        nw = NodeWebSim()
+        await nw.connect(host, port)
+        out = []
+        for req in PARITY_HIST:
+            # NM: the reference envelope carries the time params in
+            # options; REST: the same dict over POST /query
+            nm_obj = await nw.request(
+                2, {"qtype": req["subsys"],
+                    "options": {k: v for k, v in req.items()
+                                if k != "subsys"}})
+            rest_raw = await rest_query(req)
+            out.append((req, nm_obj, rest_raw))
+        await nw.close()
+        await gw.stop()
+        await srv.stop()
+        return out
+
+    results = asyncio.run(scenario())
+    for req, nm_obj, rest_raw in results:
+        assert json.dumps(nm_obj).encode() == rest_raw, \
+            f"NM != REST for {req}"
+        assert nm_obj["nrecs"] > 0, req
+    at_sv, win_tk, _hist = results
+    assert at_sv[1]["tick"] == 4
+    assert all("errbound" in r and "source" in r
+               for r in win_tk[1]["recs"])
+    comp.close()
+    rt.close()
 
 
 def test_nm_handshake_version_gates():
