@@ -44,9 +44,10 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 PHASE_TIMEOUT = {"fold_toy": 1500, "fold_ns": 2700,
                  "feed_toy": 900, "feed_ns": 1500,
                  "feed_toy_wal": 900, "topk_recover": 900,
-                 "compact": 1200}
+                 "compact": 1200, "timeview_aggr": 900}
 PHASE_ORDER = ("fold_toy", "fold_ns", "feed_ns", "feed_toy",
-               "feed_toy_wal", "topk_recover", "compact")
+               "feed_toy_wal", "topk_recover", "compact",
+               "timeview_aggr")
 
 
 def _geometry(which: str):
@@ -529,6 +530,58 @@ def _bench_compact(cfg, sim, dep_pairs: int, dep_edges: int) -> dict:
     return out
 
 
+def _bench_timeview_aggr() -> dict:
+    """Windowed COLUMN aggregation, old vs new (ISSUE 9 satellite /
+    ROADMAP history item (a)): the keyed python loop vs the np.unique
+    + segment-sum vectorization, on a synthetic 100k-entity svcstate
+    window (3 shard samples, ~30% per-sample churn). Parity is
+    asserted here too — a fast wrong answer is no answer."""
+    import numpy as np
+
+    from gyeeta_tpu.history import timeview as TV
+
+    rng = np.random.default_rng(17)
+    n_ent, n_parts = 100_000, 3
+    ids = np.array([f"{i:016x}" for i in range(n_ent)], object)
+    names = np.array([f"svc-{i % 997}" for i in range(n_ent)], object)
+    parts = []
+    for _ in range(n_parts):
+        cols = {
+            "svcid": ids, "svcname": names,
+            "qps5s": rng.uniform(0, 100, n_ent),
+            "nqry5s": rng.uniform(0, 500, n_ent),
+            "nconns": rng.integers(0, 50, n_ent).astype(np.float64),
+            "sererr": rng.uniform(0, 5, n_ent),
+            "state": rng.integers(0, 5, n_ent).astype(np.int32),
+            "hostid": (np.arange(n_ent) % 1024).astype(np.float64),
+        }
+        parts.append((cols, rng.uniform(size=n_ent) > 0.3))
+
+    t0 = time.perf_counter()
+    ref, rmask = TV.aggregate_window_columns_ref("svcstate", parts)
+    ref_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got, gmask = TV.aggregate_window_columns("svcstate", parts)
+    vec_s = time.perf_counter() - t0
+    for c in ref:
+        if ref[c].dtype == object:
+            assert got[c].tolist() == ref[c].tolist(), c
+        else:
+            assert np.array_equal(got[c], ref[c]), c
+    out = {
+        "entities": int(len(rmask)),
+        "rows_aggregated": int(sum(int(p[1].sum()) for p in parts)),
+        "ref_loop_s": round(ref_s, 3),
+        "vectorized_s": round(vec_s, 3),
+        "speedup": round(ref_s / max(vec_s, 1e-9), 1),
+    }
+    print(f"bench[timeview_aggr]: {out['rows_aggregated']} rows → "
+          f"{out['entities']} entities: loop {ref_s:.2f}s vs "
+          f"vectorized {vec_s:.3f}s (x{out['speedup']})",
+          file=sys.stderr, flush=True)
+    return out
+
+
 def _run_phase(phase: str) -> dict:
     """Leaf mode: run ONE phase in-process and return its fields."""
     import jax
@@ -565,6 +618,8 @@ def _run_phase(phase: str) -> dict:
     if phase == "compact":
         cfg, sim, dp, de = _geometry("toy")
         return _bench_compact(cfg, sim, dp, de)
+    if phase == "timeview_aggr":
+        return _bench_timeview_aggr()
     raise SystemExit(f"unknown phase {phase!r}")
 
 
@@ -704,9 +759,32 @@ def _orchestrate(platform: str | None, degraded: bool,
         if "rate" in ns:
             result["compact"]["replay_vs_ns_fold"] = round(
                 cp["replay_ev_per_sec"] / ns["rate"], 4)
+    tv = phases.get("timeview_aggr", {})
+    if "speedup" in tv:
+        # windowed-aggregation vectorization row (ISSUE 9 satellite):
+        # keyed python loop vs np.unique segment sums at 100k entities
+        result["timeview_aggr"] = dict(tv)
+    # snapshot-serving contract row (ISSUE 9): embed the concurrent
+    # phase summary from the most recent _querylat.py artifact — the
+    # orchestrator only READS the json (never imports the engine)
+    for art in ("QUERYLAT_r06.json",):
+        try:
+            with open(os.path.join(HERE, art)) as f:
+                conc = json.load(f).get("concurrent")
+        except (OSError, ValueError):
+            conc = None
+        if conc:
+            result["querylat_concurrent"] = {
+                k: conc[k] for k in (
+                    "qps", "p50_ms", "p99_ms", "cache_hit_rate",
+                    "snapshot_age_p99_s", "feed_impact_ratio",
+                    "queries_shed", "meets_target")
+                if k in conc}
+            result["querylat_concurrent"]["artifact"] = art
     failed = [p for p, v in phases.items()
               if "rate" not in v and "recover_ms_per_tick" not in v
-              and "replay_ev_per_sec" not in v]
+              and "replay_ev_per_sec" not in v
+              and "speedup" not in v]
     if failed:
         result["phases_failed"] = failed
     print(json.dumps(result))

@@ -65,6 +65,16 @@ if ! JAX_PLATFORMS=cpu python _hist_smoke.py; then
     exit 1
 fi
 
+# Snapshot-serving QPS smoke: boot a TICKING server + REST gateway,
+# feed from a NetAgent while 8 concurrent clients hammer svcstate/
+# topk/hoststate — asserts non-empty single-tick-consistent rows,
+# nonzero result-cache hits, and zero sheds at smoke load.
+echo "ci: snapshot query-serving QPS smoke" >&2
+if ! JAX_PLATFORMS=cpu python _qps_smoke.py; then
+    echo "ci: FATAL — QPS smoke failed" >&2
+    exit 1
+fi
+
 # Chaos smoke: a REAL `serve` subprocess behind the seeded chaos proxy
 # (sim/chaos.py) — corruption/disconnect faults, a slow-loris conn,
 # one SIGTERM kill + --restore-latest restart. Fails on agent exit,

@@ -111,7 +111,11 @@ class AlertManager:
         self._clock = clock or time.time
         self.stats = {"nchecks": 0, "nfired": 0, "nsilenced": 0,
                       "ninhibited": 0, "nresolved": 0, "ndbchecks": 0,
-                      "ngroups_flushed": 0}
+                      "ngroups_flushed": 0,
+                      # windowed defs checked before the first history
+                      # window exists skip COUNTED (check() bumps this;
+                      # it must pre-exist or the += KeyErrors)
+                      "nwindow_skipped": 0}
 
     # ------------------------------------------------------------- CRUD
     def add_def(self, d: dict | AlertDef) -> AlertDef:

@@ -301,7 +301,11 @@ def _cmd_nm(argv) -> None:
             for sub in args.subsys.split(","):
                 sub = sub.strip()
                 try:
-                    out = await nw.query_web(sub, maxrecs=1)
+                    # strong: the probe checks the LIVE wire+engine
+                    # path end to end (the snapshot default would
+                    # serve a possibly-empty boot-time view)
+                    out = await nw.query_web(sub, maxrecs=1,
+                                             consistency="strong")
                     print(f"  {sub:<14} ok  nrecs={out.get('nrecs')}",
                           file=sys.stderr)
                 except NMError as e:

@@ -120,11 +120,17 @@ async def _read_nm_frame(reader) -> tuple[int, bytes]:
     return int(hdr["data_type"]), body[: len(body) - pad]
 
 
-def _route(rt, qtype: int, obj: dict) -> dict:
+async def _route(server, qtype: int, obj: dict) -> dict:
     """One NM request → the shared engine path. Raises ValueError on
-    envelope errors (caught into an error response by the loop)."""
+    envelope errors (caught into an error response by the loop).
+    QUERY_WEB_JSON rides ``server.run_query`` — the same snapshot +
+    off-loop executor routing as the GYT and REST edges, so NM/REST
+    parity holds through the snapshot path by construction; CRUD
+    mutates live structures and stays inline."""
+    rt = server.rt
     if qtype == RQ.REF_QUERY_WEB_JSON:
-        return rt.query(RQ.web_json_to_query(obj))
+        return await server.run_query(RQ.web_json_to_query(obj))
+    server._feed_barrier()
     if qtype == RQ.REF_CRUD_GENERIC_JSON:
         return rt.crud(RQ.crud_to_request(obj, alert=False))
     if qtype == RQ.REF_CRUD_ALERT_JSON:
@@ -173,14 +179,17 @@ async def _query_loop(server, reader, writer, st: NMConnState) -> None:
             continue
         outstanding += 1
         try:
-            server._feed_barrier()
             with rt.stats.timeit(f"nm_{verb}"):
-                out = _route(rt, qtype, obj)
+                out = await _route(server, qtype, obj)
         except Exception as e:
+            from gyeeta_tpu.net.qexec import Overloaded
             outstanding -= 1
             rt.stats.bump("nm_query_errors")
+            # shed → 503 (counted in gyt_queries_shed_total), envelope
+            # errors → 400; either way the conn and loop stay live
+            code = 503 if isinstance(e, Overloaded) else 400
             writer.write(RQ.encode_response_frames(
-                seqid, {"error": str(e), "errcode": 400},
+                seqid, {"error": str(e), "errcode": code},
                 RQ.REF_RESP_ERROR))
             await writer.drain()
             continue

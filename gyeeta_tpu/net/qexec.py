@@ -1,0 +1,86 @@
+"""Off-loop query executor: bounded worker pool + admission control.
+
+Every query edge (GYT binary, REST gateway, stock NM) used to execute
+inline on the asyncio event loop — the same loop that drains agent
+sockets into ``Runtime.feed``. A dashboard fleet therefore stalled the
+fold and the fold stalled query p99. With snapshot serving
+(``query/snapshot.py``) a live query never touches the fold, so it can
+leave the loop entirely: :class:`QueryExecutor` runs it on a bounded
+``ThreadPoolExecutor`` (snapshot reads are thread-safe — frozen device
+buffers + GIL-shared result caches), and sheds with a COUNTED overload
+error once the in-flight window fills, instead of wedging the loop
+behind an unbounded queue (``gyt_queries_shed_total``; the reference's
+L2 pools bound their MPMC queues the same way,
+``server/gy_mconnhdlr.h:53-75``).
+
+Knobs (env, read at construction; also settable via ``serve`` flags):
+
+- ``GYT_QUERY_WORKERS``    — pool width (default 4)
+- ``GYT_QUERY_QUEUE_MAX``  — max in-flight (queued + running) before
+  shedding (default 128)
+- ``GYT_QUERY_SNAPSHOT``   — 0 routes the serving edges back to inline
+  strong-consistency execution (the pre-snapshot behavior; the
+  escape hatch)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+from typing import Optional
+
+
+class Overloaded(Exception):
+    """Admission control shed: the in-flight query window is full.
+    The serving edge answers a counted busy/overload error; the loop
+    (and the fold) stay live."""
+
+
+def snapshot_serving_enabled(env=None) -> bool:
+    env = os.environ if env is None else env
+    return str(env.get("GYT_QUERY_SNAPSHOT", "1")).strip().lower() \
+        not in ("0", "false", "no")
+
+
+class QueryExecutor:
+    def __init__(self, rt, workers: Optional[int] = None,
+                 queue_max: Optional[int] = None):
+        env = os.environ
+        self.rt = rt
+        self.workers = int(workers if workers is not None
+                           else env.get("GYT_QUERY_WORKERS", "4"))
+        self.queue_max = int(queue_max if queue_max is not None
+                             else env.get("GYT_QUERY_QUEUE_MAX", "128"))
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, self.workers),
+            thread_name_prefix="gyt-query")
+        self._inflight = 0
+
+    # -------------------------------------------------------------- run
+    async def run(self, req: dict) -> dict:
+        """Admit one query and execute it on the pool with
+        ``consistency=snapshot`` forced — or raise :class:`Overloaded`
+        (counted) when the in-flight window is full. The caller holds
+        the event loop; the query holds a worker thread."""
+        stats = self.rt.stats
+        if self._inflight >= self.queue_max:
+            stats.bump("queries_shed")
+            raise Overloaded(
+                f"query queue full ({self._inflight} in flight, "
+                f"max {self.queue_max})")
+        self._inflight += 1
+        stats.gauge("query_queue_depth", float(self._inflight))
+        try:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                self._pool, self._call, req)
+        finally:
+            self._inflight -= 1
+            stats.gauge("query_queue_depth", float(self._inflight))
+
+    def _call(self, req: dict) -> dict:
+        return self.rt.query({**req, "consistency": "snapshot"})
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -64,7 +64,10 @@ class GytServer:
                  throttle_hold_ms: int = 1500,
                  throttle_lag_s: float = 0.75,
                  throttle_pending_mb: float = 32.0,
-                 throttle_slab_frac: float = 0.85):
+                 throttle_slab_frac: float = 0.85,
+                 query_workers: Optional[int] = None,
+                 query_queue_max: Optional[int] = None,
+                 query_snapshot: Optional[bool] = None):
         self.rt = rt
         self.host = host
         self.port = port
@@ -158,6 +161,19 @@ class GytServer:
         # conn identity per (hostname, port) + live-conn gauge
         self._nm_idents: dict[tuple, object] = {}
         self._nm_conns_live = 0
+        # ---- snapshot-isolated query serving (query/snapshot.py +
+        # net/qexec.py): live queries on ANY edge default to reading
+        # the last published per-tick snapshot on a bounded worker
+        # pool — the fold never waits on a dashboard and a dashboard
+        # never waits on the fold. CRUD, multiquery, historical SQL
+        # and explicit consistency=strong requests stay inline on the
+        # loop (they mutate live structures / need the live handle).
+        from gyeeta_tpu.net import qexec as _qexec
+        self.query_snapshot = (_qexec.snapshot_serving_enabled()
+                               if query_snapshot is None
+                               else bool(query_snapshot))
+        self.qexec = _qexec.QueryExecutor(rt, workers=query_workers,
+                                          queue_max=query_queue_max)
 
     def _nm_register(self, hostname: str, port: int):
         """Sticky NM conn identity for a node (hostname, port) pair —
@@ -290,8 +306,42 @@ class GytServer:
         if self._pipe is not None:
             self._pipe.flush()
 
+    # ---------------------------------------------------- query routing
+    def _inline_query(self, req: dict) -> bool:
+        """True when the request must run inline on the loop: CRUD and
+        multiquery mutate/compose against live structures, relational
+        tstart/tend history reads a thread-bound DB handle, shard-tier
+        at=/window= requests materialize through the runtime's shared
+        TimeView, and an explicit ``consistency=strong`` asked for the
+        flush-then-read semantics (tests / ``nm probe``)."""
+        if not self.query_snapshot:
+            return True
+        if req.get("op") or "multiquery" in req:
+            return True
+        if req.get("consistency") == "strong":
+            return True
+        return any(k in req for k in ("at", "window", "tstart", "tend"))
+
+    async def run_query(self, req: dict) -> dict:
+        """One query request → response dict, shared by the GYT query
+        loop and the NM edge (the REST gateway rides the GYT loop).
+        Snapshot-eligible queries run OFF-loop on the executor with
+        admission control; everything else keeps the original inline
+        strong path (feed barrier + live read). Raises
+        :class:`~gyeeta_tpu.net.qexec.Overloaded` on shed."""
+        if self._inline_query(req):
+            self._feed_barrier()
+            return self.rt.query(req)
+        return await self.qexec.run(req)
+
     # ------------------------------------------------------------- serving
     async def start(self) -> tuple[str, int]:
+        # snapshot serving needs a snapshot BEFORE the first tick: the
+        # bootstrap publish happens here on the loop, so query worker
+        # threads never publish (they'd race the feed path)
+        if self.query_snapshot and getattr(self.rt, "snapshot",
+                                           None) is None:
+            self.rt.publish_snapshot()
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port)
         sock = self._server.sockets[0].getsockname()
@@ -320,6 +370,7 @@ class GytServer:
             rec.close()      # live conns see None, never a closed file
         if self._pipe is not None:
             self._pipe.close()           # barrier + worker shutdown
+        self.qexec.close()   # query worker pool (no new snapshot reads)
         self.rt.close()      # alert delivery worker + history handle
 
     async def _tick_loop(self) -> None:
@@ -790,12 +841,17 @@ class GytServer:
             outstanding += 1
             try:
                 self.rt.stats.bump("net_queries")
-                self._feed_barrier()
-                out = self.rt.query(req)
+                out = await self.run_query(req)
             except Exception as e:
+                from gyeeta_tpu.net.qexec import Overloaded
                 outstanding -= 1
+                # admission-control shed answers QS_BUSY (counted in
+                # gyt_queries_shed_total) — the client backs off; a
+                # plain error keeps the conn and the loop alive
+                status = wire.QS_BUSY if isinstance(e, Overloaded) \
+                    else wire.QS_ERROR
                 writer.write(wire.encode_query(seqid, {"error": str(e)},
-                                               wire.QS_ERROR, resp=True))
+                                               status, resp=True))
                 await writer.drain()
                 continue
             try:

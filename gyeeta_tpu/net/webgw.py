@@ -15,7 +15,10 @@ for identical queries (``tests/test_nmquery.py``):
   ``maxrecs``, ``sortcol``, ``sortdesc``, ``tstart``, ``tend``, plus
   the time-travel params ``at`` (pin a snapshot instant) and
   ``window`` (trailing-duration aggregate) served from compaction
-  shards (``history/timeview.py``)
+  shards (``history/timeview.py``), and ``consistency``
+  (``snapshot`` — the server default: read the last published
+  per-tick engine view off-loop; ``strong`` — flush-then-read on the
+  serving loop, the pre-snapshot semantics)
 - ``GET  /healthz``          — gateway + upstream liveness
 - ``GET  /metrics``          — Prometheus text-format exposition of the
   upstream server's self-metrics (the ``metrics`` query subsystem,
@@ -168,7 +171,7 @@ class WebGateway:
             if method == "GET" and path.startswith("/v1/"):
                 req = {"subsys": path[4:].strip("/")}
                 q = urllib.parse.parse_qs(qs)
-                for k in ("filter", "sortcol"):
+                for k in ("filter", "sortcol", "consistency"):
                     if k in q:
                         req[k] = q[k][0]
                 for k in ("maxrecs",):

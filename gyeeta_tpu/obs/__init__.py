@@ -55,9 +55,25 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
         for k, v in dur.items():
             lines.append(f"  {k:<36} {v}")
 
+    # query-serving surface: snapshot freshness, result-cache hit
+    # rate, executor depth and shed counts (the 1k+ QPS dashboard
+    # health picture — OPERATIONS.md "Query serving")
+    qry = {k: v for k, v in sorted(c.items())
+           if str(k).startswith(("query_", "queries", "snapshot"))}
+    if qry:
+        lines.append("")
+        lines.append("query serving:")
+        hits = c.get("query_cache_hits", 0)
+        misses = c.get("query_cache_misses", 0)
+        if hits or misses:
+            qry["cache_hit_rate"] = round(hits / (hits + misses), 4)
+        for k, v in qry.items():
+            lines.append(f"  {k:<36} {v}")
+
     plain = {k: v for k, v in sorted(c.items())
              if not str(k).startswith(("engine_", "journal_", "wal_",
-                                       "throttle"))
+                                       "throttle", "query_", "queries",
+                                       "snapshot"))
              and isinstance(v, (int, float))}
     lines.append("")
     hdr = f"  {'counter':<36} {'total':>12}"

@@ -61,12 +61,16 @@ def render(stats, alerts=None) -> str:
     batched readback in before rendering)."""
     out: list[str] = []
 
+    # consistent copies: the exposition renders on query worker threads
+    # in snapshot mode while the serving loop keeps bumping
+    counters, gauges = stats.export()
+
     # group counters into families: plain names stand alone; "name|k=v"
     # label-encoded names collapse into one family with labeled samples
     families: dict[str, list] = {}
-    for k in stats.counters:
+    for k in counters:
         base, _, labels = k.partition("|")
-        families.setdefault(base, []).append((labels, stats.counters[k]))
+        families.setdefault(base, []).append((labels, counters[k]))
     for base in sorted(families):
         n = f"gyt_{_name(base)}_total"
         out.append(f"# TYPE {n} counter")
@@ -84,7 +88,6 @@ def render(stats, alerts=None) -> str:
             out.append(f"# TYPE {n} counter")
             out.append(f"{n} {_num(alerts.stats[k])}")
 
-    gauges = dict(stats.gauges)
     gauges["uptime_seconds"] = time.time() - stats.t_start
     for k in sorted(gauges):
         n = f"gyt_{_name(k)}"

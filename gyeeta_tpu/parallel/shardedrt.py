@@ -25,10 +25,13 @@ DCN mesh.
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from gyeeta_tpu.alerts import AlertManager
@@ -215,6 +218,17 @@ class ShardedRuntime:
         # edge detection — see Runtime.heavy_recover)
         self._hh_prev_hot: set = set()
 
+        # snapshot publication (query/snapshot.py): one non-donating
+        # jitted copy of the stacked (state, dep) per publish — output
+        # shardings follow the inputs, so collectives (rollup, edge
+        # rollup) run on the frozen copy unchanged. See Runtime.
+        self._snap_copy = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+        self.snapshot = None
+        self._snap_version = 0
+        # registry renders on query worker threads vs updates on the
+        # serving loop (see Runtime._reg_lock)
+        self._reg_lock = threading.RLock()
+
         from gyeeta_tpu.alerts import columns as AC
         self._aux = {
             "topk": self._topk_columns,
@@ -290,7 +304,8 @@ class ShardedRuntime:
         # wide per-shard dispatch (the single-node slab discipline)
         conn = recs.pop(wire.NOTIFY_TCP_CONN, None)
         if conn is not None and len(conn):
-            self.natclusters.observe_conns(conn)
+            with self._reg_lock:
+                self.natclusters.observe_conns(conn)
             self._conn_raw.append(conn)
             self._n_conn_raw += len(conn)
             self.stats.bump("conn_events", len(conn))
@@ -339,7 +354,8 @@ class ShardedRuntime:
                     wire.MAX_CPUMEM_PER_BATCH))
                 n += len(chunks[0])
             elif kind == "trace":
-                self.traceconns.observe(chunks[0])
+                with self._reg_lock:
+                    self.traceconns.observe(chunks[0])
                 self.state = self._fold_trace(self.state, self._stack(
                     decode.trace_batch, chunks[0],
                     wire.MAX_TRACE_PER_BATCH, count_path=False))
@@ -359,24 +375,32 @@ class ShardedRuntime:
                         self._n_resp_raw += len(rs)
                         self.stats.bump("resp_from_trace", len(rs))
             elif kind == "listener_info":
-                self.stats.bump("listener_infos",
-                                self.svcreg.update(chunks[0]))
+                # registry updates under the registry lock — their
+                # columns render on query worker threads in snapshot
+                # mode (see Runtime.ingest_records)
+                with self._reg_lock:
+                    self.stats.bump("listener_infos",
+                                    self.svcreg.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "host_info":
-                self.stats.bump("host_infos",
-                                self.hostinfo.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("host_infos",
+                                    self.hostinfo.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "mount":
-                self.stats.bump("mount_records",
-                                self.mounts.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("mount_records",
+                                    self.mounts.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "netif":
-                self.stats.bump("netif_records",
-                                self.netifs.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("netif_records",
+                                    self.netifs.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "cgroup":
-                self.stats.bump("cgroup_records",
-                                self.cgroups.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("cgroup_records",
+                                    self.cgroups.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "agent_stats":
                 # agent delivery-continuity deltas → server counters
@@ -392,8 +416,9 @@ class ShardedRuntime:
                     if tot:
                         self.stats.bump(ctr, tot)
             elif kind == "names":
-                self.stats.bump("names_interned",
-                                self.names.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("names_interned",
+                                    self.names.update(chunks[0]))
         return n
 
     def _dispatch_slab(self, lanes_c: int, lanes_r: int) -> None:
@@ -537,14 +562,17 @@ class ShardedRuntime:
                                           self._cols, live=True)
 
     def _merged_columns_state(self, subsys: str, state, dep, cache,
-                              live: bool = False):
+                              live: bool = False, reg: bool = False):
         """Per-shard provider outputs concatenated, or collective-
         rollup-backed for global subsystems — parameterized on
         (state, dep, cache) so the SAME pipeline serves the live mesh
         AND shard-materialized historical snapshots
-        (``history/timeview.py``). ``live`` routes recursive lookups
+        (``history/timeview.py``) AND the per-tick published snapshot
+        (``query/snapshot.py``). ``live`` routes recursive lookups
         through the top-level cached path and keeps registry-backed
-        joins (which have no historical source) available."""
+        joins (which have no historical source) available; ``reg``
+        keeps the registry joins available over a NON-live state (the
+        published snapshot: engine columns frozen, registries live)."""
         if live:
             def get(s):
                 return self._merged_columns(s)
@@ -552,9 +580,9 @@ class ShardedRuntime:
             def get(s):
                 return cache.get(
                     s, lambda: self._merged_columns_state(
-                        s, state, dep, cache))
+                        s, state, dep, cache, reg=reg))
         if subsys == fieldmaps.SUBSYS_SVCINFO:
-            if not live:
+            if not (live or reg):
                 raise ValueError(
                     "svcinfo is registry-backed — not available "
                     "historically")
@@ -564,7 +592,7 @@ class ShardedRuntime:
             cols, live_m = get(fieldmaps.SUBSYS_SVCSTATE)
             return api.svcsumm_from_svc(cols, live_m, self.names)
         if subsys == fieldmaps.SUBSYS_EXTSVCSTATE:
-            if not live:
+            if not (live or reg):
                 raise ValueError(
                     "extsvcstate joins the live registry — not "
                     "available historically")
@@ -572,7 +600,7 @@ class ShardedRuntime:
             info_cols, _ = self.svcreg.columns(self.names)
             return api.extsvc_join(cols, live_m, info_cols)
         if subsys == fieldmaps.SUBSYS_SVCPROCMAP:
-            if not live:
+            if not (live or reg):
                 raise ValueError(
                     "svcprocmap joins the live registry — not "
                     "available historically")
@@ -836,6 +864,29 @@ class ShardedRuntime:
         }
         return cols, np.ones(1, bool)
 
+    # ----------------------------------------------------- snapshot tier
+    def publish_snapshot(self):
+        """Freeze the stacked mesh state into an immutable
+        :class:`~gyeeta_tpu.query.snapshot.EngineSnapshot` (see
+        ``Runtime.publish_snapshot`` — same double-buffer contract; the
+        copied leaves keep their shardings, so the merged-columns
+        pipeline and the rollup collectives serve the frozen view
+        unchanged)."""
+        from gyeeta_tpu.query.snapshot import EngineSnapshot
+        with self.stats.timeit("snapshot_publish"):
+            state, dep = self._snap_copy((self.state, self.dep))
+        self._snap_version += 1
+        snap = EngineSnapshot(
+            self, state, dep, tick=self._tick_no,
+            published_at=self._clock(), version=self._snap_version,
+            result_cache_max=int(os.environ.get(
+                "GYT_QUERY_CACHE_MAX", "1024")))
+        self.snapshot = snap
+        self.stats.bump("snapshots_published")
+        self.stats.gauge("snapshot_tick", float(self._tick_no))
+        self.stats.gauge("snapshot_age_seconds", 0.0)
+        return snap
+
     # ------------------------------------------------------------ cadence
     def td_drain(self, max_iters: int | None = None) -> int:
         """Drain per-shard digest stages with O(m) partial flushes
@@ -894,6 +945,10 @@ class ShardedRuntime:
             self.td_drain(max_iters=self.opts.td_drain_iters_per_tick)
         self.state = self._classify(self.state)
         self._cols.bump()
+        # publish the post-classify view and route alert evaluation
+        # through it — tick-time work pre-warms the snapshot's merged
+        # columns for the dashboards (see Runtime._run_tick)
+        snap = self.publish_snapshot()
         # per-tick heavy-hitter recovery (memoized — an alertdef on
         # `topk` and queries until the next feed reuse the readback)
         ev = self.opts.hh_recover_every_ticks
@@ -901,7 +956,7 @@ class ShardedRuntime:
                 and (self._tick_no + 1) % ev == 0:
             report["topk_recovered"] = self._cols.get(
                 "__hh_recover", self.heavy_recover)["recovered_keys"]
-        fired = self.alerts.check(None, columns_fn=self._merged_columns)
+        fired = self.alerts.check(None, columns_fn=snap.columns)
         report["alerts_fired"] = len(fired)
         for a in fired:
             self.notifylog.add_alert(a)
@@ -925,11 +980,12 @@ class ShardedRuntime:
             self.state = self._age_tasks(self.state)
             self.state = self._age_apis(self.state)
         self.dep = self._dep_age(self.dep, np.int32(self._tick_no))
-        self.cgroups.age()
-        self.mounts.age()
-        self.netifs.age()
-        self.natclusters.age()
-        self.traceconns.age()
+        with self._reg_lock:      # ageing structurally mutates the
+            self.cgroups.age()    # registries snapshot aux renders
+            self.mounts.age()     # iterate on worker threads
+            self.netifs.age()
+            self.natclusters.age()
+            self.traceconns.age()
         # journal fsync cadence backstop + checkpoint-with-WAL-position
         # (same durability contract as the single-node Runtime: the
         # checkpoint records the fsynced journal position and
@@ -956,7 +1012,12 @@ class ShardedRuntime:
     # -------------------------------------------------------------- query
     def crud(self, req: dict) -> dict:
         from gyeeta_tpu.query import crud as CR
-        return CR.crud(self, req)
+        with self._reg_lock:
+            out = CR.crud(self, req)
+        snap = self.snapshot          # CRUD invalidates aux views
+        if snap is not None:
+            snap.on_mutation()
+        return out
 
     def query(self, req: dict) -> dict:
         if req.get("op"):
@@ -964,6 +1025,13 @@ class ShardedRuntime:
         if "multiquery" in req:
             from gyeeta_tpu.query import crud as CR
             return CR.multiquery(self.query, req)
+        if req.get("consistency") == "snapshot":
+            return self.query_snapshot(req)
+        if "consistency" in req:
+            req = dict(req)
+            if req.pop("consistency") != "strong":
+                raise ValueError(
+                    "consistency must be 'snapshot' or 'strong'")
         # process-local subsystems (selfstats + metrics exposition) —
         # shared routing with the single-node Runtime (api.py)
         out = api.local_response(self, req)
@@ -982,6 +1050,24 @@ class ShardedRuntime:
             return api.execute(self.cfg, None, QueryOptions.from_json(req),
                                names=self.names,
                                columns_fn=self._merged_columns)
+
+    def query_snapshot(self, req: dict) -> dict:
+        """Serve a live query from the last published snapshot (no
+        flush, no fold-path dispatch; safe from worker threads) — the
+        mesh twin of ``Runtime.query_snapshot``."""
+        req = {k: v for k, v in req.items() if k != "consistency"}
+        snap = self.snapshot
+        if snap is None:
+            snap = self.publish_snapshot()
+        if req.get("subsys") in api.LOCAL_SUBSYS:
+            return api.local_response(self, req, snapshot=snap)
+        from gyeeta_tpu.history.timeview import route_historical
+        out = route_historical(self, req)
+        if out is not None:
+            return out
+        self.stats.bump("queries")
+        with self.stats.timeit("query"):
+            return snap.query(req)
 
     def close(self) -> None:
         """Release background workers (alert delivery, DNS resolver).
@@ -1028,6 +1114,9 @@ class ShardedRuntime:
         self._sweep_last_seq = {
             int(k): int(v)
             for k, v in extra.get("sweep_seq", {}).items()}
+        # republish over the restored view (see Runtime.restore)
+        if self.snapshot is not None:
+            self.publish_snapshot()
         return extra
 
     def replay_journal(self, pos=None) -> dict:

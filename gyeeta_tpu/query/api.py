@@ -901,11 +901,16 @@ def columns_for(cfg: EngineCfg, st: AggState, subsys: str, names=None,
 LOCAL_SUBSYS = ("selfstats", "metrics")
 
 
-def local_response(rt, req: dict):
+def local_response(rt, req: dict, snapshot=None):
     """Answer a process-local subsystem for a runtime-like object
     (``.stats``/``.alerts``, optional ``.spans`` ring, and
     ``.engine_health()`` for the batched device readback), or None
-    when ``req`` targets an engine subsystem."""
+    when ``req`` targets an engine subsystem.
+
+    ``snapshot`` (an ``EngineSnapshot``) selects the snapshot-serving
+    path: the scrape renders the gauges the last tick's health pass
+    already refreshed instead of touching live device state — a
+    /metrics scrape fleet can no longer stall the fold."""
     subsys = req.get("subsys")
     if subsys == "selfstats":
         from gyeeta_tpu.utils.selfstats import selfstats_response
@@ -913,10 +918,19 @@ def local_response(rt, req: dict):
                                   spans=getattr(rt, "spans", None))
     if subsys == "metrics":
         from gyeeta_tpu.obs import prom
-        # fold staged records + refresh the engine-health gauges so the
-        # scrape sees current device state (one batched transfer)
-        rt.flush()
-        rt.engine_health()
+        if snapshot is None:
+            # strong path: fold staged records + refresh the engine-
+            # health gauges so the scrape sees current device state
+            # (one batched transfer)
+            rt.flush()
+            rt.engine_health()
+        else:
+            # snapshot path: no flush, no device readback — refresh
+            # only the snapshot-freshness gauges (the tracked-staleness
+            # surface: alert when age exceeds ~3x the tick interval)
+            rt.stats.gauge("snapshot_age_seconds", max(
+                0.0, rt._clock() - snapshot.published_at))
+            rt.stats.gauge("snapshot_tick", float(snapshot.tick))
         return prom.metrics_response(rt.stats, rt.alerts)
     return None
 

@@ -13,10 +13,12 @@ pool — the device pipeline is the concurrency.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from gyeeta_tpu.alerts import AlertManager
@@ -259,6 +261,20 @@ class Runtime:
         # other compiled program
         self._hh_recover = mj("hh_recover", lambda: jax.jit(
             lambda s: step.heavy_recover(cfg, s)))
+        # snapshot publication (query/snapshot.py): ONE non-donating
+        # jitted copy of (state, dep) per publish — jit outputs never
+        # alias non-donated inputs, so the snapshot's buffers survive
+        # every later donating fold (the double buffer: queries read
+        # snapshot N on worker threads while the fold builds N+1)
+        self._snap_copy = mj("snap_copy", lambda: jax.jit(
+            lambda t: jax.tree.map(jnp.copy, t)))
+        self.snapshot = None          # last published EngineSnapshot
+        self._snap_version = 0
+        # host-side registry renders (snapshot aux views) run on query
+        # worker threads; registry UPDATES stay on the serving loop —
+        # this lock keeps dict/deque iteration away from concurrent
+        # structural mutation (cheap: uncontended except at render)
+        self._reg_lock = threading.RLock()
         # recovered-hot key set from the previous recovery: promotions
         # count keys NEWLY recovered at/above the hot threshold, so the
         # counter tracks churn into the top view, not steady residency
@@ -428,7 +444,8 @@ class Runtime:
         # per-slab decode in _dispatch_slab is the only decode they get
         conn = recs.pop(wire.NOTIFY_TCP_CONN, None)
         if conn is not None and len(conn):
-            self.natclusters.observe_conns(conn)
+            with self._reg_lock:
+                self.natclusters.observe_conns(conn)
             self._conn_raw.append(conn)
             self._n_conn_raw += len(conn)
             self.stats.bump("conn_events", len(conn))
@@ -482,24 +499,33 @@ class Runtime:
                 n += len(chunks[0])
                 self.stats.bump("trace_records", len(chunks[0]))
             elif kind == "listener_info":
-                self.stats.bump("listener_infos",
-                                self.svcreg.update(chunks[0]))
+                # registry updates run under the registry lock: their
+                # columns render on query worker threads in snapshot
+                # mode (query/snapshot.py) and dict iteration must not
+                # race a structural mutation
+                with self._reg_lock:
+                    self.stats.bump("listener_infos",
+                                    self.svcreg.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "host_info":
-                self.stats.bump("host_infos",
-                                self.hostinfo.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("host_infos",
+                                    self.hostinfo.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "cgroup":
-                self.stats.bump("cgroup_records",
-                                self.cgroups.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("cgroup_records",
+                                    self.cgroups.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "mount":
-                self.stats.bump("mount_records",
-                                self.mounts.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("mount_records",
+                                    self.mounts.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "netif":
-                self.stats.bump("netif_records",
-                                self.netifs.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("netif_records",
+                                    self.netifs.update(chunks[0]))
                 n += len(chunks[0])
             elif kind == "agent_stats":
                 # agent delivery-continuity deltas → server counters
@@ -519,8 +545,9 @@ class Runtime:
                 # names don't count into n (not telemetry events) but
                 # DO invalidate cached columns: resolved name strings
                 # are part of every snapshot view
-                self.stats.bump("names_interned",
-                                self.names.update(chunks[0]))
+                with self._reg_lock:
+                    self.stats.bump("names_interned",
+                                    self.names.update(chunks[0]))
                 self._cols.bump()
         if self._fused:
             self._dispatch_fused_pending()
@@ -534,7 +561,8 @@ class Runtime:
         """Host-side half of the trace fold (registry observe + the
         trace→resp bridge with per-host native-stream precedence) —
         shared by the fused staging path and the legacy dispatch."""
-        self.traceconns.observe(recs)
+        with self._reg_lock:
+            self.traceconns.observe(recs)
         if self.opts.trace_resp_bridge:
             rs = decode.resp_from_trace(recs)
             # per-host precedence: hosts with a RECENT native resp
@@ -871,6 +899,33 @@ class Runtime:
             rec["flows"], svc=self._cached_columns("svcstate"),
             trace=self._cached_columns("tracereq"))
 
+    # ----------------------------------------------------- snapshot tier
+    def publish_snapshot(self):
+        """Freeze the current engine view into an immutable
+        :class:`~gyeeta_tpu.query.snapshot.EngineSnapshot` and swap it
+        in (plain attribute store — atomic under the GIL). One
+        non-donating device copy of (state, dep) per publish; queries
+        on worker threads keep reading the PREVIOUS snapshot until the
+        swap, and the old snapshot's buffers free when its last reader
+        drops it. Called once per tick (post-classify, pre-window-roll)
+        and on restore; ``run_tick`` routes alert evaluation and the
+        history sweep through the fresh snapshot so tick-time work
+        PRE-WARMS the columns dashboards then reuse."""
+        from gyeeta_tpu.query.snapshot import EngineSnapshot
+        with self.stats.timeit("snapshot_publish"):
+            state, dep = self._snap_copy((self.state, self.dep))
+        self._snap_version += 1
+        snap = EngineSnapshot(
+            self, state, dep, tick=self._tick_no,
+            published_at=self._clock(), version=self._snap_version,
+            result_cache_max=int(os.environ.get(
+                "GYT_QUERY_CACHE_MAX", "1024")))
+        self.snapshot = snap
+        self.stats.bump("snapshots_published")
+        self.stats.gauge("snapshot_tick", float(self._tick_no))
+        self.stats.gauge("snapshot_age_seconds", 0.0)
+        return snap
+
     # ------------------------------------------------------------ cadence
     def run_tick(self) -> dict:
         with self.stats.timeit("tick"), self.spans.span(
@@ -886,6 +941,11 @@ class Runtime:
         report = {}
         self.state = self._classify(self.state)
         self._cols.bump()             # classify + tick mutate views
+        # publish the post-classify view: the snapshot dashboards read
+        # for the next 5s window. Everything below that reads columns
+        # (alert eval, the history sweep) goes THROUGH it — tick-time
+        # work pre-warms the snapshot's column cache.
+        snap = self.publish_snapshot()
         # per-tick heavy-hitter recovery (one read-only readback,
         # memoized per state version — an alertdef on `topk` and every
         # query until the next fold reuse it). 0 disables the cadence;
@@ -895,8 +955,7 @@ class Runtime:
                 and (self._tick_no + 1) % ev == 0:
             report["topk_recovered"] = self._cols.get(
                 "__hh_recover", self.heavy_recover)["recovered_keys"]
-        fired = self.alerts.check(self.state,
-                                  columns_fn=self._alert_columns)
+        fired = self.alerts.check(self.state, columns_fn=snap.columns)
         # history snapshots BEFORE the window tick: the closing 5s slab is
         # still readable (tick zeroes it)
         tick = int(np.asarray(self.state.resp_win.tick)) + 1
@@ -904,35 +963,38 @@ class Runtime:
         self._tick_no = tick
         self.stats.gauge("tick", tick)
         self.dep = self._dep_age(self.dep, tick)
-        self.cgroups.age()
-        self.mounts.age()
-        self.netifs.age()
-        self.natclusters.age()
-        self.traceconns.age()
+        with self._reg_lock:      # ageing structurally mutates the
+            self.cgroups.age()    # registries snapshot aux renders
+            self.mounts.age()     # iterate on worker threads
+            self.netifs.age()
+            self.natclusters.age()
+            self.traceconns.age()
 
         if self.history and tick % self.opts.history_every_ticks == 0:
             now = self._clock()
-            # render on the fold thread (device readbacks), WRITE on
-            # the history writer thread (bounded queue, drop-oldest
-            # counted) — a slow sqlite/pg write can no longer stall
-            # run_tick (it used to be synchronous SQL in this loop)
-            out = api.execute(self.cfg, self.state, api.QueryOptions(
+            # render on the fold thread from the JUST-published
+            # snapshot (pre-warming its column cache for dashboards),
+            # WRITE on the history writer thread (bounded queue,
+            # drop-oldest counted) — a slow sqlite/pg write can no
+            # longer stall run_tick (it used to be synchronous SQL in
+            # this loop)
+            out = api.execute(self.cfg, None, api.QueryOptions(
                 subsys="svcstate", maxrecs=self.cfg.svc_capacity),
-                names=self.names)
-            hout = api.execute(self.cfg, self.state, api.QueryOptions(
+                names=self.names, columns_fn=snap.columns)
+            hout = api.execute(self.cfg, None, api.QueryOptions(
                 subsys="hoststate", maxrecs=self.cfg.n_hosts),
-                names=self.names)
-            cout = api.execute(self.cfg, self.state, api.QueryOptions(
-                subsys="clusterstate"))
-            tout = api.execute(self.cfg, self.state, api.QueryOptions(
+                names=self.names, columns_fn=snap.columns)
+            cout = api.execute(self.cfg, None, api.QueryOptions(
+                subsys="clusterstate"), columns_fn=snap.columns)
+            tout = api.execute(self.cfg, None, api.QueryOptions(
                 subsys="taskstate", maxrecs=self.cfg.task_capacity),
-                names=self.names)
-            mout = api.execute(self.cfg, self.state, api.QueryOptions(
+                names=self.names, columns_fn=snap.columns)
+            mout = api.execute(self.cfg, None, api.QueryOptions(
                 subsys="cpumem", maxrecs=self.cfg.n_hosts),
-                names=self.names)
-            trout = api.execute(self.cfg, self.state, api.QueryOptions(
+                names=self.names, columns_fn=snap.columns)
+            trout = api.execute(self.cfg, None, api.QueryOptions(
                 subsys="tracereq", maxrecs=self.cfg.api_capacity),
-                names=self.names)
+                names=self.names, columns_fn=snap.columns)
             sweep = [("svcstate", now, out["recs"]),
                      ("hoststate", now, hout["recs"]),
                      ("clusterstate", now, cout["recs"]),
@@ -941,9 +1003,9 @@ class Runtime:
                      ("tracereq", now, trout["recs"])]
             ncg = 0
             if len(self.cgroups):
-                cgout = api.execute(self.cfg, self.state, api.QueryOptions(
+                cgout = api.execute(self.cfg, None, api.QueryOptions(
                     subsys="cgroupstate", maxrecs=100_000),
-                    names=self.names, aux=self._aux)
+                    names=self.names, columns_fn=snap.columns)
                 sweep.append(("cgroupstate", now, cgout["recs"]))
                 ncg = cgout["nrecs"]
             self._histwriter.write_sweep(sweep)
@@ -1138,19 +1200,39 @@ class Runtime:
     # ---------------------------------------------------------------- CRUD
     def crud(self, req: dict) -> dict:
         from gyeeta_tpu.query import crud as CR
-        return CR.crud(self, req)
+        with self._reg_lock:
+            out = CR.crud(self, req)
+        # CRUD mutates aux views mid-snapshot: invalidate the published
+        # snapshot's result + column caches so the next query re-renders
+        snap = self.snapshot
+        if snap is not None:
+            snap.on_mutation()
+        return out
 
     # -------------------------------------------------------------- query
     def query(self, req: dict) -> dict:
         """Point-in-time (live) or historical (time-ranged) JSON query;
         requests with an "op" field route to the CRUD channel; a
         "multiquery" list runs several queries in one round trip (the
-        reference's multiquery batches, ``gy_query_common.h:24``)."""
+        reference's multiquery batches, ``gy_query_common.h:24``).
+
+        ``consistency`` selects the live-query path: ``"strong"`` (the
+        default for direct callers — flush staged events, read the live
+        engine) or ``"snapshot"`` (read the last published per-tick
+        :class:`~gyeeta_tpu.query.snapshot.EngineSnapshot`; never
+        touches the fold — the serving edges default to this)."""
         if req.get("op"):
             return self.crud(req)
         if "multiquery" in req:
             from gyeeta_tpu.query import crud as CR
             return CR.multiquery(self.query, req)
+        if req.get("consistency") == "snapshot":
+            return self.query_snapshot(req)
+        if "consistency" in req:
+            req = dict(req)
+            if req.pop("consistency") != "strong":
+                raise ValueError(
+                    "consistency must be 'snapshot' or 'strong'")
         # process-local subsystems (selfstats readback + Prometheus
         # metrics exposition) — shared routing with ShardedRuntime
         out = api.local_response(self, req)
@@ -1158,6 +1240,35 @@ class Runtime:
             return out
         with self.stats.timeit("query"):
             return self._query(req)
+
+    def query_snapshot(self, req: dict) -> dict:
+        """Serve a live query from the last published snapshot — no
+        ``flush()``, no fold-path device dispatch, safe from worker
+        threads (the off-loop executor's path, ``net/qexec.py``).
+        Historical ``at=``/``window=`` requests route to the shard tier
+        (file-backed — also fold-free); relational ``tstart/tend`` SQL
+        runs against the live history handle and must use
+        ``consistency=strong`` (the serving edge routes it inline)."""
+        req = {k: v for k, v in req.items() if k != "consistency"}
+        snap = self.snapshot
+        if snap is None:
+            # bootstrap publish (single-threaded callers); the serving
+            # edge publishes at start() so worker threads always find
+            # a snapshot here
+            snap = self.publish_snapshot()
+        if req.get("subsys") in api.LOCAL_SUBSYS:
+            return api.local_response(self, req, snapshot=snap)
+        if ("tstart" in req or "tend" in req) and "at" not in req \
+                and "window" not in req and self.history:
+            raise ValueError(
+                "relational history queries need consistency=strong")
+        from gyeeta_tpu.history.timeview import route_historical
+        out = route_historical(self, req)
+        if out is not None:
+            return out
+        self.stats.bump("queries")
+        with self.stats.timeit("query"):
+            return snap.query(req)
 
     def _query(self, req: dict) -> dict:
         # time-travel tier: at=/window= materialize snapshot shards
@@ -1236,6 +1347,11 @@ class Runtime:
         self._sweep_last_seq = {
             int(k): int(v)
             for k, v in extra.get("sweep_seq", {}).items()}
+        # snapshot serving must not keep answering from pre-restore
+        # state: republish over the restored view (only when a snapshot
+        # was ever published — bare runtimes pay nothing)
+        if self.snapshot is not None:
+            self.publish_snapshot()
         return extra
 
     def replay_journal(self, pos=None) -> dict:

@@ -93,7 +93,14 @@ class Daemon:
                              throttle_lag_s=getattr(
                                  args, "throttle_lag_s", 0.75),
                              throttle_pending_mb=getattr(
-                                 args, "throttle_pending_mb", 32.0))
+                                 args, "throttle_pending_mb", 32.0),
+                             query_workers=getattr(
+                                 args, "query_workers", None),
+                             query_queue_max=getattr(
+                                 args, "query_queue_max", None),
+                             query_snapshot=(
+                                 False if getattr(args, "query_strong",
+                                                  False) else None))
         self._hot = C.HotReload(args.config, opts) if args.config else None
         # history compaction daemon: sealed WAL segments → columnar
         # snapshot shards (the time-travel tier's writer). Runs only
@@ -352,6 +359,20 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--frame-error-budget", type=int, default=8,
                     help="recoverable frame-level errors per query "
                     "conn before it is closed")
+    # snapshot-isolated query serving (query/snapshot.py, net/qexec.py;
+    # OPERATIONS.md "Query serving"): live queries read the last
+    # published per-tick engine view on a bounded off-loop worker pool
+    ap.add_argument("--query-workers", type=int, default=None,
+                    help="query worker-pool width (default "
+                    "GYT_QUERY_WORKERS or 4)")
+    ap.add_argument("--query-queue-max", type=int, default=None,
+                    help="max in-flight queries before shedding with "
+                    "a counted overload error (default "
+                    "GYT_QUERY_QUEUE_MAX or 128)")
+    ap.add_argument("--query-strong", action="store_true",
+                    help="serve every query inline with strong "
+                    "consistency (the pre-snapshot behavior; also "
+                    "GYT_QUERY_SNAPSHOT=0)")
     # durable-ingest tier: write-ahead journal + admission control
     # (utils/journal.py; OPERATIONS.md "Durability & recovery")
     ap.add_argument("--journal-dir",

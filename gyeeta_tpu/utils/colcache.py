@@ -27,6 +27,14 @@ class ColumnCache:
     def clear(self) -> None:
         self._cache.clear()
 
+    def peek(self, subsys: str):
+        """Current-version cached entry or None — the lock-free fast
+        path of the snapshot tier's single-flight column compute."""
+        ent = self._cache.get(subsys)
+        if ent is not None and ent[0] == self.version:
+            return ent[1]
+        return None
+
     def get(self, subsys: str, compute):
         """Cached (cols, mask) for ``subsys``; ``compute()`` runs only
         when the cached entry predates the current version."""

@@ -15,6 +15,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import math
+import threading
 import time
 
 import numpy as np
@@ -34,22 +35,31 @@ class Stats:
         self._timings: dict[str, np.ndarray] = {}
         self._t_sum_ms: collections.Counter = collections.Counter()
         self.t_start = time.time()
+        # queries run on worker threads since the snapshot tier
+        # (net/qexec.py): Counter += and histogram increments are
+        # read-modify-write, so the registry takes a lock — uncontended
+        # cost is ~100ns against per-BATCH (not per-event) bumps
+        self._mu = threading.Lock()
 
     def bump(self, name: str, n=1):
-        self.counters[name] += n
+        with self._mu:
+            self.counters[name] += n
 
     def gauge(self, name: str, v):
-        self.gauges[name] = v
+        with self._mu:
+            self.gauges[name] = v
 
     # ------------------------------------------------------------ timing
     def observe_ms(self, name: str, ms: float) -> None:
-        h = self._timings.get(name)
-        if h is None:
-            h = self._timings[name] = np.zeros(_T_NB, np.int64)
-        b = 0 if ms <= _T_VMIN_MS else min(
-            _T_NB - 1, int(math.log(ms / _T_VMIN_MS) / _T_LOG_GAMMA) + 1)
-        h[b] += 1
-        self._t_sum_ms[name] += ms
+        with self._mu:
+            h = self._timings.get(name)
+            if h is None:
+                h = self._timings[name] = np.zeros(_T_NB, np.int64)
+            b = 0 if ms <= _T_VMIN_MS else min(
+                _T_NB - 1,
+                int(math.log(ms / _T_VMIN_MS) / _T_LOG_GAMMA) + 1)
+            h[b] += 1
+            self._t_sum_ms[name] += ms
 
     @contextlib.contextmanager
     def timeit(self, name: str):
@@ -64,16 +74,22 @@ class Stats:
     def _bucket_ms(b: int) -> float:
         return _T_VMIN_MS * _T_GAMMA ** max(0, b - 1)
 
+    def export(self) -> tuple[dict, dict]:
+        """Consistent (counters, gauges) copies for renderers that
+        iterate off-thread (the Prometheus exposition)."""
+        with self._mu:
+            return dict(self.counters), dict(self.gauges)
+
     def timing_rows(self) -> list[dict]:
         """One row per timed stage: count + p50/p95/p99 + total."""
         out = []
-        for name, h in sorted(self._timings.items()):
+        for name, h, tot in self.timing_hists():
             n = int(h.sum())
             if n == 0:
                 continue
             cum = np.cumsum(h)
             row = {"stage": name, "count": n,
-                   "totalms": round(float(self._t_sum_ms[name]), 3)}
+                   "totalms": round(tot, 3)}
             for q, col in ((0.5, "p50ms"), (0.95, "p95ms"),
                            (0.99, "p99ms")):
                 # rank semantics: the q-quantile sample is the
@@ -91,19 +107,22 @@ class Stats:
         """Raw geometric buckets per stage: (name, counts, total_ms) —
         the exposition source (``obs/prom.py`` maps these to cumulative
         ``le`` buckets)."""
-        return [(name, self._timings[name].copy(),
-                 float(self._t_sum_ms[name]))
-                for name in sorted(self._timings)]
+        with self._mu:
+            return [(name, self._timings[name].copy(),
+                     float(self._t_sum_ms[name]))
+                    for name in sorted(self._timings)]
 
     def snapshot(self) -> dict:
-        out = dict(self.counters)
-        out.update(self.gauges)
+        with self._mu:
+            out = dict(self.counters)
+            out.update(self.gauges)
         out["uptime_sec"] = round(time.time() - self.t_start, 1)
         return out
 
     def delta(self) -> dict:
         """Counters since the previous delta() call (rate reporting)."""
-        cur = dict(self.counters)
+        with self._mu:
+            cur = dict(self.counters)
         out = {k: v - self._last.get(k, 0) for k, v in cur.items()}
         self._last = cur
         return {k: v for k, v in out.items() if v}

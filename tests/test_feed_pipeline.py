@@ -95,17 +95,22 @@ def test_server_with_pipeline_end_to_end():
             await a.send_sweep(n_conn=128, n_resp=256)
             qc = QueryClient()
             await qc.connect(host, port)
-            # the query must barrier the PIPELINE (no rt.flush here);
-            # a short retry absorbs the unrelated socket-delivery race
-            # between the event conn and the query conn
+            # the query must barrier the PIPELINE (no rt.flush here) —
+            # consistency=strong keeps the barrier-then-read semantics
+            # this test exists to verify (the snapshot default serves
+            # the last published tick instead); a short retry absorbs
+            # the unrelated socket-delivery race between the event
+            # conn and the query conn
             for _ in range(40):
                 out = await qc.query({"subsys": "svcstate",
-                                      "maxrecs": 50})
+                                      "maxrecs": 50,
+                                      "consistency": "strong"})
                 if out["ntotal"] == a.n_svcs:
                     break
                 await asyncio.sleep(0.05)
             assert out["ntotal"] == a.n_svcs
-            st = await qc.query({"subsys": "serverstatus"})
+            st = await qc.query({"subsys": "serverstatus",
+                                 "consistency": "strong"})
             assert st["recs"][0]["connevents"] == 128
             await qc.close()
             await a.close()

@@ -110,7 +110,11 @@ def test_tracedef_drives_live_capture_end_to_end():
             await asyncio.sleep(0.3)
             rt.flush()
 
-            tr = await qc.query({"subsys": "tracereq", "maxrecs": 50})
+            # strong: read the live engine (no tick ran since the
+            # capture drained; the snapshot default would serve the
+            # pre-capture tick)
+            tr = await qc.query({"subsys": "tracereq", "maxrecs": 50,
+                                 "consistency": "strong"})
             apis = {r["api"] for r in tr["recs"]}
             assert "GET /v1/ok/{}" in apis, apis
             assert any(r["nerr"] >= 1 for r in tr["recs"]), tr["recs"]
@@ -118,7 +122,8 @@ def test_tracedef_drives_live_capture_end_to_end():
             # the traced listener's svcstate row carries REAL
             # latencies (trace→resp bridge) + the 500
             s = await qc.query({"subsys": "svcstate", "maxrecs": 100,
-                                "sortcol": "sererr", "sortdesc": True})
+                                "sortcol": "sererr", "sortdesc": True,
+                                "consistency": "strong"})
             top = s["recs"][0]
             assert top["sererr"] >= 1 and top["nqry5s"] >= 3
             assert top["p95resp5s"] > 0

@@ -104,6 +104,7 @@ def test_large_response_over_socket():
             await a.send_sweep(n_conn=256, n_resp=256)
         await asyncio.sleep(0.05)
         rt.flush()
+        rt.run_tick()     # publish the snapshot served on the wire
         qc = QueryClient()
         await qc.connect(host, port)
         out = await qc.query({"subsys": "taskstate", "maxrecs": 4096})

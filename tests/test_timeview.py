@@ -484,3 +484,94 @@ def test_cli_compact_offline(tmp_path):
     assert len(listing["shards"]) == 1
     assert os.path.exists(tmp_path / "shards"
                           / listing["shards"][0]["file"])
+
+
+# -------------------------------------- windowed-aggregation vectorization
+def _synth_parts(n_entities, n_parts, seed=0, subsys="svcstate"):
+    """Randomized (cols, mask) parts shaped like stored svcstate
+    panels: str identity cols + numeric cols + churn in the mask."""
+    rng = np.random.default_rng(seed)
+    parts = []
+    ids = np.array([f"{i:016x}" for i in range(n_entities)], object)
+    names = np.array([f"svc-{i % 97}" for i in range(n_entities)],
+                     object)
+    for p in range(n_parts):
+        cols = {
+            "svcid": ids,
+            "svcname": names,
+            "qps5s": rng.uniform(0, 100, n_entities),
+            "nconns": rng.integers(0, 50, n_entities).astype(
+                np.float64),
+            "state": rng.integers(0, 5, n_entities).astype(np.int32),
+            "hostid": (np.arange(n_entities) % 8).astype(np.float64),
+        }
+        mask = rng.uniform(size=n_entities) > 0.3
+        parts.append((cols, mask))
+    return parts
+
+
+def test_window_aggregation_vectorized_parity():
+    """ROADMAP history item (a): the np.unique/segment-sum window
+    aggregator is bit-identical to the reference keyed loop —
+    including first-appearance row order, per-entity means, and
+    last-observation semantics — plus the key-less positional path."""
+    from gyeeta_tpu.history import timeview as TV
+
+    parts = _synth_parts(500, 4, seed=3)
+    # entity churn: a part with rows the others never see
+    extra = _synth_parts(520, 1, seed=9)[0]
+    parts.insert(2, extra)
+    got, gmask = TV.aggregate_window_columns("svcstate", parts)
+    ref, rmask = TV.aggregate_window_columns_ref("svcstate", parts)
+    assert list(got) == list(ref)
+    assert np.array_equal(gmask, rmask)
+    for c in ref:
+        if ref[c].dtype == object:
+            assert got[c].tolist() == ref[c].tolist(), c
+        else:
+            assert np.array_equal(got[c], ref[c]), c
+
+    # multi-key subsystem (tracereq: svcid+svcname+api identity)
+    rng = np.random.default_rng(5)
+    tparts = []
+    for p in range(3):
+        n = 200
+        cols = {
+            "svcid": np.array([f"{i % 40:016x}" for i in range(n)],
+                              object),
+            "svcname": np.array([f"s{i % 40}" for i in range(n)],
+                                object),
+            "api": np.array([f"GET /api/{i % 13}" for i in range(n)],
+                            object),
+            "nreq": rng.uniform(0, 1e6, n),
+            "p99resp": rng.uniform(0, 1e3, n),
+            "hostid": (np.arange(n) % 8).astype(np.float64),
+        }
+        tparts.append((cols, rng.uniform(size=n) > 0.2))
+    got, _ = TV.aggregate_window_columns("tracereq", tparts)
+    ref, _ = TV.aggregate_window_columns_ref("tracereq", tparts)
+    for c in ref:
+        if ref[c].dtype == object:
+            assert got[c].tolist() == ref[c].tolist(), c
+        else:
+            assert np.array_equal(got[c], ref[c]), c
+
+    # key-less positional path (clusterstate) + all-masked-out parts
+    cparts = [({"nhosts": np.arange(4.0), "state": np.ones(4, np.int32)},
+               np.zeros(4, bool)),
+              ({"nhosts": np.arange(4.0) * 2,
+                "state": np.full(4, 2, np.int32)},
+               np.ones(4, bool))]
+    got, gmask = TV.aggregate_window_columns("clusterstate", cparts)
+    ref, rmask = TV.aggregate_window_columns_ref("clusterstate", cparts)
+    assert np.array_equal(gmask, rmask)
+    for c in ref:
+        assert np.array_equal(got[c], ref[c]), c
+
+    # empty window (every row masked out on a keyed subsystem)
+    eparts = [(parts[0][0], np.zeros(500, bool))]
+    got, gmask = TV.aggregate_window_columns("svcstate", eparts)
+    ref, rmask = TV.aggregate_window_columns_ref("svcstate", eparts)
+    assert len(gmask) == len(rmask) == 0
+    for c in ref:
+        assert got[c].dtype == ref[c].dtype and len(got[c]) == 0, c
