@@ -1,22 +1,431 @@
-"""Scale-sweep wrapper: runs the opt-in north-star geometry test.
+"""Fleet-scale harness: 50k simulated agents + the MULTICHIP bench row.
 
-Thin driver so `_tpu_watch.py` (and humans) can produce a SCALE artifact
-with one command on whatever platform JAX resolves to. Equivalent to:
-  GYT_SCALE_TEST=1 python -m pytest tests/test_scale.py -x -q -s
+Two phases, each a killable subprocess (the bench.py isolation
+discipline), merged into ``MULTICHIP_r06.json``:
+
+- ``fold``  — the sharded ns-geometry fold on a simulated 8-device
+  mesh: ONE compiled mesh program (per-shard fused fold_all + dep
+  a2a), measured twice — single-shard-loaded (only shard 0's lanes
+  carry events: the pre-sharding shape, every other shard provisioned
+  but idle) vs all-shards-loaded (host-partitioned ingest fills every
+  shard's lanes). The acceptance gate is aggregate ≥ 3x the
+  single-shard rate of the SAME program — the win host-partitioning
+  actually buys: a mesh program's wall-clock is the max over shards,
+  not the sum, so filling the idle shards' provisioned lanes is ~free.
+  The once-per-tick fleet roll-up collective is timed alongside
+  (rolled-up ev/s = aggregate including the roll-up cadence cost).
+
+- ``fleet`` — 50,048 simulated agents (sim/partha) through the chaos
+  proxy (latency + chunk-resplit faults; no corruption, so accounting
+  is exact) over BATCHED conns (each conn aggregates ~1565 hosts — the
+  relay shape; 32 sockets, not 50k) into a REAL ``--shards`` serving
+  stack (GytServer + ShardFeeder + ShardedRuntime + per-shard WAL),
+  ticking live. Gate: ZERO silent event loss —
+  accepted + counted-drops + spooled == records_built, exactly.
+
+Legacy single-chip north-star geometry test (the old _scale.py):
+``python _scale.py --northstar``.
 """
+
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
+import tempfile
+import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+ART = os.path.join(HERE, "MULTICHIP_r06.json")
+N_SHARDS = int(os.environ.get("GYT_SCALE_SHARDS", "8"))
+# cfg.n_hosts of the ns geometry; override for quick dev runs
+N_AGENTS = int(os.environ.get("GYT_SCALE_AGENTS", "50048"))
+N_CONNS = int(os.environ.get("GYT_SCALE_CONNS", "32"))
+
+PHASE_TIMEOUT = {"fold": 3600, "fleet": 3600}
+
+
+# --------------------------------------------------------------- fold phase
+def _phase_fold() -> dict:
+    """Sharded ns-geometry fold: single-shard-loaded vs all-loaded on
+    ONE mesh program + the fleet roll-up cadence cost."""
+    import jax
+    import numpy as np
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import decode
+    from gyeeta_tpu.parallel import depgraph as dg
+    from gyeeta_tpu.parallel import rollup, sharded
+    from gyeeta_tpu.parallel.mesh import make_mesh
+    from gyeeta_tpu.parallel.partition import ShardLayout
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    # the ns fleet PARTITIONED: each shard owns 1/8 of the host space
+    # and a slab sized for its slice (the host-partitioning dividend:
+    # per-shard working set fits closer to cache than one 131k slab)
+    cfg = EngineCfg(svc_capacity=16384, n_hosts=N_AGENTS,
+                    task_capacity=8192, conn_batch=2048,
+                    resp_batch=4096, fold_k=4)
+    # per-shard dep capacities: the roll-up merges n_shards × edge
+    # capacity gathered lanes per tick — sized for the bounded caller
+    # fan-in of the partitioned fleet, not the single-node maximum
+    dep_pairs, dep_edges = 65536, 16384
+    mesh = make_mesh(N_SHARDS)
+    layout = ShardLayout(mesh)
+    t0 = time.perf_counter()
+    st = sharded.init_sharded(cfg, mesh)
+    dep = layout.put(jax.tree.map(
+        lambda x: np.broadcast_to(np.asarray(x)[None],
+                                  (N_SHARDS,) + np.asarray(x).shape),
+        dg.init(dep_pairs, dep_edges)))
+    fold = sharded.fold_step_dep_sharded(
+        cfg, mesh, cap_per_dest=cfg.conn_batch * cfg.fold_k)
+    # (batches are flat (lanes,) per shard — the slab-width variant)
+    flush = sharded.td_flush_sharded(cfg, mesh)
+    froll = rollup.fleet_rollup_fn(cfg, mesh, dep_edges)
+
+    # per-shard record streams: every shard folds ITS OWN host range
+    # (distinct universes — what host-partitioned ingest delivers)
+    per_shard_hosts = N_AGENTS // N_SHARDS // 8     # ~40% slab load
+    sims = [ParthaSim(n_hosts=per_shard_hosts, n_svcs=8,
+                      n_clients=4096,
+                      host_base=s * (N_AGENTS // N_SHARDS),
+                      seed=100 + s)
+            for s in range(N_SHARDS)]
+    K = cfg.fold_k
+    lanes_c, lanes_r = K * cfg.conn_batch, K * cfg.resp_batch
+
+    def shard_batch(sim):
+        # the sharded slab shape: ONE flat wide batch per shard
+        # (fold_k microbatches' worth of lanes — shardedrt's
+        # _dispatch_slab discipline)
+        return (decode.conn_batch(sim.conn_records(lanes_c), lanes_c),
+                decode.resp_batch(sim.resp_records(lanes_r), lanes_r))
+
+    def empty_batch():
+        return (decode.conn_batch(sims[0].conn_records(0), lanes_c),
+                decode.resp_batch(sims[0].resp_records(0), lanes_r))
+
+    def stacked(loaded_shards):
+        """(n_shards, K, B, ...) batches with only ``loaded_shards``
+        carrying events."""
+        per = []
+        e = empty_batch()
+        for s in range(N_SHARDS):
+            per.append(shard_batch(sims[s])
+                       if s in loaded_shards else e)
+        cb = jax.tree.map(lambda *xs: np.stack(xs),
+                          *[p[0] for p in per])
+        rb = jax.tree.map(lambda *xs: np.stack(xs),
+                          *[p[1] for p in per])
+        return layout.put(cb), layout.put(rb)
+
+    n_distinct = 2
+    slabs_one = [stacked({0}) for _ in range(n_distinct)]
+    slabs_all = [stacked(set(range(N_SHARDS)))
+                 for _ in range(n_distinct)]
+    ev_shard = K * (cfg.conn_batch + cfg.resp_batch)
+
+    # warmup/compile both legs on the SAME executable + absorb inserts
+    for i in range(2 * n_distinct):
+        st, dep, _p = fold(st, dep, *slabs_all[i % n_distinct],
+                           np.int32(i))
+        st, dep, _p = fold(st, dep, *slabs_one[i % n_distinct],
+                           np.int32(i))
+    st = flush(st)
+    fv = froll(st, dep)
+    jax.block_until_ready(fv.health)
+    print(f"scale[fold]: init+compile "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr,
+          flush=True)
+
+    def leg(slabs, events_per_call, calls):
+        nonlocal st, dep
+        t0 = time.perf_counter()
+        for i in range(calls):
+            st, dep, _p = fold(st, dep, *slabs[i % n_distinct],
+                               np.int32(i))
+        jax.block_until_ready(jax.tree.leaves(st)[0])
+        dt = time.perf_counter() - t0
+        return calls * events_per_call / dt, dt / calls
+
+    r_one, ms_one = leg(slabs_one, ev_shard, 6)
+    r_all, ms_all = leg(slabs_all, N_SHARDS * ev_shard, 6)
+
+    # fleet roll-up cadence cost (the once-per-tick collective)
+    t0 = time.perf_counter()
+    n_roll = 4
+    for _ in range(n_roll):
+        fv = froll(st, dep)
+        jax.block_until_ready(fv.health)
+    roll_s = (time.perf_counter() - t0) / n_roll
+    # rolled-up rate: a 5s tick pays one roll-up per tick of folding
+    tick_s = 5.0
+    folds_per_tick = tick_s / ms_all
+    rolled_rate = (folds_per_tick * N_SHARDS * ev_shard) \
+        / (tick_s + roll_s)
+
+    out = {
+        "n_shards": N_SHARDS,
+        "per_shard_geometry": {"svc_capacity": cfg.svc_capacity,
+                               "n_hosts": cfg.n_hosts,
+                               "conn_batch": cfg.conn_batch,
+                               "resp_batch": cfg.resp_batch,
+                               "fold_k": K},
+        "events_per_dispatch_per_shard": ev_shard,
+        "single_shard_ev_per_sec": round(r_one, 1),
+        "single_shard_ms_per_dispatch": round(ms_one * 1e3, 2),
+        "per_shard_ev_per_sec": round(r_all / N_SHARDS, 1),
+        "aggregate_ev_per_sec": round(r_all, 1),
+        "aggregate_ms_per_dispatch": round(ms_all * 1e3, 2),
+        "aggregate_vs_single_shard": round(r_one and r_all / r_one, 3),
+        "rollup_seconds": round(roll_s, 4),
+        "rolledup_ev_per_sec": round(rolled_rate, 1),
+        "meets_3x_gate": bool(r_all >= 3.0 * r_one),
+        "device": f"{jax.devices()[0].platform}",
+    }
+    print(f"scale[fold]: single-shard {r_one:,.0f} ev/s "
+          f"({ms_one * 1e3:.1f} ms), aggregate {r_all:,.0f} ev/s "
+          f"({ms_all * 1e3:.1f} ms, {N_SHARDS} shards) = "
+          f"x{out['aggregate_vs_single_shard']}, roll-up "
+          f"{roll_s * 1e3:.0f} ms → rolled-up {rolled_rate:,.0f} ev/s",
+          file=sys.stderr, flush=True)
+    return out
+
+
+# -------------------------------------------------------------- fleet phase
+async def _fleet_scenario() -> dict:
+    import numpy as np
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.net.agent import register
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.parallel.mesh import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    from gyeeta_tpu.sim.chaos import ChaosProxy, FaultPlan
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils.config import RuntimeOpts
+    import asyncio
+
+    tmp = tempfile.mkdtemp(prefix="gyt_fleet_")
+    hosts_per_conn = N_AGENTS // N_CONNS            # 1564
+    n_svcs = 2                                      # 100k services total
+    cfg = EngineCfg(svc_capacity=32768, n_hosts=N_AGENTS,
+                    task_capacity=4096, conn_batch=2048,
+                    resp_batch=2048, listener_batch=512, fold_k=2)
+    # dep-edge capacity bounds the per-tick roll-up's gather+merge —
+    # the CPU sim pays all 8 shards' merge serially, so size it for
+    # the bounded caller fan-in below, not the parity-test maximum
+    opts = RuntimeOpts(dep_pair_capacity=32768, dep_edge_capacity=8192,
+                       journal_dir=os.path.join(tmp, "wal"),
+                       journal_backlog_mb=512)
+    srt = ShardedRuntime(cfg, make_mesh(N_SHARDS), opts)
+    srv = GytServer(srt, tick_interval=None, idle_timeout=3600.0,
+                    hostmap_path=os.path.join(tmp, "hostmap.json"),
+                    shard_ingest=True, shard_queue_mb=64.0)
+    host, port = await srv.start()
+
+    # chaos proxy: latency/jitter + chunk re-splitting at scale — no
+    # corruption faults, so the no-silent-loss ledger balances exactly
+    plan = FaultPlan(seed=11, latency_s=0.001, jitter_s=0.002,
+                     resplit=1 << 15)
+    proxy = ChaosProxy(host, port, plan=plan)
+    ph, pp = await proxy.start()
+
+    sims = [ParthaSim(n_hosts=hosts_per_conn, n_svcs=n_svcs,
+                      n_clients=512, host_base=k * hosts_per_conn,
+                      seed=500 + k, cli_groups_per_svc=2)
+            for k in range(N_CONNS)]
+    built = {"conn": 0, "resp": 0, "listener": 0, "host": 0}
+
+    conns = []
+    for k in range(N_CONNS):
+        reader, writer, status, hid = await register(
+            ph, pp, machine_id=0xF1EE7000 + k, conn_type=wire.CONN_EVENT)
+        assert status == wire.REG_OK, (k, status)
+        conns.append((reader, writer))
+
+    async def drive(k: int, rounds: int, inventory: bool):
+        _reader, writer = conns[k]
+        sim = sims[k]
+        for r in range(rounds):
+            nc, nr = 1024, 1024
+            buf = sim.conn_frames(nc) + sim.resp_frames(nr)
+            built["conn"] += nc
+            built["resp"] += nr
+            if inventory and r == 0:
+                lst = sim.listener_state_records()
+                hst = sim.host_state_records()
+                buf += wire.encode_frames_chunked(
+                    wire.NOTIFY_LISTENER_STATE, lst)
+                buf += wire.encode_frames_chunked(
+                    wire.NOTIFY_HOST_STATE, hst)
+                built["listener"] += len(lst)
+                built["host"] += len(hst)
+            writer.write(buf)
+            await writer.drain()
+            await asyncio.sleep(0)
+
+    async def settle(want_key=None):
+        for w in conns:
+            await w[1].drain()
+        for _ in range(600):
+            srv._feed_barrier()
+            srt.flush()
+            c = srt.stats.counters
+            got = c.get("conn_events", 0) + c.get("resp_events", 0)
+            if got >= built["conn"] + built["resp"]:
+                return
+            await asyncio.sleep(0.5)
+
+    # warmup: one full-shape round compiles every mesh program (fold,
+    # classify, tick, roll-up, snapshot copy) OUTSIDE the measured wall
+    await asyncio.gather(*(drive(k, 1, True)
+                           for k in range(N_CONNS)))
+    await settle()
+    srt.run_tick()
+
+    t_start = time.perf_counter()
+    rounds = 4
+    await asyncio.gather(*(drive(k, rounds, False)
+                           for k in range(N_CONNS)))
+    # settle: every byte through the proxy, the feeder and the fold
+    await asyncio.sleep(1.0)
+    await settle()
+    feed_wall = time.perf_counter() - t_start
+    t_tick = time.perf_counter()
+    rep = srt.run_tick()
+    tick_wall = time.perf_counter() - t_tick
+    wall = time.perf_counter() - t_start
+    measured = rounds * N_CONNS * 2048      # conn+resp of measured legs
+
+    c = dict(srt.stats.counters)
+    accepted = c.get("conn_events", 0) + c.get("resp_events", 0)
+    dropped = sum(v for k, v in c.items()
+                  if k.startswith(("shard_ingest_dropped|",
+                                   "frames_rejected")))
+    spooled = 0                       # raw conns: no agent spool tier
+    records_built = built["conn"] + built["resp"]
+    ledger_ok = (accepted + dropped + spooled) == records_built
+
+    # the merged fleet view actually covers the fleet
+    ss = srt.query({"subsys": "serverstatus"})["recs"][0]
+    sl = srt.query({"subsys": "shardlist", "maxrecs": 16})["recs"]
+    per_shard_hosts = [r["nhosts"] for r in sl]
+    gauges = dict(srt.stats.gauges)
+    per_shard_rates = {
+        int(k.split("=")[-1]): v for k, v in gauges.items()
+        if k.startswith("shard_fold_ev_per_sec|")}
+
+    from gyeeta_tpu.utils import journal as J
+    walshards = len(J.sharded_subdirs(opts.journal_dir))
+
+    for _r, w in conns:
+        w.close()
+    await proxy.stop()
+    await srv.stop()
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "agents": N_AGENTS, "conns": N_CONNS,
+        "hosts_per_conn": hosts_per_conn,
+        "records_built": records_built,
+        "listener_records": built["listener"],
+        "accepted": accepted, "dropped": dropped, "spooled": spooled,
+        "zero_silent_loss": ledger_ok,
+        "wall_s": round(wall, 2),
+        "feed_wall_s": round(feed_wall, 2),
+        "tick_wall_s": round(tick_wall, 2),
+        "ev_per_sec": round(measured / feed_wall, 1),
+        "ev_per_sec_with_tick": round(measured / wall, 1),
+        "nhosts_reporting": ss["nhosts"],
+        "nsvc": ss["nsvc"],
+        "per_shard_hosts": per_shard_hosts,
+        "per_shard_fold_ev_per_sec": per_shard_rates,
+        "rollup_seconds": gauges.get("rollup_seconds"),
+        "wal_shard_subdirs": walshards,
+        "alerts_tick": rep.get("tick"),
+    }
+
+
+def _phase_fleet() -> dict:
+    import asyncio
+    return asyncio.run(_fleet_scenario())
+
+
+# ------------------------------------------------------------- orchestrator
+def _run_phase_subproc(phase: str) -> dict:
+    env = dict(
+        os.environ, GYT_SCALE_PHASE=phase,
+        JAX_PLATFORMS="cpu", GYT_PLATFORM="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_force_host_platform_device_count="
+                   f"{N_SHARDS}").strip(),
+        # always-cold scoped compile cache: reloading cached shard_map
+        # executables is broken on 0.4.x (tests/conftest.py)
+        JAX_COMPILATION_CACHE_DIR=tempfile.mkdtemp(
+            prefix="gyt_scale_xla_"))
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, __file__], env=env,
+                           cwd=HERE, capture_output=True, text=True,
+                           timeout=PHASE_TIMEOUT[phase])
+    except subprocess.TimeoutExpired:
+        print(f"scale: phase {phase} TIMED OUT after "
+              f"{time.time() - t0:.0f}s", file=sys.stderr, flush=True)
+        return {"timeout": True}
+    sys.stderr.write(r.stderr or "")
+    line = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.strip().startswith("{"):
+            line = ln.strip()
+    if r.returncode != 0 or not line:
+        print(f"scale: phase {phase} failed rc={r.returncode}",
+              file=sys.stderr, flush=True)
+        return {"failed": True, "rc": r.returncode}
+    try:
+        return json.loads(line)
+    except ValueError:
+        return {"failed": True, "bad_json": True}
+
+
+def main() -> int:
+    if "--northstar" in sys.argv:
+        # legacy single-chip 65k-service geometry test
+        env = dict(os.environ, GYT_SCALE_TEST="1")
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_scale.py",
+             "-x", "-q", "-s", "-p", "no:cacheprovider"],
+            cwd=HERE, env=env)
+        return r.returncode
+
+    phase = os.environ.get("GYT_SCALE_PHASE")
+    if phase == "fold":
+        print(json.dumps(_phase_fold()))
+        return 0
+    if phase == "fleet":
+        print(json.dumps(_phase_fleet()))
+        return 0
+
+    result = {
+        "metric": "multichip_sharded_fold",
+        "n_shards": N_SHARDS,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    fold = _run_phase_subproc("fold")
+    result["fold"] = fold
+    fleet = _run_phase_subproc("fleet")
+    result["fleet"] = fleet
+    result["ok"] = bool(fold.get("meets_3x_gate")
+                        and fleet.get("zero_silent_loss"))
+    with open(ART, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
 
 if __name__ == "__main__":
-    env = dict(os.environ)
-    env["GYT_SCALE_TEST"] = "1"
-    r = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests/test_scale.py",
-         "-x", "-q", "-s", "-p", "no:cacheprovider"],
-        cwd=HERE, env=env)
-    sys.exit(r.returncode)
+    sys.exit(main())

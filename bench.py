@@ -44,10 +44,11 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 PHASE_TIMEOUT = {"fold_toy": 1500, "fold_ns": 2700,
                  "feed_toy": 900, "feed_ns": 1500,
                  "feed_toy_wal": 900, "topk_recover": 900,
-                 "compact": 1200, "timeview_aggr": 900}
+                 "compact": 1200, "timeview_aggr": 900,
+                 "snap_pingpong": 900}
 PHASE_ORDER = ("fold_toy", "fold_ns", "feed_ns", "feed_toy",
                "feed_toy_wal", "topk_recover", "compact",
-               "timeview_aggr")
+               "timeview_aggr", "snap_pingpong")
 
 
 def _geometry(which: str):
@@ -582,6 +583,70 @@ def _bench_timeview_aggr() -> dict:
     return out
 
 
+def _bench_snap_pingpong() -> dict:
+    """Snapshot ping-pong prototype (ROADMAP query item (a), ISSUE-10
+    satellite): publish cost with the retired (N-2) snapshot's buffers
+    donated as the copy's destination vs the plain non-donating copy.
+    Measured result on the 0.4.37 CPU backend: donation IS honored and
+    the ping-pong publish is ~12x cheaper at the 32k geometry (the
+    plain copy's cost is dominated by allocating+freeing the full
+    state every publish; the donated path writes into the retired
+    buffers). ``donations``/``fallbacks`` count how often the refcount
+    guard allowed it. Default stays OFF (GYT_SNAP_PINGPONG=1 enables):
+    on CPU the merged-column renders are ZERO-COPY numpy views of
+    snapshot buffers, and an off-tick consumer (the history writer's
+    queue) that falls more than two ticks behind could still hold
+    views of the N-2 snapshot when it donates — see OPERATIONS.md
+    "Fleet-scale deployment" for the enablement conditions."""
+    import gc
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    cfg = EngineCfg(svc_capacity=32768, n_hosts=8192,
+                    task_capacity=8192)
+    sim = ParthaSim(n_hosts=256, n_svcs=64, n_clients=2048)
+    out: dict = {}
+    for mode in ("off", "on"):
+        os.environ["GYT_SNAP_PINGPONG"] = "1" if mode == "on" else "0"
+        rt = Runtime(cfg, RuntimeOpts(dep_pair_capacity=16384,
+                                      dep_edge_capacity=16384))
+        rt.feed(sim.conn_frames(2048) + sim.resp_frames(2048))
+        rt.flush()
+        for _ in range(3):              # compile + settle generations
+            rt.publish_snapshot()
+        gc.collect()
+        iters = 12
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            rt.publish_snapshot()
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        c = rt.stats.counters
+        out[f"publish_ms_{mode}"] = round(ms, 3)
+        if mode == "on":
+            out["donations"] = c.get("snapshot_pingpong_donations", 0)
+            out["fallbacks"] = c.get("snapshot_pingpong_fallbacks", 0)
+            out["errors"] = c.get("snapshot_pingpong_errors", 0)
+        rt.close()
+        del rt
+        gc.collect()
+    os.environ.pop("GYT_SNAP_PINGPONG", None)
+    out["ratio_on_vs_off"] = round(
+        out["publish_ms_on"] / max(out["publish_ms_off"], 1e-9), 4)
+    out["note"] = (
+        "donation honored on this backend; default OFF because CPU "
+        "merged-column renders are zero-copy views — enable when "
+        "off-tick consumers drain within 2 ticks (OPERATIONS.md)")
+    print(f"bench[snap_pingpong]: publish {out['publish_ms_off']} ms "
+          f"(copy) vs {out['publish_ms_on']} ms (ping-pong, "
+          f"{out.get('donations', 0)} donations / "
+          f"{out.get('fallbacks', 0)} fallbacks)",
+          file=sys.stderr, flush=True)
+    return out
+
+
 def _run_phase(phase: str) -> dict:
     """Leaf mode: run ONE phase in-process and return its fields."""
     import jax
@@ -620,6 +685,8 @@ def _run_phase(phase: str) -> dict:
         return _bench_compact(cfg, sim, dp, de)
     if phase == "timeview_aggr":
         return _bench_timeview_aggr()
+    if phase == "snap_pingpong":
+        return _bench_snap_pingpong()
     raise SystemExit(f"unknown phase {phase!r}")
 
 
@@ -759,6 +826,12 @@ def _orchestrate(platform: str | None, degraded: bool,
         if "rate" in ns:
             result["compact"]["replay_vs_ns_fold"] = round(
                 cp["replay_ev_per_sec"] / ns["rate"], 4)
+    pp = phases.get("snap_pingpong", {})
+    if "ratio_on_vs_off" in pp:
+        # snapshot ping-pong prototype row (ISSUE-10 satellite): copy
+        # cost ± donated-destination publish, with the CPU-donation
+        # caveat recorded in the row itself
+        result["snap_pingpong"] = dict(pp)
     tv = phases.get("timeview_aggr", {})
     if "speedup" in tv:
         # windowed-aggregation vectorization row (ISSUE 9 satellite):
@@ -784,7 +857,8 @@ def _orchestrate(platform: str | None, degraded: bool,
     failed = [p for p, v in phases.items()
               if "rate" not in v and "recover_ms_per_tick" not in v
               and "replay_ev_per_sec" not in v
-              and "speedup" not in v]
+              and "speedup" not in v
+              and "ratio_on_vs_off" not in v]
     if failed:
         result["phases_failed"] = failed
     print(json.dumps(result))

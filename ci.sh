@@ -75,6 +75,17 @@ if ! JAX_PLATFORMS=cpu python _qps_smoke.py; then
     exit 1
 fi
 
+# Multichip smoke: a REAL `serve --shards 8` subprocess on the
+# simulated 8-device mesh — per-shard ingest + WAL subdirs + collective
+# roll-up; 2 agents on different shards; asserts the MERGED
+# svcstate/topk rows are non-empty and byte-equal on REST and stock NM,
+# chunks routed to their layout shards, per-shard gauges exposed.
+echo "ci: multichip --shards smoke" >&2
+if ! JAX_PLATFORMS=cpu python _multichip_smoke.py; then
+    echo "ci: FATAL — multichip smoke failed" >&2
+    exit 1
+fi
+
 # Chaos smoke: a REAL `serve` subprocess behind the seeded chaos proxy
 # (sim/chaos.py) — corruption/disconnect faults, a slow-loris conn,
 # one SIGTERM kill + --restore-latest restart. Fails on agent exit,

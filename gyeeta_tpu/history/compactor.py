@@ -161,7 +161,12 @@ class Compactor:
         rt._cols.bump()
         self._last_t = float(ent["t1"])
         wal = data["meta"].get("wal")
-        self._pos = tuple(wal) if wal else None
+        if wal and isinstance(wal[0], (list, tuple)):
+            # sharded WAL: [shard, seg, off] triples → per-shard map
+            self._pos = {int(e[0]): (int(e[1]), int(e[2]))
+                         for e in wal}
+        else:
+            self._pos = tuple(wal) if wal else None
 
     def _ensure_rt(self):
         if self._rt is not None:
@@ -190,6 +195,41 @@ class Compactor:
         with self._lock:
             return self._compact_once(seal, upto_tick)
 
+    def _pos_serial(self):
+        """JSON-stable resume position: the flat ``(seg, off)`` pair,
+        or ``[shard, seg, off]`` triples for the sharded WAL."""
+        if isinstance(self._pos, dict):
+            return [[int(s), int(p[0]), int(p[1])]
+                    for s, p in sorted(self._pos.items())]
+        return self._pos
+
+    def _chunk_stream(self, upto):
+        """Sealed-WAL chunks from the resume position: the flat-dir
+        walk, or the tick-merged walk over ``shard_NN/`` subdirs when
+        the journal is sharded (the mesh tier's per-shard WAL — the
+        merge keeps windows in order; within a tick the cross-shard
+        interleave is irrelevant, records are host-disjoint). Yields
+        ``(pos_update_fn, t, hid, tick, cid, chunk)``."""
+        subdirs = J.sharded_subdirs(self.journal_dir)
+        if subdirs:
+            pos_map = dict(self._pos) if isinstance(self._pos, dict) \
+                else {}
+            for s, seq, off, t, hid, tick, cid, chunk in \
+                    J.read_sealed_sharded(subdirs, pos_map, upto,
+                                          stats=self.stats):
+                def upd(s=s, seq=seq, off=off):
+                    cur = dict(self._pos) if isinstance(self._pos,
+                                                        dict) else {}
+                    cur[s] = (seq, off)
+                    self._pos = cur
+                yield upd, t, hid, tick, cid, chunk
+            return
+        for seq, off, t, hid, tick, cid, chunk in J.read_sealed(
+                self.journal_dir, self._pos, upto, stats=self.stats):
+            def upd(seq=seq, off=off):
+                self._pos = (seq, off)
+            yield upd, t, hid, tick, cid, chunk
+
     def _compact_once(self, seal, upto_tick) -> dict:
         t_wall = time.perf_counter()
         rt = self._ensure_rt()
@@ -197,16 +237,18 @@ class Compactor:
             self.journal.seal_active()
         upto = self.journal.sealed_upto() \
             if self.journal is not None else None
+        if upto is not None and not isinstance(upto, (list, tuple)) \
+                and J.sharded_subdirs(self.journal_dir):
+            upto = None                    # layout mismatch: read all
         nrec = nch = windows = 0
         with self.stats.timeit("compact_replay"):
-            for seq, off, t, hid, tick, cid, chunk in J.read_sealed(
-                    self.journal_dir, self._pos, upto,
-                    stats=self.stats):
+            for upd, t, hid, tick, cid, chunk in self._chunk_stream(
+                    upto):
                 if tick > rt._tick_no:
                     windows += self._tick_to(rt, tick)
                 nrec += rt.feed(chunk, hid=hid, conn_id=cid)
                 nch += 1
-                self._pos = (seq, off)
+                upd()
                 self._win_t0 = t if self._win_t0 is None \
                     else min(self._win_t0, t)
                 self._win_t1 = t if self._win_t1 is None \
@@ -228,7 +270,7 @@ class Compactor:
             if pos is not None:
                 # durable handoff: checkpoint truncation may now drop
                 # segments the shard tier has absorbed
-                self.journal.set_truncate_floor(int(pos[0]))
+                self.journal.set_truncate_floor(J.floors_of(pos))
         dropped = self.retention()
         return {"chunks": nch, "records": nrec, "windows": windows,
                 "ev_per_sec": round(ev_s, 1), "secs": round(secs, 4),
@@ -275,7 +317,7 @@ class Compactor:
                 dep_leaves=jax.tree_util.tree_leaves(rt.dep),
                 columns=columns,
                 cfg_fp=_cfg_fingerprint(self.cfg),
-                wal_pos=self._pos)
+                wal_pos=self._pos_serial())
         self.stats.gauge("compact_shard_bytes", float(ent["bytes"]))
         self._last_t = t1
         self._win_t0 = self._win_t1 = None

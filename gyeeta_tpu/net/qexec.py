@@ -13,11 +13,22 @@ behind an unbounded queue (``gyt_queries_shed_total``; the reference's
 L2 pools bound their MPMC queues the same way,
 ``server/gy_mconnhdlr.h:53-75``).
 
+Shedding is queue-depth-aware and policy-selectable (ROADMAP query
+item (d)): under sustained overload the default ``lifo`` policy serves
+the NEWEST waiting query first and sheds the OLDEST — a dashboard
+refreshing every second wants its latest request answered, not a
+30-second-old one it already gave up on; the stale request costs the
+same render and produces an ignored response. ``fifo`` keeps classic
+arrival order with tail-drop (shed the newest arrival when full) as
+the control. Every shed lands on ``gyt_queries_shed_total{policy=…}``.
+
 Knobs (env, read at construction; also settable via ``serve`` flags):
 
 - ``GYT_QUERY_WORKERS``    — pool width (default 4)
 - ``GYT_QUERY_QUEUE_MAX``  — max in-flight (queued + running) before
   shedding (default 128)
+- ``GYT_QUERY_SHED_POLICY`` — ``lifo`` (default: serve newest, shed
+  oldest) or ``fifo`` (serve oldest, shed newest arrival)
 - ``GYT_QUERY_SNAPSHOT``   — 0 routes the serving edges back to inline
   strong-consistency execution (the pre-snapshot behavior; the
   escape hatch)
@@ -26,6 +37,7 @@ Knobs (env, read at construction; also settable via ``serve`` flags):
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import os
 from typing import Optional
@@ -45,42 +57,112 @@ def snapshot_serving_enabled(env=None) -> bool:
 
 class QueryExecutor:
     def __init__(self, rt, workers: Optional[int] = None,
-                 queue_max: Optional[int] = None):
+                 queue_max: Optional[int] = None,
+                 shed_policy: Optional[str] = None):
         env = os.environ
         self.rt = rt
         self.workers = int(workers if workers is not None
                            else env.get("GYT_QUERY_WORKERS", "4"))
         self.queue_max = int(queue_max if queue_max is not None
                              else env.get("GYT_QUERY_QUEUE_MAX", "128"))
+        self.shed_policy = (shed_policy if shed_policy is not None
+                            else env.get("GYT_QUERY_SHED_POLICY",
+                                         "lifo")).strip().lower()
+        if self.shed_policy not in ("lifo", "fifo"):
+            raise ValueError(
+                f"GYT_QUERY_SHED_POLICY must be lifo|fifo, got "
+                f"{self.shed_policy!r}")
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, self.workers),
             thread_name_prefix="gyt-query")
-        self._inflight = 0
+        self._running = 0             # queries holding a worker thread
+        # waiting room, newest at the right; (req, future) pairs.
+        # All scheduling state is event-loop-confined — no locks.
+        self._pending: collections.deque = collections.deque()
+
+    @property
+    def _inflight(self) -> int:
+        return self._running + len(self._pending)
 
     # -------------------------------------------------------------- run
     async def run(self, req: dict) -> dict:
-        """Admit one query and execute it on the pool with
-        ``consistency=snapshot`` forced — or raise :class:`Overloaded`
-        (counted) when the in-flight window is full. The caller holds
-        the event loop; the query holds a worker thread."""
+        """Admit one query: execute immediately while the pool has
+        headroom, else wait in the policy-ordered queue. Raises
+        :class:`Overloaded` (counted, policy-labeled) when admission
+        sheds it — which under ``lifo`` is the OLDEST waiter, so THIS
+        call usually proceeds and a stale one errors out instead."""
         stats = self.rt.stats
-        if self._inflight >= self.queue_max:
+        loop = asyncio.get_running_loop()
+        if self._running < self.workers and not self._pending:
+            return await self._execute(loop, req)
+        if self.shed_policy == "fifo" \
+                and self._inflight >= self.queue_max:
+            # classic bounded-FIFO tail drop: the NEW arrival sheds
+            stats.bump("queries_shed|policy=fifo")
             stats.bump("queries_shed")
             raise Overloaded(
                 f"query queue full ({self._inflight} in flight, "
                 f"max {self.queue_max})")
-        self._inflight += 1
-        stats.gauge("query_queue_depth", float(self._inflight))
+        fut = loop.create_future()
+        self._pending.append((req, fut))
+        if self.shed_policy == "lifo":
+            # depth-aware freshness shed: drop the OLDEST waiters past
+            # the bound — the dashboard that sent them has already
+            # refreshed; the newest request is the one still on screen
+            while self._inflight > self.queue_max and len(self._pending) > 1:
+                old_req, old_fut = self._pending.popleft()
+                if not old_fut.done():
+                    stats.bump("queries_shed|policy=lifo")
+                    stats.bump("queries_shed")
+                    old_fut.set_exception(Overloaded(
+                        f"query queue full (lifo: oldest shed, "
+                        f"{self._inflight} in flight, max "
+                        f"{self.queue_max})"))
+        self._gauge()
+        return await fut
+
+    async def _execute(self, loop, req: dict) -> dict:
+        self._running += 1
+        self._gauge()
         try:
-            loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(
-                self._pool, self._call, req)
+            return await loop.run_in_executor(self._pool, self._call,
+                                              req)
         finally:
-            self._inflight -= 1
-            stats.gauge("query_queue_depth", float(self._inflight))
+            self._running -= 1
+            self._dispatch_next(loop)
+            self._gauge()
+
+    def _dispatch_next(self, loop) -> None:
+        """A worker freed: hand it the policy's next waiter (lifo =
+        newest first; fifo = oldest first)."""
+        while self._pending and self._running < self.workers:
+            req, fut = (self._pending.pop() if self.shed_policy == "lifo"
+                        else self._pending.popleft())
+            if fut.done():                # already shed
+                continue
+
+            async def _chain(req=req, fut=fut):
+                try:
+                    out = await self._execute(loop, req)
+                except BaseException as e:     # noqa: BLE001
+                    if not fut.done():
+                        fut.set_exception(e)
+                else:
+                    if not fut.done():
+                        fut.set_result(out)
+
+            loop.create_task(_chain())
+            return                        # _execute's finally continues
+
+    def _gauge(self) -> None:
+        self.rt.stats.gauge("query_queue_depth", float(self._inflight))
 
     def _call(self, req: dict) -> dict:
         return self.rt.query({**req, "consistency": "snapshot"})
 
     def close(self) -> None:
+        for _req, fut in self._pending:
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -67,7 +67,9 @@ class GytServer:
                  throttle_slab_frac: float = 0.85,
                  query_workers: Optional[int] = None,
                  query_queue_max: Optional[int] = None,
-                 query_snapshot: Optional[bool] = None):
+                 query_snapshot: Optional[bool] = None,
+                 shard_ingest: bool = False,
+                 shard_queue_mb: float = 8.0):
         self.rt = rt
         self.host = host
         self.port = port
@@ -148,6 +150,18 @@ class GytServer:
             # decoded cleanly get recorded (replayability; see the
             # pipeline docstring for the poison-frame divergence)
             self._pipe = FeedPipeline(rt, recorder=self._recorder)
+        # --shards mode: per-shard ingest loops between the conn
+        # handlers and the mesh runtime (net/shardfeed.py). Mutually
+        # exclusive with the decode pipeline — the feeder owns the
+        # handoff.
+        self._feeder = None
+        if shard_ingest and getattr(rt, "n", 1) > 1:
+            if self._pipe is not None:
+                raise ValueError(
+                    "--feed-pipeline and shard ingest are mutually "
+                    "exclusive (the shard feeder owns the handoff)")
+            from gyeeta_tpu.net.shardfeed import ShardFeeder
+            self._feeder = ShardFeeder(rt, queue_max_mb=shard_queue_mb)
         # stock-partha registration state: machine-id → the ident key
         # issued at PS_REGISTER (the SM_PARTHA_IDENT_NOTIFY flow,
         # gy_comm_proto.h:946 — shyama hands the key to madhava; the
@@ -296,13 +310,17 @@ class GytServer:
         """Ingest complete-frame bytes: through the decode pipeline
         when enabled, else directly. ``hid``/``conn_id`` attribute the
         bytes in the write-ahead journal."""
+        if self._feeder is not None:
+            return self._feeder.submit(buf, hid=hid, conn_id=conn_id)
         if self._pipe is not None:
             return self._pipe.feed(buf, hid=hid, conn_id=conn_id)
         return self.rt.feed(buf, hid=hid, conn_id=conn_id)
 
     def _feed_barrier(self) -> None:
-        """Make every submitted byte visible (pipeline barrier) before
-        a tick or query reads state."""
+        """Make every submitted byte visible (pipeline / shard-queue
+        barrier) before a tick or query reads state."""
+        if self._feeder is not None:
+            self._feeder.flush_pending()
         if self._pipe is not None:
             self._pipe.flush()
 
@@ -346,6 +364,8 @@ class GytServer:
             self._handle_conn, self.host, self.port)
         sock = self._server.sockets[0].getsockname()
         self.host, self.port = sock[0], sock[1]
+        if self._feeder is not None:
+            self._feeder.start()
         if self.tick_interval:
             self._tick_task = asyncio.create_task(self._tick_loop())
         log.info("gyt server on %s:%d", self.host, self.port)
@@ -368,6 +388,8 @@ class GytServer:
         if self._recorder is not None:
             rec, self._recorder = self._recorder, None
             rec.close()      # live conns see None, never a closed file
+        if self._feeder is not None:
+            await self._feeder.stop()    # drain queued runs, then fold
         if self._pipe is not None:
             self._pipe.close()           # barrier + worker shutdown
         self.qexec.close()   # query worker pool (no new snapshot reads)

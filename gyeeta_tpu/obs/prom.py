@@ -89,10 +89,22 @@ def render(stats, alerts=None) -> str:
             out.append(f"{n} {_num(alerts.stats[k])}")
 
     gauges["uptime_seconds"] = time.time() - stats.t_start
-    for k in sorted(gauges):
-        n = f"gyt_{_name(k)}"
+    # gauges share the counters' "name|k=v" label convention (the
+    # per-shard fold-rate / occupancy gauges of the mesh tier)
+    gfam: dict[str, list] = {}
+    for k in gauges:
+        base, _, labels = k.partition("|")
+        gfam.setdefault(base, []).append((labels, gauges[k]))
+    for base in sorted(gfam):
+        n = f"gyt_{_name(base)}"
         out.append(f"# TYPE {n} gauge")
-        out.append(f"{n} {_num(gauges[k])}")
+        for labels, v in sorted(gfam[base]):
+            lab = ""
+            if labels:
+                parts = [f'{_name(kk)}="{vv}"' for kk, _, vv in
+                         (p.partition("=") for p in labels.split(","))]
+                lab = "{" + ",".join(parts) + "}"
+            out.append(f"{n}{lab} {_num(v)}")
 
     hists = stats.timing_hists()
     if hists:

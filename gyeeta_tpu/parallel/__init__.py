@@ -14,10 +14,11 @@ madhavas per shyama) connected by TCP RPCs. Here the same roles map onto a
 """
 
 from gyeeta_tpu.parallel.mesh import HOST_AXIS, make_mesh, shard_of_host
-from gyeeta_tpu.parallel import sharded, rollup, pairing, depgraph
+from gyeeta_tpu.parallel import sharded, rollup, pairing, depgraph, \
+    partition
 
 __all__ = ["HOST_AXIS", "make_mesh", "shard_of_host", "sharded", "rollup",
-           "pairing", "depgraph", "ShardedRuntime"]
+           "pairing", "depgraph", "partition", "ShardedRuntime"]
 
 
 def __getattr__(name):

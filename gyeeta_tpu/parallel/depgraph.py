@@ -537,3 +537,12 @@ def mesh_clusters(es: EdgeSet, node_capacity: int, n_iters: int = 16):
         jnp.where(live, labels, node_capacity)].add(1, mode="drop")
     sizes = jnp.where(live, counts[jnp.where(live, labels, 0)], 0)
     return ntbl, labels, sizes
+
+
+# Process-wide compiled-builder memo (see sharded.memo_sharded: also a
+# 0.4.x persistent-cache-reload correctness fix — the dep-graph a2a
+# programs were exactly the ones that came back with broken layouts).
+from gyeeta_tpu.parallel.sharded import memoize_builder as _memoize  # noqa: E402
+
+dep_step_fn = _memoize(dep_step_fn)
+edge_rollup_fn = _memoize(edge_rollup_fn)

@@ -1,0 +1,207 @@
+"""Shard-layout declaration: partition rules named once, reused everywhere.
+
+Before this module every consumer of the mesh re-derived its own layout
+ad hoc: ``init_sharded`` broadcast + leading-sharded, the snapshot copy
+inherited input shardings implicitly, the dep graph replicated by hand,
+and the WAL/history tiers had no layout notion at all. The fleet-scale
+tier makes the layout a FIRST-CLASS declaration (the
+``match_partition_rules`` idiom of large-model training codebases): a
+:class:`ShardLayout` holds the mesh plus an ordered list of
+``(leaf-path regex, PartitionSpec)`` rules, and fold, roll-up, snapshot
+publication, checkpoint restore and the per-shard WAL all ask IT where
+data lives instead of encoding the answer locally.
+
+The default rules say exactly what the sharded tier has always meant:
+
+- stacked engine/dep leaves split on their LEADING axis over every mesh
+  axis (each shard owns the full-geometry slab for its slice of the
+  host space — data parallelism over ``HOST_AXIS``),
+- scalars and rollup outputs replicate.
+
+``pjit_with_cpu_fallback`` keeps single-device hosts (a laptop, the
+1-device bench leg) on plain ``jax.jit`` — sharding constraints over a
+1-element mesh only cost compile time — while mesh hosts get explicit
+in/out shardings.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gyeeta_tpu.parallel.mesh import SLICE_AXIS, axes_of, make_mesh, \
+    make_mesh2d, shard_of_host
+
+
+def named_tree_paths(tree, sep: str = "/"):
+    """Flatten ``tree`` to ``[(path, leaf)]`` with ``sep``-joined path
+    names (NamedTuple fields and dict keys become path components —
+    e.g. ``state/tbl/key_hi``). The name side of the partition-rule
+    match."""
+    out = []
+
+    def walk(prefix, node):
+        if hasattr(node, "_fields"):          # NamedTuple
+            for f in node._fields:
+                walk(prefix + [f], getattr(node, f))
+        elif isinstance(node, dict):
+            for k in sorted(node):
+                walk(prefix + [str(k)], node[k])
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(prefix + [str(i)], v)
+        else:
+            out.append((sep.join(prefix), node))
+
+    walk([], tree)
+    return out
+
+
+def match_partition_rules(rules, tree, sep: str = "/"):
+    """Pytree of PartitionSpec chosen by the first rule whose regex
+    matches each leaf's path name (scalars never partition). Raises on
+    an unmatched non-scalar leaf so a new engine field cannot silently
+    fall through the layout declaration."""
+    def spec_of(name, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            return P()
+        for rule, ps in rules:
+            if re.search(rule, name) is not None:
+                return ps
+        raise ValueError(f"partition rule not found for leaf: {name}")
+
+    leaves = named_tree_paths(tree, sep=sep)
+    specs = [spec_of(name, leaf) for name, leaf in leaves]
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def pjit_with_cpu_fallback(fun, in_shardings=None, out_shardings=None,
+                           static_argnums=(), donate_argnums=(),
+                           mesh: Optional[Mesh] = None):
+    """``jax.jit`` with explicit shardings on a real mesh; plain jit on
+    a 1-device mesh (the CPU/laptop fallback — constraints over a
+    single device add compile cost and nothing else)."""
+    if mesh is not None and mesh.devices.size <= 1:
+        return jax.jit(fun, static_argnums=static_argnums,
+                       donate_argnums=donate_argnums)
+    kw = {}
+    if in_shardings is not None:
+        kw["in_shardings"] = in_shardings
+    if out_shardings is not None:
+        kw["out_shardings"] = out_shardings
+    return jax.jit(fun, static_argnums=static_argnums,
+                   donate_argnums=donate_argnums, **kw)
+
+
+def make_hybrid_mesh(n_slices: int, per_slice: int) -> Mesh:
+    """(slices × hosts) mesh via ``create_hybrid_device_mesh`` when the
+    backend exposes multi-granularity devices (real multi-slice TPU),
+    else the local reshape (``make_mesh2d`` — the simulated-mesh and
+    single-slice path). Same axis names either way, so every collective
+    written against ``axes_of(mesh)`` is layout-agnostic."""
+    try:
+        from jax.experimental import mesh_utils
+        devs = mesh_utils.create_hybrid_device_mesh(
+            (per_slice,), (n_slices,), devices=jax.devices())
+        return Mesh(devs.reshape(n_slices, per_slice),
+                    (SLICE_AXIS, "hosts"))
+    except Exception:
+        # no DCN granularity on this backend (CPU sim, one slice)
+        return make_mesh2d(n_slices, per_slice)
+
+
+# The sharded tier's layout in one place. Order matters: first match
+# wins. Leaves are named by pytree path (AggState/DepGraph field names).
+DEFAULT_RULES: tuple = (
+    # every stacked engine / dep-graph slab: split the leading shard
+    # axis over the whole mesh (1-D and multi-slice alike)
+    (r".*", "leading"),
+)
+
+
+class ShardLayout:
+    """The one declaration of where sharded data lives.
+
+    ``spec(tree)`` resolves the partition rules against a STACKED
+    ``(n_shards, ...)`` pytree; ``sharding(tree)`` turns the specs into
+    NamedShardings ready for ``jax.device_put`` / jit out_shardings.
+    ``shard_of_host`` / ``wal_subdir`` are the host-facing half: the
+    ingest edge, the WAL and replay all place by the same stable rule
+    the fold uses, so a chunk journaled for host h replays into the
+    shard that folded it (stable across reconnect AND restore)."""
+
+    WAL_SUBDIR_FMT = "shard_{:02d}"
+
+    def __init__(self, mesh: Optional[Mesh] = None,
+                 rules: tuple = DEFAULT_RULES):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.rules = tuple(
+            (pat, self._leading_spec() if ps == "leading" else ps)
+            for pat, ps in rules)
+        self.n = int(self.mesh.devices.size)
+        self._shd_memo: dict = {}     # (treedef, scalar flags) → shardings
+
+    def _leading_spec(self) -> P:
+        return P(axes_of(self.mesh))
+
+    # ------------------------------------------------------------- specs
+    def spec(self, tree):
+        """Pytree of PartitionSpec for a stacked pytree."""
+        return match_partition_rules(self.rules, tree)
+
+    def sharding(self, tree):
+        """Pytree of NamedSharding (device placement) for ``tree``."""
+        return jax.tree_util.tree_map(
+            lambda ps: NamedSharding(self.mesh, ps), self.spec(tree),
+            is_leaf=lambda x: isinstance(x, P))
+
+    @property
+    def leading(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self._leading_spec())
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # -------------------------------------------------- host-side placement
+    def shard_of_host(self, host_id):
+        """The stable ingest-edge hash: host → shard (works on scalars
+        and arrays; the same modulus the stacked fold routes by)."""
+        return shard_of_host(host_id, self.n)
+
+    def wal_subdir(self, shard: int) -> str:
+        """Per-shard WAL subdirectory name (journaling shards with the
+        fold — ``utils/journal.py:ShardedJournal``)."""
+        return self.WAL_SUBDIR_FMT.format(int(shard))
+
+    # ------------------------------------------------------------ plumbing
+    def put(self, tree):
+        """Place a stacked host-side pytree onto the mesh per the
+        rules (the ``put_sharded`` role, layout-declared). The resolved
+        sharding list is memoized per tree shape — rule matching never
+        rides the per-dispatch hot path."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        key = (treedef, tuple(
+            len(getattr(x, "shape", ())) == 0
+            or int(np.prod(x.shape)) <= 1 for x in leaves))
+        shds = self._shd_memo.get(key)
+        if shds is None:
+            shds = self._shd_memo[key] = jax.tree_util.tree_leaves(
+                self.sharding(tree),
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+        return jax.tree_util.tree_unflatten(
+            treedef, [jax.device_put(x, s)
+                      for x, s in zip(leaves, shds)])
+
+    def jit(self, fun, donate_argnums=(), static_argnums=(),
+            out_shardings=None):
+        """Layout-aware jit with the 1-device fallback."""
+        return pjit_with_cpu_fallback(
+            fun, out_shardings=out_shardings, mesh=self.mesh,
+            donate_argnums=donate_argnums, static_argnums=static_argnums)

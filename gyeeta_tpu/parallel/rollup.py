@@ -125,3 +125,62 @@ def rollup_fn(cfg: aggstate.EngineCfg, mesh):
         return _rollup_local(jax.tree.map(lambda x: x[0], st), axes)
 
     return jax.jit(_roll)
+
+
+class FleetView(NamedTuple):
+    """The whole once-per-tick cross-shard fleet view, from ONE
+    collective program: cluster aggregates + heavy-hitter candidates
+    (:class:`GlobalRollup`), the merged service dependency graph
+    (``depgraph.EdgeSet``) and the engine-health vector. This is the
+    madhava→shyama push cycle as a single mesh dispatch — everything a
+    dashboard, an alertdef or the ops cadence reads about the FLEET in
+    a tick comes off this one program's outputs."""
+    rollup: GlobalRollup
+    edges: object                  # depgraph.EdgeSet
+    health: jnp.ndarray            # (len(HEALTH_KEYS),) f32, merged
+
+
+def fleet_rollup_fn(cfg: aggstate.EngineCfg, mesh, edge_capacity: int):
+    """Compiled (state, dep) → replicated :class:`FleetView`.
+
+    One shard_map program per tick instead of three (rollup + edge
+    rollup + health readback): the psum/pmax/all_gather traffic for all
+    three shares one dispatch, and the host does one readback. The
+    health vector merges per HEALTH_KEYS semantics — sums across
+    shards, max for stage pressure (index of ``td_stage_max``)."""
+    from gyeeta_tpu.engine import step as _step
+    from gyeeta_tpu.parallel import depgraph as dg
+    from gyeeta_tpu.parallel.mesh import axes_of
+
+    axes = axes_of(mesh)
+    max_idx = _step.HEALTH_KEYS.index("td_stage_max")
+    is_max = jnp.zeros(len(_step.HEALTH_KEYS), bool).at[max_idx].set(True)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes), P(axes)),
+             out_specs=P(), check_vma=False)
+    def _roll(st, dep):
+        sloc = jax.tree.map(lambda x: x[0], st)
+        dloc = jax.tree.map(lambda x: x[0], dep)
+        ru = _rollup_local(sloc, axes)
+        live = table.live_mask(dloc.edge_tbl)
+        g = lambda x: _gather_all(x, axes)       # noqa: E731
+        es = dg._edge_merge(
+            edge_capacity, g(dloc.e_cli_hi), g(dloc.e_cli_lo),
+            g(dloc.e_cli_svc), g(dloc.e_ser_hi), g(dloc.e_ser_lo),
+            g(dloc.e_nconn), g(dloc.e_bytes), g(live))
+        vec = _step.engine_health_vec(cfg, sloc, dloc)
+        vsum, vmax = vec, vec
+        for ax in axes:
+            vsum = lax.psum(vsum, ax)
+            vmax = lax.pmax(vmax, ax)
+        return FleetView(rollup=ru, edges=es,
+                         health=jnp.where(is_max, vmax, vsum))
+
+    return jax.jit(_roll)
+
+
+# Process-wide compiled-builder memo (see sharded.memo_sharded).
+from gyeeta_tpu.parallel.sharded import memoize_builder as _memoize  # noqa: E402
+
+rollup_fn = _memoize(rollup_fn)
+fleet_rollup_fn = _memoize(fleet_rollup_fn)

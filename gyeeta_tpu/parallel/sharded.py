@@ -23,6 +23,35 @@ from gyeeta_tpu.parallel.mesh import HOST_AXIS, axes_of, \
     leading_sharding, shard_of_host
 
 
+_MESH_MEMO: dict = {}
+
+
+def mesh_key(mesh) -> tuple:
+    """Hashable identity of a mesh's geometry (axis names + shape +
+    device ids): two Mesh objects over the same devices compile the
+    same programs, so they share memoized executables."""
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def memo_sharded(key: tuple, make):
+    """Process-wide compiled-function memo for the mesh tier (the
+    sharded twin of ``runtime._memo_jit``). Beyond the compile-time
+    win, this is a CORRECTNESS fix on the 0.4.x jaxlib line: a second
+    ShardedRuntime with identical geometry used to re-trace the same
+    shard_map program, HIT the persistent XLA cache entry written
+    minutes earlier by the first instance, and the reloaded executable
+    came back with broken layouts — the long-standing "a2a rollup"
+    garbage-value failure (negative collective sums, NaN health
+    counters) that only reproduced when two mesh runtimes shared a
+    process. Sharing the in-memory executable means the program is
+    never re-traced, so the broken reload path is never taken."""
+    fn = _MESH_MEMO.get(key)
+    if fn is None:
+        fn = _MESH_MEMO[key] = make()
+    return fn
+
+
 def _local(tree):
     """Strip the singleton shard axis inside shard_map."""
     return jax.tree.map(lambda x: x[0], tree)
@@ -44,6 +73,18 @@ def init_sharded(cfg: aggstate.EngineCfg, mesh):
             lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), one)
 
     return _init()
+
+
+def stack_prerouted(batch_fns, per_shard_records):
+    """Stacked batches from records ALREADY routed per shard — the
+    ingest edge hashes hosts to shards once at staging time
+    (``ShardedRuntime._stage_raw``), so the dispatch path just builds
+    each shard's lanes from its own bucket. Returns host-side numpy
+    leaves ``(n_shards, lanes, ...)`` ready for ``put_sharded``."""
+    builder, lanes = batch_fns
+    return jax.tree.map(
+        lambda *xs: np.stack(xs),
+        *[builder(recs, lanes) for recs in per_shard_records])
 
 
 def shard_batches(cfg: aggstate.EngineCfg, mesh, batch_fns, records,
@@ -258,3 +299,36 @@ def age_apis_sharded(cfg: aggstate.EngineCfg, mesh, max_age_ticks: int):
         return _relocal(step.age_apis(cfg, _local(st), max_age_ticks))
 
     return jax.jit(_age, donate_argnums=(0,))
+
+
+def memoize_builder(builder):
+    """Route a compiled-program builder ``f(cfg?, mesh, extras...)``
+    through the process-wide memo (every arg must be hashable; Mesh
+    args key by geometry). Used below and by ``depgraph``/``rollup`` —
+    see :func:`memo_sharded` for why this is also a 0.4.x correctness
+    fix, not just a compile-time saving."""
+    from jax.sharding import Mesh
+
+    def wrapper(*args, **kwargs):
+        key = (builder.__module__, builder.__name__) + tuple(
+            mesh_key(a) if isinstance(a, Mesh) else a for a in args) \
+            + tuple(sorted(kwargs.items()))
+        return memo_sharded(key, lambda: builder(*args, **kwargs))
+
+    wrapper.__name__ = builder.__name__
+    wrapper.__doc__ = builder.__doc__
+    wrapper.__wrapped__ = builder
+    return wrapper
+
+
+# Memoize every pure compiled-program builder in this module (NOT
+# init_sharded — it returns live state buffers that are later donated,
+# so instances must never share them).
+for _n in ("fold_step_sharded", "fold_step_dep_sharded",
+           "td_flush_sharded", "td_pressure_sharded", "tick_5s_sharded",
+           "ingest_listener_sharded", "ingest_host_sharded",
+           "ingest_cpumem_sharded", "ingest_trace_sharded",
+           "ingest_task_sharded", "ping_tasks_sharded",
+           "classify_sharded", "age_tasks_sharded", "age_apis_sharded"):
+    globals()[_n] = memoize_builder(globals()[_n])
+del _n

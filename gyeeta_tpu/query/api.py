@@ -321,8 +321,14 @@ def heavy_topk_columns(flow_rows, svc=None, trace=None,
         # id/name projection over just the kept rows (LazyCols row
         # path — the string groups never format at slab width here)
         got = rows_of(cols, [idcol, namecol], idx[keep])
-        return [(got[idcol][j], got[namecol][j], vals[keep[j]], 0.0,
+        rows = [(got[idcol][j], got[namecol][j], vals[keep[j]], 0.0,
                  "dense") for j in range(len(keep))]
+        # deterministic rank on TIED values: value desc, id asc — the
+        # kept window renders bit-identically whether the rows came
+        # from one slab or a mesh's concatenated shard slabs (lane
+        # order differs; the ranking must not)
+        rows.sort(key=lambda r: (-r[2], r[0]))
+        return rows
 
     if svc is not None:
         scols, slive = svc
@@ -350,6 +356,7 @@ def heavy_topk_columns(flow_rows, svc=None, trace=None,
                      f"{got['svcname'][j]}:{got['api'][j]}",
                      p99[keep[j]], 0.0, "dense")
                     for j in range(len(keep))]
+            rows.sort(key=lambda r: (-r[2], r[0], r[1]))
         emit("p99resp", rows)
 
     n = len(metric)

@@ -64,6 +64,61 @@ def _memo_jit(key: tuple, make):
     return fn
 
 
+def snap_pingpong_enabled(env=None) -> bool:
+    """Snapshot ping-pong prototype (ROADMAP query item (a)): donate
+    the retired (N-2) snapshot's buffers back as the next tree-copy's
+    destination. Measured ~12x cheaper publish at the 32k geometry on
+    the 0.4.37 CPU backend (bench.py ``snap_pingpong`` row — the plain
+    copy pays full-state alloc+free every publish). Default OFF
+    because the win has a sharp edge: on CPU the merged-column renders
+    are ZERO-COPY numpy views of snapshot buffers, so an off-tick
+    consumer (history writer queue, alert delivery) more than two
+    ticks behind could still hold views of the N-2 snapshot when its
+    buffers are donated — reading reused memory SILENTLY. The refcount
+    guard in :func:`snapshot_copy` protects the snapshot OBJECT only,
+    not loose views. Enable when those consumers provably drain within
+    the tick (OPERATIONS.md "Fleet-scale deployment")."""
+    env = os.environ if env is None else env
+    return str(env.get("GYT_SNAP_PINGPONG", "0")).strip().lower() \
+        in ("1", "true", "yes")
+
+
+def make_pingpong_copy():
+    """The donating tree-copy: output buffers may alias the retired
+    snapshot's leaves (same shapes/dtypes every publish).
+    ``keep_unused`` keeps the donated pytree in the compiled signature
+    — jax would otherwise prune the unused arg and donation could
+    never alias."""
+    return jax.jit(lambda old, t: jax.tree.map(jnp.copy, t),
+                   donate_argnums=(0,), keep_unused=True)
+
+
+def snapshot_copy(rt, tree):
+    """(state, dep) copy for snapshot publication. With ping-pong on,
+    the N-2 snapshot — retired at the LAST publish and provably
+    unreferenced now (refcount guard: queries in flight still hold the
+    object if any are reading it) — donates its buffers as the copy's
+    destination. Counted either way (``gyt_snapshot_pingpong_*``) so
+    the hit rate is observable."""
+    import sys as _sys
+
+    old = getattr(rt, "_snap_old", None)
+    rt._snap_old = None
+    pp = getattr(rt, "_snap_copy_pp", None)
+    if pp is None:
+        return rt._snap_copy(tree)
+    if old is not None and _sys.getrefcount(old) == 2:
+        try:
+            out = pp((old.state, old.dep), tree)
+            rt.stats.bump("snapshot_pingpong_donations")
+            return out
+        except Exception:              # noqa: BLE001 — prototype guard
+            rt.stats.bump("snapshot_pingpong_errors")
+            return rt._snap_copy(tree)
+    rt.stats.bump("snapshot_pingpong_fallbacks")
+    return rt._snap_copy(tree)
+
+
 def fused_fold_enabled(env=None) -> bool:
     """The fused ``fold_all`` megakernel is the default fold path;
     ``GYT_FUSED_FOLD=0`` selects the legacy per-subsystem dispatch
@@ -268,6 +323,15 @@ class Runtime:
         # snapshot N on worker threads while the fold builds N+1)
         self._snap_copy = mj("snap_copy", lambda: jax.jit(
             lambda t: jax.tree.map(jnp.copy, t)))
+        # GYT_SNAP_PINGPONG=1: donate the RETIRED snapshot's buffers as
+        # the next copy's destination (ROADMAP query item (a) — halves
+        # HBM churn per publish where the backend implements donation;
+        # see snapshot_copy for the refcount guard and the 0.4.x/CPU
+        # caveats, measured by bench.py's snap_pingpong phase)
+        self._snap_pingpong = snap_pingpong_enabled()
+        self._snap_copy_pp = mj("snap_copy_pp", make_pingpong_copy) \
+            if self._snap_pingpong else None
+        self._snap_old = None         # retired-snapshot donation pool
         self.snapshot = None          # last published EngineSnapshot
         self._snap_version = 0
         # host-side registry renders (snapshot aux views) run on query
@@ -913,13 +977,17 @@ class Runtime:
         PRE-WARMS the columns dashboards then reuse."""
         from gyeeta_tpu.query.snapshot import EngineSnapshot
         with self.stats.timeit("snapshot_publish"):
-            state, dep = self._snap_copy((self.state, self.dep))
+            state, dep = snapshot_copy(self, (self.state, self.dep))
         self._snap_version += 1
         snap = EngineSnapshot(
             self, state, dep, tick=self._tick_no,
             published_at=self._clock(), version=self._snap_version,
             result_cache_max=int(os.environ.get(
                 "GYT_QUERY_CACHE_MAX", "1024")))
+        # the snapshot being replaced becomes the NEXT publish's
+        # donation candidate — retained ONLY in ping-pong mode (with
+        # the flag off it would just pin an extra full copy in memory)
+        self._snap_old = self.snapshot if self._snap_pingpong else None
         self.snapshot = snap
         self.stats.bump("snapshots_published")
         self.stats.gauge("snapshot_tick", float(self._tick_no))

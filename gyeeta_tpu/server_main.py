@@ -64,7 +64,7 @@ class Daemon:
             n = _ck.sweep_stale_tmp(opts.checkpoint_dir)
             if n:
                 log.info("swept %d stale .tmp.npz staging file(s)", n)
-        self.rt = Runtime(cfg, opts)
+        self.rt = _make_runtime(args, cfg, opts)
         if args.restore:
             extra = self.rt.restore(args.restore)
             log.info("restored checkpoint %s (tick %s)", args.restore,
@@ -100,7 +100,10 @@ class Daemon:
                                  args, "query_queue_max", None),
                              query_snapshot=(
                                  False if getattr(args, "query_strong",
-                                                  False) else None))
+                                                  False) else None),
+                             shard_ingest=getattr(args, "shards", 0) > 1,
+                             shard_queue_mb=getattr(
+                                 args, "shard_queue_mb", 8.0))
         self._hot = C.HotReload(args.config, opts) if args.config else None
         # history compaction daemon: sealed WAL segments → columnar
         # snapshot shards (the time-travel tier's writer). Runs only
@@ -253,6 +256,38 @@ class Daemon:
         self.stop_event.set()
 
 
+def _make_runtime(args, cfg, opts):
+    """The ``--shards N`` fleet mode: a :class:`ShardedRuntime` over an
+    N-device mesh (the production shape — per-shard fused folds, one
+    collective roll-up per tick, per-shard WAL subdirs), else the flat
+    single-device Runtime. On a CPU host the mesh devices are forced
+    via ``xla_force_host_platform_device_count`` — set BEFORE the first
+    jax backend init, which is why this helper owns runtime
+    construction."""
+    shards = int(getattr(args, "shards", 0) or 0)
+    if shards <= 1:
+        return Runtime(cfg, opts)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={shards}"
+        ).strip()
+    import jax
+
+    from gyeeta_tpu.parallel.mesh import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    ndev = len(jax.devices())
+    if ndev < shards:
+        raise SystemExit(
+            f"--shards {shards} needs {shards} devices, backend has "
+            f"{ndev} (a CPU host must not initialize jax before the "
+            f"device-count flag is set — check for early jax use)")
+    log.info("sharded runtime: %d-shard mesh (%d devices available), "
+             "per-shard WAL %s", shards, ndev,
+             "on" if opts.journal_dir else "off")
+    return ShardedRuntime(cfg, make_mesh(shards), opts)
+
+
 def checkpoint_candidates(ckpt_dir: Optional[str]) -> list:
     """Complete checkpoint files, newest first. Excludes the .tmp.npz
     a crash mid-``ckpt.save`` leaves behind (atomic-rename staging) —
@@ -339,6 +374,17 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--record", help="tee ingested wire bytes to this "
                     "capture file (replay with `gyeeta_tpu replay`)")
     ap.add_argument("--tick-interval", type=float, default=5.0)
+    # fleet-scale sharded serving (OPERATIONS.md "Fleet-scale
+    # deployment"): per-shard ingest loops + fused per-shard folds +
+    # one collective roll-up per tick on an N-device mesh
+    ap.add_argument("--shards", type=int, default=0,
+                    help="run the sharded mesh runtime over N devices "
+                    "(hosts hash to shards by sticky hid; per-shard "
+                    "WAL subdirs under --journal-dir; 0/1 = flat "
+                    "single-device runtime)")
+    ap.add_argument("--shard-queue-mb", type=float, default=8.0,
+                    help="per-shard ingest queue byte bound before "
+                    "counted oldest-first drops (--shards mode)")
     ap.add_argument("--feed-pipeline", action="store_true",
                     help="deframe/decode on a worker thread (the "
                     "reference's L1/L2 split; useful on multi-core "

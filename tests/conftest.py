@@ -66,7 +66,7 @@ def rng():
 # test in a heavy module inherits the tier automatically.
 _SLOW_MODULES = {
     "test_shardedrt", "test_mesh2d", "test_mesh_skew", "test_parallel",
-    "test_net",
+    "test_shardfeed", "test_net",
     "test_subsystems2", "test_collect", "test_recovery", "test_query",
     "test_runtime", "test_replay", "test_tracedef", "test_scale",
     "test_tcpconn", "test_taskproc", "test_semantic", "test_depgraph",

@@ -69,6 +69,54 @@ def test_full_loop_matches_1d_and_single():
     assert f1["recs"][0]["flowid"] == f2["recs"][0]["flowid"]
 
 
+def test_mesh2d_4x2_rollup_parity_under_skew():
+    """ISSUE-10 satellite: 2D-mesh (4x2) roll-up parity under SKEWED
+    shard load — two hot hosts hash to the same shard (0 and 8 ≡ 0
+    mod 8) and carry ~10x the cold fleet's traffic; the collective
+    roll-up over (slices, hosts) must still render the fleet view
+    byte-identical to a single-Runtime fold of the same stream."""
+    import json
+
+    mesh2 = make_mesh2d(4, 2)
+    # roomy dep capacities: open-addressing probe failures are load
+    # shedding, not state — byte-parity is asserted below the shed point
+    opts = OPTS._replace(dep_edge_capacity=4096)
+    srt = ShardedRuntime(CFG, mesh2, opts)
+    rt = Runtime(CFG, opts)
+    hot = [ParthaSim(n_hosts=1, n_svcs=3, host_base=h, seed=60 + h)
+           for h in (0, 8)]
+    cold = ParthaSim(n_hosts=16, n_svcs=2, seed=71)
+    bufs = [cold.name_frames()] + [h.name_frames() for h in hot]
+    for _ in range(2):
+        for h in hot:
+            bufs.append(h.conn_frames(512) + h.resp_frames(512)
+                        + h.listener_frames())
+        bufs.append(cold.conn_frames(64) + cold.resp_frames(128)
+                    + cold.listener_frames())
+    for buf in bufs:
+        srt.feed(buf)
+        rt.feed(buf)
+    srt.run_tick()
+    rt.run_tick()
+    rt.flush()
+
+    def rows(r, subsys):
+        out = r.query({"subsys": subsys, "maxrecs": 2000})
+        key = lambda x: json.dumps(x, sort_keys=True, default=str)  # noqa
+        return json.dumps(sorted(out["recs"], key=key),
+                          sort_keys=True, default=str)
+
+    for subsys in ("svcstate", "hoststate", "svcdependency"):
+        assert rows(srt, subsys) == rows(rt, subsys), subsys
+    # the skew is real: shard 0 owns the hot hosts' rows
+    sl = {r["shard"]: r for r in srt.query(
+        {"subsys": "shardlist", "maxrecs": 16})["recs"]}
+    assert sl[0]["nconn"] > 4 * max(
+        r["nconn"] for s, r in sl.items() if s not in (0,))
+    srt.close()
+    rt.close()
+
+
 def test_staged_pairing_crosses_dcn_once():
     """Cross-shard halves pair correctly through the 2-stage dispatch."""
     sim = ParthaSim(n_hosts=16, n_svcs=4, seed=53)
