@@ -1,0 +1,145 @@
+"""CI smoke: edge pre-aggregation (sketch-at-the-edge, ISSUE 11).
+
+Boots a real server with GYT_PREAGG=1 (the serve-side opt-in), then:
+
+- a DEFAULT agent negotiates delta mode via the REGISTER_RESP advert
+  and ships NOTIFY_SKETCH_DELTA sweeps; an opted-out agent
+  (``preagg=False``) feeds raw sweeps into the SAME server;
+- svcstate/hoststate render rows for BOTH hosts, byte-equal on the
+  REST gateway and a stock NM conn (the three-edge parity contract);
+- the delta host's per-service counter columns agree with the agent's
+  OWN exact local partials (the edge fold keeps a float64 oracle of
+  what it shipped) within float tolerance — "within bounds" checked
+  against ground truth, not just non-empty;
+- ``gyt_preagg_*`` counters render in /metrics.
+
+Run by ci.sh; standalone: ``JAX_PLATFORMS=cpu python _preagg_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+os.environ["GYT_PREAGG"] = "1"
+
+
+async def _rest_query(gh, gp, req: dict):
+    reader, writer = await asyncio.open_connection(gh, gp)
+    qs = "&".join(f"{k}={str(v).lower()}" for k, v in req.items()
+                  if k != "subsys")
+    path = f"/v1/{req['subsys']}" + (f"?{qs}" if qs else "")
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: s\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert int(head.split()[1]) == 200, head[:200]
+    return body, json.loads(body)
+
+
+async def scenario() -> None:
+    import numpy as np
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.net import GytServer, NetAgent
+    from gyeeta_tpu.net.webgw import WebGateway
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.nodeweb import NodeWebSim
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=256, conn_batch=256,
+                    resp_batch=512, listener_batch=64, fold_k=2)
+    rt = Runtime(cfg)
+    srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+    host, port = await srv.start()
+
+    a_delta = NetAgent(seed=1, n_svcs=4, n_groups=3)        # negotiates
+    a_raw = NetAgent(seed=2, n_svcs=4, n_groups=3, preagg=False)
+    await a_delta.connect(host, port)
+    await a_raw.connect(host, port)
+    assert a_delta._preagg_params is not None, \
+        "server advert did not reach the default agent"
+    assert a_raw._preagg_params is None
+    for _ in range(3):
+        await a_delta.send_sweep(n_conn=512, n_resp=1024)
+        await a_raw.send_sweep(n_conn=512, n_resp=1024)
+    await asyncio.sleep(0.2)
+    rt.flush()
+
+    c = rt.stats.counters
+    assert c.get("preagg_delta_records", 0) > 0, dict(c)
+    assert c.get("preagg_agents_negotiated", 0) >= 2
+    assert c.get("conn_events", 0) > 0          # the raw agent's tuples
+    assert int(a_delta.stats.counters["preagg_sweeps"]) == 3
+
+    # ---- the delta host's server-side counters vs the agent's OWN
+    # exact local partials (edgefold keeps a float64 oracle) — checked
+    # BEFORE the window tick rolls cur into the ring
+    import jax.numpy as jnp
+
+    from gyeeta_tpu.engine import table as T
+    ef = a_delta._edgefold
+    keys = np.array(sorted(ef.totals), np.uint64)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    rows = np.asarray(T.lookup(rt.state.tbl, jnp.asarray(hi),
+                               jnp.asarray(keys.astype(np.uint32)),
+                               jnp.ones(len(keys), bool)))
+    assert (rows >= 0).all(), "delta-host services missing server-side"
+    cur = np.asarray(rt.state.ctr_win.cur)[rows]
+    for i, k in enumerate(keys.tolist()):
+        want = ef.totals[int(k)]          # [bs, br, ncl, dur, nc, nr]
+        got = cur[i]                      # [bs, br, ncl, dur]
+        for j in range(4):
+            assert abs(got[j] - want[j]) <= max(1e-3 * abs(want[j]),
+                                                1.0), \
+                (hex(k), j, float(got[j]), want[j])
+
+    rt.run_tick()
+
+    # ---- three-edge parity over the mixed-mode fleet view
+    gw = WebGateway(host, port)
+    gh, gp = await gw.start()
+    nw = NodeWebSim(hostname="ci-preagg")
+    hs = await nw.connect(host, port)
+    assert hs["error_code"] == 0, hs
+    for subsys in ("svcstate", "hoststate"):
+        req = {"subsys": subsys, "maxrecs": 100}
+        nm = await nw.query_web(subsys, maxrecs=100)
+        rest_raw, _rest = await _rest_query(gh, gp, req)
+        assert nm["nrecs"] > 0, (subsys, nm)
+        assert json.dumps(nm).encode() == rest_raw, \
+            f"{subsys} NM vs REST bytes differ"
+        hosts = {int(float(r["hostid"])) for r in nm["recs"]}
+        assert {a_delta.host_id, a_raw.host_id} <= hosts, \
+            (subsys, hosts)
+
+    # ---- gyt_preagg_* counters render in the exposition
+    met = await nw.query_web("metrics")
+    for name in ("gyt_preagg_delta_records_total",
+                 "gyt_preagg_lanes_total",
+                 "gyt_preagg_agents_negotiated_total"):
+        assert name in met["text"], f"{name} missing from /metrics"
+
+    await nw.close()
+    await gw.stop()
+    await a_delta.close()
+    await a_raw.close()
+    await srv.stop()
+    rt.close()
+    print("preagg smoke: OK — negotiated delta agent + raw agent on "
+          "one server; svcstate/hoststate byte-equal on REST and "
+          "stock NM; delta-host counters match the agent's exact "
+          "partials; gyt_preagg_* counters exposed",
+          file=sys.stderr)
+
+
+def main() -> int:
+    asyncio.run(scenario())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

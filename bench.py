@@ -695,51 +695,94 @@ def _partial_path() -> str:
                           os.path.join(HERE, "bench_partial.jsonl"))
 
 
-def _orchestrate(platform: str | None, degraded: bool,
-                 probe_log) -> None:
-    """Run each phase as a killable subprocess; merge survivors."""
+# primary metric per phase — the median-selection key of the repeat
+# runs (the shared 1-core box shows ±15-50% run-to-run variance, PR
+# 8/10 notes; a single-shot row reads as a trend where there is none)
+_PHASE_METRIC = {"fold_toy": "rate", "fold_ns": "rate",
+                 "feed_toy": "rate", "feed_ns": "rate",
+                 "feed_toy_wal": "rate",
+                 "topk_recover": "recover_ms_per_tick",
+                 "compact": "replay_ev_per_sec",
+                 "timeview_aggr": "speedup",
+                 "snap_pingpong": "ratio_on_vs_off"}
+
+
+def _phase_subproc(phase: str, platform: str | None):
+    """One killable leaf run of ``phase`` → its dict, or a failure
+    marker dict."""
     import subprocess
 
+    env = dict(os.environ)
+    env["GYT_BENCH_PHASE"] = phase
+    if platform:
+        env["GYT_BENCH_PLATFORM"] = platform
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, __file__], env=env,
+                           cwd=HERE, capture_output=True, text=True,
+                           timeout=PHASE_TIMEOUT[phase])
+    except subprocess.TimeoutExpired as e:
+        print(f"bench: phase {phase} TIMED OUT after "
+              f"{time.time() - t0:.0f}s — tunnel wedge likely; "
+              f"stderr tail: {(e.stderr or b'')[-300:]!r}",
+              file=sys.stderr, flush=True)
+        return {"timeout": True}
+    sys.stderr.write(r.stderr or "")
+    line = None
+    for ln in (r.stdout or "").splitlines():
+        if ln.strip().startswith("{"):
+            line = ln.strip()
+    if r.returncode != 0 or not line:
+        print(f"bench: phase {phase} failed rc={r.returncode}",
+              file=sys.stderr, flush=True)
+        return {"failed": True, "rc": r.returncode}
+    try:
+        return json.loads(line)
+    except ValueError:
+        print(f"bench: phase {phase} emitted non-JSON: "
+              f"{line[:200]!r}", file=sys.stderr, flush=True)
+        return {"failed": True, "bad_json": True}
+
+
+def _orchestrate(platform: str | None, degraded: bool,
+                 probe_log) -> None:
+    """Run each phase as a killable subprocess; merge survivors.
+
+    Measured phases repeat ``GYT_BENCH_RUNS`` times (default 3): the
+    reported row is the MEDIAN run by the phase's primary metric, and
+    every row records its per-run values + spread — single-shot rows
+    on the shared box kept misleading trend reads (PR 8/10 notes)."""
     partial = _partial_path()
     # stale partials from a previous run must not leak into this one
     try:
         os.remove(partial)
     except OSError:
         pass
+    runs_want = max(1, int(os.environ.get("GYT_BENCH_RUNS", "3")))
     phases: dict[str, dict] = {}
     for phase in PHASE_ORDER:
-        env = dict(os.environ)
-        env["GYT_BENCH_PHASE"] = phase
-        if platform:
-            env["GYT_BENCH_PLATFORM"] = platform
-        t0 = time.time()
-        try:
-            r = subprocess.run([sys.executable, __file__], env=env,
-                               cwd=HERE, capture_output=True, text=True,
-                               timeout=PHASE_TIMEOUT[phase])
-        except subprocess.TimeoutExpired as e:
-            print(f"bench: phase {phase} TIMED OUT after "
-                  f"{time.time() - t0:.0f}s — tunnel wedge likely; "
-                  f"stderr tail: {(e.stderr or b'')[-300:]!r}",
-                  file=sys.stderr, flush=True)
-            phases[phase] = {"timeout": True}
-            continue
-        sys.stderr.write(r.stderr or "")
-        line = None
-        for ln in (r.stdout or "").splitlines():
-            if ln.strip().startswith("{"):
-                line = ln.strip()
-        if r.returncode != 0 or not line:
-            print(f"bench: phase {phase} failed rc={r.returncode}",
-                  file=sys.stderr, flush=True)
-            phases[phase] = {"failed": True, "rc": r.returncode}
-            continue
-        try:
-            phases[phase] = json.loads(line)
-        except ValueError:
-            print(f"bench: phase {phase} emitted non-JSON: "
-                  f"{line[:200]!r}", file=sys.stderr, flush=True)
-            phases[phase] = {"failed": True, "bad_json": True}
+        metric = _PHASE_METRIC.get(phase)
+        n_runs = runs_want if metric else 1
+        attempts = []
+        for i in range(n_runs):
+            out = _phase_subproc(phase, platform)
+            attempts.append(out)
+            if metric is None or metric not in out:
+                break           # a failed/degraded run ends the repeat
+        good = [a for a in attempts if metric and metric in a]
+        if metric and good:
+            vals = sorted(float(a[metric]) for a in good)
+            med = vals[len(vals) // 2]
+            pick = min(good, key=lambda a: abs(float(a[metric]) - med))
+            pick = dict(pick)
+            pick["runs"] = [round(float(a[metric]), 4) for a in good]
+            if med:
+                pick["spread_pct"] = round(
+                    100.0 * (vals[-1] - vals[0]) / abs(med), 1)
+            phases[phase] = pick
+        else:
+            phases[phase] = attempts[-1]
+        if "failed" in phases[phase] or "timeout" in phases[phase]:
             continue
         with open(partial, "a") as f:
             f.write(json.dumps({"phase": phase, **phases[phase]}) + "\n")

@@ -75,6 +75,17 @@ if ! JAX_PLATFORMS=cpu python _qps_smoke.py; then
     exit 1
 fi
 
+# Edge pre-aggregation smoke: a GYT_PREAGG=1 server negotiates delta
+# mode with a default agent while an opted-out agent feeds raw sweeps;
+# svcstate/hoststate agree byte-equal on REST and stock NM, the delta
+# host's counters match the agent's own exact partials, and
+# gyt_preagg_* counters render in /metrics.
+echo "ci: edge pre-aggregation smoke" >&2
+if ! JAX_PLATFORMS=cpu python _preagg_smoke.py; then
+    echo "ci: FATAL — preagg smoke failed" >&2
+    exit 1
+fi
+
 # Multichip smoke: a REAL `serve --shards 8` subprocess on the
 # simulated 8-device mesh — per-shard ingest + WAL subdirs + collective
 # roll-up; 2 agents on different shards; asserts the MERGED

@@ -441,6 +441,133 @@ def ping_tasks(cfg: EngineCfg, st: AggState, pb) -> AggState:
     return st._replace(task_last_tick=last)
 
 
+def ingest_delta(cfg: EngineCfg, st: AggState, dep, db, tick):
+    """Fold a DeltaBatch (``ingest/decode.py:delta_batch``) — the edge
+    pre-aggregation path: agents fold their own conn/resp streams
+    locally (``sketch/edgefold.py``) and the wire carries mergeable
+    partials instead of raw tuples. Every merge here is the SAME
+    monotone operation the raw fold (and the history downsampler)
+    applies, so a delta-fed engine reaches the same state the raw-fed
+    fold would, up to float-addition order and the declared truncation
+    bounds:
+
+    - counters / loghist buckets / CMS mass / dep edges: scatter-add
+      of per-sweep sums (counts are exact; float byte sums differ only
+      in addition order);
+    - HLL registers: scatter-max of the agent's register maxes —
+      BIT-IDENTICAL to folding the raw keys;
+    - flows: aggregated (key, bytes) lanes feed CMS/top-K/invertible
+      exactly like raw lanes, with the agent's truncated residual mass
+      charged to the top-K ``evicted`` undercount bound — bound
+      honesty survives the edge fold.
+
+    One table upsert per dispatch (the unique-svc section); every
+    family then row-resolves with lookups against the updated table.
+    Returns ``(state, dep)``.
+    """
+    from gyeeta_tpu.parallel import depgraph as dg
+
+    S = cfg.svc_capacity
+    # ---- ONE upsert over the unique svc keys of the whole dispatch
+    tbl, urows, any_new = table.upsert_fast2(
+        st.tbl, db.svc_hi, db.svc_lo, db.svc_valid)
+    ok_u = db.svc_valid & (urows >= 0)
+    lanes_u = jnp.where(ok_u, urows, S)
+    # owning-host column rides the upsert's own miss signal (see
+    # ingest_conn: existing rows re-write the value they already hold)
+    svc_host = jax.lax.cond(
+        any_new,
+        lambda col: col.at[lanes_u].set(db.svc_host, mode="drop"),
+        lambda col: col, st.svc_host)
+
+    # ---- per-svc exact counters (ctr_win order) + event counts
+    rc = table.lookup(tbl, db.ctr_hi, db.ctr_lo, db.ctr_valid)
+    ok_c = db.ctr_valid & (rc >= 0)
+    lanes_c = jnp.where(ok_c, rc, S)
+    upd = jnp.where(ok_c[:, None], db.ctr_vals[:, :4],
+                    jnp.float32(0.0))
+    ctr_win = st.ctr_win._replace(
+        cur=st.ctr_win.cur.at[lanes_c].add(upd, mode="drop"))
+    n_conn_add = jnp.sum(jnp.where(ok_c, db.ctr_vals[:, 4], 0.0))
+    n_resp_add = jnp.sum(jnp.where(ok_c, db.ctr_vals[:, 5], 0.0))
+
+    # ---- per-svc resp loghist bucket counts (exact scatter-add)
+    rh = table.lookup(tbl, db.hist_hi, db.hist_lo, db.hist_valid)
+    ok_h = db.hist_valid & (rh >= 0)
+    roww = jnp.where(ok_h, rh, 0)
+    w = jnp.where(ok_h, db.hist_w, 0.0)
+    resp_win = st.resp_win._replace(
+        cur=st.resp_win.cur.at[roww, db.hist_bucket].add(w))
+
+    # ---- per-svc distinct-client HLL register maxes (scatter-max)
+    rs = table.lookup(tbl, db.shll_hi, db.shll_lo, db.shll_valid)
+    ok_s = db.shll_valid & (rs >= 0)
+    rank_s = jnp.where(ok_s, db.shll_rank, 0)
+    svc_hll = st.svc_hll._replace(
+        regs=st.svc_hll.regs.at[jnp.where(ok_s, rs, 0),
+                                db.shll_reg].max(rank_s))
+
+    # ---- global flow HLL register maxes
+    rank_g = jnp.where(db.ghll_valid, db.ghll_rank, 0)
+    glob_hll = st.glob_hll._replace(
+        regs=st.glob_hll.regs.at[db.ghll_reg].max(rank_g))
+
+    # ---- t-digest stage (pre-strided at the agent — the same duty
+    # cycle the raw fold applies; compression stays pressure-driven)
+    rt_ = table.lookup(tbl, db.td_hi, db.td_lo, db.td_valid)
+    ok_t = db.td_valid & (rt_ >= 0)
+    stage, stage_n, n_over = tdigest.stage_samples(
+        st.td_stage, st.td_stage_n, jnp.where(ok_t, rt_, -1),
+        db.td_val)
+
+    # ---- flow aggregates → CMS, top-K, invertible buckets (with the
+    # agent-side truncation residual charged to the undercount bound)
+    fv = db.flow_valid
+    cms = countmin.update(st.cms, db.flow_hi, db.flow_lo, db.flow_val,
+                          valid=fv)
+    est = countmin.upper_bound(cms, db.flow_hi, db.flow_lo)
+    ftk = st.flow_topk._replace(
+        evicted=st.flow_topk.evicted + db.evicted_add[0])
+    hot = None
+    vhot = fv
+    if cfg.hh_hot_frac > 0:
+        thresh = jnp.float32(cfg.hh_hot_frac) * countmin.total(cms)
+        hot = est >= thresh
+        vhot = fv & hot
+        # cold valid mass never reaches the exact merge — accounted
+        # (the PSketch floor, same semantics as ingest_conn)
+        ftk = ftk._replace(evicted=ftk.evicted + jnp.sum(
+            jnp.where(fv & ~hot, db.flow_val, 0.0)))
+    flow_topk = topk.update(ftk, db.flow_hi, db.flow_lo, db.flow_val,
+                            valid=vhot, est=est,
+                            budget=cfg.topk_budget)
+    if "hh" in _ABLATE or cfg.hh_width <= 0:
+        inv = st.inv
+    else:
+        inv = invertible.update(st.inv, db.flow_hi, db.flow_lo,
+                                jnp.where(vhot, est, 0.0), valid=vhot,
+                                budget=cfg.topk_budget)
+        if hot is not None:
+            inv = inv._replace(n_hot=inv.n_hot + jnp.sum(
+                fv & hot).astype(jnp.float32))
+
+    # ---- dependency edges (pre-aggregated direct edges)
+    dep = dg.fold_edges(dep, db.dep_cli_hi, db.dep_cli_lo,
+                        db.dep_cli_svc, db.dep_ser_hi, db.dep_ser_lo,
+                        db.dep_bytes, db.dep_valid, tick,
+                        nconn=db.dep_nconn)
+
+    st = st._replace(
+        tbl=tbl, ctr_win=ctr_win, resp_win=resp_win, svc_host=svc_host,
+        svc_hll=svc_hll, glob_hll=glob_hll, td_stage=stage,
+        td_stage_n=stage_n, cms=cms, flow_topk=flow_topk, inv=inv,
+        n_conn=st.n_conn + n_conn_add,
+        n_resp=st.n_resp + n_resp_add,
+        n_td_overflow=st.n_td_overflow + n_over.astype(jnp.float32),
+    )
+    return st, dep
+
+
 def age_tasks(cfg: EngineCfg, st: AggState, max_age_ticks: int) -> AggState:
     """Tombstone process groups not seen for ``max_age_ticks`` base ticks
     (the reference ages MAGGR_TASK entries via ping/delete msgs,
@@ -649,12 +776,12 @@ def jit_fold_many(cfg: EngineCfg):
 # after the chunk loop), so a fused dispatch is bit-identical to the
 # dispatch sequence it replaces (tests/test_fusedfold.py fuzzes this).
 FOLD_ALL_ORDER = ("listener", "host", "task", "cpumem", "trace", "ping",
-                  "connresp")
+                  "delta", "connresp")
 
 
 def fold_all(cfg: EngineCfg, st: AggState, dep, tick, *, listener=None,
              host=None, task=None, cpumem=None, trace=None, ping=None,
-             connresp=None):
+             delta=None, connresp=None):
     """The fused per-batch megakernel: every staged subsystem section +
     the conn/resp K-slab + the dependency-graph fold + the digest-stage
     pressure scalar, in ONE compiled dispatch with full state donation.
@@ -690,6 +817,8 @@ def fold_all(cfg: EngineCfg, st: AggState, dep, tick, *, listener=None,
         st = ingest_trace(cfg, st, trace)
     if ping is not None:
         st = ping_tasks(cfg, st, ping)
+    if delta is not None:
+        st, dep = ingest_delta(cfg, st, dep, delta, tick)
     if connresp is not None:
         cbs, rbs = connresp
         st = fold_many(cfg, st, cbs, rbs)

@@ -73,6 +73,21 @@ NOTIFY_AGENT_STATS = 24       # agent self-report: spool drops/resends +
 #                               delivery-continuity accounting the server
 #                               folds into its own selfstats registry so
 #                               /metrics shows fleet-wide loss counters
+NOTIFY_SKETCH_DELTA = 26      # edge pre-aggregation (wire v5): the
+#                               agent folds its own conn/resp streams
+#                               locally (sketch/edgefold.py) and ships
+#                               ONE stream of mergeable delta records
+#                               per sweep instead of N raw tuples —
+#                               per-svc counter/loghist partials,
+#                               incremental HLL register maxes, top
+#                               flow aggregates with a truncation
+#                               errbound, and dep-graph edge sums. The
+#                               server folds them with the SAME
+#                               monotone-merge semantics the history
+#                               downsampler proves (sketch merge =
+#                               state union; counters scatter-add).
+#                               v4 servers skip the unknown subtype
+#                               COUNTED (drain2 forward compat).
 NOTIFY_SWEEP_SEQ = 25         # agent sweep sequence mark: one record
 #                               prepended to every built sweep carrying
 #                               the agent's monotone sweep counter. The
@@ -450,7 +465,66 @@ NAME_INTERN_DT = np.dtype([
 
 MAX_NAMES_PER_BATCH = 1024
 
+# SKETCH_DELTA record — ONE fixed columnar layout for every mergeable
+# partial an edge-folding agent ships (wire v5; see sketch/edgefold.py
+# for the producer and engine/step.py:ingest_delta for the fold). The
+# record is a typed envelope: ``kind`` selects how the 96-byte payload
+# block decodes (sparse (index, weight) pairs / packed flow triplets /
+# raw f32 vectors), ``nitem`` counts the occupied payload items, and
+# ``errb`` is the self-describing error bound the row contributes
+# (DK_RESID rows: flow mass truncated at the agent — folded into the
+# top-K ``evicted`` undercount bound, the same annotation topk rows
+# already carry). Splitting one logical sweep across any number of
+# records/frames is ALWAYS safe: every fold the records feed is a
+# monotone merge (scatter-add for counters/histograms/CMS/edges,
+# scatter-max for HLL registers), so chunking never changes semantics.
+DELTA_PAYLOAD_BYTES = 96
+
+DELTA_DT = np.dtype([
+    ("key_hi", "<u4"),       # svc glob-id halves (svc-keyed kinds),
+    ("key_lo", "<u4"),       #   server svc for DK_DEP, 0 otherwise
+    ("aux_hi", "<u4"),       # DK_DEP: client entity id halves
+    ("aux_lo", "<u4"),
+    ("payload", "u1", (DELTA_PAYLOAD_BYTES,)),
+    ("errb", "<f4"),         # self-describing bound (DK_RESID: bytes)
+    ("kind", "u1"),          # DK_* selector
+    ("flags", "u1"),         # DK_DEP bit0: client entity is a listener
+    ("nitem", "<u2"),        # occupied payload items
+    ("host_id", "<u4"),      # source agent (shard routing key)
+    ("pad", "u1", (4,)),
+])
+
+# payload interpretations (all little-endian, packed)
+DELTA_PAIR_DT = np.dtype([("idx", "<u2"), ("wt", "<f4")])   # 6 B/item
+DELTA_FLOW_DT = np.dtype([("hi", "<u4"), ("lo", "<u4"),
+                          ("val", "<f4")])                  # 12 B/item
+DELTA_PAIRS = DELTA_PAYLOAD_BYTES // DELTA_PAIR_DT.itemsize    # 16
+DELTA_FLOWS = DELTA_PAYLOAD_BYTES // DELTA_FLOW_DT.itemsize    # 8
+DELTA_SAMPLES = DELTA_PAYLOAD_BYTES // 4                       # 24 f32
+
+# DK_* record kinds (unknown kinds are skipped + counted at decode —
+# the same forward-compat discipline as unknown subtypes)
+DK_SVC_CTR = 1    # payload f32[6]: bytes_sent, bytes_rcvd, n_close,
+#                   dur_sum_us, n_conn_records, n_resp_records — the
+#                   exact per-service counter columns the raw fold
+#                   would have produced (scatter-add, ctr_win order)
+DK_SVC_HIST = 2   # pairs (resp loghist bucket, count) — exact
+DK_SVC_HLL = 3    # pairs (register, rank) for the per-svc distinct-
+#                   client HLL — incremental register maxes
+DK_GLOB_HLL = 4   # pairs (register, rank) for the global flow HLL
+DK_SVC_TD = 5     # f32 samples for the per-svc t-digest stage
+#                   (duty-cycled at the negotiated stride)
+DK_FLOW = 6       # packed (flow_hi, flow_lo, bytes) aggregates — the
+#                   CMS / top-K / invertible-bucket inputs
+DK_DEP = 7        # one dependency edge: key=server svc, aux=client
+#                   entity, payload f32[2] = [nconn, bytes]
+DK_RESID = 8      # sweep residual: errb = flow bytes truncated by the
+#                   agent's flow_max cap (→ top-K evicted bound)
+
+MAX_DELTA_PER_BATCH = 1024
+
 DTYPE_OF_SUBTYPE = {
+    NOTIFY_SKETCH_DELTA: DELTA_DT,
     NOTIFY_TCP_CONN: TCP_CONN_DT,
     NOTIFY_LISTENER_STATE: LISTENER_STATE_DT,
     NOTIFY_HOST_STATE: HOST_STATE_DT,
@@ -488,6 +562,7 @@ MAX_OF_SUBTYPE = {
     NOTIFY_TASK_PING: MAX_PINGS_PER_BATCH,
     NOTIFY_AGENT_STATS: MAX_AGENT_STATS_PER_BATCH,
     NOTIFY_SWEEP_SEQ: MAX_SWEEP_SEQ_PER_BATCH,
+    NOTIFY_SKETCH_DELTA: MAX_DELTA_PER_BATCH,
 }
 
 for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT),
@@ -504,7 +579,8 @@ for _name, _dt in [("HEADER_DT", HEADER_DT), ("EVENT_NOTIFY_DT", EVENT_NOTIFY_DT
                    ("CGROUP_DT", CGROUP_DT),
                    ("TASK_PING_DT", TASK_PING_DT),
                    ("AGENT_STATS_DT", AGENT_STATS_DT),
-                   ("SWEEP_SEQ_DT", SWEEP_SEQ_DT)]:
+                   ("SWEEP_SEQ_DT", SWEEP_SEQ_DT),
+                   ("DELTA_DT", DELTA_DT)]:
     assert _dt.itemsize % 8 == 0, (_name, _dt.itemsize)
 
 
@@ -669,10 +745,61 @@ def encode_register_req(machine_id: int, conn_type: int,
     return _frame(COMM_REGISTER_REQ, r.tobytes(), MAGIC_PM)
 
 
+# Edge pre-aggregation negotiation (wire v5): when the server opts in
+# (GYT_PREAGG=1), REGISTER_RESP grows a second trailing extension after
+# the v4 last_seq word — the sketch geometry the agent MUST fold with
+# (the server's resp loghist spec and HLL precisions are engine-cfg
+# compile-time constants; a mismatched agent partial would scatter into
+# the wrong buckets). Agents that predate v5 parse the fixed prefix +
+# last_seq and ignore the tail; agents that understand it enable delta
+# sweeps (net/agent.py). No advert → the agent stays in raw mode.
+PREAGG_MAGIC = 0x50524147        # "GARP" little-endian sanity word
+
+PREAGG_DT = np.dtype([
+    ("magic", "<u4"),
+    ("hll_p_svc", "<u4"),        # per-svc distinct-client HLL precision
+    ("hll_p_global", "<u4"),     # global flow HLL precision
+    ("td_stride", "<u4"),        # digest duty-cycle (1-in-N samples)
+    ("resp_nbuckets", "<u4"),    # resp loghist spec (vmin/vmax below)
+    ("flow_max", "<u4"),         # per-sweep flow-aggregate cap; mass
+    #                              past it ships as a DK_RESID bound
+    ("resp_vmin", "<f8"),
+    ("resp_vmax", "<f8"),
+])
+
+assert PREAGG_DT.itemsize % 8 == 0
+
+_PREAGG_FIELDS = ("hll_p_svc", "hll_p_global", "td_stride",
+                  "resp_nbuckets", "flow_max", "resp_vmin", "resp_vmax")
+
+
+def encode_preagg(params: dict) -> bytes:
+    """Pre-aggregation advert dict → the REGISTER_RESP v5 tail."""
+    r = np.zeros((), PREAGG_DT)
+    r["magic"] = PREAGG_MAGIC
+    for f in _PREAGG_FIELDS:
+        r[f] = params[f]
+    return r.tobytes()
+
+
+def decode_preagg(buf: bytes):
+    """v5 tail bytes → params dict, or None when absent/foreign."""
+    if len(buf) < PREAGG_DT.itemsize:
+        return None
+    r = np.frombuffer(buf, PREAGG_DT, count=1)[0]
+    if int(r["magic"]) != PREAGG_MAGIC:
+        return None
+    out = {f: (float(r[f]) if f.startswith("resp_v") else int(r[f]))
+           for f in _PREAGG_FIELDS}
+    return out
+
+
 def encode_register_resp(status: int, host_id: int,
-                         curr_version: int, last_seq: int = 0) -> bytes:
+                         curr_version: int, last_seq: int = 0,
+                         preagg: dict | None = None) -> bytes:
     """REGISTER_RESP + the v4 trailing extension: the server's durable
-    per-host sweep-seq high-water mark (``last_seq``). Agents built
+    per-host sweep-seq high-water mark (``last_seq``), + the optional
+    v5 pre-aggregation advert (``preagg``, see PREAGG_DT). Agents built
     before v4 parse the fixed prefix and ignore the tail; agents that
     understand it prune already-durable sweeps from their resend spool
     (the WAL dedup contract, see NOTIFY_SWEEP_SEQ)."""
@@ -681,20 +808,25 @@ def encode_register_resp(status: int, host_id: int,
     r["host_id"] = host_id
     r["curr_version"] = curr_version
     ext = np.uint64(last_seq).tobytes()
+    if preagg is not None:
+        ext += encode_preagg(preagg)
     return _frame(COMM_REGISTER_RESP, r.tobytes() + ext, MAGIC_MS)
 
 
-def decode_register_resp(payload: bytes) -> tuple[int, int, int, int]:
+def decode_register_resp(payload: bytes) -> tuple:
     """REGISTER_RESP payload → (status, host_id, curr_version,
-    last_seq). ``last_seq`` is 0 when the server predates the v4
-    extension (16-byte fixed payload only)."""
+    last_seq, preagg). ``last_seq`` is 0 when the server predates the
+    v4 extension (16-byte fixed payload only); ``preagg`` is None
+    unless the server advertised the v5 edge pre-aggregation tail."""
     r = np.frombuffer(payload, REGISTER_RESP_DT, count=1)[0]
     last_seq = 0
+    preagg = None
     base = REGISTER_RESP_DT.itemsize
     if len(payload) >= base + 8:
         last_seq = int(np.frombuffer(payload, "<u8", 1, base)[0])
+        preagg = decode_preagg(payload[base + 8:])
     return (int(r["status"]), int(r["host_id"]),
-            int(r["curr_version"]), last_seq)
+            int(r["curr_version"]), last_seq, preagg)
 
 
 def encode_query(seqid: int, obj, status: int = QS_OK,

@@ -58,9 +58,11 @@ async def register_ex(host: str, port: int, machine_id: int,
                       wire_version: int = version.CURR_WIRE_VERSION,
                       hostname_id: int = 0):
     """Open + register one conn → (reader, writer, status, host_id,
-    last_seq). ``last_seq`` is the server's durable sweep-seq
+    last_seq, preagg). ``last_seq`` is the server's durable sweep-seq
     high-water mark for this host (0 from pre-v4 servers) — the WAL
-    dedup handshake (see ``wire.NOTIFY_SWEEP_SEQ``)."""
+    dedup handshake (see ``wire.NOTIFY_SWEEP_SEQ``); ``preagg`` is the
+    server's edge pre-aggregation advert (the v5 tail — the sketch
+    geometry delta sweeps must fold with), or None."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
         writer.write(wire.encode_register_req(
@@ -73,15 +75,16 @@ async def register_ex(host: str, port: int, machine_id: int,
     if dtype != wire.COMM_REGISTER_RESP:
         writer.close()
         raise wire.FrameError(f"expected REGISTER_RESP, got {dtype}")
-    status, host_id, _ver, last_seq = wire.decode_register_resp(payload)
-    return reader, writer, status, host_id, last_seq
+    status, host_id, _ver, last_seq, preagg = \
+        wire.decode_register_resp(payload)
+    return reader, writer, status, host_id, last_seq, preagg
 
 
 async def register(host: str, port: int, machine_id: int, conn_type: int,
                    wire_version: int = version.CURR_WIRE_VERSION,
                    hostname_id: int = 0):
     """Open + register one conn → (reader, writer, status, host_id)."""
-    reader, writer, status, host_id, _seq = await register_ex(
+    reader, writer, status, host_id, _seq, _pre = await register_ex(
         host, port, machine_id, conn_type, wire_version, hostname_id)
     return reader, writer, status, host_id
 
@@ -103,7 +106,8 @@ class NetAgent:
                  livecap: bool = False, cap_ifname: str = "lo",
                  connect_timeout: float = 15.0,
                  spool_max_bytes: int = 8 << 20,
-                 resend_last: int = 2):
+                 resend_last: int = 2,
+                 preagg: Optional[bool] = None):
         self.machine_id = machine_id if machine_id is not None \
             else H.hash_bytes_np(f"sim-agent-{seed}".encode())
         self.seed = seed
@@ -176,6 +180,16 @@ class NetAgent:
         # server→agent admission control (COMM_THROTTLE): feed class →
         # monotonic deadline until which that class holds in the spool
         self._hold_until: dict[int, float] = {}
+        # ---- edge pre-aggregation (wire v5, sketch/edgefold.py)
+        # preagg=None follows the server's REGISTER_RESP advert (the
+        # serve-negotiated default: GYT_PREAGG=1 on the server flips
+        # the fleet); False opts this agent out; True REQUIRES the
+        # advert and falls back raw COUNTED when it is absent (the
+        # agent cannot guess the server's sketch geometry). Sim-mode
+        # only: real collectors keep the raw contract.
+        self.preagg = preagg
+        self._preagg_params: Optional[dict] = None
+        self._edgefold = None
 
     async def connect(self, host: str, port: int,
                       timeout: Optional[float] = None) -> int:
@@ -205,9 +219,10 @@ class NetAgent:
         self.trace_enabled.clear()
         self._conn_dead = False
         hostname_id = self.machine_id & 0xFFFFFFFF
-        reader, writer, status, hid, last_seq = await register_ex(
-            host, port, self.machine_id, wire.CONN_EVENT,
-            self.wire_version, hostname_id)
+        reader, writer, status, hid, last_seq, preagg_adv = \
+            await register_ex(
+                host, port, self.machine_id, wire.CONN_EVENT,
+                self.wire_version, hostname_id)
         if status != wire.REG_OK:
             writer.close()
             raise ConnectionRefusedError(f"registration status {status}")
@@ -227,6 +242,23 @@ class NetAgent:
             self.sim = ParthaSim(
                 n_hosts=1, n_svcs=self.n_svcs, n_groups=self.n_groups,
                 seed=1000 + hid, host_base=hid)
+        # edge pre-aggregation: enable only on a server advert (the
+        # advert carries the sketch geometry the partials must land
+        # in); the local fold's cumulative HLL state survives sticky
+        # reconnects like the sim does
+        self._preagg_params = None
+        if (preagg_adv is not None and self.preagg is not False
+                and not self.real):
+            self._preagg_params = preagg_adv
+            if self._edgefold is None \
+                    or self._edgefold.host_id != hid \
+                    or self._edgefold.params != preagg_adv:
+                from gyeeta_tpu.sketch.edgefold import EdgeFold
+                self._edgefold = EdgeFold(preagg_adv, host_id=hid)
+        elif self.preagg:
+            # explicit opt-in against a server that never advertised:
+            # stay raw, counted (never guess the sketch geometry)
+            self.stats.bump("preagg_not_advertised")
         if self.collect:
             from gyeeta_tpu.net import collect as C
             self._cpumem = C.CpuMemCollector(host_id=hid)
@@ -368,8 +400,24 @@ class NetAgent:
         if self.real:
             buf = mark + self._real_sweep_frames()
         else:
-            buf = (mark
-                   + s.conn_frames(n_conn) + s.resp_frames(n_resp)
+            if self._preagg_params is not None:
+                # edge pre-aggregation: fold the conn/resp streams
+                # locally and ship ONE mergeable-delta stream instead
+                # of N raw tuples (sketch/edgefold.py); the 5s state
+                # sweeps (listener/task/host) are already one record
+                # per entity and stay raw
+                conn = s.conn_records(n_conn)
+                resp = s.resp_records(n_resp)
+                delta = self._edgefold.fold_sweep(conn, resp)
+                hot = wire.encode_frames_chunked(
+                    wire.NOTIFY_SKETCH_DELTA, delta)
+                self.stats.bump("preagg_sweeps")
+                self.stats.bump("preagg_source_records",
+                                len(conn) + len(resp))
+                self.stats.bump("preagg_delta_records", len(delta))
+            else:
+                hot = s.conn_frames(n_conn) + s.resp_frames(n_resp)
+            buf = (mark + hot
                    + s.listener_frames() + s.task_frames()
                    + wire.encode_frame(wire.NOTIFY_HOST_STATE,
                                        s.host_state_records()))

@@ -708,13 +708,24 @@ class GytServer:
             # host — a reconnecting agent prunes already-durable sweeps
             # from its resend spool (the WAL dedup contract)
             last_seq = 0
+            preagg = None
             if (status == wire.REG_OK
                     and int(req["conn_type"]) == wire.CONN_EVENT
                     and host_id != 0xFFFFFFFF):
                 last_seq = int(getattr(self.rt, "_sweep_last_seq",
                                        {}).get(host_id, 0))
+                # edge pre-aggregation advert (wire v5): when the
+                # serve tier opts in (GYT_PREAGG=1), tell the agent
+                # EXACTLY which sketch geometry to fold with — the
+                # engine-cfg constants its delta partials must land in
+                # (sketch/edgefold.py). Pre-v5 agents ignore the tail.
+                from gyeeta_tpu.sketch import edgefold
+                if edgefold.preagg_enabled():
+                    preagg = edgefold.params_of_cfg(self.rt.cfg)
+                    self.rt.stats.bump("preagg_agents_negotiated")
             writer.write(wire.encode_register_resp(
-                status, host_id, version.CURR_WIRE_VERSION, last_seq))
+                status, host_id, version.CURR_WIRE_VERSION, last_seq,
+                preagg=preagg))
             await writer.drain()
             if status != wire.REG_OK:
                 return

@@ -127,12 +127,16 @@ def edge_key(cli_hi, cli_lo, ser_hi, ser_lo):
 
 
 def fold_edges(dep: DepGraph, cli_hi, cli_lo, cli_svc, ser_hi, ser_lo,
-               byts, valid, tick) -> DepGraph:
+               byts, valid, tick, nconn=None) -> DepGraph:
     """Accumulate (cli→ser) flows into the edge slab (batched upsert).
 
     ``upsert_fast``: the edge working set is small and long-lived (one
     row per cli→ser dependency), so after warmup every batch is all-hit
-    and the insert rounds are skipped entirely (``lax.cond``)."""
+    and the insert rounds are skipped entirely (``lax.cond``).
+
+    ``nconn``: per-lane flow count (default 1 per lane — the raw-record
+    path). Edge-folding agents ship PRE-AGGREGATED edges, so a lane may
+    represent many flows (``engine/step.py:ingest_delta``)."""
     khi, klo = edge_key(cli_hi, cli_lo, ser_hi, ser_lo)
     tbl, rows, any_new = table.upsert_fast2(dep.edge_tbl, khi, klo,
                                             valid=valid)
@@ -166,7 +170,8 @@ def fold_edges(dep: DepGraph, cli_hi, cli_lo, cli_svc, ser_hi, ser_lo,
         e_cli_hi=e_cli_hi, e_cli_lo=e_cli_lo, e_cli_svc=e_cli_svc,
         e_ser_hi=e_ser_hi, e_ser_lo=e_ser_lo,
         e_ctr=dep.e_ctr.at[lanes].add(
-            jnp.stack([jnp.where(ok, 1.0, 0.0),
+            jnp.stack([jnp.where(ok, jnp.float32(1.0) if nconn is None
+                                 else nconn.astype(jnp.float32), 0.0),
                        jnp.where(ok, byts, 0.0)], axis=1),
             mode="drop"),
         e_last_tick=set_(dep.e_last_tick, jnp.int32(tick)),

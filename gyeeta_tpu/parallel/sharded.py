@@ -261,6 +261,24 @@ def ingest_task_sharded(cfg: aggstate.EngineCfg, mesh):
     return jax.jit(_fold, donate_argnums=(0,))
 
 
+def ingest_delta_sharded(cfg: aggstate.EngineCfg, mesh):
+    """Sharded edge pre-aggregation fold: each shard folds the delta
+    lanes of ITS hosts (records were routed by the layout's hid hash at
+    staging time, like every raw stream) into its own state AND dep
+    slice — pre-aggregated dep edges are direct edges (both endpoints
+    known at the agent), so no pairing collective is needed."""
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(axes_of(mesh)),) * 3 + (P(),),
+             out_specs=(P(axes_of(mesh)),) * 2, check_vma=False)
+    def _fold(st, dep, db, tick):
+        lst, ldep = step.ingest_delta(cfg, _local(st), _local(dep),
+                                      _local(db), tick)
+        return _relocal(lst), _relocal(ldep)
+
+    return jax.jit(_fold, donate_argnums=(0, 1))
+
+
 def ping_tasks_sharded(cfg: aggstate.EngineCfg, mesh):
     @partial(jax.shard_map, mesh=mesh, in_specs=(P(axes_of(mesh)),) * 2,
              out_specs=P(axes_of(mesh)), check_vma=False)
@@ -329,6 +347,7 @@ for _n in ("fold_step_sharded", "fold_step_dep_sharded",
            "ingest_listener_sharded", "ingest_host_sharded",
            "ingest_cpumem_sharded", "ingest_trace_sharded",
            "ingest_task_sharded", "ping_tasks_sharded",
+           "ingest_delta_sharded",
            "classify_sharded", "age_tasks_sharded", "age_apis_sharded"):
     globals()[_n] = memoize_builder(globals()[_n])
 del _n

@@ -189,6 +189,14 @@ class ShardedRuntime:
         self._pressure = None         # device scalar from last dispatch
         self._fold_lst = sharded.ingest_listener_sharded(self.cfg,
                                                          self.mesh)
+        # edge pre-aggregation fold (state + dep donated; delta records
+        # route per shard by host_id like every raw stream)
+        self._fold_delta = sharded.ingest_delta_sharded(self.cfg,
+                                                        self.mesh)
+        self._delta_dims = dict(
+            resp_nbuckets=self.cfg.resp_spec.nbuckets,
+            hll_m_svc=1 << self.cfg.hll_p_svc,
+            hll_m_glob=1 << self.cfg.hll_p_global)
         self._fold_host = sharded.ingest_host_sharded(self.cfg, self.mesh)
         self._fold_task = sharded.ingest_task_sharded(self.cfg, self.mesh)
         self._fold_ping = sharded.ping_tasks_sharded(self.cfg, self.mesh)
@@ -404,6 +412,18 @@ class ShardedRuntime:
                     wire.MAX_PINGS_PER_BATCH))
                 n += len(chunks[0])
                 self.stats.bump("task_pings", len(chunks[0]))
+            elif kind == "delta":
+                bd = lambda r, sz: decode.delta_batch(  # noqa: E731
+                    r, sz, stats=self.stats, **self._delta_dims)
+                db = self._stack(bd, chunks[0],
+                                 decode.DELTA_LANES_DEFAULT,
+                                 count_path=False)
+                self.state, self.dep = self._fold_delta(
+                    self.state, self.dep, db,
+                    np.int32(self._tick_no))
+                n += len(chunks[0])
+                self.stats.bump("preagg_delta_records",
+                                len(chunks[0]))
             elif kind == "cpumem":
                 self.state = self._fold_cm(self.state, self._stack(
                     decode.cpumem_batch_fast, chunks[0],

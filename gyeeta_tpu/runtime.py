@@ -144,9 +144,15 @@ def _slab_lanes(env=None) -> dict:
         "cpumem": wire.MAX_CPUMEM_PER_BATCH,
         "trace": wire.MAX_TRACE_PER_BATCH,
         "ping": wire.MAX_PINGS_PER_BATCH,
+        # SKETCH_DELTA records per dispatch (each expands into its
+        # per-family payload lanes host-side); must stay >= the
+        # drain_chunks chunk size (decode.DELTA_LANES_DEFAULT)
+        "delta": decode.DELTA_LANES_DEFAULT,
     }
-    return {k: int(env.get(f"GYT_SLAB_{k.upper()}_LANES", v))
-            for k, v in base.items()}
+    lanes = {k: int(env.get(f"GYT_SLAB_{k.upper()}_LANES", v))
+             for k, v in base.items()}
+    lanes["delta"] = max(lanes["delta"], decode.DELTA_LANES_DEFAULT)
+    return lanes
 
 
 # fused-slab section plumbing: selfstats counter, wire subtype (for the
@@ -155,11 +161,13 @@ _SECTION_COUNTERS = {
     "listener": "listener_records", "host": "host_records",
     "task": "task_records", "ping": "task_pings",
     "cpumem": "cpumem_records", "trace": "trace_records",
+    "delta": "preagg_delta_records",
 }
 _SECTION_SUBTYPES = {
     "listener": wire.NOTIFY_LISTENER_STATE, "host": wire.NOTIFY_HOST_STATE,
     "task": wire.NOTIFY_AGGR_TASK_STATE, "ping": wire.NOTIFY_TASK_PING,
     "cpumem": wire.NOTIFY_CPU_MEM_STATE, "trace": wire.NOTIFY_REQ_TRACE,
+    "delta": wire.NOTIFY_SKETCH_DELTA,
 }
 _SECTION_BUILDERS = {
     "listener": lambda r, sz, st: decode.listener_batch_fast(r, sz,
@@ -367,10 +375,26 @@ class Runtime:
         self._dep_age = mj("dep_age", lambda: jax.jit(
             lambda d, t: dg.age(d, t, _pttl, _ettl),
             donate_argnums=(0,)), _pttl, _ettl)
+        # edge pre-aggregation fold (NOTIFY_SKETCH_DELTA): one donated
+        # dispatch folding a DeltaBatch into state AND dep (legacy
+        # path; the fused path folds deltas inside fold_all)
+        self._fold_delta = mj("delta", lambda: jax.jit(
+            lambda s, d, b, t: step.ingest_delta(cfg, s, d, b, t),
+            donate_argnums=(0, 1)))
+        # delta decode geometry: payload indices outside it are
+        # dropped + counted at decode, never scattered out of range
+        self._delta_dims = dict(
+            resp_nbuckets=cfg.resp_spec.nbuckets,
+            hll_m_svc=1 << cfg.hll_p_svc,
+            hll_m_glob=1 << cfg.hll_p_global)
         # ---- fused fold path (the default; GYT_FUSED_FOLD=0 keeps the
         # legacy per-subsystem dispatch sequence above selectable) ----
         self._fused = fused_fold_enabled()
         self._slab_lanes_cfg = _slab_lanes()
+        self._sect_builders = dict(_SECTION_BUILDERS)
+        self._sect_builders["delta"] = \
+            lambda r, sz, st: decode.delta_batch(r, sz, stats=st,
+                                                 **self._delta_dims)
         # per-subsystem staging sections: raw record-array backlogs that
         # ride the NEXT fold_all dispatch (drained at the end of every
         # ingest_records call, so they never outlive a feed batch)
@@ -550,6 +574,16 @@ class Runtime:
                 self.state = self._fold_ping(self.state, pb)
                 n += len(chunks[0])
                 self.stats.bump("task_pings", len(chunks[0]))
+            elif kind == "delta":
+                db = decode.delta_batch(
+                    chunks[0], self._slab_lanes_cfg["delta"],
+                    stats=self.stats, **self._delta_dims)
+                self.state, self.dep = self._fold_delta(
+                    self.state, self.dep, db,
+                    np.int32(self._tick_no))
+                n += len(chunks[0])
+                self.stats.bump("preagg_delta_records",
+                                len(chunks[0]))
             elif kind == "cpumem":
                 cmb = decode.cpumem_batch_fast(chunks[0],
                                                stats=self.stats)
@@ -707,7 +741,7 @@ class Runtime:
                 recs = decode._concat_chunks(
                     self._stage_recs[kind],
                     wire.DTYPE_OF_SUBTYPE[_SECTION_SUBTYPES[kind]])
-                sections[kind] = _SECTION_BUILDERS[kind](
+                sections[kind] = self._sect_builders[kind](
                     recs, self._slab_lanes_cfg[kind], self.stats)
                 self._stage_recs[kind] = []
                 self._stage_n[kind] = 0

@@ -518,6 +518,26 @@ class ParthaSim:
         return wire.encode_frames_chunked(
             wire.NOTIFY_TCP_CONN, self.conn_records(n_events))
 
+    def delta_frames(self, n_conn: int, n_resp: int,
+                     params: dict | None = None) -> bytes:
+        """Edge pre-aggregation form of one conn+resp sweep: fold the
+        records locally (``sketch/edgefold.py`` — per-svc counters,
+        loghist buckets, incremental HLL register maxes, capped flow
+        aggregates + residual bound, dep edges) and frame the
+        mergeable NOTIFY_SKETCH_DELTA stream instead of raw tuples.
+        The fold state (cumulative HLL registers) persists on the sim,
+        so successive sweeps ship shrinking register deltas — the
+        fixture mirror of a preagg-negotiated ``NetAgent``."""
+        from gyeeta_tpu.sketch import edgefold as EF
+        if getattr(self, "_edgefold", None) is None:
+            self._edgefold = EF.EdgeFold(
+                params if params is not None else EF.default_params(),
+                host_id=self.host_base)
+        return wire.encode_frames_chunked(
+            wire.NOTIFY_SKETCH_DELTA,
+            self._edgefold.fold_sweep(self.conn_records(n_conn),
+                                      self.resp_records(n_resp)))
+
     def resp_frames(self, n_events: int) -> bytes:
         return wire.encode_frames_chunked(
             wire.NOTIFY_RESP_SAMPLE, self.resp_records(n_events))

@@ -5,7 +5,7 @@ __version__ = "0.1.0"
 
 # Minimum wire-format version this build accepts from agents/simulators.
 MIN_WIRE_VERSION = 3   # v2: AGGR_TASK_DT grew forks_sec (TOPFORK);
-CURR_WIRE_VERSION = 4  # v3: REQ_TRACE_DT grew conn_id/cli ids
+CURR_WIRE_VERSION = 5  # v3: REQ_TRACE_DT grew conn_id/cli ids
 #                        (TRACECONN) — older record layouts cannot be
 #                        decoded, so the registration gate must reject
 #                        older producers outright.
@@ -13,4 +13,9 @@ CURR_WIRE_VERSION = 4  # v3: REQ_TRACE_DT grew conn_id/cli ids
 #                        marks, COMM_THROTTLE control, REGISTER_RESP
 #                        last_seq tail) — no existing layout changed,
 #                        so v3 producers stay accepted (MIN stays 3);
-#                        v3 peers skip the new subtype/control frames
+#                        v3 peers skip the new subtype/control frames.
+#                        v5: edge pre-aggregation (NOTIFY_SKETCH_DELTA
+#                        + the REGISTER_RESP preagg advert tail) —
+#                        additive again: v3/v4 servers skip the new
+#                        subtype COUNTED, v3/v4 agents ignore the
+#                        advert tail and stay raw (MIN stays 3)

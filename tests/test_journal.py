@@ -257,12 +257,12 @@ def test_register_resp_last_seq_roundtrip():
     b = wire.encode_register_resp(wire.REG_OK, 3,
                                   version.CURR_WIRE_VERSION, 41)
     hsz = wire.HEADER_DT.itemsize
-    st, hid, ver, seq = wire.decode_register_resp(b[hsz:])
+    st, hid, ver, seq, _pre = wire.decode_register_resp(b[hsz:])
     assert (st, hid, seq) == (wire.REG_OK, 3, 41)
     # legacy 16-byte payload (pre-v4 server): last_seq defaults to 0
     legacy = np.zeros((), wire.REGISTER_RESP_DT)
     legacy["status"], legacy["host_id"] = wire.REG_OK, 9
-    st, hid, _ver, seq = wire.decode_register_resp(legacy.tobytes())
+    st, hid, _ver, seq, _pre = wire.decode_register_resp(legacy.tobytes())
     assert (st, hid, seq) == (wire.REG_OK, 9, 0)
 
 

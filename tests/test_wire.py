@@ -86,6 +86,58 @@ def test_unknown_subtype_skipped():
     assert consumed == len(unknown) + len(known)
 
 
+def test_sketch_delta_roundtrip():
+    recs = np.zeros(9, wire.DELTA_DT)
+    recs["kind"] = wire.DK_SVC_CTR
+    recs["key_hi"] = np.arange(9)
+    recs["host_id"] = 3
+    buf = wire.encode_frame(wire.NOTIFY_SKETCH_DELTA, recs)
+    frames, consumed = wire.decode_frames(buf)
+    assert consumed == len(buf) and len(frames) == 1
+    subtype, out = frames[0]
+    assert subtype == wire.NOTIFY_SKETCH_DELTA
+    assert np.array_equal(out, recs)
+
+
+def test_sketch_delta_forward_compat_v4_server(monkeypatch):
+    """A v4 server (no NOTIFY_SKETCH_DELTA in its subtype table)
+    receiving delta frames counts a skip — the PR-4 unknown-subtype
+    drain path — and never folds garbage. Emulated by stripping the
+    subtype from the live table (decode_frames reads it per call; the
+    native deframer receives the same table at load, so both paths
+    share the discipline)."""
+    recs = np.zeros(7, wire.DELTA_DT)
+    recs["kind"] = wire.DK_FLOW
+    known = wire.encode_frame(wire.NOTIFY_RESP_SAMPLE,
+                              np.zeros(2, wire.RESP_SAMPLE_DT))
+    delta = wire.encode_frame(wire.NOTIFY_SKETCH_DELTA, recs)
+    monkeypatch.delitem(wire.DTYPE_OF_SUBTYPE, wire.NOTIFY_SKETCH_DELTA)
+    monkeypatch.delitem(wire.MAX_OF_SUBTYPE, wire.NOTIFY_SKETCH_DELTA)
+    counts: dict = {}
+    frames, consumed = wire.decode_frames(delta + known, counts)
+    # the delta frame is fully consumed, yields NO records, and its
+    # record count lands in the loss accounting — never silent
+    assert consumed == len(delta) + len(known)
+    assert [f[0] for f in frames] == [wire.NOTIFY_RESP_SAMPLE]
+    assert counts["unknown_records"] == 7
+
+
+def test_register_resp_preagg_tail_roundtrip():
+    params = {"hll_p_svc": 10, "hll_p_global": 14, "td_stride": 16,
+              "resp_nbuckets": 256, "flow_max": 128,
+              "resp_vmin": 1.0, "resp_vmax": 1e8}
+    buf = wire.encode_register_resp(wire.REG_OK, 5, 5, 77,
+                                    preagg=params)
+    hsz = wire.HEADER_DT.itemsize
+    st, hid, _ver, seq, pre = wire.decode_register_resp(buf[hsz:])
+    assert (st, hid, seq) == (wire.REG_OK, 5, 77)
+    assert pre == params
+    # v4 server (no tail): preagg is None
+    buf4 = wire.encode_register_resp(wire.REG_OK, 5, 4, 77)
+    *_rest, pre4 = wire.decode_register_resp(buf4[hsz:])
+    assert pre4 is None
+
+
 def test_conn_batch_columns():
     sim = ParthaSim(n_hosts=4, n_svcs=2, n_clients=64, seed=9)
     recs = sim.conn_records(50)
