@@ -1,7 +1,18 @@
-"""Fleet-scale harness: 50k simulated agents + the MULTICHIP bench row.
+"""Fleet-scale harness: 50k/1M simulated agents + the MULTICHIP row.
 
-Two phases, each a killable subprocess (the bench.py isolation
-discipline), merged into ``MULTICHIP_r06.json``:
+Phases, each a killable subprocess (the bench.py isolation
+discipline), merged into ``MULTICHIP_r08.json`` (``GYT_SCALE_PHASES``
+selects; unselected phases carry forward from the previous artifact
+when their code paths are unchanged — the PR-11 precedent):
+
+- ``mproc``   — ISSUE-12 feed-rate-per-ingest-process scaling: the
+  same stream through 1/2/4 ingest worker processes, per-worker
+  saturation rate in records per worker-CPU-second (one subprocess
+  per leg, mirrored slot order — see ``_phase_mproc``), exact
+  cross-process ledger including a SIGKILL/respawn window.
+- ``million`` — 2^20 simulated agents over 64 batched relay conns
+  through 4 ingest workers into a live 8-shard mesh: every agent's
+  host row lands, uniform shard placement, zero silent loss.
 
 - ``fold``  — the sharded ns-geometry fold on a simulated 8-device
   mesh: ONE compiled mesh program (per-shard fused fold_all + dep
@@ -37,13 +48,30 @@ import tempfile
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-ART = os.path.join(HERE, "MULTICHIP_r07.json")
+ART = os.path.join(HERE, "MULTICHIP_r08.json")
 N_SHARDS = int(os.environ.get("GYT_SCALE_SHARDS", "8"))
 # cfg.n_hosts of the ns geometry; override for quick dev runs
 N_AGENTS = int(os.environ.get("GYT_SCALE_AGENTS", "50048"))
 N_CONNS = int(os.environ.get("GYT_SCALE_CONNS", "32"))
+# the ISSUE-12 million-agent leg: 2^20 simulated agents over batched
+# relay conns through 4 ingest worker processes
+N_MILLION = int(os.environ.get("GYT_SCALE_MILLION_AGENTS",
+                               str(1 << 20)))
+MILLION_CONNS = int(os.environ.get("GYT_SCALE_MILLION_CONNS", "64"))
 
-PHASE_TIMEOUT = {"fold": 3600, "fleet": 3600, "preagg": 1800}
+PHASE_TIMEOUT = {"fold": 3600, "fleet": 3600, "preagg": 1800,
+                 "mproc": 1800, "million": 3600}
+
+
+def _usage() -> dict:
+    """Fold-vs-worker CPU split + peak RSS (the bench.py satellite —
+    per-process numbers on a shared box need it to be interpretable)."""
+    import resource
+    s = resource.getrusage(resource.RUSAGE_SELF)
+    c = resource.getrusage(resource.RUSAGE_CHILDREN)
+    return {"rss_peak_mb": round(s.ru_maxrss / 1024.0, 1),
+            "fold_cpu_s": round(s.ru_utime + s.ru_stime, 2),
+            "worker_cpu_s": round(c.ru_utime + c.ru_stime, 2)}
 
 
 # --------------------------------------------------------------- fold phase
@@ -522,6 +550,551 @@ def _phase_preagg() -> dict:
     return out
 
 
+# ----------------------------------------------------------- mproc phase
+def _phase_mproc() -> dict:
+    """Parent half: one SUBPROCESS per measured leg (the bench.py
+    isolation discipline). Measured in-process, later legs ran 2-3x
+    slower per CPU-second on IDENTICAL work — the long-lived harness
+    bloats past 10GB folding earlier legs and fresh workers then pay
+    reclaim/compaction on every allocation; a crc32 calibration probe
+    in the warm harness showed ~1.0 drift, pinning the contamination
+    to process memory state, not the box. Fresh leg processes remove
+    it; the mirrored slot order stays as belt-and-braces against
+    real box drift."""
+    slots = os.environ.get("GYT_SCALE_MPROC_LEGS",
+                           "1,2,4,4,2,1").split(",")
+    leg_runs: dict = {}
+    crash_done = False
+    for slot_i, n in enumerate(slots):
+        env = dict(
+            os.environ, GYT_SCALE_PHASE="mproc",
+            GYT_SCALE_MPROC_CHILD="1", GYT_SCALE_MPROC_LEGS=n,
+            GYT_SCALE_MPROC_SLOT=str(slot_i),
+            GYT_SCALE_MPROC_CRASH=(
+                "1" if int(n) >= 4 and not crash_done else "0"),
+            JAX_COMPILATION_CACHE_DIR=tempfile.mkdtemp(
+                prefix="gyt_mproc_xla_"))
+        if int(n) >= 4 and not crash_done:
+            crash_done = True
+        t0 = time.time()
+        try:
+            r = subprocess.run([sys.executable, __file__], env=env,
+                               cwd=HERE, capture_output=True,
+                               text=True, timeout=1500)
+        except subprocess.TimeoutExpired:
+            print(f"mproc: leg {n} (slot {slot_i}) timed out after "
+                  f"{time.time() - t0:.0f}s", file=sys.stderr,
+                  flush=True)
+            continue
+        sys.stderr.write(r.stderr or "")
+        line = None
+        for ln in (r.stdout or "").splitlines():
+            if ln.strip().startswith("{"):
+                line = ln.strip()
+        if r.returncode != 0 or not line:
+            print(f"mproc: leg {n} (slot {slot_i}) failed "
+                  f"rc={r.returncode}", file=sys.stderr, flush=True)
+            continue
+        child = json.loads(line)
+        for k, runs in child.get("leg_runs", {}).items():
+            leg_runs.setdefault(int(k), []).extend(runs)
+
+    # merge mirrored runs: the reported leg is the MEAN of its early
+    # and late slot; raw runs ride along
+    legs = {}
+    for nprocs, runs in leg_runs.items():
+        mean = lambda k: round(  # noqa: E731
+            sum(r[k] for r in runs) / len(runs), 1)
+        legs[str(nprocs)] = {
+            "workers": nprocs,
+            "aggregate_ev_per_cpu_sec": mean(
+                "aggregate_ev_per_cpu_sec"),
+            "aggregate_wall_ev_per_sec": mean(
+                "aggregate_wall_ev_per_sec"),
+            "wall_serialized_ev_per_sec": mean(
+                "wall_serialized_ev_per_sec"),
+            "zero_silent_loss": all(r["zero_silent_loss"]
+                                    for r in runs),
+            "crash_window": next((r["crash_window"] for r in runs
+                                  if r.get("crash_window")), None),
+            "runs": runs,
+        }
+    if "1" not in legs or "4" not in legs:
+        return {"failed": True, "legs": legs}
+    r1 = legs["1"]["aggregate_ev_per_cpu_sec"]
+    r4 = legs["4"]["aggregate_ev_per_cpu_sec"]
+    out = {
+        "n_shards": N_SHARDS,
+        "legs": legs,
+        "scaling_4w_vs_1w": round(r4 / max(r1, 1e-9), 2),
+        "wall_serialized_4w_vs_1w": round(
+            legs["4"]["wall_serialized_ev_per_sec"]
+            / max(legs["1"]["wall_serialized_ev_per_sec"], 1e-9), 2),
+        "usage": _usage(),
+        "methodology": (
+            "per-worker saturation rates in records per worker "
+            "CPU-second summed (workers are fully partitioned: own "
+            "conns, own deframe/decode, own WAL files, own rings — N "
+            "cores run them in parallel at their per-CPU rate); the "
+            "1-core sim serializes them, so wall_serialized is the "
+            "same-box control and wall windows carry scheduler "
+            "noise. One subprocess per leg, mirrored slot order. "
+            "MULTICHIP_r06 fleet methodology."),
+    }
+    out["meets_2p5x_gate"] = bool(
+        out["scaling_4w_vs_1w"] >= 2.5
+        and all(leg["zero_silent_loss"] for leg in legs.values())
+        and legs["4"]["crash_window"] is not None)
+    return out
+
+
+def _phase_mproc_leaf() -> dict:
+    """ISSUE-12 feed-rate-per-ingest-process scaling: the same wire
+    stream through 1 / 2 / 4 ingest worker processes (sticky shard
+    groups over an 8-shard mesh, worker-owned per-shard WAL on).
+
+    Methodology on the 1-core CPU sim (the MULTICHIP_r06 discipline —
+    the host serializes what real deployments run in parallel): each
+    worker is measured at SATURATION on its own stream slice with the
+    other workers idle and the fold drain deferred (the rings hold
+    the leg). The PRIMARY per-worker rate is records per WORKER
+    CPU-SECOND (/proc/<pid>/stat utime+stime across the window):
+    wall windows of tens of ms on this shared box swing 10-20x with
+    scheduler noise, while CPU-normalized cost per record is stable —
+    and it is exactly the partitioning claim being measured (worker
+    state shares no GIL, no locks, no WAL files, so N cores run N
+    workers at their per-CPU rate; the aggregate is the sum).
+    ``wall_ev_per_sec`` rides along per worker as the unnormalized
+    control, and ``wall_serialized_ev_per_sec`` is the whole-leg
+    1-core number. Ledger gate: zero silent loss at 4 processes
+    INCLUDING a SIGKILL/respawn window."""
+    import signal
+    import socket as _socket
+    import threading
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.net.ingestproc import IngestSupervisor
+    from gyeeta_tpu.parallel.mesh import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    def proc_cpu_s(pid: int) -> float:
+        """utime+stime of one process in seconds (scheduler-noise-
+        immune base for the per-worker rate)."""
+        with open(f"/proc/{pid}/stat") as f:
+            parts = f.read().rsplit(")", 1)[1].split()
+        hz = os.sysconf("SC_CLK_TCK")
+        return (int(parts[11]) + int(parts[12])) / hz
+
+    import zlib
+    _cal_buf = os.urandom(1 << 20)
+
+    def calibrate() -> float:
+        """CPU-seconds-per-op of a FIXED C-speed reference (crc32 of
+        1MiB) right now. This shared box derates 2-3x over a phase
+        run (frequency/SMT/neighbor pressure — measured: identical
+        worker windows slow monotonically regardless of worker
+        count); dividing each window's rate by the box's concurrent
+        derate factor makes windows minutes apart comparable."""
+        t0 = time.thread_time()
+        n = 0
+        while time.thread_time() - t0 < 0.25:
+            zlib.crc32(_cal_buf)
+            n += 1
+        return n / (time.thread_time() - t0)
+
+    # rings sized to PARK one worker's whole measured stream: the
+    # fold drains between windows, never during one — a concurrent
+    # drain time-shares the core and its cache thrash inflates the
+    # measured worker's cycles-per-record (stall cycles bill as CPU)
+    os.environ.setdefault("GYT_SHM_RING_SLOTS", "192")
+    os.environ.setdefault("GYT_SHM_RING_SLOT_KB", "192")
+    cfg = EngineCfg(n_hosts=4096, svc_capacity=8192,
+                    task_capacity=1024, conn_batch=2048,
+                    resp_batch=2048, listener_batch=512, fold_k=2)
+    # long enough that each worker's window spans >= dozens of
+    # /proc/stat ticks (10ms granularity) — short windows quantize
+    # the CPU-normalized rate into noise. FOUR conns per shard home:
+    # every leg's workers then see the same deep-buffered interleave
+    # (few conns per worker = shallow socket buffers = small recv
+    # chunks = per-chunk overhead billed as phantom per-record cost)
+    rounds = int(os.environ.get("GYT_SCALE_MPROC_ROUNDS", "12"))
+    conns_per_home = 4
+    ev_per_conn = rounds * (2048 + 2048)
+    hosts_per_home = 4096 // N_SHARDS
+    sims = [ParthaSim(n_hosts=hosts_per_home, n_svcs=2,
+                      host_base=h * hosts_per_home, seed=700 + h)
+            for h in range(N_SHARDS)]
+    home_streams = [b"".join(sims[h].conn_frames(2048)
+                             + sims[h].resp_frames(2048)
+                             for _ in range(rounds))
+                    for h in range(N_SHARDS)]
+    # conn j: home hid j % N_SHARDS, stream = its home's bytes
+    all_conns = list(range(conns_per_home * N_SHARDS))
+    streams = {j: home_streams[j % N_SHARDS] for j in all_conns}
+
+    # warm the mesh fold programs ONCE before any leg (process jit
+    # memo): without this the first leg's drain bills multi-minute
+    # XLA compiles to the wall numbers
+    warm_rt = ShardedRuntime(cfg, make_mesh(N_SHARDS),
+                             RuntimeOpts(dep_pair_capacity=8192,
+                                         dep_edge_capacity=4096))
+    warm_rt.feed(sims[0].conn_frames(2048) + sims[0].resp_frames(2048))
+    warm_rt.flush()
+    warm_rt.close()
+    del warm_rt
+
+    def settle(sup, srt) -> bool:
+        """Drain until every accepted record is published AND every
+        published record is consumed (checking backlog alone races a
+        worker mid-chunk: accept is counted before its publishes).
+        Returns False on deadline — callers surface it rather than
+        letting a slow box masquerade as a ledger violation."""
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            sup.drain()
+            acc = sum(h.shm.counter("accepted_records")
+                      for h in sup.workers)
+            pub = sum(h.shm.counter("published_records")
+                      for h in sup.workers)
+            drops = sum(v for k, v in srt.stats.counters.items()
+                        if k.startswith("ingest_ring_dropped_records"))
+            cons = srt.stats.counters.get(
+                "ingest_ring_consumed_records", 0)
+            if acc == pub and cons + drops == pub \
+                    and sum(h.shm.backlog() for h in sup.workers) == 0:
+                return True
+            time.sleep(0.005)
+        print("mproc: settle DEADLINE expired", file=sys.stderr,
+              flush=True)
+        return False
+
+    leg_runs: dict = {}
+    cal_ref = [None]                # first window's reference speed
+    total_cpu0 = _usage()
+    # mirrored leg order: every leg samples one early (cool) and one
+    # late (derated) slot, so the box's monotone drift cancels in the
+    # per-leg average instead of masquerading as a scaling trend
+    leg_order = tuple(int(x) for x in os.environ.get(
+        "GYT_SCALE_MPROC_LEGS", "1,2,4,4,2,1").split(","))
+    for leg_i, nprocs in enumerate(leg_order):
+        tmp = tempfile.mkdtemp(prefix=f"gyt_mproc_{nprocs}_")
+        srt = ShardedRuntime(
+            cfg, make_mesh(N_SHARDS),
+            RuntimeOpts(dep_pair_capacity=8192, dep_edge_capacity=4096,
+                        journal_dir=os.path.join(tmp, "wal")))
+        sup = IngestSupervisor(srt, nprocs,
+                               journal_dir=os.path.join(tmp, "wal"))
+        sup.start(loop=None)
+        # readiness gate: a freshly spawned worker spends seconds in
+        # imports — measuring before its loop heartbeats would bill
+        # python startup to the ingest rate
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(h.shm.counter("hb_seq") >= 2 for h in sup.workers):
+                break
+            time.sleep(0.05)
+
+        # conn j (home hid = j % N_SHARDS) → worker of that home
+        per_worker: dict = {}
+        for j in all_conns:
+            per_worker.setdefault(
+                sup.worker_of_hid(j % N_SHARDS), []).append(j)
+
+        rates = {}
+        warm_chunk = {j: sims[j % N_SHARDS].conn_frames(256)
+                      for j in all_conns}
+        t_all0 = time.perf_counter()
+        for w, conns in sorted(per_worker.items()):
+            shm = sup.workers[w].shm
+            socks = []
+            death = threading.Event()
+            for h in conns:
+                a, b = _socket.socketpair()
+                a.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF,
+                             1 << 20)
+                assert sup.handoff(h, 1000 + h, b.fileno(), b"", death)
+                b.close()
+                socks.append((h, a))
+            # unmeasured warmup: conn registered, first chunk decoded
+            # (numpy import paths, journal open, ring first-touch)
+            base = shm.counter("accepted_records")
+            for h, a in socks:
+                a.sendall(warm_chunk[h])
+            while shm.counter("accepted_records") \
+                    < base + 256 * len(conns):
+                time.sleep(0.001)
+            base = shm.counter("accepted_records")
+            want = base + len(conns) * ev_per_conn
+            writers = [threading.Thread(target=a.sendall,
+                                        args=(streams[h],),
+                                        daemon=True)
+                       for h, a in socks]
+            pid = sup.workers[w].proc.pid
+            cal = calibrate()
+            if cal_ref[0] is None:
+                cal_ref[0] = cal
+            derate = cal / cal_ref[0]
+            cpu0 = proc_cpu_s(pid)
+            t0 = time.perf_counter()
+            for t in writers:
+                t.start()
+            while shm.counter("accepted_records") < want:
+                time.sleep(0.001)
+            dt = time.perf_counter() - t0
+            cpu = max(proc_cpu_s(pid) - cpu0, 1e-6)
+            nrec = len(conns) * ev_per_conn
+            rates[w] = {"ev_per_cpu_sec": nrec / cpu / derate,
+                        "ev_per_cpu_sec_raw": nrec / cpu,
+                        "box_derate": round(derate, 3),
+                        "wall_ev_per_sec": nrec / dt,
+                        "cpu_s": round(cpu, 3)}
+            for t in writers:
+                t.join(timeout=30)
+            for _h, s in socks:
+                s.close()
+        # ALL folding deferred to the leg end: the rings park every
+        # window's records (sized above), so the measured windows run
+        # back-to-back on a cool box — the fold drain is the phase's
+        # big heater and this shared box visibly derates over minutes
+        # (measured: identical worker windows run 2-3x slower late in
+        # the phase regardless of worker count)
+        t_drain0 = time.perf_counter()
+        settle(sup, srt)
+        srt.flush()
+        drain_wall = time.perf_counter() - t_drain0
+        wall_all = time.perf_counter() - t_all0
+
+        crash = None
+        if nprocs >= 4 \
+                and os.environ.get("GYT_SCALE_MPROC_CRASH") == "1":
+            # ---- SIGKILL/respawn window inside the ledger
+            victim = sup.workers[2]
+            pid0 = victim.proc.pid
+            os.kill(pid0, signal.SIGKILL)
+            victim.proc.wait(timeout=10)
+            for _ in range(200):
+                if sup.poll():
+                    break
+                time.sleep(0.05)
+            assert victim.proc.pid != pid0, "respawn failed"
+            time.sleep(1.0)                 # fresh worker attaches
+            a, b = _socket.socketpair()
+            death = threading.Event()
+            assert sup.handoff(2, 9002, b.fileno(), b"", death)
+            b.close()
+            tail = sims[2].conn_frames(2048) + sims[2].resp_frames(2048)
+            before = victim.shm.counter("accepted_records")
+            a.sendall(tail)
+            while victim.shm.counter("accepted_records") \
+                    < before + 4096:
+                time.sleep(0.005)
+            settle(sup, srt)
+            a.close()
+            crash = {"respawned": True, "sticky_shards": victim.shards,
+                     "respawns_counted": srt.stats.counters.get(
+                         "ingest_proc_respawns|proc=2", 0)}
+
+        sup.poll()
+        published = sum(h.shm.counter("published_records")
+                        for h in sup.workers)
+        accepted = sum(h.shm.counter("accepted_records")
+                       for h in sup.workers)
+        c = srt.stats.counters
+        consumed = c.get("ingest_ring_consumed_records", 0)
+        ring_drops = sum(v for k, v in c.items()
+                         if k.startswith("ingest_ring_dropped_records"))
+        folded = c.get("conn_events", 0) + c.get("resp_events", 0)
+        ledger_ok = (published == consumed + ring_drops
+                     and accepted == published and folded == consumed)
+        run = {
+            "workers": nprocs,
+            "per_worker": {str(w): {k: round(v, 1) for k, v
+                                    in r.items()}
+                           for w, r in rates.items()},
+            "aggregate_ev_per_cpu_sec": round(
+                sum(r["ev_per_cpu_sec"] for r in rates.values()), 1),
+            "aggregate_wall_ev_per_sec": round(
+                sum(r["wall_ev_per_sec"] for r in rates.values()), 1),
+            "wall_serialized_ev_per_sec": round(
+                len(all_conns) * ev_per_conn / wall_all, 1),
+            "drain_wall_s": round(drain_wall, 2),
+            "accepted": int(accepted), "published": int(published),
+            "consumed": int(consumed), "ring_drops": int(ring_drops),
+            "zero_silent_loss": bool(ledger_ok),
+            "crash_window": crash,
+        }
+        run["records"] = len(all_conns) * ev_per_conn
+        run["usage"] = {k: round(v - total_cpu0.get(k, 0), 2)
+                        if k.endswith("_s") else v
+                        for k, v in _usage().items()}
+        leg_runs.setdefault(nprocs, []).append(run)
+        print(f"mproc {nprocs}w (slot "
+              f"{os.environ.get('GYT_SCALE_MPROC_SLOT', leg_i)}): "
+              f"aggregate {run['aggregate_ev_per_cpu_sec']:,.0f} "
+              f"ev/cpu-s (wall sum "
+              f"{run['aggregate_wall_ev_per_sec']:,.0f},"
+              f" serialized "
+              f"{run['wall_serialized_ev_per_sec']:,.0f}"
+              f"), ledger {'OK' if ledger_ok else 'BROKEN'}",
+              file=sys.stderr, flush=True)
+        sup.stop()
+        sup.close()
+        srt.close()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.sync()
+
+    return {"leg_runs": {str(k): v for k, v in leg_runs.items()}}
+
+
+# --------------------------------------------------------- million phase
+def _phase_million() -> dict:
+    """Toward the north star: 2^20 simulated agents over batched
+    relay conns (the production shape: ~16k agents per relay conn)
+    through 4 ingest worker processes into a live 8-shard mesh fold.
+    Gates: every agent's host row lands (rollup n_hosts_up == 2^20),
+    per-shard placement uniform, ledger exact."""
+    import socket as _socket
+    import threading
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.net.ingestproc import IngestSupervisor
+    from gyeeta_tpu.parallel.mesh import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils.config import RuntimeOpts
+
+    os.environ.setdefault("GYT_SHM_RING_SLOTS", "96")
+    os.environ.setdefault("GYT_SHM_RING_SLOT_KB", "192")
+    n_agents = N_MILLION
+    n_conns = MILLION_CONNS
+    hosts_per_conn = n_agents // n_conns
+    cfg = EngineCfg(n_hosts=n_agents, svc_capacity=8192,
+                    task_capacity=1024, conn_batch=2048,
+                    resp_batch=2048, listener_batch=512, fold_k=2)
+    srt = ShardedRuntime(cfg, make_mesh(N_SHARDS),
+                         RuntimeOpts(dep_pair_capacity=8192,
+                                     dep_edge_capacity=4096))
+    sup = IngestSupervisor(srt, 4, journal_dir=None)
+    sup.start(loop=None)
+    time.sleep(1.0)
+
+    # ONE sim generates the per-conn record template; each relay conn
+    # rebases host ids into its own 16k block (one init, 64 rebases —
+    # a per-conn ParthaSim would spend minutes just constructing)
+    sim = ParthaSim(n_hosts=hosts_per_conn, n_svcs=2, seed=900)
+    hs_template = sim.host_state_records()
+    conn_sweep = sim.conn_frames(2048)      # svc traffic on conn 0 only
+    t_gen0 = time.perf_counter()
+    streams = []
+    built = 0
+    for k in range(n_conns):
+        recs = hs_template.copy()
+        recs["host_id"] = (recs["host_id"] % hosts_per_conn) \
+            + k * hosts_per_conn
+        buf = wire.encode_frames_chunked(wire.NOTIFY_HOST_STATE, recs)
+        if k == 0:
+            buf += conn_sweep
+            built += 2048
+        built += len(recs)
+        streams.append(buf)
+    gen_wall = time.perf_counter() - t_gen0
+
+    death = threading.Event()
+    socks = []
+    writers = []
+    t0 = time.perf_counter()
+    for k in range(n_conns):
+        hid = k * hosts_per_conn            # home hid spreads workers
+        a, b = _socket.socketpair()
+        a.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 1 << 20)
+        assert sup.handoff(hid, 2000 + k, b.fileno(), b"", death)
+        b.close()
+        socks.append(a)
+        t = threading.Thread(target=a.sendall, args=(streams[k],),
+                             daemon=True)
+        writers.append(t)
+        t.start()
+    # drain concurrently: a million records of ring traffic cannot be
+    # parked. Settle condition: every accepted record PUBLISHED and
+    # every published record consumed (accept is counted before its
+    # publishes — checking backlog alone races the last chunk)
+    deadline = time.monotonic() + PHASE_TIMEOUT["million"] - 300
+    while time.monotonic() < deadline:
+        sup.drain(max_slots_per_ring=64)
+        acc = sum(h.shm.counter("accepted_records")
+                  for h in sup.workers)
+        pub = sum(h.shm.counter("published_records")
+                  for h in sup.workers)
+        cons = srt.stats.counters.get("ingest_ring_consumed_records",
+                                      0)
+        drops = sum(v for k, v in srt.stats.counters.items()
+                    if k.startswith("ingest_ring_dropped_records"))
+        if acc >= built and pub == acc and cons + drops == pub \
+                and sum(h.shm.backlog() for h in sup.workers) == 0:
+            break
+        time.sleep(0.001)
+    for t in writers:
+        t.join(timeout=30)
+    for s in socks:
+        s.close()
+    srt.flush()
+    feed_wall = time.perf_counter() - t0
+    t_tick0 = time.perf_counter()
+    srt.run_tick()
+    tick_wall = time.perf_counter() - t_tick0
+
+    sup.poll()
+    published = sum(h.shm.counter("published_records")
+                    for h in sup.workers)
+    accepted = sum(h.shm.counter("accepted_records")
+                   for h in sup.workers)
+    c = srt.stats.counters
+    consumed = c.get("ingest_ring_consumed_records", 0)
+    ring_drops = sum(v for k, v in c.items()
+                     if k.startswith("ingest_ring_dropped_records"))
+    ledger_ok = (accepted == built and published == accepted
+                 and published == consumed + ring_drops)
+    ru = srt.rollup_stats()
+    sl = srt.query({"subsys": "shardlist", "maxrecs": 16})["recs"]
+    per_shard_hosts = [int(r["nhosts"]) for r in sl]
+    sup.stop()
+    sup.close()
+    srt.close()
+
+    out = {
+        "agents": n_agents, "relay_conns": n_conns,
+        "hosts_per_conn": hosts_per_conn,
+        "ingest_workers": 4,
+        "records_built": int(built),
+        "accepted": int(accepted), "published": int(published),
+        "consumed": int(consumed), "ring_drops": int(ring_drops),
+        "zero_silent_loss": bool(ledger_ok),
+        "gen_wall_s": round(gen_wall, 2),
+        "feed_wall_s": round(feed_wall, 2),
+        "tick_wall_s": round(tick_wall, 2),
+        "ev_per_sec": round(built / feed_wall, 1),
+        "n_hosts_up": int(ru["n_hosts_up"]),
+        "all_agents_reporting": bool(int(ru["n_hosts_up"])
+                                     == n_agents),
+        "per_shard_hosts": per_shard_hosts,
+        "per_shard_uniform": bool(
+            max(per_shard_hosts) - min(per_shard_hosts)
+            <= max(1, n_agents // N_SHARDS // 100)),
+        "usage": _usage(),
+    }
+    out["meets_gate"] = bool(ledger_ok and out["all_agents_reporting"])
+    print(f"million: {n_agents:,} agents over {n_conns} relay conns / "
+          f"4 workers — {out['ev_per_sec']:,.0f} ev/s, hosts up "
+          f"{out['n_hosts_up']:,}, ledger "
+          f"{'OK' if ledger_ok else 'BROKEN'}",
+          file=sys.stderr, flush=True)
+    return out
+
+
 # ------------------------------------------------------------- orchestrator
 def _run_phase_subproc(phase: str) -> dict:
     env = dict(
@@ -569,6 +1142,10 @@ def main() -> int:
         return r.returncode
 
     phase = os.environ.get("GYT_SCALE_PHASE")
+    if phase == "mproc" and os.environ.get("GYT_SCALE_MPROC_CHILD") \
+            == "1":
+        print(json.dumps(_phase_mproc_leaf()))
+        return 0
     if phase == "fold":
         print(json.dumps(_phase_fold()))
         return 0
@@ -578,21 +1155,46 @@ def main() -> int:
     if phase == "preagg":
         print(json.dumps(_phase_preagg()))
         return 0
+    if phase == "mproc":
+        print(json.dumps(_phase_mproc()))
+        return 0
+    if phase == "million":
+        print(json.dumps(_phase_million()))
+        return 0
 
     result = {
         "metric": "multichip_sharded_fold",
         "n_shards": N_SHARDS,
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
-    fold = _run_phase_subproc("fold")
-    result["fold"] = fold
-    fleet = _run_phase_subproc("fleet")
-    result["fleet"] = fleet
-    preagg = _run_phase_subproc("preagg")
-    result["preagg"] = preagg
+    # GYT_SCALE_PHASES selects; "carry" pulls a phase's row from the
+    # previous artifact when its code paths are unchanged this round
+    # (the PR-11 precedent — reruns on this shared box cost an hour+
+    # and add no information when the measured path didn't move)
+    want = os.environ.get(
+        "GYT_SCALE_PHASES", "fold,fleet,preagg,mproc,million").split(",")
+    prev = {}
+    prev_art = os.path.join(HERE, os.environ.get(
+        "GYT_SCALE_CARRY_FROM", "MULTICHIP_r07.json"))
+    if os.path.exists(prev_art):
+        with open(prev_art) as f:
+            prev = json.load(f)
+    for ph in ("fold", "fleet", "preagg", "mproc", "million"):
+        if ph in want:
+            result[ph] = _run_phase_subproc(ph)
+        elif ph in prev:
+            result[ph] = dict(prev[ph])
+            result[ph]["carried_from"] = os.path.basename(prev_art)
+    fold = result.get("fold", {})
+    fleet = result.get("fleet", {})
+    preagg = result.get("preagg", {})
+    mproc = result.get("mproc", {})
+    million = result.get("million", {})
     result["ok"] = bool(fold.get("meets_3x_gate")
                         and fleet.get("zero_silent_loss")
-                        and preagg.get("meets_20x_gate"))
+                        and preagg.get("meets_20x_gate")
+                        and mproc.get("meets_2p5x_gate")
+                        and million.get("meets_gate"))
     with open(ART, "w") as f:
         f.write(json.dumps(result, indent=1) + "\n")
     print(json.dumps(result))

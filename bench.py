@@ -647,6 +647,34 @@ def _bench_snap_pingpong() -> dict:
     return out
 
 
+def _proc_usage() -> dict:
+    """Per-phase resource row (ISSUE-12 satellite): peak RSS plus
+    CPU-seconds split between THIS process (the fold side) and its
+    CHILDREN (ingest workers / render-pool children) — without the
+    split, per-process scaling numbers on a shared box are
+    uninterpretable (a phase can look fast while its workers burned a
+    core somewhere else)."""
+    import resource
+    self_ru = resource.getrusage(resource.RUSAGE_SELF)
+    child_ru = resource.getrusage(resource.RUSAGE_CHILDREN)
+    rss_mb = self_ru.ru_maxrss / 1024.0       # linux: KiB
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmHWM:"):
+                    rss_mb = int(ln.split()[1]) / 1024.0
+                    break
+    except OSError:                            # pragma: no cover
+        pass
+    return {
+        "rss_peak_mb": round(rss_mb, 1),
+        "cpu_user_s": round(self_ru.ru_utime, 2),
+        "cpu_sys_s": round(self_ru.ru_stime, 2),
+        "child_cpu_user_s": round(child_ru.ru_utime, 2),
+        "child_cpu_sys_s": round(child_ru.ru_stime, 2),
+    }
+
+
 def _run_phase(phase: str) -> dict:
     """Leaf mode: run ONE phase in-process and return its fields."""
     import jax
@@ -923,7 +951,12 @@ def main() -> None:
         import jax
         if plat:
             jax.config.update("jax_platforms", plat)
-        print(json.dumps(_run_phase(phase)))
+        out = _run_phase(phase)
+        # resource row AFTER the measured work: peak RSS + the fold-
+        # vs-child CPU-seconds split (shared-box interpretability)
+        if isinstance(out, dict):
+            out["usage"] = _proc_usage()
+        print(json.dumps(out))
         return
 
     degraded = False

@@ -97,6 +97,19 @@ if ! JAX_PLATFORMS=cpu python _multichip_smoke.py; then
     exit 1
 fi
 
+# Multi-process ingest smoke: a REAL `serve --shards 8
+# --ingest-procs 2` subprocess — registration + fd handoff to sticky
+# shard-group workers, worker-side deframe/decode + WAL append,
+# shared-memory rings into the fold; 2 agents on different shard
+# groups; asserts merged svcstate byte-equal on REST and stock NM,
+# per-worker heartbeat gauges + ledger counters in /metrics, and the
+# worker-owned per-shard WAL in the stock layout.
+echo "ci: multi-process ingest smoke" >&2
+if ! JAX_PLATFORMS=cpu python _mproc_smoke.py; then
+    echo "ci: FATAL — mproc smoke failed" >&2
+    exit 1
+fi
+
 # Chaos smoke: a REAL `serve` subprocess behind the seeded chaos proxy
 # (sim/chaos.py) — corruption/disconnect faults, a slow-loris conn,
 # one SIGTERM kill + --restore-latest restart. Fails on agent exit,

@@ -1,3 +1,8 @@
 from gyeeta_tpu.cli import main
 
-main()
+# the __name__ guard matters: the GYT_QUERY_PROCS render pool uses a
+# spawn-context ProcessPoolExecutor, and spawn re-imports the parent's
+# main module in the child (as "__mp_main__") — an unguarded main()
+# would re-run the CLI inside every pool worker
+if __name__ == "__main__":
+    main()

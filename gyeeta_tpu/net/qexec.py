@@ -32,6 +32,21 @@ Knobs (env, read at construction; also settable via ``serve`` flags):
 - ``GYT_QUERY_SNAPSHOT``   — 0 routes the serving edges back to inline
   strong-consistency execution (the pre-snapshot behavior; the
   escape hatch)
+
+GIL relief (ISSUE-12): the worker threads above still serialize on
+the GIL for the pure-Python half of a render, and the REST gateway
+additionally pays ``json.dumps`` of every response body ON its
+serving loop — at dashboard fan-out sizes that encode is the loop's
+single biggest CPU bite. :class:`JsonRenderPool` moves the final
+JSON encode of LARGE responses into a ``ProcessPoolExecutor`` behind
+``GYT_QUERY_PROCS`` (default 0 = off): the loop thread pays a cheap
+C-speed pickle of the row dicts, the child pays the slow encode with
+its own GIL, and the bytes come back ready to write. Small responses
+(below ``GYT_QUERY_PROCS_MIN_ROWS``, default 64 rows) stay inline —
+the pickle round trip would cost more than it frees. The win is
+measured, not assumed: ``_querylat.py``'s render-offload phase
+records loop-thread CPU per response in both modes
+(QUERYLAT_r07.json ``render_offload`` row).
 """
 
 from __future__ import annotations
@@ -53,6 +68,89 @@ def snapshot_serving_enabled(env=None) -> bool:
     env = os.environ if env is None else env
     return str(env.get("GYT_QUERY_SNAPSHOT", "1")).strip().lower() \
         not in ("0", "false", "no")
+
+
+def query_procs(env=None) -> int:
+    env = os.environ if env is None else env
+    try:
+        return max(0, int(env.get("GYT_QUERY_PROCS", "0")))
+    except ValueError:
+        return 0
+
+
+def _encode_json(obj) -> bytes:
+    """Child-process encode (top-level for pickling)."""
+    import json
+    return json.dumps(obj).encode()
+
+
+class JsonRenderPool:
+    """Off-GIL JSON encode tier for the REST gateway edge (see the
+    module docstring). Safe by construction: a broken pool (killed
+    child, fork trouble) falls back to the inline encode and counts
+    it — responses never fail because the relief tier did."""
+
+    def __init__(self, procs: Optional[int] = None,
+                 min_rows: Optional[int] = None, stats=None):
+        env = os.environ
+        self.procs = query_procs() if procs is None else int(procs)
+        self.min_rows = int(min_rows if min_rows is not None
+                            else env.get("GYT_QUERY_PROCS_MIN_ROWS",
+                                         "64"))
+        self.stats = stats
+        self._pool = None
+        if self.procs > 0:
+            # spawn, not fork: the serving process is multi-threaded
+            # (JAX runtime, query workers, WAL writer) and a forked
+            # child can deadlock on locks snapshotted mid-hold
+            import multiprocessing
+            self._pool = concurrent.futures.ProcessPoolExecutor(
+                max_workers=self.procs,
+                mp_context=multiprocessing.get_context("spawn"))
+
+    @property
+    def enabled(self) -> bool:
+        return self._pool is not None
+
+    def _offloadable(self, obj) -> bool:
+        return (self._pool is not None and isinstance(obj, dict)
+                and obj.get("nrecs", 0) >= self.min_rows)
+
+    def _bump(self, name: str) -> None:
+        if self.stats is not None:
+            self.stats.bump(name)
+
+    async def encode(self, obj) -> bytes:
+        import json
+        if not self._offloadable(obj):
+            return json.dumps(obj).encode()
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(self._pool, _encode_json,
+                                             obj)
+            self._bump("query_renders_offloaded")
+            return out
+        except Exception:               # noqa: BLE001 — relief tier
+            self._bump("query_render_offload_errors")
+            return json.dumps(obj).encode()
+
+    def encode_sync(self, obj) -> bytes:
+        """Blocking form (bench harness)."""
+        import json
+        if not self._offloadable(obj):
+            return json.dumps(obj).encode()
+        try:
+            out = self._pool.submit(_encode_json, obj).result()
+            self._bump("query_renders_offloaded")
+            return out
+        except Exception:               # noqa: BLE001
+            self._bump("query_render_offload_errors")
+            return json.dumps(obj).encode()
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
 
 
 class QueryExecutor:

@@ -69,7 +69,8 @@ class GytServer:
                  query_queue_max: Optional[int] = None,
                  query_snapshot: Optional[bool] = None,
                  shard_ingest: bool = False,
-                 shard_queue_mb: float = 8.0):
+                 shard_queue_mb: float = 8.0,
+                 ingest_procs: int = 1):
         self.rt = rt
         self.host = host
         self.port = port
@@ -162,6 +163,40 @@ class GytServer:
                     "exclusive (the shard feeder owns the handoff)")
             from gyeeta_tpu.net.shardfeed import ShardFeeder
             self._feeder = ShardFeeder(rt, queue_max_mb=shard_queue_mb)
+        # ---- multi-process ingest edge (net/ingestproc.py): N worker
+        # processes own wire validation + deframe/decode + WAL append
+        # for their sticky shard groups and publish decoded record
+        # batches into shared-memory rings; this process keeps the ONE
+        # listener + registration and drains the rings into the fold.
+        # ingest_procs <= 1 (the default) spawns nothing — byte-for-
+        # byte today's in-process path.
+        self._ingest = None
+        self._ingest_tasks: list = []
+        if ingest_procs and int(ingest_procs) > 1:
+            if getattr(rt, "n", 1) < int(ingest_procs):
+                raise ValueError(
+                    f"--ingest-procs {ingest_procs} needs --shards >= "
+                    f"{ingest_procs} (one worker owns at least one "
+                    "whole shard group)")
+            from gyeeta_tpu.net.ingestproc import IngestSupervisor, \
+                ProcWalView
+            self._ingest = IngestSupervisor(
+                rt, int(ingest_procs),
+                journal_dir=rt.opts.journal_dir,
+                idle_timeout=self.idle_timeout)
+            if rt.journal is not None:
+                # the WORKERS own the WAL writers from here: release
+                # this process's segment handles (restore/replay used
+                # them already — Daemon builds the server after
+                # recovery) and swap in the cross-process view so
+                # checkpoint/truncate/compactor handoff keep working
+                rt.journal.close()
+                rt.journal = ProcWalView(
+                    self._ingest, rt.opts.journal_dir,
+                    getattr(rt, "n", 1), stats=rt.stats,
+                    subdir_fmt=getattr(
+                        getattr(rt, "layout", None), "WAL_SUBDIR_FMT",
+                        "shard_{:02d}"))
         # stock-partha registration state: machine-id → the ident key
         # issued at PS_REGISTER (the SM_PARTHA_IDENT_NOTIFY flow,
         # gy_comm_proto.h:946 — shyama hands the key to madhava; the
@@ -317,8 +352,13 @@ class GytServer:
         return self.rt.feed(buf, hid=hid, conn_id=conn_id)
 
     def _feed_barrier(self) -> None:
-        """Make every submitted byte visible (pipeline / shard-queue
-        barrier) before a tick or query reads state."""
+        """Make every submitted byte visible (pipeline / shard-queue /
+        ingest-ring barrier) before a tick or query reads state. With
+        ingest workers this drains what the rings HOLD — bytes still
+        inside a worker's deframe loop surface next barrier (the
+        cross-process analogue of a conn's partial frame)."""
+        if self._ingest is not None:
+            self._ingest.drain()
         if self._feeder is not None:
             self._feeder.flush_pending()
         if self._pipe is not None:
@@ -366,10 +406,41 @@ class GytServer:
         self.host, self.port = sock[0], sock[1]
         if self._feeder is not None:
             self._feeder.start()
+        if self._ingest is not None:
+            self._ingest.start(asyncio.get_running_loop())
+            self._ingest_tasks = [
+                asyncio.create_task(self._ingest_drain_loop()),
+                asyncio.create_task(self._ingest_monitor_loop())]
         if self.tick_interval:
             self._tick_task = asyncio.create_task(self._tick_loop())
         log.info("gyt server on %s:%d", self.host, self.port)
         return self.host, self.port
+
+    async def _ingest_drain_loop(self) -> None:
+        """Pull decoded record batches out of the worker rings into
+        the staging slabs. Adaptive cadence: drain again immediately
+        while records flow, back off to the poll interval when idle
+        (an empty drain reads one head word per ring)."""
+        from gyeeta_tpu.net import ingestproc
+        iv = ingestproc.drain_interval_s()
+        while True:
+            try:
+                n = self._ingest.drain()
+            except Exception:                  # pragma: no cover
+                log.exception("ingest ring drain failed")
+                n = 0
+            await asyncio.sleep(0.0 if n else iv)
+
+    async def _ingest_monitor_loop(self) -> None:
+        """Worker liveness + metrics cadence: respawn dead/wedged
+        workers onto their sticky shard groups, publish the
+        gyt_ingest_proc_* counter/gauge rows."""
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                self._ingest.poll()
+            except Exception:                  # pragma: no cover
+                log.exception("ingest worker monitor failed")
 
     async def stop(self) -> None:
         if self._tick_task:
@@ -388,6 +459,18 @@ class GytServer:
         if self._recorder is not None:
             rec, self._recorder = self._recorder, None
             rec.close()      # live conns see None, never a closed file
+        if self._ingest is not None:
+            # graceful worker drain BEFORE the runtime closes: workers
+            # stop their conns, fsync + close their WALs and report
+            # final positions; every ring slot is folded before stop()
+            # returns — the final checkpoint supersedes the whole WAL
+            # window (the SIGTERM drain contract, tested with
+            # --ingest-procs 2 in tests/test_ingestproc.py)
+            for t in self._ingest_tasks:
+                t.cancel()
+            self._ingest_tasks = []
+            self._ingest.stop()
+            self._ingest.close()     # rings unlinked (positions cached)
         if self._feeder is not None:
             await self._feeder.stop()    # drain queued runs, then fold
         if self._pipe is not None:
@@ -401,6 +484,10 @@ class GytServer:
             try:
                 self._feed_barrier()
                 self.rt.run_tick()
+                if self._ingest is not None:
+                    # workers stamp WAL chunks with the window tick
+                    # (replay merge order + compactor window evidence)
+                    self._ingest.broadcast_tick(self.rt._tick_no)
                 self._resolve_pending_domains()
                 await self.push_trace_control()
                 await self.push_throttle()
@@ -735,8 +822,13 @@ class GytServer:
                     # reconnect resync: re-push full capture state
                     self.rt.tracedefs.forget_host(host_id)
                 try:
-                    await self._event_loop(reader, host_id,
-                                           conn_id=conn_id)
+                    if self._ingest is not None \
+                            and host_id != 0xFFFFFFFF:
+                        await self._handoff_event_conn(
+                            reader, writer, host_id, conn_id)
+                    else:
+                        await self._event_loop(reader, host_id,
+                                               conn_id=conn_id)
                 finally:
                     if self._event_writers.get(host_id) is writer:
                         del self._event_writers[host_id]
@@ -762,6 +854,36 @@ class GytServer:
                 await writer.wait_closed()
             except (ConnectionError, OSError):   # pragma: no cover
                 pass
+
+    async def _handoff_event_conn(self, reader, writer, host_id: int,
+                                  conn_id: int) -> None:
+        """Multi-process ingest: hand this registered event conn's
+        socket to its shard group's worker and park until it dies.
+
+        The transport stops reading FIRST; whatever the stream reader
+        already buffered ships to the worker as initial bytes (no
+        awaits between the pause and the snapshot, so no byte can
+        slip past). This process keeps the (paused) transport: the
+        reverse direction — trace control, COMM_THROTTLE — still
+        writes from the supervisor, while the worker owns every read.
+        A worker crash (or its conn_closed notice) sets the death
+        event; unwinding closes the socket and the agent reconnects
+        through this listener — same port, same sticky hid, same
+        shard group after the respawn."""
+        transport = writer.transport
+        transport.pause_reading()
+        initial = bytes(reader._buffer)          # noqa: SLF001
+        reader._buffer.clear()                   # noqa: SLF001
+        sock = writer.get_extra_info("socket")
+        death = asyncio.Event()
+        if sock is None or not self._ingest.handoff(
+                host_id, conn_id, sock.fileno(), initial, death):
+            # owning worker down (respawn window): close — the agent's
+            # supervision loop retries and lands on the fresh worker
+            self.rt.stats.bump("ingest_handoff_failed")
+            return
+        self.rt.stats.bump("ingest_conns_handed_off")
+        await death.wait()
 
     async def _event_loop(self, reader, host_id: int = 0,
                           ref_session=None, conn_id: int = 0) -> None:

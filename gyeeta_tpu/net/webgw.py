@@ -52,6 +52,11 @@ class WebGateway:
         self._server = None
         self._qc: Optional[QueryClient] = None
         self._lock = asyncio.Lock()
+        # GIL-relief JSON encode tier (GYT_QUERY_PROCS, net/qexec.py):
+        # large response bodies encode in a child process so the
+        # gateway loop pays a cheap pickle instead of the full dumps
+        from gyeeta_tpu.net.qexec import JsonRenderPool
+        self._render = JsonRenderPool()
 
     async def start(self) -> tuple:
         self._server = await asyncio.start_server(
@@ -68,6 +73,7 @@ class WebGateway:
         if self._qc is not None:
             await self._qc.close()
             self._qc = None
+        self._render.close()
 
     # -------------------------------------------------------- upstream
     async def _query(self, req: dict) -> dict:
@@ -204,10 +210,10 @@ class WebGateway:
                413: "Payload Too Large", 431: "Headers Too Large",
                502: "Bad Gateway", 503: "Service Unavailable"}
 
-    @classmethod
-    async def _respond(cls, writer, status: int, obj) -> None:
-        await cls._respond_bytes(writer, status, json.dumps(obj).encode(),
-                                 "application/json")
+    async def _respond(self, writer, status: int, obj) -> None:
+        await self._respond_bytes(writer, status,
+                                  await self._render.encode(obj),
+                                  "application/json")
 
     @classmethod
     async def _respond_text(cls, writer, status: int, text: str,

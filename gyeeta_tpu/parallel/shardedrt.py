@@ -352,6 +352,17 @@ class ShardedRuntime:
                                 conn_id=conn_id, tick=self._tick_no)
         if unknown:
             self.stats.bump("records_unknown_subtype", unknown)
+        return self.ingest_records(recs)
+
+    def ingest_records(self, recs: dict, shard=None) -> int:
+        """Fold a drained ``{subtype: record array}`` dict — the
+        post-deframe half of :meth:`feed`. The multi-process ingest
+        supervisor (``net/ingestproc.py``) drains shared-memory ring
+        slots through here with ``shard=`` set: the worker already
+        routed the records by the layout's host hash, so conn/resp
+        arrays go STRAIGHT into that shard's staging bucket (no
+        re-hash, no argsort — the pre-routed fast path the per-shard
+        rings exist for)."""
         n = 0
         self._cols.bump()
         # sweep-seq marks → per-host high-water mark (WAL dedup)
@@ -370,7 +381,11 @@ class ShardedRuntime:
         if conn is not None and len(conn):
             with self._reg_lock:
                 self.natclusters.observe_conns(conn)
-            self._stage_raw(self._conn_raw, self._conn_staged, conn)
+            if shard is None:
+                self._stage_raw(self._conn_raw, self._conn_staged, conn)
+            else:
+                self._conn_raw[shard].append(conn)
+                self._conn_staged[shard] += len(conn)
             self._n_conn_raw += len(conn)
             self.stats.bump("conn_events", len(conn))
             n += len(conn)
@@ -379,7 +394,11 @@ class ShardedRuntime:
             hid = resp["host_id"]
             self._host_resp_tick[hid[hid < self.cfg.n_hosts]] = \
                 self._tick_no
-            self._stage_raw(self._resp_raw, self._resp_staged, resp)
+            if shard is None:
+                self._stage_raw(self._resp_raw, self._resp_staged, resp)
+            else:
+                self._resp_raw[shard].append(resp)
+                self._resp_staged[shard] += len(resp)
             self._n_resp_raw += len(resp)
             self.stats.bump("resp_events", len(resp))
             n += len(resp)

@@ -103,7 +103,9 @@ class Daemon:
                                                   False) else None),
                              shard_ingest=getattr(args, "shards", 0) > 1,
                              shard_queue_mb=getattr(
-                                 args, "shard_queue_mb", 8.0))
+                                 args, "shard_queue_mb", 8.0),
+                             ingest_procs=getattr(
+                                 args, "ingest_procs", 1) or 1)
         self._hot = C.HotReload(args.config, opts) if args.config else None
         # history compaction daemon: sealed WAL segments → columnar
         # snapshot shards (the time-travel tier's writer). Runs only
@@ -385,6 +387,14 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--shard-queue-mb", type=float, default=8.0,
                     help="per-shard ingest queue byte bound before "
                     "counted oldest-first drops (--shards mode)")
+    # multi-process ingest edge (net/ingestproc.py; OPERATIONS.md
+    # "Multi-process deployment"): N worker processes own wire
+    # validation + deframe/decode + per-shard WAL append off the fold
+    # GIL and publish decoded slabs over shared-memory rings
+    ap.add_argument("--ingest-procs", type=int, default=1,
+                    help="ingest worker processes (sticky shard "
+                    "groups; needs --shards >= N; 1 = today's "
+                    "in-process edge, zero behavior change)")
     ap.add_argument("--feed-pipeline", action="store_true",
                     help="deframe/decode on a worker thread (the "
                     "reference's L1/L2 split; useful on multi-core "

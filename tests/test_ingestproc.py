@@ -1,0 +1,281 @@
+"""Multi-process ingest edge (net/ingestproc.py + utils/shmring.py):
+the ISSUE-12 acceptance surface.
+
+- fold parity: the worker-process path (handoff → deframe/decode in a
+  worker → shared-memory ring → pre-routed staging) renders the same
+  fleet view as the in-process edge fed the same stream;
+- graceful SIGTERM with ``--ingest-procs 2``: workers drain + fsync,
+  the final checkpoint supersedes the whole WAL window, and a respawn
+  replays ZERO chunks;
+- worker-crash chaos: SIGKILL one worker mid-feed — the supervisor
+  respawns it onto the SAME shard group, the ring ledger stays exact
+  (published == consumed + counted drops; accepted-but-unpublished
+  chunks survive in the worker-owned WAL), and the reconnecting agent
+  lands on the same sticky hid/shard;
+- the per-shard WAL subdirs written BY WORKERS are byte-compatible
+  with the in-process ShardedJournal layout (replay reads them).
+
+Slow tier: every test compiles mesh programs (see conftest).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.parallel import make_mesh
+from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+from gyeeta_tpu.utils.config import RuntimeOpts
+
+CFG = EngineCfg(n_hosts=16, svc_capacity=256, task_capacity=256,
+                conn_batch=64, resp_batch=64, listener_batch=32,
+                fold_k=2)
+OPTS = RuntimeOpts(dep_pair_capacity=2048, dep_edge_capacity=1024)
+
+
+def _rows_json(out, drop=("evictedbytes",)):
+    recs = [{k: v for k, v in r.items() if k not in drop}
+            for r in out["recs"]]
+    key = lambda r: json.dumps(r, sort_keys=True, default=str)  # noqa
+    return json.dumps(sorted(recs, key=key), sort_keys=True,
+                      default=str)
+
+
+async def _settle(srv, rt, want: int, timeout: float = 60.0) -> None:
+    """Barrier until the fold has seen ``want`` conn+resp events (the
+    worker → ring → staging path is asynchronous by design)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        srv._feed_barrier()
+        rt.flush()
+        c = rt.stats.counters
+        if c.get("conn_events", 0) + c.get("resp_events", 0) >= want:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"fold never saw {want} events "
+        f"(conn={rt.stats.counters.get('conn_events', 0)}, "
+        f"resp={rt.stats.counters.get('resp_events', 0)})")
+
+
+def _mk_server(rt, ingest_procs: int):
+    from gyeeta_tpu.net.server import GytServer
+    return GytServer(rt, tick_interval=None, ingest_procs=ingest_procs)
+
+
+# --------------------------------------------------------------- parity
+@pytest.mark.slow
+def test_mproc_fold_parity_vs_inprocess(tmp_path):
+    """The same two-agent stream through ``ingest_procs=2`` (worker
+    deframe/decode + rings) and through the in-process edge renders
+    equal svcstate/hoststate rows and identical event totals."""
+    from gyeeta_tpu.net.agent import NetAgent
+
+    async def run(ingest_procs: int) -> tuple:
+        rt = ShardedRuntime(CFG, make_mesh(2), OPTS)
+        srv = _mk_server(rt, ingest_procs)
+        host, port = await srv.start()
+        agents = [NetAgent(machine_id=0x6100 + i, seed=7 + i, n_svcs=3)
+                  for i in range(2)]
+        for a in agents:
+            await a.connect(host, port)
+        for _ in range(3):
+            for a in agents:
+                await a.send_sweep(n_conn=64, n_resp=64)
+        await _settle(srv, rt, 2 * 3 * 128)
+        rt.run_tick()
+        svc = _rows_json(rt.query({"subsys": "svcstate",
+                                   "maxrecs": 1000}))
+        hostrows = _rows_json(rt.query({"subsys": "hoststate",
+                                        "maxrecs": 64}))
+        totals = (rt.stats.counters.get("conn_events", 0),
+                  rt.stats.counters.get("resp_events", 0))
+        for a in agents:
+            await a.close()
+        await srv.stop()
+        return svc, hostrows, totals
+
+    svc_m, host_m, tot_m = asyncio.run(run(2))
+    svc_i, host_i, tot_i = asyncio.run(run(1))
+    assert tot_m == tot_i
+    assert svc_m == svc_i
+    assert host_m == host_i
+
+
+# ----------------------------------------------- graceful SIGTERM drain
+@pytest.mark.slow
+def test_graceful_sigterm_drains_rings_zero_replay(tmp_path,
+                                                   monkeypatch):
+    """The PR-5 graceful-shutdown invariant extended across the
+    process boundary: SIGTERM with ``--ingest-procs 2`` drains the
+    worker rings + WALs BEFORE the final checkpoint, so a respawn
+    replays ZERO chunks and reproduces the fold state."""
+    from gyeeta_tpu import server_main as SM
+    from gyeeta_tpu.net.agent import NetAgent
+
+    for k, v in (("SVC_CAPACITY", 256), ("N_HOSTS", 16),
+                 ("TASK_CAPACITY", 256), ("CONN_BATCH", 64),
+                 ("RESP_BATCH", 64), ("LISTENER_BATCH", 32),
+                 ("FOLD_K", 2)):
+        monkeypatch.setenv(f"GYT_{k}", str(v))
+    ckdir = tmp_path / "ck"
+    wal = tmp_path / "wal"
+    args = SM.parse_args([
+        "--host", "127.0.0.1", "--port", "0",
+        "--shards", "2", "--ingest-procs", "2",
+        "--checkpoint-dir", str(ckdir), "--journal-dir", str(wal),
+        "--restore-latest", "--tick-interval", "0",
+        "--stats-interval", "3600", "--log-level", "WARNING"])
+    args.tick_interval = None                      # manual ticks
+
+    async def scenario():
+        d = SM.Daemon(args)
+        host, port = await d.srv.start()
+        agents = [NetAgent(machine_id=0x6200 + i, seed=11 + i,
+                           n_svcs=2, n_groups=3) for i in range(2)]
+        for a in agents:
+            await a.connect(host, port)
+            for _ in range(2):
+                await a.send_sweep(n_conn=32, n_resp=32)
+        # the stream is in flight through workers/rings — do NOT
+        # barrier here: the SIGTERM path itself must drain it
+        await asyncio.sleep(0.3)
+        for a in agents:
+            await a.close()
+        d.handle_signal(15)
+        assert d.stop_event.is_set()
+        await d.shutdown()
+        return d.rt
+
+    rt1 = asyncio.run(scenario())
+    c = rt1.stats.counters
+    assert c.get("conn_events", 0) == 2 * 2 * 32     # all drained
+    assert c.get("resp_events", 0) == 2 * 2 * 32
+    finals = list(ckdir.glob("gyt_final_*.npz"))
+    assert len(finals) == 1
+    # worker-owned WAL wrote the standard shard_NN layout
+    from gyeeta_tpu.utils import journal as J
+    assert len(J.sharded_subdirs(str(wal))) == 2
+
+    # respawn: restore + replay an EMPTY window (clean shutdown)
+    rt2 = ShardedRuntime(CFG, make_mesh(2),
+                         OPTS._replace(journal_dir=str(wal),
+                                       checkpoint_dir=str(ckdir)))
+    assert SM.restore_latest_checkpoint(rt2, str(ckdir)) \
+        == str(finals[0])
+    assert rt2.stats.counters.get("wal_replayed_chunks", 0) == 0
+    assert float(np.asarray(rt2.state.n_conn).sum()) \
+        == float(np.asarray(rt1.state.n_conn).sum())
+    rt2.close()
+
+
+# ------------------------------------------------- worker-crash chaos
+@pytest.mark.slow
+def test_worker_sigkill_respawn_ledger_exact(tmp_path):
+    """SIGKILL one ingest worker mid-feed: the supervisor respawns it
+    onto the SAME shard group, the reconnecting agent keeps its
+    sticky hid (→ same shard), the ring ledger closes exactly
+    (published == consumed + counted drops) and nothing vanishes
+    silently — accepted-but-unpublished chunks are in the worker's
+    WAL."""
+    from gyeeta_tpu.net.agent import NetAgent
+
+    async def scenario():
+        rt = ShardedRuntime(
+            CFG, make_mesh(2),
+            OPTS._replace(journal_dir=str(tmp_path / "wal")))
+        srv = _mk_server(rt, 2)
+        host, port = await srv.start()
+        sup = srv._ingest
+
+        a0 = NetAgent(machine_id=0x6300, seed=21, n_svcs=2)
+        a1 = NetAgent(machine_id=0x6301, seed=22, n_svcs=2)
+        h0 = await a0.connect(host, port)
+        h1 = await a1.connect(host, port)
+        assert (h0 % 2, h1 % 2) == (0, 1)      # different shard groups
+        for a in (a0, a1):
+            await a.send_sweep(n_conn=32, n_resp=32)
+        await _settle(srv, rt, 2 * 64)
+
+        # ---- SIGKILL the worker owning hid 1's shard group
+        w1 = sup.workers[sup.worker_of_hid(h1)]
+        pid1 = w1.proc.pid
+        epoch_before = w1.shm.epoch()
+        os.kill(pid1, signal.SIGKILL)
+        w1.proc.wait(timeout=10)
+        # agent 0's worker is untouched: keep feeding through the kill
+        await a0.send_sweep(n_conn=32, n_resp=32)
+        # supervisor detects + respawns (the monitor task does this at
+        # 1s cadence; drive it directly for determinism)
+        for _ in range(100):
+            if sup.poll():
+                break
+            await asyncio.sleep(0.05)
+        assert w1.proc.pid != pid1              # respawned
+        assert w1.shards == [1]                 # sticky shard group
+        # agent 1's conn died with the worker (supervisor released it)
+        with pytest.raises((ConnectionError, OSError,
+                            asyncio.IncompleteReadError,
+                            asyncio.TimeoutError)):
+            for _ in range(50):
+                await a1.send_sweep(n_conn=8, n_resp=8)
+                await asyncio.sleep(0.1)
+        await a1.close()
+
+        # reconnect: same machine id → same sticky hid → same shard,
+        # handled by the RESPAWNED worker
+        a1b = NetAgent(machine_id=0x6301, seed=23, n_svcs=2)
+        h1b = await a1b.connect(host, port)
+        assert h1b == h1                        # same shard by hash
+        for _ in range(90):
+            if w1.shm.epoch() > epoch_before:
+                break
+            await asyncio.sleep(0.1)
+        assert w1.shm.epoch() > epoch_before    # new epoch attached
+        await a1b.send_sweep(n_conn=32, n_resp=32)
+        # folded total: a0 2 sweeps + a1 1 sweep + a1b 1 sweep; the
+        # mid-outage a1 sends died with the closed conn (never
+        # accepted anywhere — the agent spool tier is what re-sends
+        # in production, exercised by the PR-4 supervision tests)
+        await _settle(srv, rt, 4 * 64)
+
+        # ---- the cross-process ledger closes EXACTLY
+        sup.poll()
+        published = sum(h.shm.counter("published_records")
+                        for h in sup.workers)
+        accepted = sum(h.shm.counter("accepted_records")
+                       for h in sup.workers)
+        srv._feed_barrier()
+        c = rt.stats.counters
+        consumed = c.get("ingest_ring_consumed_records", 0)
+        dropped = sum(v for k, v in c.items()
+                      if k.startswith("ingest_ring_dropped_records"))
+        assert published == consumed + dropped
+        assert accepted >= published            # crash window only
+        assert c.get(f"ingest_proc_respawns|proc={w1.w}", 0) == 1
+
+        rt.run_tick()
+        out = rt.query({"subsys": "hoststate", "maxrecs": 64})
+        hosts = {int(r["hostid"]) for r in out["recs"]}
+        assert {h0, h1} <= hosts                # both survived the kill
+        await a0.close()
+        await a1b.close()
+        await srv.stop()
+
+    asyncio.run(scenario())
+
+
+# -------------------------------------------------------- guard rails
+@pytest.mark.slow
+def test_ingest_procs_needs_enough_shards():
+    rt = ShardedRuntime(CFG, make_mesh(2), OPTS)
+    with pytest.raises(ValueError):
+        _mk_server(rt, 4)
+    rt.close()

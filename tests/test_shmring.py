@@ -1,0 +1,151 @@
+"""Shared-memory staging ring (utils/shmring.py): the ingest-worker →
+fold-process transport of the multi-process control plane.
+
+Covers the concurrency contract pure-functionally (one process, both
+roles on one segment — the cross-process halves are exercised by the
+ingest-worker e2e in test_ingestproc.py): commit-then-head publication,
+drop-oldest with RECORD-exact accounting recovered from the per-shard
+cum chain, producer resume after a simulated worker crash, and the
+section pack/split/unpack round trip over real wire dtypes."""
+
+import numpy as np
+import pytest
+
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.utils import shmring
+
+
+@pytest.fixture
+def seg():
+    import uuid
+    s = shmring.WorkerShm(f"gyt_test_ring_{uuid.uuid4().hex[:8]}",
+                          nshards=2, slots=8, slot_bytes=4096,
+                          create=True)
+    yield s
+    s.close()
+    s.unlink()
+
+
+def _conn_recs(n, hid=0):
+    r = np.zeros(n, wire.TCP_CONN_DT)
+    r["host_id"] = hid
+    r["bytes_sent"] = np.arange(n)
+    return r
+
+
+def test_pack_unpack_roundtrip():
+    recs = {wire.NOTIFY_TCP_CONN: _conn_recs(5),
+            wire.NOTIFY_RESP_SAMPLE: np.zeros(3, wire.RESP_SAMPLE_DT)}
+    buf = shmring.pack_sections(recs)
+    out, n = shmring.unpack_sections(buf, wire.DTYPE_OF_SUBTYPE)
+    assert n == 8
+    assert set(out) == set(recs)
+    np.testing.assert_array_equal(out[wire.NOTIFY_TCP_CONN],
+                                  recs[wire.NOTIFY_TCP_CONN])
+
+
+def test_unpack_skips_unknown_subtype():
+    buf = shmring.pack_sections({wire.NOTIFY_TCP_CONN: _conn_recs(2)})
+    out, n = shmring.unpack_sections(buf, {})
+    assert out == {} and n == 0
+
+
+def test_split_records_respects_slot_budget():
+    recs = {wire.NOTIFY_TCP_CONN: _conn_recs(100)}
+    pieces = list(shmring.split_records(recs, max_payload=4096))
+    assert len(pieces) > 1                     # forced multiple slots
+    total = 0
+    for payload, nrec in pieces:
+        assert len(payload) <= 4096
+        out, n = shmring.unpack_sections(payload,
+                                         wire.DTYPE_OF_SUBTYPE)
+        assert n == nrec
+        total += n
+    assert total == 100
+
+
+def test_publish_drain_roundtrip(seg):
+    recs = {wire.NOTIFY_TCP_CONN: _conn_recs(4, hid=3)}
+    payload = shmring.pack_sections(recs)
+    seg.publish(1, payload, 4)
+    bufs, nrec, ds, dr = seg.drain(1)
+    assert (nrec, ds, dr) == (4, 0, 0)
+    out, n = shmring.unpack_sections(bufs[0], wire.DTYPE_OF_SUBTYPE)
+    assert n == 4
+    assert int(out[wire.NOTIFY_TCP_CONN]["host_id"][0]) == 3
+    # the other ring saw nothing
+    assert seg.drain(0) == ([], 0, 0, 0)
+    assert seg.counter("published_records") == 4
+
+
+def test_drop_oldest_counted_in_records(seg):
+    # 8-slot ring: publish 13 slots of 2 records without draining —
+    # the first 5 slots are lapped; the drain must recover EXACTLY 10
+    # dropped records from the cum chain (counted, never silent)
+    for i in range(13):
+        seg.publish(0, shmring.pack_sections(
+            {wire.NOTIFY_TCP_CONN: _conn_recs(2, hid=i)}), 2)
+    bufs, nrec, ds, dr = seg.drain(0)
+    assert ds == 5 and dr == 10
+    assert nrec == 16 and len(bufs) == 8
+    # ledger closes: published == consumed + dropped
+    assert seg.counter("published_records") == nrec + dr
+    # and the surviving slots are the NEWEST ones, in order
+    hids = []
+    for b in bufs:
+        out, _ = shmring.unpack_sections(b, wire.DTYPE_OF_SUBTYPE)
+        hids.append(int(out[wire.NOTIFY_TCP_CONN]["host_id"][0]))
+    assert hids == list(range(5, 13))
+
+
+def test_drop_accounting_isolated_per_shard(seg):
+    # records parked (unread) in ring 1 must NOT be counted as drops
+    # when ring 0 laps — the regression the per-shard cum chain exists
+    # to prevent
+    seg.publish(1, shmring.pack_sections(
+        {wire.NOTIFY_TCP_CONN: _conn_recs(7)}), 7)
+    for i in range(10):
+        seg.publish(0, shmring.pack_sections(
+            {wire.NOTIFY_TCP_CONN: _conn_recs(1, hid=i)}), 1)
+    _bufs, nrec, ds, dr = seg.drain(0)
+    assert (nrec, ds, dr) == (8, 2, 2)
+    _bufs, nrec, ds, dr = seg.drain(1)
+    assert (nrec, ds, dr) == (7, 0, 0)
+
+
+def test_producer_resume_after_crash(seg):
+    # "crash": throw the producer-side object away mid-stream and
+    # re-attach by name (what a respawned worker does). The seq/cum
+    # chain continues — the consumer sees one continuous ring.
+    for i in range(3):
+        seg.publish(0, shmring.pack_sections(
+            {wire.NOTIFY_TCP_CONN: _conn_recs(2, hid=i)}), 2)
+    w2 = shmring.WorkerShm(seg.name)           # respawned worker
+    try:
+        assert w2.heads()[0] == 3
+        w2.publish(0, shmring.pack_sections(
+            {wire.NOTIFY_TCP_CONN: _conn_recs(2, hid=9)}), 2)
+        bufs, nrec, ds, dr = seg.drain(0)
+        assert (nrec, ds, dr) == (8, 0, 0) and len(bufs) == 4
+        assert seg._read_head(0) == 4
+        # cum chain continued exactly (no phantom drops on next lap)
+        assert w2.counter("published_records") >= 8
+    finally:
+        w2.close()
+
+
+def test_heartbeat_and_counters(seg):
+    assert seg.hb_age_s() == float("inf")      # never beaten
+    seg.heartbeat()
+    assert seg.hb_age_s() < 5.0
+    assert seg.counter("hb_seq") == 1
+    seg.add_counter("accepted_records", 41)
+    seg.add_counter("accepted_records", 1)
+    assert seg.counters()["accepted_records"] == 42
+    assert seg.epoch() == 0
+    assert seg.bump_epoch() == 1
+
+
+def test_oversize_payload_rejected(seg):
+    with pytest.raises(ValueError):
+        seg.publish(0, b"x" * (seg.slot_payload + 1), 1)
