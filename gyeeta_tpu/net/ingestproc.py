@@ -183,6 +183,7 @@ class IngestWorker:
         msg, blob = _unpack_msg(data)
         cmd = msg.get("cmd")
         if cmd == "conn" and fds:
+            from gyeeta_tpu.ingest import wire
             sock = socket.socket(fileno=fds[0])
             sock.setblocking(False)
             hid = int(msg["hid"])
@@ -192,7 +193,14 @@ class IngestWorker:
             self.sel.register(sock, selectors.EVENT_READ, c)
             self.shm.add_counter("conns_open")
             if blob:
-                self._on_bytes(c, blob)
+                try:
+                    self._on_bytes(c, blob)
+                except wire.FrameError:
+                    # poison bytes buffered before the handoff: same
+                    # containment as _on_readable — only this conn dies,
+                    # never the whole shard group's worker
+                    self.shm.add_counter("frames_bad")
+                    self._close_conn(c, "frame_error")
         elif cmd == "wal":
             # a supervisor-handled conn's validated chunk (stock-partha
             # adapter path): journal it here — this worker owns the
@@ -228,18 +236,34 @@ class IngestWorker:
             self._stop_req = msg
         return True
 
+    def _ctrl_send(self, data: bytes, timeout: float = 5.0) -> bool:
+        """Send one ctrl packet, waiting (bounded) for the SEQPACKET
+        buffer to drain on EAGAIN. SEQPACKET sends are atomic, so a
+        BlockingIOError means NOTHING was sent and a straight retry is
+        safe. Dropping instead would be far worse than a short stall:
+        a lost conn_closed parks the supervisor's handoff task on its
+        death event forever, and a lost quiesced/stopped reply stalls
+        the checkpoint barrier for its full timeout."""
+        import select
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.ctrl.sendall(data)
+                return True
+            except BlockingIOError:
+                remain = deadline - time.monotonic()
+                if remain <= 0:             # pragma: no cover
+                    return False
+                select.select([], [self.ctrl], [], min(remain, 0.1))
+            except OSError:                 # pragma: no cover
+                return False
+
     def _reply(self, req: dict, ev: str, **kw) -> None:
-        out = {"ev": ev, "req": req.get("req"), **kw}
-        try:
-            self.ctrl.sendall(_pack_msg(out))
-        except OSError:                     # pragma: no cover
-            pass
+        self._ctrl_send(_pack_msg({"ev": ev, "req": req.get("req"),
+                                   **kw}))
 
     def _notify(self, ev: str, **kw) -> None:
-        try:
-            self.ctrl.sendall(_pack_msg({"ev": ev, **kw}))
-        except OSError:                     # pragma: no cover
-            pass
+        self._ctrl_send(_pack_msg({"ev": ev, **kw}))
 
     # ------------------------------------------------------------ conns
     def _close_conn(self, c: _Conn, reason: str) -> None:
@@ -557,6 +581,14 @@ class IngestSupervisor:
             self._spawn(h)
 
     def _spawn(self, h: _WorkerHandle) -> None:
+        # zero the heartbeat words BEFORE the child exists: they
+        # persist in the shared segment across respawns, and poll()'s
+        # wedged check must not judge the new worker by the dead
+        # epoch's last stamp (slow interpreter/numpy startup past
+        # GYT_INGEST_HB_STALE_S would otherwise respawn-loop forever).
+        # hb_seq == 0 disarms the check until the new loop's first beat.
+        h.shm.set_counter("hb_seq", 0)
+        h.shm.set_counter("hb_time_us", 0)
         sup_sock, child_sock = socket.socketpair(
             socket.AF_UNIX, socket.SOCK_SEQPACKET)
         for s in (sup_sock, child_sock):

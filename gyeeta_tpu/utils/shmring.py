@@ -166,8 +166,16 @@ def split_records(recs: dict, max_payload: int) -> Iterator[tuple]:
             budget = max_payload - cur_bytes - _SEC.size
             take = min(len(arr) - i, max(0, budget // itemsize))
             if take <= 0:
-                if cur_n:
-                    yield pack_sections(cur), cur_n
+                if not cur_n:
+                    # even an empty batch can't fit one record: a wide
+                    # dtype vs a tiny slot. Fail loud — continuing here
+                    # would spin forever and wedge the ingest worker.
+                    raise ValueError(
+                        f"record itemsize {itemsize}B (subtype "
+                        f"{subtype}) exceeds slot payload budget "
+                        f"{max_payload - _SEC.size}B; raise "
+                        "GYT_SHM_RING_SLOT_KB")
+                yield pack_sections(cur), cur_n
                 cur, cur_bytes, cur_n = {}, 0, 0
                 continue
             piece = arr[i:i + take]
@@ -359,20 +367,21 @@ class WorkerShm:
             tail = new_tail
         out = []
         nrec_total = 0
-        first = True
         while tail < head and (not max_slots or len(out) < max_slots):
             off = self._slot_off(shard, tail % self.slots)
             seq, nbytes, nrec, cum = _SH.unpack_from(self.buf, off)
             if seq != tail:
                 # overwritten between the head read and ours (another
-                # lap) — resync forward and account the gap
+                # lap) — resync forward; the skipped RECORDS are
+                # recovered by the cum-chain gap check at the next
+                # valid slot read (possibly in a LATER drain call: the
+                # stale head may end this one before another read)
                 head2 = self._read_head(shard)
                 new_tail = max(tail, head2 - self.slots)
                 if new_tail == tail:        # torn/unexpected: bail out
                     break
                 dropped_slots += new_tail - tail
                 tail = new_tail
-                first = True
                 continue
             payload = bytes(self.buf[off + SLOT_HEADER_BYTES:
                                      off + SLOT_HEADER_BYTES + nbytes])
@@ -380,15 +389,20 @@ class WorkerShm:
             seq2 = _SH.unpack_from(self.buf, off)[0]
             if seq2 != tail:
                 continue                    # retry resyncs via seq path
-            if first and dropped_slots:
-                # recover the dropped RECORD count from the per-shard
-                # chain: cum(after this slot) - nrec(this slot) is the
-                # producer's ring total BEFORE it — minus what this
-                # consumer has accounted (consumed + prior drops).
-                dropped_records = max(
-                    0, (cum - nrec) - self._consumed_base[shard]
-                    - self._consumed_recs[shard])
-            first = False
+            # cum-chain gap check, on EVERY slot: cum(after) - nrec is
+            # the producer's ring total BEFORE this slot; anything this
+            # consumer has not yet accounted — prior calls
+            # (consumed_recs folds prior drops in), this call's
+            # consumption, this call's earlier gaps — was overwritten
+            # unread. Accumulated (+=), since the producer can lap us
+            # more than once per drain; zero in steady state, and
+            # negative (a cum reset after a failed producer resume)
+            # never counts.
+            gap = ((cum - nrec) - self._consumed_base[shard]
+                   - self._consumed_recs[shard] - nrec_total
+                   - dropped_records)
+            if gap > 0:
+                dropped_records += gap
             out.append(payload)
             nrec_total += nrec
             tail += 1

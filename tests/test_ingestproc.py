@@ -273,6 +273,53 @@ def test_worker_sigkill_respawn_ledger_exact(tmp_path):
 
 
 # -------------------------------------------------------- guard rails
+def test_handoff_blob_frame_error_contained():
+    """Poison bytes buffered BEFORE the handoff (the initial blob in
+    the 'conn' ctrl packet) must close only that conn — the FrameError
+    used to escape _ctrl_recv and crash the whole worker, turning one
+    garbage-sending agent into a respawn crash loop for its entire
+    shard group."""
+    import socket as socklib
+    import uuid
+
+    from gyeeta_tpu.net import ingestproc
+    from gyeeta_tpu.utils import shmring
+
+    name = f"gyt_test_ing_{uuid.uuid4().hex[:8]}"
+    seg = shmring.WorkerShm(name, nshards=1, slots=8, slot_bytes=4096,
+                            create=True)
+    sup, child = socklib.socketpair(socklib.AF_UNIX,
+                                    socklib.SOCK_SEQPACKET)
+    w = conn_a = conn_b = None
+    try:
+        cfg = {"worker": 0, "nshards": 1, "shards": [0], "shm": name,
+               "journal_dir": None, "idle_timeout": 0}
+        w = ingestproc.IngestWorker(cfg, child.detach())
+        conn_a, conn_b = socklib.socketpair()
+        socklib.send_fds(
+            sup, [ingestproc._pack_msg({"cmd": "conn", "hid": 1,
+                                        "conn_id": 7},
+                                       b"\x00" * 64)],   # bad magic
+            [conn_a.fileno()])
+        assert w._ctrl_recv() is True          # loop survives
+        assert w.running
+        assert not w.conns                     # only the conn died
+        assert w.shm.counter("frames_bad") == 1
+        msg, _blob = ingestproc._unpack_msg(sup.recv(1 << 16))
+        assert msg == {"ev": "conn_closed", "hid": 1, "conn_id": 7,
+                       "reason": "frame_error"}
+    finally:
+        for s in (conn_a, conn_b, sup):
+            if s is not None:
+                s.close()
+        if w is not None:
+            w.sel.close()
+            w.ctrl.close()
+            w.shm.close()
+        seg.close()
+        seg.unlink()
+
+
 @pytest.mark.slow
 def test_ingest_procs_needs_enough_shards():
     rt = ShardedRuntime(CFG, make_mesh(2), OPTS)

@@ -64,6 +64,14 @@ def test_split_records_respects_slot_budget():
     assert total == 100
 
 
+def test_split_records_oversized_record_raises():
+    # a record wider than the slot payload must fail LOUD: silently
+    # `continue`-ing here used to spin forever and wedge the worker
+    wide = np.zeros(2, dtype=[("blob", "V512")])
+    with pytest.raises(ValueError, match="itemsize"):
+        list(shmring.split_records({9999: wide}, max_payload=256))
+
+
 def test_publish_drain_roundtrip(seg):
     recs = {wire.NOTIFY_TCP_CONN: _conn_recs(4, hid=3)}
     payload = shmring.pack_sections(recs)
@@ -98,7 +106,40 @@ def test_drop_oldest_counted_in_records(seg):
     assert hids == list(range(5, 13))
 
 
-def test_drop_accounting_isolated_per_shard(seg):
+def test_mid_drain_second_lap_accumulates_drops(seg):
+    # the producer can lap the consumer AGAIN while one drain call is
+    # mid-loop (seq-mismatch resync). The first gap's count used to be
+    # overwritten (assignment, not accumulation) and the second gap's
+    # records — skipped past the call's stale head — were never
+    # counted at all, breaking the "published == consumed + dropped,
+    # exactly" ledger. Now the gap accumulates and anything left
+    # behind the stale head is recovered by the NEXT drain's cum-chain
+    # check.
+    for i in range(13):                        # lap #1 before draining
+        seg.publish(0, shmring.pack_sections(
+            {wire.NOTIFY_TCP_CONN: _conn_recs(2, hid=i)}), 2)
+    orig = seg._slot_off
+    calls = {"n": 0}
+
+    def hook(shard, idx):
+        calls["n"] += 1
+        if calls["n"] == 3:                    # after 2 consumed slots
+            for j in range(13, 21):            # lap #2, mid-drain
+                seg.publish(0, shmring.pack_sections(
+                    {wire.NOTIFY_TCP_CONN: _conn_recs(2, hid=j)}), 2)
+        return orig(shard, idx)
+
+    seg._slot_off = hook
+    try:
+        _bufs, nrec1, ds1, dr1 = seg.drain(0)
+    finally:
+        seg._slot_off = orig
+    assert ds1 > 0 and dr1 > 0
+    _bufs, nrec2, ds2, dr2 = seg.drain(0)      # picks up lap #2's ring
+    assert seg.counter("published_records") == 21 * 2
+    # ledger closes exactly across the two calls
+    assert nrec1 + dr1 + nrec2 + dr2 == 21 * 2
+    assert seg.backlog(0) == 0
     # records parked (unread) in ring 1 must NOT be counted as drops
     # when ring 0 laps — the regression the per-shard cum chain exists
     # to prevent
