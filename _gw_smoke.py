@@ -1,0 +1,213 @@
+"""CI smoke: the query-fabric gateway tier (ISSUE 13).
+
+Boots TWO serve replicas (fed identically, manual ticks) + ONE fabric
+gateway fanning over both, then asserts the tier's contract at smoke
+scale:
+
+- a query rendered once upstream serves every later client from the
+  gateway's (snaptick, request-hash) edge cache — the REPLICAS' result
+  -cache miss counters prove the single render (one miss total across
+  both replicas for N client requests);
+- an SSE subscriber on ``/v1/subscribe`` receives a pushed event after
+  a fed tick that REASSEMBLES BYTE-EQUAL to a fresh full query of the
+  same shape at the same snaptick (query/delta.py apply contract);
+- ``GET /metrics`` on the gateway exposes the ``gyt_gw_*`` families.
+
+Run by ci.sh; standalone: ``JAX_PLATFORMS=cpu python _gw_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+
+async def _http_get(h, p, path: str) -> tuple[int, bytes]:
+    reader, writer = await asyncio.open_connection(h, p)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: s\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), body
+
+
+async def _until(cond, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"gw smoke: timed out waiting for {msg}")
+
+
+async def scenario() -> None:
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.subs import read_sse_events
+    from gyeeta_tpu.query import delta as D
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                    conn_batch=256, resp_batch=512, listener_batch=64,
+                    fold_k=2)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=9)
+
+    def feed(rt):
+        rt.feed(sim.conn_frames(256) + sim.resp_frames(512)
+                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                    sim.host_state_records()))
+
+    # two replicas, fed IDENTICALLY (interchangeable upstreams — the
+    # production shape is replicas folding the same agent fleet)
+    replicas, servers = [], []
+    for _ in range(2):
+        rt = Runtime(cfg)
+        rt.feed(sim.name_frames())
+        rt.feed(sim.listener_frames())
+        feed(rt)
+        rt.run_tick()
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        await srv.start()
+        replicas.append(rt)
+        servers.append(srv)
+
+    gw = FabricGateway([(s.host, s.port) for s in servers],
+                       poll_s=0.05)
+    gh, gp = await gw.start()
+    snap_tick = replicas[0].snapshot.tick
+    await _until(lambda: gw.fabric_tick >= snap_tick,
+                 msg="tick discovery")
+
+    # ---- shared cache: N client requests, ONE upstream render
+    def misses():
+        return sum(r.stats.counters.get("query_cache_misses", 0)
+                   for r in replicas)
+
+    path = "/v1/svcstate?sortcol=qps5s&sortdesc=true&maxrecs=50"
+    m0 = misses()
+    status, body = await _http_get(gh, gp, path)
+    assert status == 200, body[:200]
+    first = json.loads(body)
+    assert first.get("nrecs", 0) > 0, "empty svcstate rows"
+    assert "snaptick" in first, "response lost its snaptick"
+    for _ in range(6):          # replica B's clients, replica A's render
+        status, body = await _http_get(gh, gp, path)
+        assert status == 200
+        assert json.loads(body) == first, "cache served a different view"
+    assert misses() == m0 + 1, (
+        f"expected ONE upstream render, got {misses() - m0} "
+        "(the shared edge cache is not collapsing)")
+    assert gw.stats.counters.get("gw_cache_hits|tier=local", 0) >= 6
+    print(f"gw smoke: shared cache OK (1 render, 6 client hits, "
+          f"snaptick {first['snaptick']})")
+
+    # ---- SSE subscription: delta after a fed tick, byte-equal
+    reader, writer = await asyncio.open_connection(gh, gp)
+    writer.write(b"GET /v1/subscribe?subsys=svcstate&sortcol=qps5s&"
+                 b"sortdesc=true&maxrecs=50 HTTP/1.1\r\n"
+                 b"Host: s\r\n\r\n")
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    assert b"200" in head.split(b"\r\n", 1)[0], head
+    events: list = []
+
+    async def sse_loop():
+        async for ev in read_sse_events(reader):
+            events.append(ev)
+
+    task = asyncio.create_task(sse_loop())
+    await _until(lambda: events, msg="initial full event")
+    assert events[0]["t"] == "full"
+    held = D.apply_event(None, events[0])
+
+    n0 = len(events)
+    for rt in replicas:          # a fed tick on both replicas
+        feed(rt)
+        rt.run_tick()
+    await _until(lambda: len(events) > n0, msg="pushed delta")
+    held = D.apply_event(held, events[-1])
+    status, body = await _http_get(gh, gp, path)
+    assert status == 200
+    fresh = json.loads(body)
+    assert fresh["snaptick"] == held["snaptick"], (
+        "tick raced the verification query")
+    assert json.dumps(held) == json.dumps(fresh), (
+        "delta reassembly is NOT byte-equal to the full render")
+    kinds = {e["t"] for e in events[n0:]}
+    print(f"gw smoke: subscription OK (events {kinds}, reassembled "
+          f"byte-equal at snaptick {held['snaptick']})")
+
+    # ---- a genuinely incremental stream: hostlist rows are stable
+    # across fed ticks (same hosts, same ages), so the push MUST be a
+    # delta event (the full-resync escape would mean the diff tier is
+    # not pulling its weight), and it must still apply byte-equal
+    r2, w2 = await asyncio.open_connection(gh, gp)
+    w2.write(b"GET /v1/subscribe?subsys=hostlist&maxrecs=64 "
+             b"HTTP/1.1\r\nHost: s\r\n\r\n")
+    await w2.drain()
+    await r2.readuntil(b"\r\n\r\n")
+    hl_events: list = []
+
+    async def hl_loop():
+        async for ev in read_sse_events(r2):
+            hl_events.append(ev)
+
+    hl_task = asyncio.create_task(hl_loop())
+    await _until(lambda: hl_events, msg="hostlist initial full")
+    hl_held = D.apply_event(None, hl_events[0])
+    n1 = len(hl_events)
+    for rt in replicas:
+        feed(rt)
+        rt.run_tick()
+    await _until(lambda: len(hl_events) > n1, msg="hostlist delta")
+    assert hl_events[-1]["t"] == "delta", (
+        f"stable-row subscription pushed {hl_events[-1]['t']!r}, "
+        "expected a delta")
+    hl_held = D.apply_event(hl_held, hl_events[-1])
+    status, body = await _http_get(gh, gp, "/v1/hostlist?maxrecs=64")
+    assert status == 200
+    hl_fresh = json.loads(body)
+    assert hl_fresh["snaptick"] == hl_held["snaptick"]
+    assert json.dumps(hl_held) == json.dumps(hl_fresh)
+    db = gw.stats.counters.get("gw_delta_bytes", 0)
+    fb = gw.stats.counters.get("gw_full_bytes", 0)
+    print(f"gw smoke: hostlist delta OK (delta-vs-full byte ratio "
+          f"{db / max(fb, 1):.3f} cumulative)")
+    hl_task.cancel()
+    w2.close()
+
+    # ---- gateway /metrics exposes the gyt_gw_* families
+    status, body = await _http_get(gh, gp, "/metrics")
+    assert status == 200
+    text = body.decode()
+    for fam in ("gyt_gw_cache_hits_total", "gyt_gw_cache_misses_total",
+                "gyt_gw_renders_upstream_total", "gyt_gw_subscribers",
+                "gyt_gw_sub_events_total", "gyt_gw_fabric_tick"):
+        assert fam in text, f"{fam} missing from gateway /metrics"
+    print("gw smoke: gyt_gw_* metric families exposed OK")
+
+    task.cancel()
+    writer.close()
+    await gw.stop()
+    for srv in servers:
+        await srv.stop()
+
+
+def main() -> None:
+    asyncio.run(scenario())
+    print("gw smoke: OK")
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except AssertionError as e:
+        print(f"gw smoke: FAIL — {e}", file=sys.stderr)
+        sys.exit(1)

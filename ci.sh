@@ -86,6 +86,19 @@ if ! JAX_PLATFORMS=cpu python _preagg_smoke.py; then
     exit 1
 fi
 
+# Query-fabric gateway smoke: 2 serve replicas + 1 gateway — a query
+# rendered once upstream serves every later client from the shared
+# (snaptick, request-hash) edge cache (replica render counters prove
+# the single render), an SSE subscriber receives a pushed event after
+# a fed tick that reassembles byte-equal to a fresh full query (and a
+# stable-row subscription pushes a REAL delta), and the gateway's
+# /metrics exposes the gyt_gw_* families.
+echo "ci: query-fabric gateway smoke" >&2
+if ! JAX_PLATFORMS=cpu python _gw_smoke.py; then
+    echo "ci: FATAL — gateway smoke failed" >&2
+    exit 1
+fi
+
 # Multichip smoke: a REAL `serve --shards 8` subprocess on the
 # simulated 8-device mesh — per-shard ingest + WAL subdirs + collective
 # roll-up; 2 agents on different shards; asserts the MERGED

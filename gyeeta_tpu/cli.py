@@ -408,14 +408,53 @@ def _cmd_web(argv) -> None:
     asyncio.run(run())
 
 
+def _cmd_gateway(argv) -> None:
+    ap = argparse.ArgumentParser(prog="gyeeta_tpu gateway")
+    ap.add_argument("--upstream", action="append", required=True,
+                    metavar="HOST:PORT",
+                    help="serve replica to fan out to (repeatable; "
+                    ">=2 makes the cache worth the hop)")
+    ap.add_argument("--peer", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="another gateway instance to exchange cached "
+                    "results with (repeatable)")
+    # loopback by default, same reasoning as the web gateway: the
+    # fabric edge is UNAUTHENTICATED query + subscribe
+    ap.add_argument("--listen-host", default="127.0.0.1")
+    ap.add_argument("--listen-port", type=int, default=10090)
+    ap.add_argument("--poll-s", type=float, default=None,
+                    help="snaptick watch cadence per upstream "
+                    "(default GYT_GW_POLL_S or 0.5)")
+    args = ap.parse_args(argv)
+
+    def hp(s):
+        h, _, p = s.rpartition(":")
+        return (h or "127.0.0.1", int(p))
+
+    async def run():
+        from gyeeta_tpu.net.gateway import FabricGateway
+        gw = FabricGateway([hp(u) for u in args.upstream],
+                           host=args.listen_host,
+                           port=args.listen_port,
+                           peers=[hp(p) for p in args.peer],
+                           poll_s=args.poll_s)
+        h, p = await gw.start()
+        print(f"fabric gateway on {h}:{p} (REST + GYT + NM) -> "
+              f"{len(gw.upstreams)} upstream(s), "
+              f"{len(gw.peers)} peer(s)", file=sys.stderr)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] in ("query", "agent", "replay", "web", "obs",
-                            "nm", "chaos", "compact"):
+                            "nm", "chaos", "compact", "gateway"):
         return {"query": _cmd_query, "agent": _cmd_agent,
                 "replay": _cmd_replay, "web": _cmd_web,
                 "obs": _cmd_obs, "nm": _cmd_nm,
-                "chaos": _cmd_chaos,
+                "chaos": _cmd_chaos, "gateway": _cmd_gateway,
                 "compact": _cmd_compact}[argv[0]](argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]

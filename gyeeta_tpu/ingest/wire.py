@@ -44,6 +44,19 @@ COMM_TRACE_SET = 6        # server→agent capture control (ref
 #                           REQ_TRACE_SET, gy_comm_proto.h:3295; rides
 #                           the event conn in reverse — the analogue of
 #                           the reference's CLI_TYPE_RESP_REQ direction)
+COMM_SUBSCRIBE_CMD = 8    # client→server streaming subscription: the
+#                           payload is a standard QUERY_HDR + JSON
+#                           envelope; the server answers an open-ended
+#                           stream of QUERY_RESP frames (status
+#                           QS_PARTIAL, seqid echoed) where EACH frame
+#                           body is one complete subscription event
+#                           (query/delta.py: full | delta | ack) —
+#                           pushed when snaptick advances, not polled.
+#                           The conn closing (either end) ends the
+#                           subscription; a QS_ERROR frame reports a
+#                           rejected registration. Pre-v6 servers
+#                           answer unknown data_type like any other
+#                           junk query frame (counted, conn survives).
 COMM_THROTTLE = 7         # server→agent admission control: hold feeds
 #                           in the agent spool for N ms (backpressure —
 #                           server pressure becomes agent-side spooling

@@ -12,8 +12,13 @@ from gyeeta_tpu.net.agent import NetAgent, QueryClient  # noqa: F401
 
 def __getattr__(name):
     # GytServer pulls in the Runtime (and with it jax); thin clients
-    # importing this package must stay jax-free, so load it lazily
+    # importing this package must stay jax-free, so load it lazily.
+    # The fabric gateway (jax-free by design) loads lazily too — most
+    # importers of this package never run one.
     if name == "GytServer":
         from gyeeta_tpu.net.server import GytServer
         return GytServer
+    if name == "FabricGateway":
+        from gyeeta_tpu.net.gateway import FabricGateway
+        return FabricGateway
     raise AttributeError(name)

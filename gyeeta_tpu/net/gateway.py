@@ -1,0 +1,754 @@
+"""Query-fabric gateway: fan-in proxy + distributed edge cache + push.
+
+The serving edge after PR 9/10 is snapshot-isolated and cached PER
+PROCESS — but dashboards still poll ONE replica, and every replica
+renders the same snapshot independently. This tier is the missing
+multiplier (ROADMAP open item 3): a thin asyncio proxy that speaks the
+EXISTING query edges on the front and fans out to N serve replicas on
+the back, with the render shared fleet-wide:
+
+- **One port, three dialects** (magic-peeked like ``GytServer``):
+  HTTP/REST (``POST /query``, ``GET /v1/<subsys>``, SSE
+  ``GET /v1/subscribe``, ``/metrics``, ``/healthz``), the GYT binary
+  query protocol (``COMM_QUERY_CMD`` + the ``COMM_SUBSCRIBE_CMD``
+  stream), and the stock NM node-webserver dialect
+  (``net/nmhandle.py`` — a stock Node tier can point at a gateway
+  unchanged).
+
+- **(snaptick, request-hash) edge cache**: every snapshot-tier
+  response already carries ``snaptick`` — the designed distributed
+  cache key. Requests key through the SAME normalizer as the
+  replica-side result cache (``query/normalize.py``), entries live in
+  an in-gateway LRU, and invalidation is BY TICK ADVANCE (a new tick
+  is a new key; old entries age out of the LRU) — no invalidation
+  protocol at all. SINGLE-FLIGHT collapse at the (tick, key) level
+  means a dashboard stampede onto a fresh tick renders each distinct
+  query exactly once per gateway; the peer exchange (below) makes
+  that once per FLEET. Upstream error envelopes negative-cache for
+  ``GYT_GW_NEG_TTL_S`` so a bad query in a dashboard loop cannot
+  hammer the replicas.
+
+- **Peer exchange**: gateways gossip results, not liveness — on a
+  local miss the gateway asks its peers for (tick, key) over a tiny
+  HTTP POST (``/gw/peer``) before rendering upstream; the peer answers
+  from its cache, WAITING on its own in-flight single-flight render if
+  one is running. A result rendered once serves the whole tier.
+
+- **Push subscriptions** (``net/subs.py``): the gateway polls each
+  upstream's ``serverstatus`` once per tick (ONE cheap cached query
+  per upstream per tick — not per client), and when ``snaptick``
+  advances it re-renders each subscribed query once THROUGH the edge
+  cache, diffs against the last delivered version
+  (``query/delta.py``), and pushes the delta to every subscriber —
+  REST SSE and GYT binary both.
+
+The gateway is deliberately **jax-free** (it imports the thin-client
+half of the tree only): it can run on any box between the dashboards
+and the replicas, and N gateways scale the query edge without touching
+the fold tier. Metrics are first-class: its own ``Stats`` registry
+renders at ``GET /metrics`` as the ``gyt_gw_*`` families
+(OPERATIONS.md "Query fabric").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+import urllib.parse
+from collections import OrderedDict
+from typing import Optional
+
+from gyeeta_tpu.net.agent import QueryClient
+from gyeeta_tpu.query.normalize import request_key
+from gyeeta_tpu.utils.selfstats import Stats
+
+log = logging.getLogger("gyeeta_tpu.net.gateway")
+
+_MAX_BODY = 8 << 20
+_MAX_HDR = 64 << 10
+
+# the tick-watch poll request: answered from the replica's snapshot
+# result cache after the first ask per tick (~a dict lookup upstream)
+_POLL_REQ = {"subsys": "serverstatus", "maxrecs": 1}
+
+
+def _envf(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _envi(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class _Upstream:
+    """One serve replica: a small checkout pool of query conns plus
+    the watcher's last-seen snaptick."""
+
+    def __init__(self, host: str, port: int, nconns: int):
+        self.host, self.port = host, int(port)
+        self.tick = -1
+        self.tick_at = 0.0
+        self.up = False
+        self._pool: asyncio.Queue = asyncio.Queue()
+        for _ in range(max(1, nconns)):
+            self._pool.put_nowait(None)
+
+    async def checkout(self, timeout: float) -> QueryClient:
+        qc = await self._pool.get()
+        if qc is None:
+            qc = QueryClient(request_timeout=timeout)
+            try:
+                await qc.connect(self.host, self.port)
+            except BaseException:
+                self._pool.put_nowait(None)
+                raise
+        return qc
+
+    def checkin(self, qc: Optional[QueryClient]) -> None:
+        self._pool.put_nowait(qc)
+
+    async def discard(self, qc: QueryClient) -> None:
+        self._pool.put_nowait(None)
+        try:
+            await qc.close()
+        except Exception:       # noqa: BLE001
+            pass
+
+
+class FabricGateway:
+    def __init__(self, upstreams, host: str = "127.0.0.1",
+                 port: int = 0, peers=(), stats: Optional[Stats] = None,
+                 poll_s: Optional[float] = None,
+                 cache_max: Optional[int] = None,
+                 neg_ttl_s: Optional[float] = None,
+                 peer_timeout_s: Optional[float] = None,
+                 upstream_conns: Optional[int] = None,
+                 upstream_timeout_s: float = 30.0,
+                 write_timeout: float = 10.0):
+        self.host, self.port = host, int(port)
+        self.stats = stats if stats is not None else Stats()
+        self.poll_s = _envf("GYT_GW_POLL_S", 0.5) \
+            if poll_s is None else float(poll_s)
+        self.cache_max = _envi("GYT_GW_CACHE_MAX", 4096) \
+            if cache_max is None else int(cache_max)
+        self.neg_ttl_s = _envf("GYT_GW_NEG_TTL_S", 2.0) \
+            if neg_ttl_s is None else float(neg_ttl_s)
+        self.peer_timeout_s = _envf("GYT_GW_PEER_TIMEOUT_S", 0.5) \
+            if peer_timeout_s is None else float(peer_timeout_s)
+        nconns = _envi("GYT_GW_UPSTREAM_CONNS", 2) \
+            if upstream_conns is None else int(upstream_conns)
+        self.upstream_timeout_s = float(upstream_timeout_s)
+        self.write_timeout = float(write_timeout)
+        self.upstreams = [_Upstream(h, p, nconns) for h, p in upstreams]
+        if not self.upstreams:
+            raise ValueError("gateway needs at least one upstream")
+        self.peers = [(h, int(p)) for h, p in peers]
+        self._peer_conns: dict = {}       # (h,p) -> [reader,writer,lock]
+        self._rr = 0
+        self._server = None
+        self._tasks: list = []
+        # (tick, key) -> ["ok", resp, body|None] | ["neg", msg, expiry]
+        self._cache: OrderedDict = OrderedDict()
+        self._flight: dict = {}           # (tick, key) -> Future
+        self._pushed_tick = -1
+        self._pushing = False
+        import secrets as _sec
+        self._madhava_id = _sec.randbits(63) | 1   # NM-front identity
+        from gyeeta_tpu.net.qexec import JsonRenderPool
+        self._render = JsonRenderPool(stats=self.stats)
+        from gyeeta_tpu.net.subs import SubscriptionHub
+        self.subs = SubscriptionHub(self.query, self.stats)
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> tuple:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.host, self.port = addr[0], addr[1]
+        self._tasks = [asyncio.create_task(self._watch_upstream(u))
+                       for u in self.upstreams]
+        log.info("fabric gateway on %s:%d -> %d upstream(s), "
+                 "%d peer(s)", self.host, self.port,
+                 len(self.upstreams), len(self.peers))
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for u in self.upstreams:
+            while not u._pool.empty():        # noqa: SLF001
+                qc = u._pool.get_nowait()     # noqa: SLF001
+                if qc is not None:
+                    await qc.close()
+        for ent in self._peer_conns.values():
+            if ent[1] is not None:
+                ent[1].close()
+        self._peer_conns.clear()
+        self._render.close()
+
+    # ------------------------------------------------------------- upstream
+    @property
+    def fabric_tick(self) -> int:
+        return max((u.tick for u in self.upstreams), default=-1)
+
+    async def _query_one(self, u: _Upstream, req: dict,
+                         timeout: Optional[float] = None) -> dict:
+        from gyeeta_tpu.ingest import wire
+        qc = await u.checkout(self.upstream_timeout_s)
+        try:
+            out = await qc.query(req, timeout=timeout)
+        except RuntimeError:
+            # server error ENVELOPE: the conn is healthy — reuse it
+            u.checkin(qc)
+            raise
+        except (ConnectionError, OSError, TimeoutError,
+                asyncio.IncompleteReadError, wire.FrameError):
+            await u.discard(qc)
+            raise
+        u.checkin(qc)
+        return out
+
+    async def _upstream_query(self, req: dict) -> dict:
+        """One render upstream: round-robin across live replicas with
+        failover. RuntimeError (the server's own error envelope)
+        propagates without failover — it is the QUERY's error and
+        every replica would answer it identically."""
+        last = None
+        n = len(self.upstreams)
+        self._rr = (self._rr + 1) % n
+        for i in range(n):
+            u = self.upstreams[(self._rr + i) % n]
+            try:
+                out = await self._query_one(u, req)
+                self.stats.bump("gw_renders_upstream")
+                return out
+            except RuntimeError:
+                raise
+            except Exception as e:      # noqa: BLE001 — conn trouble
+                self.stats.bump("gw_upstream_errors")
+                last = e
+        raise ConnectionError(f"no upstream reachable: {last}")
+
+    async def _watch_upstream(self, u: _Upstream) -> None:
+        """One cheap poll per tick per upstream: watch ``snaptick``
+        advance and trigger the subscription push when the FABRIC tick
+        (max across upstreams) moves."""
+        while True:
+            try:
+                out = await self._query_one(u, dict(_POLL_REQ),
+                                            timeout=10.0)
+                tick = int(out.get("snaptick", -1))
+                if tick > u.tick:
+                    u.tick = tick
+                u.tick_at = time.monotonic()
+                u.up = True
+                self.stats.gauge("gw_fabric_tick",
+                                 float(self.fabric_tick))
+                self.stats.gauge(
+                    "gw_upstreams_up",
+                    float(sum(1 for x in self.upstreams if x.up)))
+                new = self.fabric_tick
+                if new > self._pushed_tick and not self._pushing:
+                    self._pushing = True
+                    try:
+                        self._pushed_tick = new
+                        await self.subs.push_tick()
+                    finally:
+                        self._pushing = False
+            except asyncio.CancelledError:
+                raise
+            except Exception:       # noqa: BLE001 — down upstream
+                u.up = False
+                self.stats.bump("gw_poll_errors")
+            await asyncio.sleep(self.poll_s)
+
+    # ------------------------------------------------------ cache + query
+    @staticmethod
+    def _cacheable(req: dict) -> bool:
+        if any(k in req for k in ("op", "multiquery", "at", "window",
+                                  "tstart", "tend")):
+            return False
+        return req.get("consistency") != "strong"
+
+    def _cache_put(self, ck, entry) -> None:
+        self._cache[ck] = entry
+        self._cache.move_to_end(ck)
+        while len(self._cache) > self.cache_max:
+            self._cache.popitem(last=False)
+
+    def _cache_body(self, ck) -> Optional[bytes]:
+        ent = self._cache.get(ck)
+        if ent is None or ent[0] != "ok":
+            return None
+        if ent[2] is None:
+            ent[2] = json.dumps(ent[1]).encode()
+        return ent[2]
+
+    async def query(self, req: dict) -> dict:
+        """THE query entry every front shares. Cache-eligible requests
+        collapse onto the (fabric-tick, normalized-key) edge cache with
+        single-flight + peer exchange; everything else passes through
+        to a replica. Raises RuntimeError with the server's error
+        envelope, ConnectionError when no upstream answers."""
+        if not self._cacheable(req):
+            self.stats.bump("gw_queries_uncached")
+            return await self._upstream_query(req)
+        key = request_key(req)
+        tick = self.fabric_tick
+        ck = (tick, key)
+        ent = self._cache.get(ck)
+        if ent is not None:
+            if ent[0] == "ok":
+                self.stats.bump("gw_cache_hits|tier=local")
+                self._cache.move_to_end(ck)
+                return ent[1]
+            if ent[2] > time.monotonic():       # negative entry alive
+                self.stats.bump("gw_cache_hits|tier=neg")
+                raise RuntimeError(ent[1])
+            self._cache.pop(ck, None)
+        fut = self._flight.get(ck)
+        if fut is not None:
+            self.stats.bump("gw_singleflight_waits")
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._flight[ck] = fut
+        try:
+            self.stats.bump("gw_cache_misses")
+            resp = None
+            if self.peers:
+                resp = await self._peer_get(tick, key)
+            if resp is not None:
+                self.stats.bump("gw_cache_hits|tier=peer")
+            else:
+                try:
+                    resp = await self._upstream_query(dict(req))
+                except RuntimeError as e:
+                    # negative cache: the error is the result of THIS
+                    # query at THIS tick — a stampede of a broken
+                    # dashboard panel must not hammer the replicas
+                    self._cache_put(
+                        ck, ["neg", str(e),
+                             time.monotonic() + self.neg_ttl_s])
+                    raise
+            ent = ["ok", resp, None]
+            self._cache_put(ck, ent)
+            st = resp.get("snaptick")
+            if st is not None and (st, key) != ck:
+                # the replica rendered a fresher (or lagging) tick:
+                # alias under ITS tick too, so the next lookup at that
+                # tick hits
+                self._cache_put((st, key), ent)
+            elif st is None:
+                # uncacheable response shape (no snaptick: local
+                # subsystems, strong reads) — do not serve it across
+                # ticks
+                self._cache.pop(ck, None)
+            fut.set_result(resp)
+            return resp
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._flight.pop(ck, None)
+            if not fut.done():          # pragma: no cover — safety
+                fut.cancel()
+            elif not fut.cancelled():
+                fut.exception()     # mark retrieved (no loop warning)
+
+    # ------------------------------------------------------ peer exchange
+    async def _peer_conn(self, peer):
+        ent = self._peer_conns.get(peer)
+        if ent is None:
+            ent = self._peer_conns[peer] = [None, None,
+                                            asyncio.Lock()]
+        if ent[1] is None or ent[1].is_closing():
+            reader, writer = await asyncio.open_connection(*peer)
+            ent[0], ent[1] = reader, writer
+        return ent
+
+    async def _peer_post_one(self, peer, body: bytes):
+        ent = await self._peer_conn(peer)
+        reader, writer = ent[0], ent[1]
+        writer.write(
+            f"POST /gw/peer HTTP/1.1\r\nHost: gw\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        await writer.drain()
+        head = await reader.readuntil(b"\r\n\r\n")
+        status = int(head.split()[1])
+        clen = 0
+        for ln in head.decode("latin1").split("\r\n"):
+            if ln.lower().startswith("content-length:"):
+                clen = int(ln.split(":", 1)[1])
+        payload = await reader.readexactly(clen) if clen else b""
+        return status, payload
+
+    async def _peer_get(self, tick: int, key: str) -> Optional[dict]:
+        """Ask each peer for (tick, key); first hit wins. Bounded by
+        ``peer_timeout_s`` per peer — a slow peer must cost less than
+        the render it saves."""
+        body = json.dumps({"tick": tick, "key": key}).encode()
+        for peer in self.peers:
+            self.stats.bump("gw_peer_requests")
+            try:
+                status, payload = await asyncio.wait_for(
+                    self._peer_post_one(peer, body),
+                    self.peer_timeout_s)
+                if status == 200:
+                    self.stats.bump("gw_peer_hits")
+                    return json.loads(payload)["resp"]
+            except asyncio.CancelledError:
+                raise
+            except Exception:       # noqa: BLE001 — peer down/slow
+                self.stats.bump("gw_peer_errors")
+                ent = self._peer_conns.get(peer)
+                if ent is not None and ent[1] is not None:
+                    ent[1].close()
+                    ent[0] = ent[1] = None
+        return None
+
+    async def _serve_peer(self, obj: dict):
+        """The answering half: local cache lookup, waiting on an
+        in-flight render for the SAME (tick, key) — that wait is what
+        makes a fresh-tick stampede render once per FLEET, not once
+        per gateway."""
+        self.stats.bump("gw_peer_served_requests")
+        ck = (int(obj.get("tick", -1)), str(obj.get("key", "")))
+        ent = self._cache.get(ck)
+        if ent is not None and ent[0] == "ok":
+            self.stats.bump("gw_peer_served_hits")
+            return {"resp": ent[1]}
+        fut = self._flight.get(ck)
+        if fut is not None:
+            try:
+                resp = await asyncio.wait_for(asyncio.shield(fut), 2.0)
+                self.stats.bump("gw_peer_served_hits")
+                return {"resp": resp}
+            except Exception:       # noqa: BLE001
+                pass
+        return None
+
+    # ---------------------------------------------------------- the fronts
+    async def _handle(self, reader, writer) -> None:
+        try:
+            try:
+                first = await asyncio.wait_for(reader.readexactly(4),
+                                               10.0)
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    asyncio.TimeoutError, TimeoutError):
+                return
+            from gyeeta_tpu.ingest import refproto, wire
+            magic = int.from_bytes(first, "little")
+            if magic in (wire.MAGIC_PM, wire.MAGIC_MS, wire.MAGIC_NQ):
+                await self._gyt_front(reader, writer, first)
+            elif magic in refproto.REF_MAGICS:
+                await self._nm_front(reader, writer, first)
+            else:
+                await self._http_front(reader, writer, first)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass
+        except Exception:           # pragma: no cover — keep serving
+            log.exception("gateway conn failed")
+        finally:
+            self.subs.unsubscribe_conn(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):  # pragma: no cover
+                pass
+
+    # ---- GYT binary front
+    async def _gyt_front(self, reader, writer, first: bytes) -> None:
+        from gyeeta_tpu import version
+        from gyeeta_tpu.ingest import wire
+        from gyeeta_tpu.net.subs import SubscribeError
+        import numpy as np
+        dtype, payload = await wire.read_frame(reader, first)
+        if dtype != wire.COMM_REGISTER_REQ:
+            return
+        req = np.frombuffer(payload, wire.REGISTER_REQ_DT, count=1)[0]
+        if int(req["conn_type"]) != wire.CONN_QUERY:
+            # the gateway serves QUERIES; event conns belong on the
+            # serve tier
+            writer.write(wire.encode_register_resp(
+                wire.REG_ERR_VERSION, 0, version.CURR_WIRE_VERSION, 0))
+            await writer.drain()
+            return
+        writer.write(wire.encode_register_resp(
+            wire.REG_OK, 0xFFFFFFFF, version.CURR_WIRE_VERSION, 0))
+        await writer.drain()
+        while True:
+            try:
+                dtype, payload = await wire.read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if dtype == wire.COMM_SUBSCRIBE_CMD:
+                try:
+                    seqid, _, req = wire.decode_query_payload(payload)
+                except Exception:       # noqa: BLE001
+                    continue
+
+                async def send(ev, _seqid=seqid, _w=writer):
+                    _w.write(wire.encode_query(_seqid, ev,
+                                               wire.QS_PARTIAL,
+                                               resp=True))
+                    await asyncio.wait_for(_w.drain(),
+                                           self.write_timeout)
+
+                try:
+                    await self.subs.subscribe(
+                        req or {}, send,
+                        last_snaptick=(req or {}).get("last_snaptick"),
+                        conn_tag=writer)
+                    self.stats.bump("gw_queries|edge=gyt_sub")
+                except (SubscribeError, ValueError, RuntimeError,
+                        ConnectionError) as e:
+                    writer.write(wire.encode_query(
+                        seqid, {"error": str(e)}, wire.QS_ERROR,
+                        resp=True))
+                    await writer.drain()
+                continue
+            if dtype != wire.COMM_QUERY_CMD:
+                continue
+            try:
+                seqid, _, req = wire.decode_query_payload(payload)
+            except Exception:           # noqa: BLE001
+                continue
+            self.stats.bump("gw_queries|edge=gyt")
+            try:
+                with self.stats.timeit("gw_query"):
+                    out = await self.query(req or {})
+            except Exception as e:      # noqa: BLE001
+                status = wire.QS_ERROR
+                writer.write(wire.encode_query(
+                    seqid, {"error": str(e)}, status, resp=True))
+                await writer.drain()
+                continue
+            for frame in wire.iter_query_frames(seqid, out, wire.QS_OK):
+                writer.write(frame)
+                await writer.drain()
+
+    # ---- stock NM front
+    async def _nm_front(self, reader, writer, first: bytes) -> None:
+        from gyeeta_tpu.ingest import refproto as RP
+        from gyeeta_tpu.ingest import refquery as RQ
+        from gyeeta_tpu.ingest import wire
+        import numpy as np
+        hdr_b = first + await reader.readexactly(
+            RP.REF_HEADER_DT.itemsize - len(first))
+        hdr = np.frombuffer(hdr_b, RP.REF_HEADER_DT, count=1)[0]
+        total = int(hdr["total_sz"])
+        if total < len(hdr_b) or total >= wire.MAX_COMM_DATA_SZ:
+            return
+        body = await reader.readexactly(total - len(hdr_b))
+        if int(hdr["data_type"]) != RQ.REF_COMM_NM_CONNECT_CMD:
+            # only the node-webserver dialect fronts here; partha
+            # event conns belong on the serve tier
+            self.stats.bump("gw_nm_rejected")
+            return
+        from gyeeta_tpu.net import nmhandle
+        await nmhandle.serve_nm_gateway(self, reader, writer, body)
+
+    # ---- HTTP front
+    async def _http_front(self, reader, writer, first: bytes) -> None:
+        pending = first
+        while True:
+            try:
+                head = pending + await reader.readuntil(b"\r\n\r\n")
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            except asyncio.LimitOverrunError:
+                await self._respond(writer, 431,
+                                    {"error": "headers too large"})
+                return
+            pending = b""
+            if len(head) > _MAX_HDR:
+                await self._respond(writer, 431,
+                                    {"error": "headers too large"})
+                return
+            lines = head.decode("latin1").split("\r\n")
+            parts = lines[0].split()
+            if len(parts) != 3:
+                await self._respond(writer, 400,
+                                    {"error": "bad request line"})
+                return
+            method, target, _ = parts
+            headers = {}
+            for ln in lines[1:]:
+                if ":" in ln:
+                    k, v = ln.split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+            try:
+                clen = int(headers.get("content-length", 0) or 0)
+            except ValueError:
+                clen = -1
+            if clen < 0 or clen > _MAX_BODY:
+                await self._respond(writer, 400,
+                                    {"error": "bad content-length"})
+                return
+            body = await reader.readexactly(clen) if clen else b""
+            keep = headers.get("connection",
+                               "keep-alive").lower() != "close"
+            streamed = await self._http_route(writer, method, target,
+                                              body)
+            if streamed or not keep:
+                return
+
+    async def _http_route(self, writer, method: str, target: str,
+                          body: bytes) -> bool:
+        """→ True when the response is a stream that owns the conn
+        (SSE); the caller stops the keep-alive loop."""
+        path, _, qs = target.partition("?")
+        try:
+            if method == "GET" and path == "/metrics":
+                from gyeeta_tpu.obs import prom
+                await self._respond_text(writer, 200,
+                                         prom.render(self.stats),
+                                         prom.CONTENT_TYPE)
+                return False
+            if method == "GET" and path == "/healthz":
+                fresh = [u for u in self.upstreams if u.up]
+                ok = bool(fresh)
+                await self._respond(writer, 200 if ok else 503, {
+                    "ok": ok, "fabric_tick": self.fabric_tick,
+                    "upstreams_up": len(fresh),
+                    "upstreams": len(self.upstreams),
+                    "subscribers": self.subs.nsubs})
+                return False
+            if method == "POST" and path == "/gw/peer":
+                out = await self._serve_peer(json.loads(body or b"{}"))
+                if out is None:
+                    await self._respond(writer, 404, {"miss": True})
+                else:
+                    await self._respond(writer, 200, out)
+                return False
+            if method == "GET" and path == "/v1/subscribe":
+                await self._sse_subscribe(writer, qs)
+                return True
+            if method == "POST" and path == "/query":
+                req = json.loads(body or b"{}")
+                self.stats.bump("gw_queries|edge=http")
+                with self.stats.timeit("gw_query"):
+                    await self._respond(writer, 200,
+                                        await self.query(req))
+                return False
+            if method == "GET" and path.startswith("/v1/"):
+                req = self._req_of_qs(path[4:].strip("/"), qs)
+                self.stats.bump("gw_queries|edge=http")
+                with self.stats.timeit("gw_query"):
+                    await self._respond(writer, 200,
+                                        await self.query(req))
+                return False
+            await self._respond(writer, 404, {"error": "not found"})
+        except (ValueError, KeyError, RuntimeError) as e:
+            await self._respond(writer, 400, {"error": str(e)})
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            await self._respond(writer, 502,
+                                {"error": "upstream unreachable"})
+        return False
+
+    @staticmethod
+    def _req_of_qs(subsys: str, qs: str) -> dict:
+        req = {"subsys": subsys}
+        q = urllib.parse.parse_qs(qs)
+        for k in ("filter", "sortcol", "consistency"):
+            if k in q:
+                req[k] = q[k][0]
+        for k in ("maxrecs",):
+            if k in q:
+                req[k] = int(q[k][0])
+        for k in ("tstart", "tend"):
+            if k in q:
+                req[k] = float(q[k][0])
+        for k in ("at", "window"):
+            if k in q:
+                req[k] = q[k][0]
+        if "sortdesc" in q:
+            req["sortdesc"] = q["sortdesc"][0].lower() in ("1", "true")
+        return req
+
+    # ---- SSE subscription edge
+    async def _sse_subscribe(self, writer, qs: str) -> None:
+        q = urllib.parse.parse_qs(qs)
+        if "subsys" not in q:
+            await self._respond(writer, 400,
+                                {"error": "subscribe needs subsys"})
+            return
+        req = self._req_of_qs(q["subsys"][0], qs)
+        last = None
+        if "last_snaptick" in q:
+            try:
+                last = int(q["last_snaptick"][0])
+            except ValueError:
+                pass
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        await writer.drain()
+
+        async def send(ev, _w=writer):
+            data = json.dumps(ev)
+            _w.write(f"event: {ev.get('t', 'message')}\n"
+                     f"data: {data}\n\n".encode())
+            await asyncio.wait_for(_w.drain(), self.write_timeout)
+
+        from gyeeta_tpu.net.subs import SubscribeError
+        try:
+            await self.subs.subscribe(req, send, last_snaptick=last,
+                                      conn_tag=writer)
+            self.stats.bump("gw_queries|edge=sse")
+        except (SubscribeError, ValueError, RuntimeError,
+                ConnectionError) as e:
+            writer.write(f"event: error\ndata: "
+                         f"{json.dumps({'error': str(e)})}\n\n"
+                         .encode())
+            try:
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            return
+        # park until the CLIENT hangs up; pushes arrive from the hub
+        # (unsubscribe happens in _handle's finally)
+        transport = writer.transport
+        while not transport.is_closing():
+            await asyncio.sleep(0.5)
+
+    # ------------------------------------------------------- http encode
+    _REASON = {200: "OK", 400: "Bad Request", 404: "Not Found",
+               413: "Payload Too Large", 431: "Headers Too Large",
+               502: "Bad Gateway", 503: "Service Unavailable"}
+
+    async def _respond(self, writer, status: int, obj) -> None:
+        await self._respond_bytes(writer, status,
+                                  await self._render.encode(obj),
+                                  "application/json")
+
+    @classmethod
+    async def _respond_text(cls, writer, status: int, text: str,
+                            ctype: str) -> None:
+        await cls._respond_bytes(writer, status, text.encode(), ctype)
+
+    @classmethod
+    async def _respond_bytes(cls, writer, status: int, body: bytes,
+                             ctype: str) -> None:
+        reason = cls._REASON.get(status, "Error")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        await writer.drain()

@@ -748,6 +748,24 @@ class IngestSupervisor:
         for h in self.workers:
             self._send(h, {"cmd": "tick", "tick": int(tick)})
 
+    def ring_backlog_frac(self) -> float:
+        """Worst committed-but-unconsumed occupancy across every
+        (worker, shard) ring, as a fraction of ring capacity — the
+        admission controller's overload signal (``net/server.py``
+        throttles agents BEFORE the drop-oldest rings shed). Reads two
+        shared-memory words per ring; 0.0 when nothing is spawned."""
+        worst = 0
+        slots = 0
+        for h in self.workers:
+            if h.shm is None:
+                continue
+            slots = h.shm.slots
+            for s in range(max(1, self.n)):
+                b = h.shm.backlog(s)
+                if b > worst:
+                    worst = b
+        return worst / slots if slots else 0.0
+
     # -------------------------------------------------------------- drain
     def drain(self, max_slots_per_ring: int = 0) -> int:
         """Drain every ring into the runtime's staging slabs. Called

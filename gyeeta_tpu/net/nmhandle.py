@@ -138,6 +138,56 @@ async def _route(server, qtype: int, obj: dict) -> dict:
     raise ValueError(f"unsupported NM query type {qtype}")
 
 
+async def serve_nm_gateway(gw, reader, writer, body: bytes) -> None:
+    """The NM dialect on the FABRIC GATEWAY front (``net/gateway.py``):
+    same handshake gates, but queries route through the gateway's
+    (snaptick, request-hash) edge cache instead of a local runtime —
+    a stock node webserver pointed at a gateway shares the fleet's
+    renders without knowing the tier exists. CRUD verbs translate and
+    pass through to a replica (mutations are never cached)."""
+    req = RQ.parse_nm_connect_cmd(body)
+    err, es = _gate_nm(req)
+    now = int(time.time())
+    writer.write(RQ.encode_nm_connect_resp(err, es, gw._madhava_id,
+                                           now))
+    await writer.drain()
+    if err:
+        gw.stats.bump("gw_nm_rejected")
+        return
+    gw.stats.bump("gw_nm_conns_accepted")
+    while True:
+        try:
+            dtype, fbody = await _read_nm_frame(reader)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+        if dtype != RQ.REF_COMM_QUERY_CMD:
+            gw.stats.bump("gw_nm_frames_unknown_type")
+            continue
+        seqid, qtype, obj = RQ.parse_query_cmd(fbody)
+        verb = _VERB_OF_QTYPE.get(qtype, f"qtype_{qtype}")
+        gw.stats.bump(f"gw_queries|edge=nm,verb={verb}")
+        try:
+            if qtype == RQ.REF_QUERY_WEB_JSON:
+                q = RQ.web_json_to_query(obj)
+            elif qtype == RQ.REF_CRUD_GENERIC_JSON:
+                q = RQ.crud_to_request(obj, alert=False)
+            elif qtype == RQ.REF_CRUD_ALERT_JSON:
+                q = RQ.crud_to_request(obj, alert=True)
+            else:
+                raise ValueError(f"unsupported NM query type {qtype}")
+            with gw.stats.timeit("gw_query"):
+                out = await gw.query(q)
+        except Exception as e:          # noqa: BLE001 — envelope error
+            writer.write(RQ.encode_response_frames(
+                seqid, {"error": str(e), "errcode": 400},
+                RQ.REF_RESP_ERROR))
+            await writer.drain()
+            continue
+        for frame in RQ.iter_response_frames(seqid, out):
+            writer.write(frame)
+            await writer.drain()
+
+
 async def _query_loop(server, reader, writer, st: NMConnState) -> None:
     rt = server.rt
     outstanding = 0

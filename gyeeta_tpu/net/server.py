@@ -65,6 +65,7 @@ class GytServer:
                  throttle_lag_s: float = 0.75,
                  throttle_pending_mb: float = 32.0,
                  throttle_slab_frac: float = 0.85,
+                 throttle_ring_frac: float = 0.75,
                  query_workers: Optional[int] = None,
                  query_queue_max: Optional[int] = None,
                  query_snapshot: Optional[bool] = None,
@@ -107,6 +108,11 @@ class GytServer:
         self.throttle_lag_s = float(throttle_lag_s)
         self.throttle_pending_mb = float(throttle_pending_mb)
         self.throttle_slab_frac = float(throttle_slab_frac)
+        # worker-ring backlog (multi-process ingest, ROADMAP
+        # control-plane item c): occupancy past throttle_ring_frac
+        # trips the trace throttle, ≥0.95 holds EVERYTHING — throttle
+        # the agents BEFORE the drop-oldest rings shed records
+        self.throttle_ring_frac = float(throttle_ring_frac)
         self._throttle_level = 0          # 0=off, 1=trace, 2=all
         if idle_timeout is None:
             idle_timeout = max(30.0, 12.0 * tick_interval) \
@@ -223,6 +229,26 @@ class GytServer:
                                else bool(query_snapshot))
         self.qexec = _qexec.QueryExecutor(rt, workers=query_workers,
                                           queue_max=query_queue_max)
+        # ---- streaming subscriptions (net/subs.py): clients register
+        # a query ONCE (COMM_SUBSCRIBE_CMD on the GYT edge; the REST
+        # gateway relays /v1/subscribe onto it) and the tick loop
+        # pushes per-tick row deltas — render once, diff once, push to
+        # every subscriber of that normalized query
+        from gyeeta_tpu.net.subs import SubscriptionHub
+        self.subs = SubscriptionHub(self._sub_fetch, rt.stats)
+
+    async def _sub_fetch(self, req: dict) -> dict:
+        """Subscription render: the same admission-controlled off-loop
+        snapshot path every poll query rides (``net/qexec.py``)."""
+        return await self.qexec.run(req)
+
+    async def push_subscriptions(self) -> int:
+        """Push per-tick subscription deltas (called by the tick loop
+        after ``run_tick``; tests drive it directly after manual
+        ticks). Returns events delivered."""
+        if not self.subs.nsubs:
+            return 0
+        return await self.subs.push_tick()
 
     def _nm_register(self, hostname: str, port: int):
         """Sticky NM conn identity for a node (hostname, port) pair —
@@ -491,6 +517,7 @@ class GytServer:
                 self._resolve_pending_domains()
                 await self.push_trace_control()
                 await self.push_throttle()
+                await self.push_subscriptions()
                 if self.watchdog is not None:
                     self.watchdog.beat()      # liveness heartbeat
             except Exception:                     # pragma: no cover
@@ -510,6 +537,18 @@ class GytServer:
         if g.get("engine_drop_pressure"):
             return 2
         lvl = 0
+        # worker-ring backlog (multi-process ingest): the rings are
+        # drop-oldest — occupancy approaching full means the NEXT
+        # burst sheds records, so agents must spool first. Head−tail
+        # occupancy reads two shared-memory words per shard ring.
+        if self._ingest is not None:
+            frac = self._ingest.ring_backlog_frac()
+            g_ = self.rt.stats.gauge
+            g_("ingest_ring_backlog_frac", frac)
+            if frac >= 0.95:
+                return 2
+            if frac > self.throttle_ring_frac:
+                lvl = 1
         if g.get("journal_fsync_lag_seconds", 0.0) > self.throttle_lag_s:
             lvl = 1
         if g.get("journal_pending_bytes", 0.0) \
@@ -959,14 +998,70 @@ class GytServer:
                     rec.write(data[:k])
 
     async def _query_loop(self, reader, writer) -> None:
+        try:
+            await self._query_loop_inner(reader, writer)
+        finally:
+            # conn teardown IS unsubscribe: every subscription this
+            # conn registered stops costing a render share
+            self.subs.unsubscribe_conn(writer)
+
+    async def _subscribe_cmd(self, writer, payload) -> bool:
+        """One COMM_SUBSCRIBE_CMD → hub registration whose pushes ride
+        this conn as QS_PARTIAL QUERY_RESP frames (seqid echoed).
+        Returns False on a recoverable envelope error (the conn and
+        its error budget continue)."""
+        from gyeeta_tpu.net.subs import SubscribeError
+        try:
+            seqid, _, req = wire.decode_query_payload(payload)
+        except Exception:
+            self.rt.stats.bump("frames_rejected|reason=bad_query")
+            return False
+
+        async def send(ev, _seqid=seqid, _w=writer):
+            _w.write(wire.encode_query(_seqid, ev, wire.QS_PARTIAL,
+                                       resp=True))
+            if self.write_timeout:
+                await asyncio.wait_for(_w.drain(), self.write_timeout)
+            else:
+                await _w.drain()
+
+        try:
+            last = (req or {}).get("last_snaptick")
+            await self.subs.subscribe(req or {}, send,
+                                      last_snaptick=last,
+                                      conn_tag=writer)
+            self.rt.stats.bump("net_subscribes")
+            return True
+        except (SubscribeError, ValueError, RuntimeError) as e:
+            writer.write(wire.encode_query(seqid, {"error": str(e)},
+                                           wire.QS_ERROR, resp=True))
+            await writer.drain()
+            return False
+
+    async def _query_loop_inner(self, reader, writer) -> None:
         outstanding = 0
         bad_frames = 0
         while True:
             try:
-                dtype, payload = await self._tread(
-                    self._read_frame(reader), "idle")
+                # a conn holding subscriptions is PUSH-only from here:
+                # it legitimately never sends another frame, so the
+                # idle reap does not apply (dead conns surface as
+                # failed pushes and unsubscribe there)
+                if self.subs.conn_subscribed(writer):
+                    dtype, payload = await self._read_frame(reader)
+                else:
+                    dtype, payload = await self._tread(
+                        self._read_frame(reader), "idle")
             except (asyncio.IncompleteReadError, ConnectionError):
                 return
+            if dtype == wire.COMM_SUBSCRIBE_CMD:
+                if not await self._subscribe_cmd(writer, payload):
+                    bad_frames += 1
+                    if bad_frames > self.frame_error_budget:
+                        self.rt.stats.bump(
+                            "frames_rejected|reason=error_budget")
+                        return
+                continue
             if dtype != wire.COMM_QUERY_CMD:
                 self.rt.stats.bump("frames_unknown_type")
                 bad_frames += 1

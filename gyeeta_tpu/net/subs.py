@@ -1,0 +1,385 @@
+"""Streaming query subscriptions: register once, receive per-tick deltas.
+
+The reference's NodeJS webserver LONG-POLLS madhava: every dashboard
+re-asks its query every few seconds and the server re-renders it even
+when nothing changed. This module inverts the edge on the same wire
+format: a client registers a query ONCE and the serving tier watches
+``snaptick`` advance — when a new engine view publishes, each DISTINCT
+subscribed query is rendered once, diffed once against the previously
+delivered version (``query/delta.py``), and the delta is pushed to
+every subscriber of that query. Thousands of dashboards cost one
+render + one diff per tick, not thousands of polls.
+
+:class:`SubscriptionHub` is the shared server half — the SAME hub runs
+inside :class:`~gyeeta_tpu.net.server.GytServer` (fetching from the
+local snapshot tier) and inside the fan-in gateway
+(``net/gateway.py``, fetching through the distributed edge cache), so
+both edges push identical event streams. Subscriptions group by the
+NORMALIZED request key (``query/normalize.py`` — the cache-key
+function), which is what makes "thousands of dashboards, one render"
+literal: every subscriber of a semantically-equal query lands in one
+group.
+
+Reconnect: a subscriber that held version T re-subscribes with
+``last_snaptick=T``; if the hub still holds T in its short version
+history it answers with a delta (or an ``ack`` when T is current),
+otherwise a full resync — the client never has to special-case it
+(``query/delta.py:apply_event`` handles all three).
+
+Client halves: :class:`SubscribeClient` speaks the GYT binary
+``COMM_SUBSCRIBE_CMD`` stream; :func:`read_sse_events` parses the REST
+``/v1/subscribe`` SSE stream. Both yield the same event dicts.
+
+Metrics (all through the hub's ``Stats`` registry — rendered as
+``gyt_gw_*`` by ``obs/prom.py``): ``gw_subscribers`` / ``gw_sub_keys``
+gauges, ``gw_deltas_pushed`` / ``gw_resyncs`` / ``gw_sub_events`` /
+``gw_sub_dropped`` counters, ``gw_delta_bytes`` / ``gw_full_bytes``
+(the delta-vs-full wire ratio, QUERYLAT_r08), and the ``gw_push``
+stage hist (render+diff+deliver lag per key per tick).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import logging
+import os
+from typing import Optional
+
+from gyeeta_tpu.query import delta as D
+from gyeeta_tpu.query.normalize import normalize_request, request_key
+
+log = logging.getLogger("gyeeta_tpu.net.subs")
+
+# subscription-channel control fields stripped from the query envelope
+# before normalization (they select HOW to deliver, not WHAT to render)
+_SUB_FIELDS = ("last_snaptick", "subscribe")
+
+
+def sub_history(env=None) -> int:
+    env = os.environ if env is None else env
+    try:
+        return max(2, int(env.get("GYT_GW_SUB_HISTORY", "4")))
+    except ValueError:
+        return 4
+
+
+def delta_max_ratio(env=None) -> float:
+    """Delta-vs-full tradeoff knob: a delta that serializes to ≥ this
+    fraction of the full body is replaced by a full resync (1.0 = only
+    beat the full body; lower = prefer fulls sooner)."""
+    env = os.environ if env is None else env
+    try:
+        return float(env.get("GYT_GW_DELTA_MAX_RATIO", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+class SubscribeError(ValueError):
+    """Subscription rejected at registration (bad envelope / at
+    capacity) — the edge answers its error frame and keeps the conn."""
+
+
+class _Sub:
+    __slots__ = ("sid", "key", "send", "last_tick", "conn_tag")
+
+    def __init__(self, sid, key, send, last_tick, conn_tag):
+        self.sid = sid
+        self.key = key
+        self.send = send
+        self.last_tick = last_tick
+        self.conn_tag = conn_tag
+
+
+class SubscriptionHub:
+    """One per serving process. ``fetch`` is the tier's full-render
+    function ``async (req) -> resp`` — the snapshot query path on a
+    serve replica, the edge-cached query path on a gateway."""
+
+    def __init__(self, fetch, stats, history: Optional[int] = None,
+                 max_ratio: Optional[float] = None,
+                 max_subs: int = 4096):
+        self._fetch = fetch
+        self.stats = stats
+        self.history = sub_history() if history is None else int(history)
+        self.max_ratio = delta_max_ratio() if max_ratio is None \
+            else float(max_ratio)
+        self.max_subs = int(max_subs)
+        self._seq = 0
+        self._subs: dict[int, _Sub] = {}
+        self._by_key: dict[str, dict] = {}
+        self._req_of_key: dict[str, dict] = {}
+        # key -> deque[(snaptick, resp)] newest-last: the reconnect
+        # window (how far back a delta can base) AND the diff source
+        self._versions: dict[str, collections.deque] = {}
+
+    # ------------------------------------------------------------ gauges
+    def _gauge(self) -> None:
+        self.stats.gauge("gw_subscribers", float(len(self._subs)))
+        self.stats.gauge("gw_sub_keys", float(len(self._by_key)))
+
+    @property
+    def nsubs(self) -> int:
+        return len(self._subs)
+
+    # --------------------------------------------------------- lifecycle
+    async def subscribe(self, req: dict, send, last_snaptick=None,
+                        conn_tag=None) -> int:
+        """Register one subscription; ``send`` is ``async (event) ->
+        None``. The initial event (full / delta-from-last-seen / ack)
+        is delivered before this returns. Raises
+        :class:`SubscribeError` on a bad envelope or at capacity."""
+        if len(self._subs) >= self.max_subs:
+            self.stats.bump("gw_subs_rejected|reason=capacity")
+            raise SubscribeError(
+                f"subscription capacity {self.max_subs} reached")
+        req = {k: v for k, v in req.items() if k not in _SUB_FIELDS}
+        if any(k in req for k in ("op", "multiquery", "at", "window",
+                                  "tstart", "tend")):
+            self.stats.bump("gw_subs_rejected|reason=envelope")
+            raise SubscribeError(
+                "subscriptions carry live point-in-time queries only")
+        if req.get("consistency") == "strong":
+            self.stats.bump("gw_subs_rejected|reason=envelope")
+            raise SubscribeError(
+                "subscriptions serve the snapshot tier "
+                "(consistency=strong cannot stream)")
+        norm = normalize_request(req)
+        key = request_key(norm)
+        self._seq += 1
+        sid = self._seq
+        cur = self._latest(key)
+        if cur is None:
+            resp = await self._fetch(dict(norm))
+            # another subscriber may have raced the fetch; keep the
+            # newest version only once
+            if self._latest(key) is None:
+                self._push_version(key, resp)
+            cur = self._latest(key)
+        tick, resp = cur
+        ev = None
+        if last_snaptick is not None and last_snaptick == tick:
+            ev = D.ack_event(tick)
+        elif last_snaptick is not None:
+            held = self._version_at(key, last_snaptick)
+            if held is not None:
+                ev, db, fb = D.compute_event(held, resp,
+                                             self.max_ratio)
+                self.stats.bump("gw_delta_bytes", db)
+                self.stats.bump("gw_full_bytes", fb)
+            else:
+                self.stats.bump("gw_resyncs")
+        if ev is None and not (last_snaptick is not None
+                               and last_snaptick == tick):
+            ev = D.full_event(resp)
+        sub = _Sub(sid, key, send, tick, conn_tag)
+        self._subs[sid] = sub
+        self._by_key.setdefault(key, {})[sid] = sub
+        self._req_of_key[key] = dict(norm)
+        self._gauge()
+        self.stats.bump("gw_subs_registered")
+        try:
+            await send(ev)
+            self.stats.bump("gw_sub_events")
+        except Exception:
+            self.unsubscribe(sid)
+            raise
+        return sid
+
+    def unsubscribe(self, sid: int) -> None:
+        sub = self._subs.pop(sid, None)
+        if sub is None:
+            return
+        grp = self._by_key.get(sub.key)
+        if grp is not None:
+            grp.pop(sid, None)
+            if not grp:
+                # last subscriber gone: the key stops costing a render
+                # per tick and its version history is released
+                self._by_key.pop(sub.key, None)
+                self._req_of_key.pop(sub.key, None)
+                self._versions.pop(sub.key, None)
+        self._gauge()
+
+    def conn_subscribed(self, conn_tag) -> bool:
+        return any(s.conn_tag == conn_tag
+                   for s in self._subs.values())
+
+    def unsubscribe_conn(self, conn_tag) -> int:
+        sids = [s.sid for s in self._subs.values()
+                if s.conn_tag == conn_tag]
+        for sid in sids:
+            self.unsubscribe(sid)
+        return len(sids)
+
+    # ---------------------------------------------------------- versions
+    def _latest(self, key):
+        dq = self._versions.get(key)
+        return dq[-1] if dq else None
+
+    def _version_at(self, key, tick):
+        dq = self._versions.get(key) or ()
+        for t, resp in dq:
+            if t == tick:
+                return resp
+        return None
+
+    def _push_version(self, key, resp) -> None:
+        dq = self._versions.setdefault(
+            key, collections.deque(maxlen=self.history))
+        dq.append((resp.get("snaptick"), resp))
+
+    # -------------------------------------------------------------- push
+    async def push_tick(self) -> int:
+        """``snaptick`` advanced: render each subscribed query once,
+        diff once, deliver to every subscriber. Returns events sent.
+        A failing subscriber (dead conn, send deadline) is dropped and
+        counted — one wedged dashboard cannot stall the tier."""
+        sent = 0
+        for key in list(self._by_key):
+            grp = self._by_key.get(key)
+            req = self._req_of_key.get(key)
+            if not grp or req is None:
+                continue
+            with self.stats.timeit("gw_push"):
+                try:
+                    resp = await self._fetch(dict(req))
+                except Exception as e:      # noqa: BLE001 — counted
+                    # upstream shed/error: subscribers keep their last
+                    # version; next tick retries
+                    self.stats.bump("gw_sub_fetch_errors")
+                    log.debug("subscription fetch failed for %s: %s",
+                              req.get("subsys"), e)
+                    continue
+                prev = self._latest(key)
+                tick = resp.get("snaptick")
+                if prev is not None and prev[0] == tick:
+                    continue                 # no advance for this key
+                ev = None
+                if prev is not None:
+                    ev, db, fb = D.compute_event(prev[1], resp,
+                                                 self.max_ratio)
+                    self.stats.bump("gw_delta_bytes", db)
+                    self.stats.bump("gw_full_bytes", fb)
+                    if ev["t"] == "delta":
+                        self.stats.bump("gw_deltas_pushed")
+                    else:
+                        self.stats.bump("gw_resyncs")
+                full_ev = None
+                for sub in list(grp.values()):
+                    if prev is not None and sub.last_tick == prev[0] \
+                            and ev is not None:
+                        out = ev
+                    elif sub.last_tick == tick:
+                        continue
+                    else:
+                        # late joiner / missed a tick: full resync
+                        if full_ev is None:
+                            full_ev = D.full_event(resp)
+                            self.stats.bump("gw_resyncs")
+                        out = full_ev
+                    try:
+                        await sub.send(out)
+                        sub.last_tick = tick
+                        sent += 1
+                        self.stats.bump("gw_sub_events")
+                    except Exception:       # noqa: BLE001 — dead conn
+                        self.stats.bump("gw_sub_dropped")
+                        self.unsubscribe(sub.sid)
+                self._push_version(key, resp)
+        return sent
+
+
+# ===================================================================
+# client halves
+# ===================================================================
+
+class SubscribeClient:
+    """GYT binary subscription conn: registers as a query client, sends
+    ONE ``COMM_SUBSCRIBE_CMD`` and then iterates the pushed event
+    stream. One subscription per conn (the stream owns the read side;
+    multiplexing poll queries over it would race the pushes)."""
+
+    def __init__(self, machine_id: Optional[int] = None):
+        from gyeeta_tpu.utils import hashing as H
+        self.machine_id = machine_id if machine_id is not None \
+            else H.hash_bytes_np(b"subscribe-client")
+        self._reader = None
+        self._writer = None
+        self._seq = 0
+
+    async def connect(self, host: str, port: int,
+                      timeout: float = 10.0) -> None:
+        from gyeeta_tpu.ingest import wire
+        from gyeeta_tpu.net.agent import register
+        reader, writer, status, _ = await asyncio.wait_for(
+            register(host, port, self.machine_id, wire.CONN_QUERY),
+            timeout)
+        if status != wire.REG_OK:
+            writer.close()
+            raise ConnectionRefusedError(f"registration status {status}")
+        self._reader, self._writer = reader, writer
+
+    async def subscribe(self, req: dict,
+                        last_snaptick=None) -> None:
+        from gyeeta_tpu.ingest import wire
+        self._seq += 1
+        body = dict(req)
+        if last_snaptick is not None:
+            body["last_snaptick"] = last_snaptick
+        payload = json.dumps(body).encode()
+        import numpy as np
+        h = np.zeros((), wire.QUERY_HDR_DT)
+        h["seqid"] = np.uint64(self._seq)
+        h["nbytes"] = len(payload)
+        self._writer.write(wire._frame(          # noqa: SLF001
+            wire.COMM_SUBSCRIBE_CMD, h.tobytes() + payload,
+            wire.MAGIC_NQ))
+        await self._writer.drain()
+
+    async def events(self):
+        """Async-iterate pushed event dicts until the conn closes.
+        A QS_ERROR frame raises RuntimeError with the server's error."""
+        from gyeeta_tpu.ingest import wire
+        while True:
+            try:
+                dtype, payload = await wire.read_frame(self._reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if dtype != wire.COMM_QUERY_RESP:
+                raise wire.FrameError(
+                    f"expected QUERY_RESP on subscription, got {dtype}")
+            _seqid, status, body = wire.decode_query_chunk(payload)
+            obj = json.loads(body or b"null")
+            if status == wire.QS_ERROR:
+                raise RuntimeError(
+                    (obj or {}).get("error", "subscription error"))
+            yield obj
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+            self._writer = None
+
+
+async def read_sse_events(reader):
+    """Parse an SSE byte stream → async iterator of event dicts (the
+    ``data:`` JSON payloads; comments and event/id lines skipped —
+    the event type rides inside the JSON as ``t``)."""
+    buf = b""
+    while True:
+        chunk = await reader.read(65536)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n\n" in buf:
+            block, buf = buf.split(b"\n\n", 1)
+            data_lines = [ln[5:].strip() for ln in block.split(b"\n")
+                          if ln.startswith(b"data:")]
+            if data_lines:
+                yield json.loads(b"\n".join(data_lines))

@@ -142,8 +142,9 @@ class WebGateway:
                 body = await reader.readexactly(clen) if clen else b""
                 keep = headers.get("connection", "keep-alive") \
                     .lower() != "close"
-                await self._route(writer, method, target, body)
-                if not keep:
+                streamed = await self._route(writer, method, target,
+                                             body)
+                if streamed or not keep:
                     return
         except (ConnectionError, OSError):
             pass
@@ -155,9 +156,12 @@ class WebGateway:
                 pass
 
     async def _route(self, writer, method: str, target: str,
-                     body: bytes) -> None:
+                     body: bytes):
         path, _, qs = target.partition("?")
         try:
+            if method == "GET" and path == "/v1/subscribe":
+                await self._sse_subscribe(writer, qs)
+                return True          # stream owned the conn: close it
             if method == "GET" and path == "/metrics":
                 out = await self._query({"subsys": "metrics"})
                 await self._respond_text(
@@ -205,6 +209,61 @@ class WebGateway:
         except (ConnectionError, OSError, asyncio.IncompleteReadError):
             await self._respond(writer, 502,
                                 {"error": "upstream unreachable"})
+
+    async def _sse_subscribe(self, writer, qs: str) -> None:
+        """REST subscription relay: one DEDICATED upstream conn per
+        SSE client carrying the server's ``COMM_SUBSCRIBE_CMD`` stream
+        (``net/subs.py``) — the upstream hub still renders each
+        distinct query once per tick; this edge only re-frames events
+        as ``text/event-stream``. ``last_snaptick=`` resumes a
+        reconnecting dashboard with a delta when the server still
+        holds that version."""
+        import json as _json
+
+        from gyeeta_tpu.net.subs import SubscribeClient
+        q = urllib.parse.parse_qs(qs)
+        if "subsys" not in q:
+            await self._respond(writer, 400,
+                                {"error": "subscribe needs subsys"})
+            return
+        req = {"subsys": q["subsys"][0]}
+        for k in ("filter", "sortcol"):
+            if k in q:
+                req[k] = q[k][0]
+        if "maxrecs" in q:
+            req["maxrecs"] = int(q["maxrecs"][0])
+        if "sortdesc" in q:
+            req["sortdesc"] = q["sortdesc"][0].lower() in ("1", "true")
+        last = None
+        if "last_snaptick" in q:
+            try:
+                last = int(q["last_snaptick"][0])
+            except ValueError:
+                pass
+        sc = SubscribeClient()
+        try:
+            await sc.connect(*self.upstream)
+            await sc.subscribe(req, last_snaptick=last)
+        except (ConnectionError, OSError,
+                asyncio.IncompleteReadError) as e:
+            await self._respond(writer, 502, {"error": str(e)})
+            await sc.close()
+            return
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        try:
+            await writer.drain()
+            async for ev in sc.events():
+                writer.write(
+                    f"event: {ev.get('t', 'message')}\n"
+                    f"data: {_json.dumps(ev)}\n\n".encode())
+                await writer.drain()
+        except (ConnectionError, OSError, RuntimeError):
+            pass                       # either side hung up / errored
+        finally:
+            await sc.close()
 
     _REASON = {200: "OK", 400: "Bad Request", 404: "Not Found",
                413: "Payload Too Large", 431: "Headers Too Large",

@@ -70,10 +70,24 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
         for k, v in qry.items():
             lines.append(f"  {k:<36} {v}")
 
+    # query-fabric surface (gateway tier, net/gateway.py): edge-cache
+    # hit tiers, fleet-wide single-render collapse, subscription fan
+    # and the delta-vs-full wire ratio (OPERATIONS.md "Query fabric")
+    gwm = {k: v for k, v in sorted(c.items())
+           if str(k).startswith("gw_")}
+    if gwm:
+        lines.append("")
+        lines.append("query fabric:")
+        db, fb = c.get("gw_delta_bytes", 0), c.get("gw_full_bytes", 0)
+        if fb:
+            gwm["delta_vs_full_byte_ratio"] = round(db / fb, 4)
+        for k, v in gwm.items():
+            lines.append(f"  {k:<36} {v}")
+
     plain = {k: v for k, v in sorted(c.items())
              if not str(k).startswith(("engine_", "journal_", "wal_",
                                        "throttle", "query_", "queries",
-                                       "snapshot"))
+                                       "snapshot", "gw_"))
              and isinstance(v, (int, float))}
     lines.append("")
     hdr = f"  {'counter':<36} {'total':>12}"

@@ -6,4 +6,14 @@ queries are pure device readbacks of sketch state; filters compile to boolean
 masks over readback columns; output is Gyeeta-shaped JSON.
 """
 
-from gyeeta_tpu.query import readback  # noqa: F401
+import importlib
+
+
+def __getattr__(name):
+    # readback pulls the engine (and with it jax); the thin-client
+    # half of this package (normalize/delta/criteria/fieldmaps) must
+    # stay importable without initializing an accelerator backend —
+    # the fabric gateway (net/gateway.py) runs on boxes with no TPU
+    if name == "readback":
+        return importlib.import_module("gyeeta_tpu.query.readback")
+    raise AttributeError(name)

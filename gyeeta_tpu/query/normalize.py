@@ -1,0 +1,112 @@
+"""Shared request normalization: ONE canonical form for cache keying.
+
+Two caches key on "the same query": the per-snapshot result cache
+(``query/snapshot.py``, PR 9) and the gateway tier's distributed
+(snaptick, request-hash) edge cache (``net/gateway.py``). They MUST
+key identically, or a result rendered once on a serve replica misses
+at the gateway (and vice versa) and the fleet pays the render twice.
+This module is that single definition; both tiers import it.
+
+Normalization is strictly semantics-preserving for the LIVE query
+envelope (the only envelope either cache ever sees — CRUD, multiquery
+and historical requests bypass both caches):
+
+- key order never matters (the key is key-sorted canonical JSON);
+- ``None`` values drop (absent and null are the same request);
+- defaulted fields drop (``maxrecs`` at the :class:`QueryOptions`
+  default, ``sortdesc=True``, ``consistency="snapshot"`` — the serving
+  edge default);
+- ``sortdesc`` without a ``sortcol`` drops entirely (it has no effect);
+- single-string ``aggr``/``groupby``/``columns`` coerce to lists, and
+  numeric strings for ``maxrecs`` coerce to int;
+- filters canonicalize through the criteria parser: equivalent filter
+  strings (whitespace, comparator aliases like ``==``/``~=``, numeric
+  literal spellings like ``1`` vs ``1.0``) render to one canonical
+  string. An unparseable filter keeps its raw text (the query will
+  fail identically wherever it lands, so keying it raw is harmless).
+"""
+
+from __future__ import annotations
+
+import json
+
+# QueryOptions defaults (query/api.py) — a request carrying exactly
+# these says nothing the bare request doesn't
+_DEFAULTS = {"maxrecs": 1000, "sortdesc": True,
+             "consistency": "snapshot"}
+
+# envelope fields that make a request uncacheable / non-live — the
+# callers gate on these before keying, but normalize() must still
+# pass them through untouched so a key is never LOSSY
+_PASSTHROUGH = ("at", "window", "tstart", "tend", "op", "multiquery")
+
+
+def canonical_filter(s: str) -> str:
+    """One canonical rendering per equivalence class of filter strings
+    (modulo the criteria grammar). Unparseable input returns as-is."""
+    from gyeeta_tpu.query import criteria
+
+    try:
+        tree = criteria.parse(s)
+    except Exception:           # noqa: BLE001 — keyed raw, fails alike
+        return s
+    return _render_tree(tree)
+
+
+def _render_val(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return str(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
+    esc = str(v).replace("\\", "\\\\").replace("'", "\\'")
+    return f"'{esc}'"
+
+
+def _render_tree(node) -> str:
+    from gyeeta_tpu.query.criteria import BoolNode, Criterion
+
+    if isinstance(node, Criterion):
+        vals = ",".join(_render_val(v) for v in node.values)
+        return f"{{ {node.subsys}.{node.field} {node.op} {vals} }}"
+    assert isinstance(node, BoolNode)
+    if node.op == "not":
+        return f"not {_render_tree(node.children[0])}"
+    inner = f" {node.op} ".join(_render_tree(c) for c in node.children)
+    return f"( {inner} )"
+
+
+def normalize_request(req: dict) -> dict:
+    """Canonical form of one live-query envelope (see module doc)."""
+    out = {}
+    for k in sorted(req):
+        v = req[k]
+        if v is None:
+            continue
+        if k == "maxrecs":
+            try:
+                v = int(v)
+            except (TypeError, ValueError):
+                pass
+        elif k == "sortdesc":
+            v = bool(v)
+        elif k in ("aggr", "groupby", "columns"):
+            v = [v] if isinstance(v, str) else list(v)
+        elif k == "filter" and isinstance(v, str):
+            v = canonical_filter(v)
+        if _DEFAULTS.get(k, _SENTINEL) == v:
+            continue
+        out[k] = v
+    if "sortcol" not in out:
+        out.pop("sortdesc", None)
+    return out
+
+
+_SENTINEL = object()
+
+
+def request_key(req: dict) -> str:
+    """Normalized request hash key: key-sorted canonical JSON of the
+    normalized envelope. Two dashboards asking the same question in a
+    different spelling collapse to one render — on EVERY cache tier."""
+    return json.dumps(normalize_request(req), sort_keys=True,
+                      separators=(",", ":"), default=str)

@@ -33,7 +33,6 @@ replace the current one (``on_mutation`` / re-publish).
 from __future__ import annotations
 
 import collections
-import json
 import threading
 
 import numpy as np
@@ -58,11 +57,13 @@ _REGISTRY_AUX = frozenset((
 
 
 def request_key(req: dict) -> str:
-    """Normalized request hash key: key-sorted canonical JSON of the
-    query envelope. Two dashboards asking the same question in a
-    different field order collapse to one render."""
-    return json.dumps(req, sort_keys=True, separators=(",", ":"),
-                      default=str)
+    """Normalized request hash key — the ONE shared definition in
+    ``query/normalize.py``: the gateway tier's distributed edge cache
+    keys with the same function, so a result rendered here serves the
+    whole fleet (and a gateway-side hit proves a replica-side hit
+    would have happened too)."""
+    from gyeeta_tpu.query.normalize import request_key as _rk
+    return _rk(req)
 
 
 class EngineSnapshot:

@@ -94,6 +94,8 @@ class Daemon:
                                  args, "throttle_lag_s", 0.75),
                              throttle_pending_mb=getattr(
                                  args, "throttle_pending_mb", 32.0),
+                             throttle_ring_frac=getattr(
+                                 args, "throttle_ring_frac", 0.75),
                              query_workers=getattr(
                                  args, "query_workers", None),
                              query_queue_max=getattr(
@@ -455,6 +457,11 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--throttle-pending-mb", type=float, default=32.0,
                     help="unsynced WAL bytes that trip the trace-feed "
                     "throttle")
+    ap.add_argument("--throttle-ring-frac", type=float, default=0.75,
+                    help="ingest worker-ring occupancy fraction that "
+                    "trips the trace-feed throttle (multi-process "
+                    "ingest; >=0.95 holds every sweep — throttle "
+                    "before the drop-oldest rings shed)")
     # time-travel history tier: WAL compaction → columnar snapshot
     # shards + at=/window= queries (OPERATIONS.md "History & time
     # travel"; GYT_HIST_* env knobs cover the rest)

@@ -1,0 +1,266 @@
+"""Delta correctness (ISSUE 13 satellite): for a randomized sequence
+of snapshot versions, cumulative application of row-keyed deltas is
+byte-equal to the full render at EVERY tick — including the ``full=``
+resync escape and subscriber reconnect-with-last-seen-snaptick —
+on synthetic tables, on Runtime-rendered responses (fast tier) and on
+ShardedRuntime-rendered responses (slow tier).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from gyeeta_tpu.query import delta as D
+
+# ------------------------------------------------------------------ helpers
+
+
+def _wire(obj):
+    """Client-side view of ``obj``: one JSON round trip, exactly what
+    SSE / the GYT frame delivers."""
+    return json.loads(json.dumps(obj))
+
+
+def _assert_byte_equal(applied, fresh):
+    assert json.dumps(applied) == json.dumps(_wire(fresh))
+
+
+def _rand_version(rng, tick, n_rows, churn, keyed=True):
+    rows = []
+    for i in range(n_rows):
+        r = {"hostid": float(i % 5),
+             "name": f"svc-{i}",
+             "qps": round(rng.uniform(0, 100), 3) if i in churn
+             else round(i * 1.25, 3),
+             "state": rng.choice(["OK", "Bad"]) if i in churn
+             else "OK"}
+        if keyed:
+            r = {"svcid": f"{i:016x}", **r}
+        rows.append(r)
+    rng.shuffle(rows)
+    return {"recs": rows, "nrecs": len(rows), "ntotal": len(rows),
+            "snaptick": tick}
+
+
+# ------------------------------------------------------------- property fuzz
+
+
+@pytest.mark.parametrize("keyed", [True, False])
+def test_delta_stream_property(keyed):
+    """Randomized version sequence: row churn, inserts, deletes, full
+    reorders; the applied stream is byte-equal at every tick. With
+    ``keyed=False`` rows carry no identity fields at all — the
+    whole-row-key fallback must still reassemble exactly."""
+    rng = random.Random(1234 + keyed)
+    held = None
+    n = 12
+    for tick in range(1, 30):
+        n = max(1, n + rng.randint(-4, 4))
+        churn = {rng.randrange(n) for _ in range(rng.randint(0, n))}
+        curr = _rand_version(rng, tick, n, churn, keyed=keyed)
+        ev, db, fb = D.compute_event(held, curr)
+        assert db > 0 and fb > 0
+        ev = _wire(ev)                       # the wire round trip
+        held = D.apply_event(held, ev)
+        _assert_byte_equal(held, curr)
+
+
+def test_full_resync_escape():
+    """A churn-heavy tick where the delta cannot beat the full body
+    must ship as a full event — and still apply byte-equal."""
+    rng = random.Random(7)
+    a = _rand_version(rng, 1, 40, set())
+    b = _rand_version(rng, 2, 40, set(range(40)))
+    ev, db, fb = D.compute_event(a, b)
+    assert ev["t"] == "full"                 # every row changed
+    assert db <= fb + 64                     # the escape bounds cost
+    _assert_byte_equal(D.apply_event(_wire(a), _wire(ev)), b)
+    # and a low max_ratio forces fulls even on tiny changes
+    c = _rand_version(rng, 3, 40, {1})
+    ev2, _, _ = D.compute_event(b, c, max_ratio=0.01)
+    assert ev2["t"] == "full"
+
+
+def test_key_collision_falls_back_to_rowjson():
+    """Two DIFFERENT rows sharing identity fields must not reassemble
+    wrongly — the keyer detects the collision and falls back to
+    whole-row keys."""
+    a = {"recs": [{"svcid": "x", "v": 1}, {"svcid": "x", "v": 2}],
+         "nrecs": 2, "snaptick": 1}
+    b = {"recs": [{"svcid": "x", "v": 2}, {"svcid": "x", "v": 3}],
+         "nrecs": 2, "snaptick": 2}
+    ev, _, _ = D.compute_event(a, b)
+    if ev["t"] == "delta":
+        assert ev["kf"] == "*"
+    _assert_byte_equal(D.apply_event(_wire(a), _wire(ev)), b)
+
+
+def test_apply_event_requires_matching_base():
+    a = {"recs": [{"svcid": "x", "v": 1}], "nrecs": 1, "snaptick": 3}
+    b = {"recs": [{"svcid": "x", "v": 2}], "nrecs": 1, "snaptick": 4}
+    ev, _, _ = D.compute_event(a, b)
+    if ev["t"] == "delta":
+        stale = {"recs": [], "nrecs": 0, "snaptick": 1}
+        with pytest.raises(D.ResyncRequired):
+            D.apply_event(stale, ev)
+    with pytest.raises(D.ResyncRequired):
+        D.apply_event(None, {"t": "delta", "base": 3, "kf": "*",
+                             "order": [], "upsert": {}, "env": {},
+                             "ekeys": []})
+    # ack keeps the held version
+    assert D.apply_event(a, D.ack_event(3)) is a
+
+
+# ------------------------------------------- hub reconnect-with-last-seen
+
+
+def test_hub_reconnect_with_last_snaptick():
+    """SubscriptionHub: a subscriber that disconnects at version T and
+    reconnects with last_snaptick=T resumes with a DELTA (not a full)
+    while T is in the version history, with an ack at the current
+    tick, and with a full resync once T ages out."""
+    from gyeeta_tpu.net.subs import SubscriptionHub
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    rng = random.Random(99)
+    versions = {}
+    cur = {"tick": 0}
+
+    async def fetch(req):
+        return versions[cur["tick"]]
+
+    async def run():
+        stats = Stats()
+        hub = SubscriptionHub(fetch, stats, history=3)
+        got: list = []
+
+        async def send(ev):
+            got.append(_wire(ev))
+
+        for t in range(1, 8):
+            versions[t] = _rand_version(rng, t, 10, {t % 10})
+        cur["tick"] = 1
+        sid = await hub.subscribe({"subsys": "svcstate"}, send)
+        assert got[-1]["t"] == "full"
+        held = D.apply_event(None, got[-1])
+        _assert_byte_equal(held, versions[1])
+        for t in (2, 3):
+            cur["tick"] = t
+            await hub.push_tick()
+            held = D.apply_event(held, got[-1])
+            _assert_byte_equal(held, versions[t])
+        # a second subscriber keeps the key warm: dropping the LAST
+        # subscriber releases the version history (the reconnect
+        # contract rides on it)
+        keeper: list = []
+
+        async def ksend(ev):
+            keeper.append(ev)
+
+        await hub.subscribe({"subsys": "svcstate"}, ksend)
+        # disconnect at tick 3, ticks advance to 4
+        hub.unsubscribe(sid)
+        cur["tick"] = 4
+        await hub.push_tick()
+        # reconnect with last seen 3 → a delta-based resume
+        got.clear()
+        await hub.subscribe({"subsys": "svcstate"}, send,
+                            last_snaptick=3)
+        ev = got[-1]
+        assert ev["t"] == "delta" and ev["base"] == 3
+        held = D.apply_event(held, ev)
+        _assert_byte_equal(held, versions[4])
+        # reconnect AT the current tick → ack, nothing re-shipped
+        got.clear()
+        await hub.subscribe({"subsys": "svcstate"}, send,
+                            last_snaptick=4)
+        assert got[-1]["t"] == "ack"
+        # age tick 4 out of the history window → full resync
+        for t in (5, 6, 7):
+            cur["tick"] = t
+            await hub.push_tick()
+        got.clear()
+        await hub.subscribe({"subsys": "svcstate"}, send,
+                            last_snaptick=4)
+        assert got[-1]["t"] == "full"
+        assert stats.counters.get("gw_resyncs", 0) >= 1
+
+    asyncio.run(run())
+
+
+# ----------------------------------------------- engine-rendered sequences
+
+_QUERIES = (
+    {"subsys": "svcstate", "sortcol": "qps5s", "sortdesc": True,
+     "maxrecs": 64},
+    {"subsys": "hoststate", "maxrecs": 32},
+    {"subsys": "svcstate", "groupby": ["hostid"],
+     "aggr": ["sum(qps5s)", "count(*)"], "maxrecs": 16},
+)
+
+
+def _stream_engine(rt, feed_fn, ticks=4):
+    """Render _QUERIES from the snapshot tier at every tick; apply the
+    delta stream client-side; assert byte-equality each tick."""
+    held = {i: None for i in range(len(_QUERIES))}
+    for _ in range(ticks):
+        feed_fn()
+        rt.run_tick()
+        for i, q in enumerate(_QUERIES):
+            curr = rt.query({**q, "consistency": "snapshot"})
+            ev, db, fb = D.compute_event(held[i], curr)
+            applied = D.apply_event(held[i], _wire(ev))
+            _assert_byte_equal(applied, curr)
+            held[i] = applied
+
+
+def test_engine_delta_stream_runtime():
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                    conn_batch=256, resp_batch=512, listener_batch=64,
+                    fold_k=2)
+    rt = Runtime(cfg)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=11)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.listener_frames())
+
+    def feed():
+        rt.feed(sim.conn_frames(256) + sim.resp_frames(512)
+                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                    sim.host_state_records()))
+
+    _stream_engine(rt, feed)
+    rt.close()
+
+
+@pytest.mark.slow
+def test_engine_delta_stream_sharded():
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.parallel import make_mesh
+    from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
+    from gyeeta_tpu.sim.partha import ParthaSim
+
+    cfg = EngineCfg(n_hosts=16, svc_capacity=256, task_capacity=256,
+                    conn_batch=256, resp_batch=512, listener_batch=64,
+                    fold_k=2)
+    srt = ShardedRuntime(cfg, make_mesh(8))
+    sim = ParthaSim(n_hosts=16, n_svcs=4, seed=13)
+    srt.feed(sim.name_frames())
+    srt.feed(sim.listener_frames())
+
+    def feed():
+        srt.feed(sim.conn_frames(256) + sim.resp_frames(512)
+                 + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                     sim.host_state_records()))
+
+    _stream_engine(srt, feed, ticks=3)
+    srt.close()

@@ -1,0 +1,401 @@
+"""Query-fabric gateway (ISSUE 13): shared (snaptick, request-hash)
+edge cache with single-flight + negative TTL + peer exchange, push
+subscriptions on REST SSE and the GYT binary edge, shared request
+normalization across both cache tiers, and the backlog-aware
+admission-control satellite.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.query import delta as D
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                conn_batch=256, resp_batch=512, listener_batch=64,
+                fold_k=2)
+
+
+async def _until(cond, timeout=20.0, interval=0.02, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        got = cond()
+        if got:
+            return got
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _feed(rt, sim, n=256):
+    rt.feed(sim.conn_frames(n) + sim.resp_frames(2 * n)
+            + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                sim.host_state_records()))
+
+
+# ------------------------------------------------ shared normalization
+
+
+def test_request_normalization_shared_across_tiers():
+    """Satellite: semantically-equal requests (key order, default
+    fields, equivalent filters) hash equal — and the replica-side
+    result cache keys with the SAME function as the gateway cache."""
+    from gyeeta_tpu.query import normalize as N
+    from gyeeta_tpu.query import snapshot as S
+
+    a = {"subsys": "svcstate", "maxrecs": 1000, "sortdesc": True,
+         "filter": "{svcstate.qps5s>1.0}"}
+    b = {"filter": "{ svcstate.qps5s  >  1 }", "subsys": "svcstate"}
+    assert N.request_key(a) == N.request_key(b)
+    # both tiers are literally the same function
+    assert S.request_key(a) == N.request_key(b)
+    # defaults drop; None drops; sortdesc without sortcol drops
+    assert N.request_key({"subsys": "hoststate", "sortdesc": False}) \
+        == N.request_key({"subsys": "hoststate", "filter": None})
+    # consistency=snapshot is the serving-edge default
+    assert N.request_key({"subsys": "topk",
+                          "consistency": "snapshot"}) \
+        == N.request_key({"subsys": "topk"})
+    # but a DIFFERENT maxrecs is a different request
+    assert N.request_key({"subsys": "topk", "maxrecs": 5}) \
+        != N.request_key({"subsys": "topk"})
+    # comparator aliases + in-lists canonicalize
+    assert N.request_key(
+        {"subsys": "svcstate",
+         "filter": "{ svcstate.state == 'Bad' }"}) \
+        == N.request_key(
+            {"subsys": "svcstate",
+             "filter": "{svcstate.state = 'Bad'}"})
+    # an unparseable filter keys raw (and unequal to a parseable one)
+    k = N.request_key({"subsys": "svcstate", "filter": "%%%"})
+    assert "%%%" in k
+
+
+# ------------------------------------------------ gateway fabric e2e
+
+
+def _mk_rt():
+    rt = Runtime(CFG)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=21)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.listener_frames())
+    _feed(rt, sim)
+    rt.run_tick()
+    return rt, sim
+
+
+def test_gateway_cache_singleflight_peers_and_subs():
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.subs import SubscribeClient, read_sse_events
+
+    rt, sim = _mk_rt()
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        gw1 = FabricGateway([(host, port)], poll_s=0.05)
+        h1, p1 = await gw1.start()
+        gw2 = FabricGateway([(host, port)], peers=[(h1, p1)],
+                            poll_s=0.05)
+        h2, p2 = await gw2.start()
+        gw1.peers = [(h2, p2)]          # full peer mesh
+
+        # watchers discover the bootstrap tick
+        snap_tick = rt.snapshot.tick
+        await _until(lambda: gw1.fabric_tick >= snap_tick and
+                     gw2.fabric_tick >= snap_tick,
+                     msg="tick discovery")
+
+        q = {"subsys": "svcstate", "sortcol": "qps5s",
+             "sortdesc": True, "maxrecs": 50}
+        # --- local cache: miss then hit, alternate spelling hits too
+        r0 = rt.stats.counters.get("query_cache_misses", 0)
+        out1 = await gw1.query(dict(q))
+        assert out1["nrecs"] > 0 and "snaptick" in out1
+        out2 = await gw1.query(dict(q))
+        out3 = await gw1.query({"subsys": "svcstate", "maxrecs": 50,
+                                "sortcol": "qps5s"})   # sortdesc dflt
+        assert out2 is out1 and out3 is out1
+        assert gw1.stats.counters.get(
+            "gw_cache_hits|tier=local", 0) >= 2
+        # --- peer exchange: gw2 serves gw1's render without a fresh
+        # upstream render (the replica-side result cache would absorb
+        # it anyway — the PROOF is the peer-hit counter + miss count)
+        out4 = await gw2.query(dict(q))
+        assert json.dumps(out4) == json.dumps(out1)
+        assert gw2.stats.counters.get("gw_cache_hits|tier=peer") == 1
+        # fleet-wide single render: the replica rendered the query
+        # shape exactly once (serverstatus polls were cached earlier)
+        assert rt.stats.counters.get("query_cache_misses", 0) \
+            == r0 + 1
+
+        # --- single-flight: a stampede of N identical queries on a
+        # FRESH tick costs one upstream render
+        _feed(rt, sim)
+        rt.run_tick()
+        await _until(lambda: gw1.fabric_tick == rt.snapshot.tick,
+                     msg="fresh tick")
+        rr0 = gw1.stats.counters.get("gw_renders_upstream", 0)
+        outs = await asyncio.gather(
+            *[gw1.query(dict(q)) for _ in range(16)])
+        assert all(o["snaptick"] == outs[0]["snaptick"] for o in outs)
+        assert gw1.stats.counters.get("gw_renders_upstream", 0) \
+            == rr0 + 1
+        assert gw1.stats.counters.get("gw_singleflight_waits", 0) >= 1
+
+        # --- negative TTL: a broken query error-caches; the stampede
+        # repeats it without re-asking the replica
+        bad = {"subsys": "nosuchsubsys"}
+        with pytest.raises(RuntimeError):
+            await gw1.query(dict(bad))
+        with pytest.raises(RuntimeError):
+            await gw1.query(dict(bad))
+        assert gw1.stats.counters.get("gw_cache_hits|tier=neg") == 1
+
+        # --- subscriptions: GYT binary on gw1, SSE on gw2
+        sc = SubscribeClient()
+        await sc.connect(h1, p1)
+        await sc.subscribe(dict(q))
+        events_gyt: list = []
+
+        async def gyt_reader():
+            async for ev in sc.events():
+                events_gyt.append(ev)
+
+        gyt_task = asyncio.create_task(gyt_reader())
+
+        sse_reader, sse_writer = await asyncio.open_connection(h2, p2)
+        sse_writer.write(
+            b"GET /v1/subscribe?subsys=svcstate&sortcol=qps5s&"
+            b"sortdesc=true&maxrecs=50 HTTP/1.1\r\nHost: s\r\n\r\n")
+        await sse_writer.drain()
+        head = await sse_reader.readuntil(b"\r\n\r\n")
+        assert b"200" in head.split(b"\r\n", 1)[0]
+        events_sse: list = []
+
+        async def sse_loop():
+            async for ev in read_sse_events(sse_reader):
+                events_sse.append(ev)
+
+        sse_task = asyncio.create_task(sse_loop())
+        await _until(lambda: events_gyt and events_sse,
+                     msg="initial full events")
+        assert events_gyt[0]["t"] == "full"
+        assert events_sse[0]["t"] == "full"
+        held_gyt = D.apply_event(None, events_gyt[0])
+        held_sse = D.apply_event(None, events_sse[0])
+
+        # advance a tick → both edges receive ONE event that
+        # reassembles byte-equal to a fresh full render
+        n_g, n_s = len(events_gyt), len(events_sse)
+        _feed(rt, sim)
+        rt.run_tick()
+        await _until(lambda: len(events_gyt) > n_g
+                     and len(events_sse) > n_s, msg="pushed deltas")
+        held_gyt = D.apply_event(held_gyt, events_gyt[-1])
+        held_sse = D.apply_event(held_sse, events_sse[-1])
+        full_g = await gw1.query(dict(q))
+        assert held_gyt["snaptick"] == full_g["snaptick"]
+        assert json.dumps(held_gyt) == json.dumps(
+            json.loads(json.dumps(full_g)))
+        full_s = await gw2.query(dict(q))
+        assert json.dumps(held_sse) == json.dumps(
+            json.loads(json.dumps(full_s)))
+        assert (gw1.stats.counters.get("gw_deltas_pushed", 0)
+                + gw1.stats.counters.get("gw_resyncs", 0)) >= 1
+
+        # gauges + /metrics families on the gateway
+        assert gw1.stats.gauges.get("gw_subscribers") == 1.0
+        gr, gwr = await asyncio.open_connection(h1, p1)
+        gwr.write(b"GET /metrics HTTP/1.1\r\nHost: s\r\n"
+                  b"Connection: close\r\n\r\n")
+        await gwr.drain()
+        raw = await gr.read(-1)
+        gwr.close()
+        text = raw.partition(b"\r\n\r\n")[2].decode()
+        for fam in ("gyt_gw_cache_hits_total", "gyt_gw_subscribers",
+                    "gyt_gw_cache_misses_total",
+                    "gyt_gw_renders_upstream_total"):
+            assert fam in text, f"{fam} missing from gateway /metrics"
+
+        gyt_task.cancel()
+        sse_task.cancel()
+        await sc.close()
+        sse_writer.close()
+        await gw2.stop()
+        await gw1.stop()
+        await srv.stop()
+
+    asyncio.run(scenario())
+    # srv.stop() closed the runtime
+
+
+def test_server_gyt_subscribe_direct():
+    """The serve tier itself speaks COMM_SUBSCRIBE_CMD (single-replica
+    deployments need no gateway): initial full, per-tick delta after
+    push_subscriptions, byte-equal reassembly."""
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.subs import SubscribeClient
+
+    rt, sim = _mk_rt()
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        sc = SubscribeClient()
+        await sc.connect(host, port)
+        await sc.subscribe({"subsys": "hoststate", "maxrecs": 32})
+        events: list = []
+
+        async def rd():
+            async for ev in sc.events():
+                events.append(ev)
+
+        task = asyncio.create_task(rd())
+        await _until(lambda: events, msg="initial full")
+        held = D.apply_event(None, events[0])
+        _feed(rt, sim)
+        rt.run_tick()
+        n = len(events)
+        await srv.push_subscriptions()
+        await _until(lambda: len(events) > n, msg="delta push")
+        held = D.apply_event(held, events[-1])
+        fresh = rt.query({"subsys": "hoststate", "maxrecs": 32,
+                          "consistency": "snapshot"})
+        assert json.dumps(held) == json.dumps(
+            json.loads(json.dumps(fresh)))
+        assert rt.stats.counters.get("net_subscribes") == 1
+        task.cancel()
+        await sc.close()
+        await srv.stop()
+
+    asyncio.run(scenario())
+
+
+def test_gateway_nm_front_and_webgw_sse_relay():
+    """The remaining front plumbing: a STOCK node-webserver conn on
+    the fabric gateway answers byte-equal to the gateway's REST edge
+    (through the same cache entry), and the per-server REST gateway
+    (webgw) relays the server's binary subscription stream as SSE."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.subs import read_sse_events
+    from gyeeta_tpu.net.webgw import WebGateway
+    from gyeeta_tpu.sim.nodeweb import NodeWebSim
+
+    rt, sim = _mk_rt()
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        gw = FabricGateway([(host, port)], poll_s=0.05)
+        gh, gp = await gw.start()
+        snap_tick = rt.snapshot.tick
+        await _until(lambda: gw.fabric_tick >= snap_tick,
+                     msg="tick discovery")
+
+        # --- NM front: stock dialect through the edge cache
+        nm = NodeWebSim()
+        await nm.connect(gh, gp)
+        opts = {"maxrecs": 50, "sortcol": "qps5s", "sortdir": "desc"}
+        out_nm = await nm.query_web("svcstate", options=opts)
+        assert out_nm.get("nrecs", 0) > 0
+        assert gw.stats.counters.get(
+            "gw_queries|edge=nm,verb=web_json", 0) >= 1
+        await nm.close()
+
+        # --- webgw SSE relay: /v1/subscribe rides the SERVER's
+        # COMM_SUBSCRIBE_CMD stream over a dedicated upstream conn
+        web = WebGateway(host, port)
+        wh, wp = await web.start()
+        reader, writer = await asyncio.open_connection(wh, wp)
+        writer.write(b"GET /v1/subscribe?subsys=hostlist&maxrecs=32 "
+                     b"HTTP/1.1\r\nHost: s\r\n\r\n")
+        await writer.drain()
+        head = await reader.readuntil(b"\r\n\r\n")
+        assert b"200" in head.split(b"\r\n", 1)[0]
+        events: list = []
+
+        async def rd():
+            async for ev in read_sse_events(reader):
+                events.append(ev)
+
+        task = asyncio.create_task(rd())
+        await _until(lambda: events, msg="relay initial full")
+        held = D.apply_event(None, events[0])
+        _feed(rt, sim)
+        rt.run_tick()
+        n = len(events)
+        await srv.push_subscriptions()
+        await _until(lambda: len(events) > n, msg="relay delta")
+        held = D.apply_event(held, events[-1])
+        fresh = rt.query({"subsys": "hostlist", "maxrecs": 32,
+                          "consistency": "snapshot"})
+        assert json.dumps(held) == json.dumps(
+            json.loads(json.dumps(fresh)))
+        task.cancel()
+        writer.close()
+        await web.stop()
+        await gw.stop()
+        await srv.stop()
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------- backlog-aware admission control
+
+
+class _StubIngest:
+    def __init__(self):
+        self.frac = 0.0
+
+    def ring_backlog_frac(self):
+        return self.frac
+
+
+def test_backlog_aware_throttle():
+    """Satellite: the COMM_THROTTLE controller reads worker-ring
+    backlog — occupancy past the knob throttles trace feeds, ≥0.95
+    holds everything, release counted on the way down."""
+    from gyeeta_tpu.net.server import GytServer
+
+    rt = Runtime(CFG)
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None,
+                        throttle_ring_frac=0.75)
+        stub = _StubIngest()
+        srv._ingest = stub
+        assert srv.throttle_level() == 0
+        stub.frac = 0.80
+        assert srv.throttle_level() == 1
+        stub.frac = 0.97
+        assert srv.throttle_level() == 2
+        # counted transitions through the push path
+        stub.frac = 0.0
+        await srv.push_throttle()
+        assert srv._throttle_level == 0
+        stub.frac = 0.80
+        await srv.push_throttle()
+        assert srv._throttle_level == 1
+        assert rt.stats.counters.get("throttle|feed=trace") == 1
+        stub.frac = 0.97
+        await srv.push_throttle()
+        assert rt.stats.counters.get("throttle|feed=all") == 1
+        stub.frac = 0.1
+        await srv.push_throttle()
+        assert rt.stats.counters.get("throttle_released") == 1
+        assert rt.stats.gauges.get("ingest_ring_backlog_frac") \
+            == pytest.approx(0.1)
+        srv._ingest = None
+        await srv.stop()
+
+    asyncio.run(scenario())
