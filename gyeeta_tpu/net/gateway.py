@@ -265,8 +265,18 @@ class FabricGateway:
                 if new > self._pushed_tick and not self._pushing:
                     self._pushing = True
                     try:
-                        self._pushed_tick = new
                         await self.subs.push_tick()
+                        # only a COMPLETED push advances the mark: a
+                        # failed push retries on the next poll instead
+                        # of silently waiting out the tick, and the
+                        # error must not flag the polled upstream down
+                        self._pushed_tick = new
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:   # noqa: BLE001 — counted
+                        self.stats.bump("gw_push_errors")
+                        log.exception("subscription push failed at "
+                                      "tick %d", new)
                     finally:
                         self._pushing = False
             except asyncio.CancelledError:
@@ -352,6 +362,13 @@ class FabricGateway:
                 # alias under ITS tick too, so the next lookup at that
                 # tick hits
                 self._cache_put((st, key), ent)
+                if st < tick:
+                    # lagging replica: keep ONLY the (st, key) alias —
+                    # parking the stale render under the current tick
+                    # would serve last tick's data for the whole tick
+                    # and single-flight would never re-render it from
+                    # a caught-up replica
+                    self._cache.pop(ck, None)
             elif st is None:
                 # uncacheable response shape (no snaptick: local
                 # subsystems, strong reads) — do not serve it across
@@ -370,32 +387,43 @@ class FabricGateway:
                 fut.exception()     # mark retrieved (no loop warning)
 
     # ------------------------------------------------------ peer exchange
-    async def _peer_conn(self, peer):
+    async def _peer_post_one(self, peer, body: bytes):
         ent = self._peer_conns.get(peer)
         if ent is None:
             ent = self._peer_conns[peer] = [None, None,
                                             asyncio.Lock()]
-        if ent[1] is None or ent[1].is_closing():
-            reader, writer = await asyncio.open_connection(*peer)
-            ent[0], ent[1] = reader, writer
-        return ent
-
-    async def _peer_post_one(self, peer, body: bytes):
-        ent = await self._peer_conn(peer)
-        reader, writer = ent[0], ent[1]
-        writer.write(
-            f"POST /gw/peer HTTP/1.1\r\nHost: gw\r\n"
-            f"Content-Type: application/json\r\n"
-            f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
-        await writer.drain()
-        head = await reader.readuntil(b"\r\n\r\n")
-        status = int(head.split()[1])
-        clen = 0
-        for ln in head.decode("latin1").split("\r\n"):
-            if ln.lower().startswith("content-length:"):
-                clen = int(ln.split(":", 1)[1])
-        payload = await reader.readexactly(clen) if clen else b""
-        return status, payload
+        # one request in flight per peer conn: responses arrive in
+        # write order, so an unserialized second reader would consume
+        # the FIRST request's response (cross-query poisoning)
+        async with ent[2]:
+            try:
+                if ent[1] is None or ent[1].is_closing():
+                    ent[0], ent[1] = await asyncio.open_connection(
+                        *peer)
+                reader, writer = ent[0], ent[1]
+                writer.write(
+                    f"POST /gw/peer HTTP/1.1\r\nHost: gw\r\n"
+                    f"Content-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + body)
+                await writer.drain()
+                head = await reader.readuntil(b"\r\n\r\n")
+                status = int(head.split()[1])
+                clen = 0
+                for ln in head.decode("latin1").split("\r\n"):
+                    if ln.lower().startswith("content-length:"):
+                        clen = int(ln.split(":", 1)[1])
+                payload = await reader.readexactly(clen) if clen \
+                    else b""
+                return status, payload
+            except BaseException:
+                # request may be half-done (cancel on timeout, IO
+                # error): the stream position is unknown, so the conn
+                # cannot be reused
+                if ent[1] is not None:
+                    ent[1].close()
+                    ent[0] = ent[1] = None
+                raise
 
     async def _peer_get(self, tick: int, key: str) -> Optional[dict]:
         """Ask each peer for (tick, key); first hit wins. Bounded by
@@ -414,11 +442,10 @@ class FabricGateway:
             except asyncio.CancelledError:
                 raise
             except Exception:       # noqa: BLE001 — peer down/slow
+                # conn teardown happens inside _peer_post_one under
+                # the per-peer lock; closing here could kill a fresh
+                # conn another coroutine just opened
                 self.stats.bump("gw_peer_errors")
-                ent = self._peer_conns.get(peer)
-                if ent is not None and ent[1] is not None:
-                    ent[1].close()
-                    ent[0] = ent[1] = None
         return None
 
     async def _serve_peer(self, obj: dict):

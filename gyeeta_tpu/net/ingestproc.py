@@ -754,17 +754,18 @@ class IngestSupervisor:
         admission controller's overload signal (``net/server.py``
         throttles agents BEFORE the drop-oldest rings shed). Reads two
         shared-memory words per ring; 0.0 when nothing is spawned."""
-        worst = 0
-        slots = 0
+        worst = 0.0
         for h in self.workers:
-            if h.shm is None:
+            if h.shm is None or not h.shm.slots:
                 continue
-            slots = h.shm.slots
             for s in range(max(1, self.n)):
-                b = h.shm.backlog(s)
-                if b > worst:
-                    worst = b
-        return worst / slots if slots else 0.0
+                # fraction per ring, against ITS OWN capacity — mixing
+                # a global worst count with one worker's slot count
+                # skews the signal under per-worker sizing
+                f = h.shm.backlog(s) / h.shm.slots
+                if f > worst:
+                    worst = f
+        return worst
 
     # -------------------------------------------------------------- drain
     def drain(self, max_slots_per_ring: int = 0) -> int:

@@ -252,42 +252,58 @@ class SubscriptionHub:
                     log.debug("subscription fetch failed for %s: %s",
                               req.get("subsys"), e)
                     continue
-                prev = self._latest(key)
-                tick = resp.get("snaptick")
-                if prev is not None and prev[0] == tick:
-                    continue                 # no advance for this key
-                ev = None
-                if prev is not None:
-                    ev, db, fb = D.compute_event(prev[1], resp,
-                                                 self.max_ratio)
-                    self.stats.bump("gw_delta_bytes", db)
-                    self.stats.bump("gw_full_bytes", fb)
-                    if ev["t"] == "delta":
-                        self.stats.bump("gw_deltas_pushed")
-                    else:
-                        self.stats.bump("gw_resyncs")
-                full_ev = None
-                for sub in list(grp.values()):
-                    if prev is not None and sub.last_tick == prev[0] \
-                            and ev is not None:
-                        out = ev
-                    elif sub.last_tick == tick:
-                        continue
-                    else:
-                        # late joiner / missed a tick: full resync
-                        if full_ev is None:
-                            full_ev = D.full_event(resp)
-                            self.stats.bump("gw_resyncs")
-                        out = full_ev
-                    try:
-                        await sub.send(out)
-                        sub.last_tick = tick
-                        sent += 1
-                        self.stats.bump("gw_sub_events")
-                    except Exception:       # noqa: BLE001 — dead conn
-                        self.stats.bump("gw_sub_dropped")
-                        self.unsubscribe(sub.sid)
-                self._push_version(key, resp)
+                try:
+                    sent += await self._push_key(key, grp, resp)
+                except Exception as e:      # noqa: BLE001 — counted
+                    # malformed response / diff failure: contain it to
+                    # THIS key — the remaining subscriptions still get
+                    # their tick, and the watcher must not mark the
+                    # upstream down for it
+                    self.stats.bump("gw_sub_push_errors")
+                    log.debug("subscription push failed for %s: %s",
+                              req.get("subsys"), e)
+        return sent
+
+    async def _push_key(self, key, grp, resp) -> int:
+        """Diff + deliver one subscribed query's new version. Raises
+        on malformed responses — push_tick contains that per key."""
+        sent = 0
+        prev = self._latest(key)
+        tick = resp.get("snaptick")
+        if prev is not None and prev[0] == tick:
+            return 0                     # no advance for this key
+        ev = None
+        if prev is not None:
+            ev, db, fb = D.compute_event(prev[1], resp,
+                                         self.max_ratio)
+            self.stats.bump("gw_delta_bytes", db)
+            self.stats.bump("gw_full_bytes", fb)
+            if ev["t"] == "delta":
+                self.stats.bump("gw_deltas_pushed")
+            else:
+                self.stats.bump("gw_resyncs")
+        full_ev = None
+        for sub in list(grp.values()):
+            if prev is not None and sub.last_tick == prev[0] \
+                    and ev is not None:
+                out = ev
+            elif sub.last_tick == tick:
+                continue
+            else:
+                # late joiner / missed a tick: full resync
+                if full_ev is None:
+                    full_ev = D.full_event(resp)
+                    self.stats.bump("gw_resyncs")
+                out = full_ev
+            try:
+                await sub.send(out)
+                sub.last_tick = tick
+                sent += 1
+                self.stats.bump("gw_sub_events")
+            except Exception:           # noqa: BLE001 — dead conn
+                self.stats.bump("gw_sub_dropped")
+                self.unsubscribe(sub.sid)
+        self._push_version(key, resp)
         return sent
 
 

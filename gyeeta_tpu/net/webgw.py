@@ -260,8 +260,21 @@ class WebGateway:
                     f"event: {ev.get('t', 'message')}\n"
                     f"data: {_json.dumps(ev)}\n\n".encode())
                 await writer.drain()
-        except (ConnectionError, OSError, RuntimeError):
-            pass                       # either side hung up / errored
+        except RuntimeError as e:
+            # upstream rejected the subscription (bad filter,
+            # capacity): relay it as an SSE error event — mirroring
+            # FabricGateway._sse_subscribe — so the client can tell a
+            # rejection from an empty stream
+            try:
+                writer.write(
+                    f"event: error\n"
+                    f"data: {_json.dumps({'error': str(e)})}\n\n"
+                    .encode())
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        except (ConnectionError, OSError):
+            pass                       # either side hung up
         finally:
             await sc.close()
 

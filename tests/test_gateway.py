@@ -399,3 +399,177 @@ def test_backlog_aware_throttle():
         await srv.stop()
 
     asyncio.run(scenario())
+
+
+# --------------------------------------------------- review regressions
+
+
+def test_peer_exchange_serializes_per_conn():
+    """Concurrent misses share ONE peer conn; without the per-peer
+    lock the second reader races the first and can consume the wrong
+    response (cross-query cache poisoning) or tear the conn down with
+    a concurrent-readuntil RuntimeError. N concurrent peer gets for
+    DISTINCT keys must each return their own body, zero peer errors."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+
+    dead = ("127.0.0.1", 9)             # never polled successfully
+
+    async def scenario():
+        gw1 = FabricGateway([dead], poll_s=3600.0)
+        h1, p1 = await gw1.start()
+        for i in range(12):
+            gw1._cache_put(
+                (5, f"k{i}"), ["ok", {"i": i, "snaptick": 5}, None])
+        gw2 = FabricGateway([dead], peers=[(h1, p1)], poll_s=3600.0,
+                            peer_timeout_s=5.0)
+        outs = await asyncio.gather(
+            *[gw2._peer_get(5, f"k{i}") for i in range(12)])
+        assert [o["i"] for o in outs] == list(range(12))
+        assert gw2.stats.counters.get("gw_peer_errors", 0) == 0
+        assert gw2.stats.counters.get("gw_peer_hits") == 12
+        await gw1.stop()
+
+    asyncio.run(scenario())
+
+
+def test_lagging_replica_not_cached_under_current_tick():
+    """A lagging replica's render must not be parked under the
+    CURRENT fabric tick: it stays available under ITS snaptick only,
+    so the next current-tick request re-renders from a caught-up
+    replica instead of serving last tick's data all tick long."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.query.normalize import request_key
+
+    async def scenario():
+        gw = FabricGateway([("127.0.0.1", 9)])
+        gw.upstreams[0].tick = 7        # fabric tick, no watcher task
+        calls = []
+
+        async def fake(req):
+            calls.append(req)
+            t = 6 if len(calls) == 1 else 7     # lags, then catches up
+            return {"snaptick": t, "nrecs": 1, "recs": [{"n": t}]}
+
+        gw._upstream_query = fake
+        q = {"subsys": "svcstate"}
+        k = request_key(q)
+        out1 = await gw.query(dict(q))
+        assert out1["snaptick"] == 6
+        assert (7, k) not in gw._cache and (6, k) in gw._cache
+        # current-tick request re-renders (replica caught up) …
+        out2 = await gw.query(dict(q))
+        assert out2["snaptick"] == 7 and len(calls) == 2
+        # … and THAT render is cached for the rest of the tick
+        out3 = await gw.query(dict(q))
+        assert out3 is out2 and len(calls) == 2
+
+    asyncio.run(scenario())
+
+
+def test_push_tick_contains_malformed_key():
+    """A malformed response for ONE subscribed key (diff raises) must
+    not abort delivery for the remaining keys, and the key retries on
+    the next tick instead of being skipped silently."""
+    from gyeeta_tpu.net.subs import SubscriptionHub
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    async def scenario():
+        tick = {"n": 0}
+
+        async def fetch(req):
+            t = tick["n"]
+            if req["subsys"] == "bad" and t == 1:
+                # recs entry that is not a dict → _key_of raises
+                return {"snaptick": t, "nrecs": 1,
+                        "recs": ["not-a-dict"]}
+            return {"snaptick": t, "nrecs": 1,
+                    "recs": [{"hostid": "h", "v": t}]}
+
+        hub = SubscriptionHub(fetch, Stats())
+        got_a: list = []
+        got_b: list = []
+
+        async def send_a(ev):
+            got_a.append(ev)
+
+        async def send_b(ev):
+            got_b.append(ev)
+
+        await hub.subscribe({"subsys": "bad"}, send_a)
+        await hub.subscribe({"subsys": "svcstate"}, send_b)
+        tick["n"] = 1
+        sent = await hub.push_tick()    # must not raise
+        assert sent == 1                # "bad" contained, b delivered
+        assert len(got_b) == 2 and got_b[-1]["snaptick"] == 1
+        assert hub.stats.counters.get("gw_sub_push_errors") == 1
+        # next tick the failed key recovers (version history intact)
+        tick["n"] = 2
+        sent = await hub.push_tick()
+        assert sent == 2
+        assert got_a[-1]["snaptick"] == 2
+
+    asyncio.run(scenario())
+
+
+def test_ring_backlog_frac_per_ring_capacity():
+    """The admission-control signal keys each ring's backlog against
+    ITS OWN capacity — mixing the global worst count with one worker's
+    slot count under-reports when workers are sized differently."""
+    from gyeeta_tpu.net.ingestproc import IngestSupervisor
+
+    class _Shm:
+        def __init__(self, slots, backlogs):
+            self.slots = slots
+            self._b = backlogs
+
+        def backlog(self, s):
+            return self._b[s]
+
+    class _H:
+        def __init__(self, shm):
+            self.shm = shm
+
+    class _Pool:
+        n = 2
+        ring_backlog_frac = IngestSupervisor.ring_backlog_frac
+
+    pool = _Pool()
+    # worst COUNT (8) lives on the big worker, worst FRACTION (2/8)
+    # on the small one
+    pool.workers = [_H(_Shm(8, [2, 1])), _H(_Shm(64, [8, 4]))]
+    assert pool.ring_backlog_frac() == pytest.approx(0.25)
+    pool.workers = [_H(None), _H(_Shm(0, [0, 0]))]
+    assert pool.ring_backlog_frac() == 0.0
+
+
+def test_webgw_sse_relay_surfaces_rejection():
+    """A subscription the upstream rejects (QS_ERROR) must reach the
+    SSE client as an ``event: error`` block — not a silent close that
+    is indistinguishable from an empty stream."""
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.webgw import WebGateway
+
+    rt, _sim = _mk_rt()
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        web = WebGateway(host, port)
+        wh, wp = await web.start()
+        reader, writer = await asyncio.open_connection(wh, wp)
+        writer.write(b"GET /v1/subscribe?subsys=nonexistent "
+                     b"HTTP/1.1\r\nHost: s\r\n\r\n")
+        await writer.drain()
+        head = await reader.readuntil(b"\r\n\r\n")
+        assert b"200" in head.split(b"\r\n", 1)[0]
+        body = await reader.read()      # stream closes after error
+        assert b"event: error" in body
+        blk = [b for b in body.split(b"\n\n") if b.strip()][-1]
+        data = [ln for ln in blk.split(b"\n")
+                if ln.startswith(b"data:")][0]
+        assert json.loads(data[5:])["error"]
+        writer.close()
+        await web.stop()
+        await srv.stop()
+
+    asyncio.run(scenario())
