@@ -44,11 +44,11 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 PHASE_TIMEOUT = {"fold_toy": 1500, "fold_ns": 2700,
                  "feed_toy": 900, "feed_ns": 1500,
                  "feed_toy_wal": 900, "topk_recover": 900,
-                 "compact": 1200, "timeview_aggr": 900,
-                 "snap_pingpong": 900}
+                 "compact": 1200, "compact_par": 2400,
+                 "timeview_aggr": 900, "snap_pingpong": 900}
 PHASE_ORDER = ("fold_toy", "fold_ns", "feed_ns", "feed_toy",
                "feed_toy_wal", "topk_recover", "compact",
-               "timeview_aggr", "snap_pingpong")
+               "compact_par", "timeview_aggr", "snap_pingpong")
 
 
 def _geometry(which: str):
@@ -531,6 +531,127 @@ def _bench_compact(cfg, sim, dep_pairs: int, dep_edges: int) -> dict:
     return out
 
 
+def _bench_compact_par(cfg, dep_pairs: int, dep_edges: int) -> dict:
+    """Distributed compaction scaling (ISSUE 14): one 4-shard WAL
+    (host-disjoint per-shard streams, two sealed halves per shard)
+    replayed by the parallel compactor at 1 worker and at 4 workers.
+
+    Methodology (the MULTICHIP_r08 records/worker-CPU-second shape —
+    wall clock cannot scale on a 1-core box, per-worker CPU
+    efficiency can): every worker process replays the FIRST half
+    unmeasured (GYT_COMPACT_WARM_SEQ — fold compiles + cache loads
+    land there), then the measured half's records/CPU-second comes
+    from per-shard rusage deltas inside the worker. Aggregate
+    capacity = Σ per-worker rate; scaling = capacity(4w) /
+    capacity(1w). Gate (ISSUE 14): ≥ 2.5x."""
+    import shutil
+    import tempfile
+
+    from gyeeta_tpu.history.compactproc import ParallelCompactor
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils import journal as J
+    from gyeeta_tpu.utils.config import RuntimeOpts
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    nshards = 4
+    # warm half = exactly one 4-tick window, SEALED into its own
+    # segment (seal_active rotates): the warm pass replays only below
+    # that bound, emits a durable resume shard, and the measured pass
+    # replays ONLY the second half
+    warm_ticks, meas_ticks = 4, 8
+    chunks_per_tick = 16
+    tmp = tempfile.mkdtemp(prefix="gyt_bench_cpar_")
+    wal = os.path.join(tmp, "wal")
+    hosts_per = max(4, cfg.n_hosts // nshards)
+    warm_seq = None
+    produced = 0
+    for s in range(nshards):
+        sub = os.path.join(wal, f"shard_{s:02d}")
+        sim = ParthaSim(n_hosts=hosts_per, n_svcs=8, seed=70 + s,
+                        host_base=s * hosts_per)
+        j = J.Journal(sub, backlog_max_bytes=1 << 30)
+        j.append(sim.name_frames(), hid=s * hosts_per, tick=0)
+        for t in range(warm_ticks):
+            for _ in range(chunks_per_tick):
+                j.append(sim.conn_frames(cfg.conn_batch)
+                         + sim.resp_frames(cfg.resp_batch),
+                         hid=s * hosts_per, tick=t)
+        bound = j.seal_active()
+        warm_seq = bound if warm_seq is None else max(warm_seq, bound)
+        for t in range(warm_ticks, warm_ticks + meas_ticks):
+            for _ in range(chunks_per_tick):
+                j.append(sim.conn_frames(cfg.conn_batch)
+                         + sim.resp_frames(cfg.resp_batch),
+                         hid=s * hosts_per, tick=t)
+                produced += cfg.conn_batch + cfg.resp_batch
+        j.close()
+
+    total_ticks = warm_ticks + meas_ticks
+    os.environ["GYT_COMPACT_WARM_SEQ"] = str(warm_seq)
+    os.environ["GYT_COMPACT_WARM_TICK"] = str(warm_ticks)
+    # persistent XLA cache OFF for the worker processes: the 0.4.x
+    # line heap-corrupts ("double free or corruption", reproduced
+    # cache-on/never cache-off) under the worker's compile-then-
+    # replay-then-recompact interleaving — the same bug class PR 4's
+    # chaos e2e pins the cache off for. The warm half absorbs the
+    # full compile cost, so the MEASURED rusage stays steady-state.
+    old_cache = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = ""
+    legs = {}
+    try:
+        for procs in (1, nshards):
+            opts = RuntimeOpts(
+                dep_pair_capacity=dep_pairs,
+                dep_edge_capacity=dep_edges,
+                hist_shard_dir=os.path.join(tmp, f"sh{procs}"),
+                hist_window_ticks=4)
+            pc = ParallelCompactor(cfg, opts, procs, journal_dir=wal,
+                                   shard_dir=opts.hist_shard_dir,
+                                   stats=Stats())
+            rep = pc.compact_once(upto_tick=total_ticks)
+            pc.close()
+            legs[procs] = rep
+    finally:
+        os.environ.pop("GYT_COMPACT_WARM_SEQ", None)
+        os.environ.pop("GYT_COMPACT_WARM_TICK", None)
+        if old_cache is not None:
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = old_cache
+
+    def capacity(rep, workers):
+        # per-worker rate over the measured half; procs=1 runs every
+        # shard in ONE worker (Σrec/Σcpu), procs=4 one shard each
+        per = rep["per_shard"]
+        if workers == 1:
+            cpu = sum(v["cpu_s"] for v in per.values())
+            rec = sum(v["records"] for v in per.values())
+            return rec / max(cpu, 1e-9)
+        return sum(v["records"] / max(v["cpu_s"], 1e-9)
+                   for v in per.values())
+
+    cap1 = capacity(legs[1], 1)
+    cap4 = capacity(legs[nshards], nshards)
+    out = {
+        "scaling_1_to_4": round(cap4 / max(cap1, 1e-9), 3),
+        "aggregate_ev_per_cpu_s_1w": round(cap1),
+        "aggregate_ev_per_cpu_s_4w": round(cap4),
+        "records_measured": legs[1]["records"],
+        "produced_events": produced,
+        "windows": legs[1]["windows"],
+        "wall_serialized_1w_s": legs[1]["secs"],
+        "wall_serialized_4w_s": legs[nshards]["secs"],
+        "per_shard_4w": legs[nshards]["per_shard"],
+        "note": ("records/worker-CPU-second methodology "
+                 "(MULTICHIP_r08): 1-core host serializes workers, so "
+                 "aggregate capacity is Σ per-worker rate, not wall "
+                 "clock; warm half excluded via GYT_COMPACT_WARM_SEQ"),
+    }
+    print(f"bench[compact_par]: 1w {cap1:,.0f} ev/cpu-s → "
+          f"{nshards}w Σ {cap4:,.0f} ev/cpu-s "
+          f"(x{out['scaling_1_to_4']})", file=sys.stderr, flush=True)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _bench_timeview_aggr() -> dict:
     """Windowed COLUMN aggregation, old vs new (ISSUE 9 satellite /
     ROADMAP history item (a)): the keyed python loop vs the np.unique
@@ -711,6 +832,9 @@ def _run_phase(phase: str) -> dict:
     if phase == "compact":
         cfg, sim, dp, de = _geometry("toy")
         return _bench_compact(cfg, sim, dp, de)
+    if phase == "compact_par":
+        cfg, _sim, dp, de = _geometry("toy")
+        return _bench_compact_par(cfg, dp, de)
     if phase == "timeview_aggr":
         return _bench_timeview_aggr()
     if phase == "snap_pingpong":
@@ -731,6 +855,7 @@ _PHASE_METRIC = {"fold_toy": "rate", "fold_ns": "rate",
                  "feed_toy_wal": "rate",
                  "topk_recover": "recover_ms_per_tick",
                  "compact": "replay_ev_per_sec",
+                 "compact_par": "scaling_1_to_4",
                  "timeview_aggr": "speedup",
                  "snap_pingpong": "ratio_on_vs_off"}
 
@@ -897,6 +1022,12 @@ def _orchestrate(platform: str | None, degraded: bool,
         if "rate" in ns:
             result["compact"]["replay_vs_ns_fold"] = round(
                 cp["replay_ev_per_sec"] / ns["rate"], 4)
+    cpp = phases.get("compact_par", {})
+    if "scaling_1_to_4" in cpp:
+        # distributed compaction row (ISSUE 14): 1→4 replay worker
+        # aggregate capacity ratio, records/worker-CPU-second
+        # methodology (gate ≥ 2.5x)
+        result["compact_par"] = dict(cpp)
     pp = phases.get("snap_pingpong", {})
     if "ratio_on_vs_off" in pp:
         # snapshot ping-pong prototype row (ISSUE-10 satellite): copy
@@ -928,6 +1059,7 @@ def _orchestrate(platform: str | None, degraded: bool,
     failed = [p for p, v in phases.items()
               if "rate" not in v and "recover_ms_per_tick" not in v
               and "replay_ev_per_sec" not in v
+              and "scaling_1_to_4" not in v
               and "speedup" not in v
               and "ratio_on_vs_off" not in v]
     if failed:

@@ -243,7 +243,18 @@ class AlertManager:
             cols, base = cols_cache[ckey]
             tree = self._trees.get(f"def:{ad.name}") \
                 or criteria.parse(ad.filter)
-            mask = base & criteria.evaluate(tree, cols, ad.subsys)
+            try:
+                mask = base & criteria.evaluate(tree, cols, ad.subsys)
+            except KeyError:
+                if not ad.window:
+                    raise
+                # a windowed QUANTILE criterion over shards without
+                # delta panels: the field was omitted from the window
+                # columns (never approximated) — skip COUNTED, exactly
+                # like a not-yet-existing window, instead of one stale
+                # store breaking the whole alert pass
+                self.stats["nwindow_skipped"] += 1
+                continue
             hits = set(np.nonzero(mask)[0].tolist())
 
             inhibited = self._inhibited(ad)

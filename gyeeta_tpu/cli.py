@@ -354,12 +354,17 @@ def _cmd_compact(argv) -> None:
     ap.add_argument("--upto-tick", type=int, default=None,
                     help="also tick past the last chunk's stamp (only "
                     "sound when the producer is stopped)")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="N>=1: parallel compaction — N replay worker "
+                    "processes over disjoint WAL shard groups into a "
+                    "parted shard store (needs a sharded WAL; N <= "
+                    "shard count). 0 = single replay runtime")
     args = ap.parse_args(argv)
 
     from gyeeta_tpu.utils import config as C
     if args.what == "list":
-        from gyeeta_tpu.history.shards import ShardStore
-        store = ShardStore(args.shard_dir)
+        from gyeeta_tpu.history.shards import open_shard_store
+        store = open_shard_store(args.shard_dir)
         out = {"pos": store.position(), "tick": store.tick(),
                "shards": store.shards()}
         json.dump(out, sys.stdout, indent=2)
@@ -372,10 +377,16 @@ def _cmd_compact(argv) -> None:
         args.config, hist_shard_dir=args.shard_dir,
         **({"hist_window_ticks": args.window_ticks}
            if args.window_ticks is not None else {}))
-    from gyeeta_tpu.history.compactor import Compactor
     from gyeeta_tpu.utils.selfstats import Stats
-    c = Compactor(cfg, opts, journal_dir=args.journal_dir,
-                  shard_dir=args.shard_dir, stats=Stats())
+    if args.procs >= 1:
+        from gyeeta_tpu.history.compactproc import ParallelCompactor
+        c = ParallelCompactor(cfg, opts, args.procs,
+                              journal_dir=args.journal_dir,
+                              shard_dir=args.shard_dir, stats=Stats())
+    else:
+        from gyeeta_tpu.history.compactor import Compactor
+        c = Compactor(cfg, opts, journal_dir=args.journal_dir,
+                      shard_dir=args.shard_dir, stats=Stats())
     try:
         rep = c.compact_once(upto_tick=args.upto_tick)
     finally:

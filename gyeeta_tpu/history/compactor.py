@@ -44,7 +44,7 @@ from typing import Optional
 
 import numpy as np
 
-from gyeeta_tpu.history import shards as SH
+from gyeeta_tpu.history import shards as SH, winquant as WQ
 from gyeeta_tpu.history.timeview import aggregate_window_columns
 from gyeeta_tpu.utils import journal as J
 
@@ -81,12 +81,18 @@ class Compactor:
     def __init__(self, cfg, opts, *, journal=None,
                  journal_dir: Optional[str] = None,
                  shard_dir: Optional[str] = None,
-                 runtime_factory=None, stats=None, clock=None):
+                 runtime_factory=None, stats=None, clock=None,
+                 upto_seq=None):
         self.cfg = cfg
         self.opts = opts
         self.window_ticks = max(1, int(opts.hist_window_ticks))
         self.journal = journal            # live Journal (seal + floor);
         #                                   None = offline dir read
+        # journal-less bound: a parallel-compaction worker reads files
+        # another process's live journal owns — it must stop at the
+        # sealed bound the supervisor snapshotted, exactly as a live
+        # journal object's sealed_upto() would bound it
+        self._upto_seq = upto_seq
         self.journal_dir = journal_dir or opts.journal_dir
         if not self.journal_dir:
             raise ValueError("compaction needs a journal dir (the WAL "
@@ -99,6 +105,9 @@ class Compactor:
         self._clock = clock or time.time
         self._rt = None
         self._pos: Optional[tuple] = None   # in-memory WAL resume point
+        # monotone-leaf snapshots at the last emit: the per-window
+        # delta base (winquant). None = engine state is all-zero.
+        self._delta_base: Optional[dict] = None
         self._win_t0: Optional[float] = None
         self._win_t1: Optional[float] = None
         self._last_t: Optional[float] = None
@@ -152,6 +161,11 @@ class Compactor:
 
         rt.state = unflatten(data["state"], rt.state)
         rt.dep = unflatten(data["dep"], rt.dep)
+        # the resumed shard's monotone leaves ARE the delta base: the
+        # next window's delta is state-at-next-emit − this state
+        self._delta_base = {name: WQ.leaf_of(rt.state, name)
+                            .astype(np.float64)
+                            for name in WQ.DELTA_SPECS}
         rt._tick_no = int(ent["tick1"])
         rt._td_dirty = True
         if hasattr(rt, "_pressures"):
@@ -236,7 +250,7 @@ class Compactor:
         if seal and self.journal is not None:
             self.journal.seal_active()
         upto = self.journal.sealed_upto() \
-            if self.journal is not None else None
+            if self.journal is not None else self._upto_seq
         if upto is not None and not isinstance(upto, (list, tuple)) \
                 and J.sharded_subdirs(self.journal_dir):
             upto = None                    # layout mismatch: read all
@@ -310,6 +324,14 @@ class Compactor:
             else (self._last_t if self._last_t is not None
                   else self._clock())
         t0 = self._win_t0 if self._win_t0 is not None else t1
+        # per-window sketch deltas: end-state minus the last emit's
+        # base for every monotone loghist leaf — the mergeable partial
+        # aggregates true windowed quantiles sum (winquant module doc)
+        deltas, self._delta_base, diag = WQ.extract_deltas(
+            self.cfg, rt.state, columns, self._delta_base)
+        for k, v in diag.items():
+            if v:
+                self.stats.bump(k, v)
         with self.stats.timeit("compact_emit"):
             ent = self.store.add_shard(
                 level="raw", tick0=tick0, tick1=tick1, t0=t0, t1=t1,
@@ -317,7 +339,8 @@ class Compactor:
                 dep_leaves=jax.tree_util.tree_leaves(rt.dep),
                 columns=columns,
                 cfg_fp=_cfg_fingerprint(self.cfg),
-                wal_pos=self._pos_serial())
+                wal_pos=self._pos_serial(),
+                deltas=deltas)
         self.stats.gauge("compact_shard_bytes", float(ent["bytes"]))
         self._last_t = t1
         self._win_t0 = self._win_t1 = None
@@ -364,7 +387,10 @@ class Compactor:
     def _merge_group(self, members: list, dst: str) -> None:
         """Merge consecutive shards into one downsampled shard: newest
         member's sketch state (monotone sketches — the merge IS the
-        newest state), per-entity aggregated columns."""
+        newest state), per-entity aggregated columns, and SUMMED
+        per-window delta panels (deltas are additive partial
+        aggregates, so a downsampled shard answers windowed quantiles
+        at full fidelity — only the window boundaries coarsen)."""
         data = [self.store.load(e) for e in members]
         columns = {}
         for subsys in SH.SNAP_SUBSYS:
@@ -373,6 +399,25 @@ class Compactor:
             if parts:
                 columns[subsys] = aggregate_window_columns(subsys,
                                                            parts)
+        deltas = {}
+        names = {n for d in data for n in d.get("deltas", {})}
+        for name in names:
+            parts = [(d["deltas"][name]["key"],
+                      d["deltas"][name]["hist"])
+                     for d in data if name in d.get("deltas", {})]
+            if len(parts) != len(data):
+                continue     # a member predates delta panels: a merged
+                #              panel would silently undercount — omit it
+                #              (windowed quantiles reject, never lie)
+            keys, hist = WQ.merge_delta_rows(parts)
+            ent = {"key": keys, "hist": hist.astype(np.float32)}
+            if WQ.DELTA_SPECS[name].td and len(keys):
+                m, w, vmin, vmax = WQ.td_from_hist(
+                    hist, WQ.spec_of(self.cfg, name),
+                    int(getattr(self.cfg, "td_capacity", 64)))
+                ent["td"] = {"means": m, "weights": w,
+                             "vmin": vmin, "vmax": vmax}
+            deltas[name] = ent
         newest = data[-1]
         self.store.add_shard(
             level=dst,
@@ -381,7 +426,7 @@ class Compactor:
             t1=max(e["t1"] for e in members),
             state_leaves=newest["state"], dep_leaves=newest["dep"],
             columns=columns, cfg_fp=newest["meta"].get("cfg", ""),
-            wal_pos=None, replaces=members)
+            wal_pos=None, replaces=members, deltas=deltas)
         self.stats.bump("compact_downsampled")
 
     # ------------------------------------------------------------- daemon

@@ -26,11 +26,13 @@ by construction.
 from __future__ import annotations
 
 import collections
+import functools
 from typing import Optional
 
 import jax
 import numpy as np
 
+from gyeeta_tpu.history import winquant as WQ
 from gyeeta_tpu.query import api, fieldmaps
 
 # suffix durations accepted by at=/window= ("90" = seconds)
@@ -61,6 +63,34 @@ def parse_when(v, now: float):
             return now - parse_dur(s[1:])
         return float(s)
     return float(v)
+
+
+@functools.lru_cache(maxsize=8)
+def _plain_recover_fn(cfg):
+    """Memoized read-only recovery program for a PLAIN (single-slab)
+    state pytree — what a parted store's per-part snapshots decode
+    with, independent of the serving runtime's kind (the serving tier
+    may be a mesh; the parts were replayed by per-shard runtimes)."""
+    from gyeeta_tpu.engine import step
+    return jax.jit(lambda s: step.heavy_recover(cfg, s))
+
+
+def plain_recover(cfg, state) -> dict:
+    """Heavy-hitter recovery + bound-honest merge over one plain state
+    pytree (the single-runtime half of :func:`hist_recover`)."""
+    from gyeeta_tpu.sketch import invertible
+
+    out = {k: np.asarray(v)
+           for k, v in _plain_recover_fn(cfg)(state).items()}
+    evicted = float(out["evicted"])
+    total = float(out["total_mass"])
+    err_term = invertible.cms_error_term(total, cfg.cms_width)
+    hot_thresh = (cfg.hh_hot_frac * total
+                  if cfg.hh_hot_frac > 0 else 0.0)
+    flows, recovered, _hot = invertible.merge_recovered_np(
+        out, err_term, hot_thresh)
+    return {"flows": flows, "err_term": err_term, "total_mass": total,
+            "evicted": evicted, "recovered_keys": len(recovered)}
 
 
 def hist_recover(rt, state) -> dict:
@@ -277,13 +307,23 @@ class HistSnapshot:
     ``flowstate``, the dep views, …) re-enters the live pytree shape
     and is produced by the unchanged column providers."""
 
-    def __init__(self, rt, store, ent: dict):
+    def __init__(self, rt, store, ent: dict, *, state_tpl=None,
+                 dep_tpl=None, plain: bool = False):
         self.rt = rt
         self.store = store
         self.ent = ent
         self._data = None
         self._state = None
         self._dep = None
+        # parted stores materialize PER-PART snapshots: the part was
+        # replayed by a plain per-shard Runtime, so its leaves unflatten
+        # against a plain-geometry template (shape metadata only, via
+        # jax.eval_shape — never the serving runtime's possibly-stacked
+        # mesh state) and state-backed subsystems decode via the plain
+        # column providers even when the serving runtime is a mesh
+        self._state_tpl = state_tpl
+        self._dep_tpl = dep_tpl
+        self._plain = plain
         from gyeeta_tpu.utils.colcache import ColumnCache
         self._cols = ColumnCache()        # per-snapshot memo (immutable
         #                                   shard → version never bumps)
@@ -317,16 +357,38 @@ class HistSnapshot:
     @property
     def state(self):
         if self._state is None:
-            self._state = self._unflatten(self._load()["state"],
-                                          self.rt.state)
+            tpl = self._state_tpl if self._state_tpl is not None \
+                else self.rt.state
+            self._state = self._unflatten(self._load()["state"], tpl)
         return self._state
 
     @property
     def dep(self):
         if self._dep is None:
-            self._dep = self._unflatten(self._load()["dep"],
-                                        self.rt.dep)
+            tpl = self._dep_tpl if self._dep_tpl is not None \
+                else self.rt.dep
+            self._dep = self._unflatten(self._load()["dep"], tpl)
         return self._dep
+
+    def delta_names(self) -> set:
+        """Delta panel names this shard carries."""
+        return set(self._load().get("deltas", {}))
+
+    def deltas(self, names) -> Optional[dict]:
+        """Per-window delta panels (winquant) for ``names``, or None
+        when ANY is absent (a shard predating delta panels — windowed
+        quantiles must reject, never approximate)."""
+        stored = self._load().get("deltas", {})
+        if any(n not in stored for n in names):
+            return None
+        return {n: (stored[n]["key"], stored[n]["hist"])
+                for n in names}
+
+    def recover(self) -> dict:
+        """Heavy-hitter recovery over this snapshot's state."""
+        if self._plain:
+            return plain_recover(self.rt.cfg, self.state)
+        return hist_recover(self.rt, self.state)
 
     def columns(self, subsys: str):
         """The ``columns_fn`` contract of ``api.execute``."""
@@ -340,12 +402,12 @@ class HistSnapshot:
             cols, live = self.columns("svcstate")
             return api.svcsumm_from_svc(cols, live, self.rt.names)
         if subsys == "topk":
-            rec = hist_recover(self.rt, self.state)
+            rec = self.recover()
             return api.heavy_topk_columns(
                 rec["flows"], svc=self.columns("svcstate"),
                 trace=self.columns("tracereq"))
         rt = self.rt
-        if hasattr(rt, "_merged_columns_state"):   # ShardedRuntime
+        if not self._plain and hasattr(rt, "_merged_columns_state"):
             return rt._merged_columns_state(subsys, self.state,
                                             self.dep, self._cols)
         if subsys in api._COLUMNS_OF or subsys in api._DEP_COLUMNS_OF:
@@ -354,6 +416,180 @@ class HistSnapshot:
         raise ValueError(
             f"subsystem {subsys!r} is not available historically "
             "(registry/CRUD-backed views are not shard-persisted)")
+
+
+def _merge_group_rows(cols: dict, mask, keycols: list,
+                      sumcols: list) -> tuple:
+    """Group concatenated per-part rows by identity: ``sumcols`` sum,
+    everything else keeps the first observation; first-appearance
+    order. The cross-part merge for views whose entity can appear in
+    more than one part (a dep edge reported by hosts on two shards)."""
+    mask = np.asarray(mask, bool)
+    idx = np.nonzero(mask)[0]
+    if len(idx) == 0:
+        return {c: np.asarray(cols[c])[:0] for c in cols}, \
+            np.zeros(0, bool)
+    keys = np.asarray(cols[keycols[0]])[idx].astype("U")
+    for c in keycols[1:]:
+        keys = np.char.add(np.char.add(keys, WQ.KEY_SEP),
+                           np.asarray(cols[c])[idx].astype("U"))
+    uniq, first, inv = np.unique(keys, return_index=True,
+                                 return_inverse=True)
+    order = np.argsort(first, kind="stable")
+    rank = np.empty(len(uniq), np.int64)
+    rank[order] = np.arange(len(uniq))
+    g = rank[inv]
+    n = len(uniq)
+    first_rows = idx[first[order]]
+    out = {}
+    for c in cols:
+        src = np.asarray(cols[c])
+        if c in sumcols:
+            acc = np.zeros(n, np.float64)
+            np.add.at(acc, g, src[idx].astype(np.float64))
+            out[c] = acc
+        else:
+            out[c] = src[first_rows]
+    return out, np.ones(n, bool)
+
+
+class PartedSnapshot:
+    """One parted-store window materialized WITHOUT funneling through
+    a single process-wide state: each ``part_NN`` sub-shard (the
+    output of one parallel replay worker over one WAL shard) opens as
+    its own plain :class:`HistSnapshot`, and queries merge at column
+    level — concatenation for entity-disjoint panels (hosts hash to
+    exactly one WAL shard, so their services/tasks/APIs are
+    part-disjoint), bound-honest summation for the global sketch views
+    (per-part flow values are upper bounds; the merged value sums them
+    and sums their error bounds)."""
+
+    def __init__(self, tv: "TimeView", store, ent: dict):
+        self.tv = tv
+        self.rt = tv.rt
+        self.ent = ent
+        state_tpl, dep_tpl = tv._part_templates()
+        self.snaps = [
+            HistSnapshot(tv.rt, store.parts[i], pe,
+                         state_tpl=state_tpl, dep_tpl=dep_tpl,
+                         plain=True)
+            for i, pe in enumerate(ent["parts"])]
+        self._memo: dict = {}
+        self._rec = None
+
+    # ----------------------------------------------------------- topk
+    def recover(self) -> dict:
+        if self._rec is not None:
+            return self._rec
+        agg: dict = {}
+        err = ev = tot = 0.0
+        nrec = 0
+        for s in self.snaps:
+            rec = s.recover()
+            err += rec["err_term"]
+            ev += rec["evicted"]
+            tot += rec["total_mass"]
+            nrec += rec["recovered_keys"]
+            for rid, v, eb, src in rec["flows"]:
+                cur = agg.get(rid)
+                if cur is None:
+                    agg[rid] = [v, eb, src]
+                else:
+                    cur[0] += v
+                    cur[1] += eb
+        rows = sorted(((rid, v, eb, src)
+                       for rid, (v, eb, src) in agg.items()),
+                      key=lambda r: (-r[1], r[0]))
+        self._rec = {"flows": rows, "err_term": err,
+                     "total_mass": tot, "evicted": ev,
+                     "recovered_keys": nrec}
+        return self._rec
+
+    def delta_names(self) -> set:
+        names = None
+        for s in self.snaps:
+            got = s.delta_names()
+            names = got if names is None else names & got
+        return names or set()
+
+    def deltas(self, names) -> Optional[dict]:
+        per = [s.deltas(names) for s in self.snaps]
+        if any(p is None for p in per):
+            return None
+        out = {}
+        for n in names:
+            out[n] = WQ.merge_delta_rows([p[n] for p in per])
+        return out
+
+    # -------------------------------------------------------- columns
+    def columns(self, subsys: str):
+        got = self._memo.get(subsys)
+        if got is None:
+            got = self._memo[subsys] = self._columns(subsys)
+        return got
+
+    def _concat(self, parts: list) -> tuple:
+        cols = {k: np.concatenate(
+            [np.asarray(p[0][k]) for p in parts])
+            for k in parts[0][0]}
+        mask = np.concatenate([np.asarray(p[1], bool) for p in parts])
+        return cols, mask
+
+    def _columns(self, subsys: str):
+        from gyeeta_tpu.query.lazycols import LazyCols
+
+        if subsys == fieldmaps.SUBSYS_CLUSTERSTATE:
+            parts = [s.columns(subsys) for s in self.snaps]
+            out = {}
+            for c in parts[0][0]:
+                vals = [float(np.asarray(p[0][c])[0]) if len(p[0][c])
+                        else 0.0 for p in parts]
+                out[c] = np.array([float(np.sum(vals))])
+            nh = float(out.get("nhosts", np.zeros(1))[0])
+            bad = float(out.get("nbad", np.zeros(1))[0]) \
+                + float(out.get("nsevere", np.zeros(1))[0])
+            out["issue_frac"] = np.array([bad / max(nh, 1.0)])
+            return out, np.ones(1, bool)
+        if subsys == "svcsumm":
+            cols, live = self.columns("svcstate")
+            return api.svcsumm_from_svc(cols, live, self.rt.names)
+        if subsys == "topk":
+            rec = self.recover()
+            return api.heavy_topk_columns(
+                rec["flows"], svc=self.columns("svcstate"),
+                trace=self.columns("tracereq"))
+        if subsys == fieldmaps.SUBSYS_FLOWSTATE:
+            rec = self.recover()
+            n = len(rec["flows"])
+            ids = np.empty(n, object)
+            ids[:] = [r[0] for r in rec["flows"]]
+            cols = {"flowid": ids,
+                    "bytes": np.array([r[1] for r in rec["flows"]],
+                                      np.float64),
+                    "evictedbytes": np.full(n, float(rec["evicted"]))}
+            return cols, np.ones(n, bool)
+        if subsys == fieldmaps.SUBSYS_SVCMESH:
+            raise ValueError(
+                "svcmesh is not available over parted history stores "
+                "(mesh clusters cannot be labelled per part)")
+        parts = [s.columns(subsys) for s in self.snaps]
+        parts = [((p[0].full() if isinstance(p[0], LazyCols)
+                   else p[0]), p[1]) for p in parts]
+        cols, mask = self._concat(parts)
+        # views whose entity may be reported from several parts merge
+        # by identity with summed flow stats (everything panel-backed
+        # is part-disjoint and stays concatenated)
+        if subsys == fieldmaps.SUBSYS_SVCDEP:
+            return _merge_group_rows(cols, mask, ["cliid", "serid"],
+                                     ["nconn", "bytes"])
+        if subsys == fieldmaps.SUBSYS_ACTIVECONN:
+            return _merge_group_rows(
+                cols, mask, ["svcid"],
+                ["nclients", "nconn", "bytes", "nsvccli"])
+        if subsys == fieldmaps.SUBSYS_CLIENTCONN:
+            return _merge_group_rows(cols, mask, ["cliid"],
+                                     ["nservers", "nconn", "bytes"])
+        return cols, mask
 
 
 class _WindowColumns:
@@ -366,6 +602,7 @@ class _WindowColumns:
         self.ents = ents
         self.start, self.end = start, end
         self._memo: dict = {}
+        self._deltas: dict = {}       # panel name → merged (keys, hist)
 
     def columns(self, subsys: str):
         got = self._memo.get(subsys)
@@ -373,23 +610,85 @@ class _WindowColumns:
             got = self._memo[subsys] = self._columns(subsys)
         return got
 
+    # -------------------------------------------------- quantile merge
+    def delta_support(self) -> set:
+        """Delta panels EVERY covering shard carries — the windowed
+        quantile sources this window can honor."""
+        avail = set(WQ.DELTA_SPECS)
+        for e in self.ents:
+            avail &= self.tv.snap(e).delta_names()
+        return avail
+
+    def _merged_deltas(self, panel: str):
+        got = self._deltas.get(panel)
+        if got is None:
+            parts = []
+            for e in self.ents:
+                d = self.tv.snap(e).deltas([panel])
+                if d is None:
+                    return None
+                parts.append(d[panel])
+            got = self._deltas[panel] = WQ.merge_delta_rows(parts)
+        return got
+
+    def _apply_window_quantiles(self, subsys: str, cols, mask):
+        """Override quantile fields with TRUE windowed quantiles: the
+        covering windows' delta histograms sum per entity (the exact
+        mergeable-summary merge) and each field reads its quantile off
+        the merged histogram. Fields whose delta panel is missing
+        (pre-delta shards) are REMOVED from the output — and counted —
+        never served as the old silent mean-of-snapshots."""
+        qf = WQ.QUANTILE_FIELDS.get(subsys)
+        if not qf or not isinstance(cols, dict) or not len(mask):
+            if qf and isinstance(cols, dict):
+                # empty window: fields stay, values are vacuous
+                pass
+            return cols
+        panels = {f.panel for f in qf.values()}
+        merged = {p: self._merged_deltas(p) for p in panels}
+        row_keys = None
+        for field, f in qf.items():
+            fd = fieldmaps.field_map(subsys).get(field)
+            if fd is None or fd.col not in cols:
+                continue
+            if merged[f.panel] is None:
+                cols.pop(fd.col, None)
+                self.tv.rt.stats.bump("windowed_quant_fields_omitted")
+                continue
+            if row_keys is None:
+                row_keys = WQ.composite_keys(
+                    WQ.DELTA_SPECS[f.panel].subsys, cols,
+                    np.arange(len(mask)))
+            spec = WQ.spec_of(self.tv.rt.cfg, f.panel)
+            hists = WQ.lookup_hists(row_keys, merged[f.panel],
+                                    spec.nbuckets)
+            if f.q is None:
+                vals = WQ.np_hist_mean(hists, spec)
+            else:
+                vals = WQ.np_hist_quantiles(
+                    hists, spec, [f.q])[:, 0]
+            cols[fd.col] = np.asarray(
+                vals, np.float64) / WQ.DELTA_SPECS[f.panel].scale
+        return cols
+
     def _columns(self, subsys: str):
         if subsys == "topk":
             return self._topk_window()
         parts = [self.tv.snap(e).columns(subsys) for e in self.ents]
-        return aggregate_window_columns(subsys, parts)
+        cols, mask = aggregate_window_columns(subsys, parts)
+        cols = self._apply_window_quantiles(subsys, cols, mask)
+        return cols, mask
 
     def _topk_window(self):
-        rt = self.tv.rt
         end_snap = self.tv.snap(self.ents[-1])
-        rec_end = hist_recover(rt, end_snap.state)
+        rec_end = end_snap.recover()
         base_ent = self.tv.store.resolve_at(self.start)
         rows = [(rid, v, eb, "window")
                 for rid, v, eb, _src in rec_end["flows"]]
         if base_ent is not None \
                 and base_ent["t1"] <= self.start \
                 and base_ent["tick1"] < self.ents[-1]["tick1"]:
-            rec_base = hist_recover(rt, self.tv.snap(base_ent).state)
+            rec_base = self.tv.snap(base_ent).recover()
             base = {rid: (v, eb)
                     for rid, v, eb, _s in rec_base["flows"]}
             rows = []
@@ -425,13 +724,36 @@ class TimeView:
         # the snapshot LRU is shared by the serving loop and (via the
         # off-loop query executor / windowed alertdefs) worker threads
         self._lock = threading.Lock()
+        self._tpl = None              # parted per-part unflatten
+        #                               templates (metadata-only)
 
-    def snap(self, ent: dict) -> HistSnapshot:
-        key = ent["file"]
+    def _part_templates(self) -> tuple:
+        """Plain-geometry (state, dep) templates for per-part snapshot
+        materialization — jax.eval_shape only (no allocation, and
+        NEVER the serving runtime's live buffers)."""
+        if self._tpl is None:
+            from gyeeta_tpu.engine import aggstate
+            from gyeeta_tpu.parallel import depgraph as dg
+            cfg, opts = self.rt.cfg, self.rt.opts
+            self._tpl = (
+                jax.eval_shape(lambda: aggstate.init(cfg)),
+                jax.eval_shape(lambda: dg.init(
+                    opts.dep_pair_capacity, opts.dep_edge_capacity)))
+        return self._tpl
+
+    def snap(self, ent: dict):
+        if "parts" in ent:
+            key = ("parted", ent["level"], ent["tick0"], ent["tick1"],
+                   tuple(pe["file"] for pe in ent["parts"]))
+        else:
+            key = ent["file"]
         with self._lock:
             s = self._snaps.get(key)
             if s is None:
-                s = HistSnapshot(self.rt, self.store, ent)
+                if "parts" in ent:
+                    s = PartedSnapshot(self, self.store, ent)
+                else:
+                    s = HistSnapshot(self.rt, self.store, ent)
                 self._snaps[key] = s
                 while len(self._snaps) > self.MAX_SNAPS:
                     self._snaps.popitem(last=False)
@@ -458,6 +780,7 @@ class TimeView:
                               columns_fn=snap.columns)
             out["at"] = ent["t1"]
             out["tick"] = ent["tick1"]
+            self._cover(out)
             return out
         newest = self.store.newest("raw") or (
             self.store.shards()[-1] if self.store.shards() else None)
@@ -477,11 +800,50 @@ class TimeView:
             raise ValueError(
                 f"no history shards sample [{start}, {end}]")
         win = _WindowColumns(self, ents, start, end)
+        self._check_windowed_quantiles(opts, win)
         out = api.execute(rt.cfg, None, opts, names=rt.names,
                           columns_fn=win.columns)
         out["window"] = [start, end]
         out["shards"] = len(ents)
+        self._cover(out)
         return out
+
+    def _cover(self, out: dict) -> None:
+        """Stamp the store's durable coverage onto a historical
+        response: the gateway's no-TTL historical cache admits an
+        entry only when the requested instant/range lies INSIDE
+        coverage at render time — interior resolutions are immutable
+        (compaction only appends windows; downsampling preserves the
+        delta merges), while a request past the frontier would
+        re-resolve once the next window lands."""
+        newest = self.store.shards()
+        if newest:
+            out["hist_cover_tick"] = max(e["tick1"] for e in newest)
+            out["hist_cover_t"] = max(e["t1"] for e in newest)
+
+    def _check_windowed_quantiles(self, opts, win: "_WindowColumns"
+                                  ) -> None:
+        """Validation-time gate for windowed quantile fields: a
+        request that REFERENCES one (filter/sort/projection/aggr) is
+        REJECTED — counted — when any covering shard lacks its delta
+        panel. Silently serving the old mean-of-snapshots would be a
+        wrong number wearing a quantile's name; an implicit full
+        projection instead omits the field (also counted)."""
+        qf = WQ.QUANTILE_FIELDS.get(opts.subsys)
+        if not qf:
+            return
+        refs = WQ.referenced_fields(opts) & set(qf)
+        if not refs:
+            return
+        avail = win.delta_support()
+        bad = sorted(f for f in refs if qf[f].panel not in avail)
+        if bad:
+            self.rt.stats.bump("windowed_quant_rejected")
+            raise ValueError(
+                f"windowed quantile field(s) {bad} need per-window "
+                "sketch deltas, but the covering shards predate delta "
+                "panels (recompact, or drop the field) — windowed "
+                "quantiles are never approximated from snapshot means")
 
     def window_columns_for(self, subsys: str, window) -> tuple:
         """Windowed (cols, mask) for alertdef evaluation — the

@@ -159,6 +159,13 @@ class FabricGateway:
         # (tick, key) -> ["ok", resp, body|None] | ["neg", msg, expiry]
         self._cache: OrderedDict = OrderedDict()
         self._flight: dict = {}           # (tick, key) -> Future
+        # historical edge cache: at=/window= responses whose anchor
+        # lies INSIDE compaction coverage are immutable by
+        # construction — no TTL, invalidation never (LRU bound only);
+        # keyed by normalized request + aliased under the RESOLVED
+        # tick (gyt_gw_hist_cache_* family)
+        self._hist_cache: OrderedDict = OrderedDict()
+        self.hist_cache_max = _envi("GYT_GW_HIST_CACHE_MAX", 4096)
         self._pushed_tick = -1
         self._pushing = False
         import secrets as _sec
@@ -308,6 +315,93 @@ class FabricGateway:
             ent[2] = json.dumps(ent[1]).encode()
         return ent[2]
 
+    # --------------------------------------------------- historical cache
+    @staticmethod
+    def _hist_anchor(req: dict) -> Optional[str]:
+        """Classify a historical request's anchor: ``"abs"`` — the
+        instant/range is spelled absolutely, so the answer can be
+        immutable; ``"rel"`` — anchored to "now"/the newest shard
+        (``at=-15m``, ``window=`` without ``tend``), re-resolving
+        every pass; None — not a historical request."""
+        if any(k in req for k in ("op", "multiquery")):
+            return None
+        if "at" in req:
+            v = req["at"]
+            if isinstance(v, str) and v.strip().startswith("-"):
+                return "rel"
+            return "abs"
+        if "tstart" in req:
+            return "abs" if "tend" in req else "rel"
+        if "window" in req:
+            return "abs" if "tend" in req else "rel"
+        if "tend" in req:
+            return "rel"
+        return None
+
+    @staticmethod
+    def _hist_immutable(req: dict, resp: dict) -> bool:
+        """An absolute historical answer is immutable ONLY when its
+        anchor resolved INSIDE compaction coverage at render time: a
+        request past the frontier (or before the earliest shard)
+        would re-resolve once compaction appends/retires windows.
+        Coverage rides the response (``timeview._cover``)."""
+        cover_t = resp.get("hist_cover_t")
+        cover_tick = resp.get("hist_cover_tick")
+        if cover_t is None:
+            return False
+        if "at" in req:
+            v = req["at"]
+            if isinstance(v, str) and v.strip().startswith("tick:"):
+                try:
+                    return int(v.strip()[5:]) <= int(cover_tick)
+                except (TypeError, ValueError):
+                    return False
+            try:
+                ts = float(v)
+            except (TypeError, ValueError):
+                return False
+            # resolved-behind (resp.at <= ts): genuine "state at ts";
+            # resolved-AHEAD means the before-everything fallback fired
+            return resp.get("at", ts + 1) <= ts <= float(cover_t)
+        end = req.get("tend")
+        try:
+            return end is not None and float(end) <= float(cover_t)
+        except (TypeError, ValueError):
+            return False
+
+    def _hist_put(self, key: str, resp: dict) -> None:
+        self._hist_cache[key] = resp
+        self._hist_cache.move_to_end(key)
+        while len(self._hist_cache) > self.hist_cache_max:
+            self._hist_cache.popitem(last=False)
+
+    async def _hist_query(self, req: dict, anchor: str) -> dict:
+        key = request_key(req)
+        if anchor == "abs":
+            ent = self._hist_cache.get(key)
+            if ent is not None:
+                self.stats.bump("gw_hist_cache_hits")
+                self._hist_cache.move_to_end(key)
+                return ent
+            self.stats.bump("gw_hist_cache_misses")
+        else:
+            self.stats.bump("gw_hist_cache_uncacheable")
+        resp = await self._upstream_query(dict(req))
+        cacheable = self._hist_immutable(req, resp)
+        if anchor == "abs" and cacheable:
+            self._hist_put(key, resp)
+        # alias every interior at= answer under its RESOLVED tick so
+        # any spelling of the same instant (epoch seconds, a relative
+        # -15m that landed here, tick:N) shares one entry forever
+        tick = resp.get("tick")
+        if tick is not None and "at" in req and cacheable:
+            alias = request_key({**{k: v for k, v in req.items()
+                                    if k != "at"},
+                                 "at": f"tick:{int(tick)}"})
+            if alias != key:
+                self._hist_put(alias, resp)
+        return resp
+
     async def query(self, req: dict) -> dict:
         """THE query entry every front shares. Cache-eligible requests
         collapse onto the (fabric-tick, normalized-key) edge cache with
@@ -315,6 +409,10 @@ class FabricGateway:
         to a replica. Raises RuntimeError with the server's error
         envelope, ConnectionError when no upstream answers."""
         if not self._cacheable(req):
+            anchor = self._hist_anchor(req)
+            if anchor is not None \
+                    and req.get("consistency") != "strong":
+                return await self._hist_query(req, anchor)
             self.stats.bump("gw_queries_uncached")
             return await self._upstream_query(req)
         key = request_key(req)

@@ -84,10 +84,22 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
         for k, v in gwm.items():
             lines.append(f"  {k:<36} {v}")
 
+    # history tier (compactor + windowed quantiles, OPERATIONS.md
+    # "Distributed compaction & windowed quantiles")
+    hist = {k: v for k, v in sorted(c.items())
+            if str(k).startswith(("compact_", "wd_",
+                                  "windowed_quant"))}
+    if hist:
+        lines.append("")
+        lines.append("history compaction:")
+        for k, v in hist.items():
+            lines.append(f"  {k:<36} {v}")
+
     plain = {k: v for k, v in sorted(c.items())
              if not str(k).startswith(("engine_", "journal_", "wal_",
                                        "throttle", "query_", "queries",
-                                       "snapshot", "gw_"))
+                                       "snapshot", "gw_", "compact_",
+                                       "wd_", "windowed_quant"))
              and isinstance(v, (int, float))}
     lines.append("")
     hdr = f"  {'counter':<36} {'total':>12}"

@@ -127,16 +127,20 @@ class ShardedRuntime:
         # queries on every edge with zero edge-specific code
         self.timeview = None
         if self.opts.hist_shard_dir:
-            from gyeeta_tpu.history.shards import ShardStore
+            from gyeeta_tpu.history.shards import open_shard_store
             from gyeeta_tpu.history.timeview import TimeView
-            store = ShardStore(self.opts.hist_shard_dir,
-                               stats=self.stats)
+            store = open_shard_store(self.opts.hist_shard_dir,
+                                     stats=self.stats)
             self.timeview = TimeView(self, store, clock=clock)
             if self.journal is not None:
                 pos = store.position()
                 if pos:
                     from gyeeta_tpu.utils.journal import floors_of
-                    self.journal.set_truncate_floor(floors_of(pos))
+                    fl = floors_of(pos)
+                    if isinstance(fl, list) \
+                            and not hasattr(self.journal, "shards"):
+                        fl = min(fl) if fl else 0
+                    self.journal.set_truncate_floor(fl)
                 else:
                     self.journal.set_truncate_floor(0)
         # per-host sweep-seq high-water marks (the WAL dedup state)

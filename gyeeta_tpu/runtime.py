@@ -228,15 +228,24 @@ class Runtime:
         # position so checkpoints never delete unconsumed segments.
         self.timeview = None
         if self.opts.hist_shard_dir:
-            from gyeeta_tpu.history.shards import ShardStore
+            from gyeeta_tpu.history.shards import open_shard_store
             from gyeeta_tpu.history.timeview import TimeView
-            store = ShardStore(self.opts.hist_shard_dir,
-                               stats=self.stats)
+            store = open_shard_store(self.opts.hist_shard_dir,
+                                     stats=self.stats)
             self.timeview = TimeView(self, store, clock=clock)
             if self.journal is not None:
                 pos = store.position()
-                self.journal.set_truncate_floor(
-                    int(pos[0]) if pos else 0)
+                if pos:
+                    from gyeeta_tpu.utils.journal import floors_of
+                    fl = floors_of(pos)
+                    if isinstance(fl, list) \
+                            and not hasattr(self.journal, "shards"):
+                        # per-shard floors against a flat journal
+                        # (layout drift): hold back at the lowest
+                        fl = min(fl) if fl else 0
+                    self.journal.set_truncate_floor(fl)
+                else:
+                    self.journal.set_truncate_floor(0)
         # per-host sweep-seq high-water marks (NOTIFY_SWEEP_SEQ): the
         # WAL dedup state — checkpointed, rebuilt by replay, echoed to
         # reconnecting agents so resend + replay never double-counts

@@ -114,10 +114,19 @@ class Daemon:
         # with BOTH a journal (the source) and a shard dir (the sink).
         self.compactor = None
         if opts.hist_shard_dir and self.rt.journal is not None:
-            from gyeeta_tpu.history.compactor import Compactor
-            self.compactor = Compactor(self.rt.cfg, opts,
-                                       journal=self.rt.journal,
-                                       stats=self.rt.stats)
+            if getattr(args, "compact_procs", 0) >= 1:
+                # distributed compaction: N replay worker processes
+                # over disjoint WAL shard groups (parted store layout)
+                from gyeeta_tpu.history.compactproc import \
+                    ParallelCompactor
+                self.compactor = ParallelCompactor(
+                    self.rt.cfg, opts, args.compact_procs,
+                    journal=self.rt.journal, stats=self.rt.stats)
+            else:
+                from gyeeta_tpu.history.compactor import Compactor
+                self.compactor = Compactor(self.rt.cfg, opts,
+                                           journal=self.rt.journal,
+                                           stats=self.rt.stats)
         elif opts.hist_shard_dir:
             log.warning("--shard-dir set without --journal-dir: the "
                         "WAL is the history source — time-travel "
@@ -476,6 +485,12 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
     ap.add_argument("--compact-interval", type=float, default=None,
                     help="compaction daemon cadence in seconds "
                     "(default 30)")
+    ap.add_argument("--compact-procs", type=int, default=0,
+                    help="N>=1: distributed compaction — N replay "
+                    "worker PROCESSES over disjoint WAL shard groups "
+                    "into a parted shard store (needs --shards; N <= "
+                    "shard count). 0 (default) = the in-process "
+                    "single-runtime compactor")
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
 

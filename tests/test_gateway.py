@@ -466,6 +466,71 @@ def test_lagging_replica_not_cached_under_current_tick():
     asyncio.run(scenario())
 
 
+def test_gateway_historical_cache_no_ttl():
+    """Satellite (ISSUE 14): at=/window= responses are immutable by
+    construction when their anchor resolves INSIDE compaction
+    coverage — the gateway caches them with NO TTL, keyed by the
+    normalized request and aliased under the RESOLVED tick; relative
+    anchors and beyond-coverage requests pass through (counted)."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+
+    async def scenario():
+        gw = FabricGateway([("127.0.0.1", 9)])
+        calls = []
+
+        async def fake(req):
+            calls.append(dict(req))
+            if "at" in req:
+                return {"nrecs": 1, "recs": [{"x": len(calls)}],
+                        "at": 100.0, "tick": 4,
+                        "hist_cover_t": 200.0, "hist_cover_tick": 8}
+            return {"nrecs": 1, "recs": [{"x": len(calls)}],
+                    "window": [50.0, 120.0], "shards": 2,
+                    "hist_cover_t": 200.0, "hist_cover_tick": 8}
+
+        gw._upstream_query = fake
+        # tick-pinned at= inside coverage: renders once, hits forever
+        q = {"subsys": "svcstate", "at": "tick:4"}
+        r1 = await gw.query(dict(q))
+        r2 = await gw.query(dict(q))
+        assert r2 is r1 and len(calls) == 1
+        assert gw.stats.counters["gw_hist_cache_hits"] == 1
+        # resolved-tick aliasing: an epoch spelling resolving to the
+        # same tick renders once, then the tick:N spelling HITS it
+        qa = {"subsys": "hoststate", "at": 150.0}
+        await gw.query(dict(qa))
+        assert len(calls) == 2
+        qb = {"subsys": "hoststate", "at": "tick:4"}
+        rb = await gw.query(dict(qb))
+        assert len(calls) == 2 and rb["tick"] == 4
+        # absolute window (tend inside coverage): cached, no TTL
+        qw = {"subsys": "svcstate", "window": "1m", "tend": 120.0}
+        w1 = await gw.query(dict(qw))
+        w2 = await gw.query(dict(qw))
+        assert w2 is w1 and len(calls) == 3
+        # relative window (anchored to the newest shard): uncacheable
+        qr = {"subsys": "svcstate", "window": "1m"}
+        await gw.query(dict(qr))
+        await gw.query(dict(qr))
+        assert len(calls) == 5
+        assert gw.stats.counters["gw_hist_cache_uncacheable"] == 2
+        # beyond coverage: the answer would re-resolve once the next
+        # window lands — rendered every time, never cached
+        qf = {"subsys": "svcstate", "at": 999.0}
+        await gw.query(dict(qf))
+        await gw.query(dict(qf))
+        assert len(calls) == 7
+        # strong consistency opts out of the historical cache
+        qs = {"subsys": "svcstate", "at": "tick:4",
+              "consistency": "strong"}
+        await gw.query(dict(qs))
+        assert len(calls) == 8
+        assert gw.stats.counters["gw_queries_uncached"] == 1
+        gw._render.close()
+
+    asyncio.run(scenario())
+
+
 def test_push_tick_contains_malformed_key():
     """A malformed response for ONE subscribed key (diff raises) must
     not abort delivery for the remaining keys, and the key retries on

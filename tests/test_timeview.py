@@ -334,6 +334,204 @@ def test_windowed_queries_and_alertdef(tmp_path):
     rt.close()
 
 
+def _capture_leaf(rt, name):
+    from gyeeta_tpu.history import winquant as WQ
+    return WQ.leaf_of(rt.state, name).astype(np.float32).copy()
+
+
+def test_windowed_quantiles_match_offline_exact_merge(tmp_path):
+    """ISSUE 14 flagship: ``window=`` p50/p95/p99 equal the quantile
+    of the OFFLINE EXACT MERGE over the same event stream — the
+    monotone resp loghist captured live at every window boundary is
+    that exact merge (per-window delta sums telescope to boundary
+    differences). Checked on svcstate (per-svc resp), tracereq
+    (per-API latency) and taskstate (cpup95), full range AND a
+    single-window partial range."""
+    from gyeeta_tpu.history import winquant as WQ
+    from gyeeta_tpu.query.api import _hex_id
+
+    rt = Runtime(CFG, _opts(tmp_path))
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=41)
+    rt.feed(sim.name_frames())
+    caps = {0: {n: _capture_leaf(rt, n) for n in WQ.DELTA_SPECS}}
+    for _ in range(4):
+        rt.feed(sim.conn_frames(256) + sim.resp_frames(512)
+                + sim.listener_frames() + sim.task_frames()
+                + sim.trace_frames(128))
+        rt.run_tick()
+        if rt._tick_no % 2 == 0:
+            caps[rt._tick_no] = {n: _capture_leaf(rt, n)
+                                 for n in WQ.DELTA_SPECS}
+    svcids = _hex_id(np.asarray(rt.state.tbl.key_hi),
+                     np.asarray(rt.state.tbl.key_lo))
+    c = Compactor(CFG, rt.opts, journal=rt.journal, stats=rt.stats)
+    c.compact_once(seal=True, upto_tick=rt._tick_no)
+
+    def quant(hist, spec, q, scale):
+        # float() before the scale division — the serving path divides
+        # in float64 (np.asarray(vals, float64) / scale)
+        return float(WQ.np_hist_quantiles(
+            np.asarray(hist, np.float32)[None, :], spec,
+            [q])[0, 0]) / scale
+
+    # --- svcstate: per-svc p50/p95/p99 over the full range
+    win = rt.query({"subsys": "svcstate", "window": "1h",
+                    "maxrecs": 100})
+    exp = caps[4]["svc_resp"] - caps[0]["svc_resp"]
+    by_id = {svcids[i]: i for i in range(len(svcids))}
+    checked = 0
+    for r in win["recs"]:
+        i = by_id.get(r["svcid"])
+        if i is None or exp[i].sum() == 0:
+            continue
+        for field, q in (("p99resp5s", 0.99), ("p95resp5s", 0.95),
+                         ("p50resp5d", 0.50)):
+            assert r[field] == pytest.approx(
+                quant(exp[i], CFG.resp_spec, q, 1e3), abs=5e-4), field
+        # p99 >= p95 >= p50: a real quantile set, not a mean
+        assert r["p99resp5s"] >= r["p95resp5s"] >= r["p50resp5d"]
+        checked += 1
+    assert checked >= 8
+
+    # --- partial range (second window only): per-window attribution
+    ents = c.store.shards("raw")
+    mid = (max(ents[0]["t1"], ents[1]["t0"]) + ents[1]["t1"]) / 2.0 \
+        if ents[1]["t0"] > ents[0]["t1"] \
+        else (ents[0]["t1"] + ents[1]["t1"]) / 2.0
+    win2 = rt.query({"subsys": "svcstate", "tstart": mid,
+                     "tend": ents[-1]["t1"] + 1.0, "maxrecs": 100})
+    assert win2["shards"] == 1
+    exp2 = caps[4]["svc_resp"] - caps[2]["svc_resp"]
+    checked = 0
+    for r in win2["recs"]:
+        i = by_id.get(r["svcid"])
+        if i is None or exp2[i].sum() == 0:
+            continue
+        assert r["p99resp5s"] == pytest.approx(
+            quant(exp2[i], CFG.resp_spec, 0.99, 1e3), abs=5e-4)
+        checked += 1
+    assert checked >= 4
+
+    # --- tracereq p99resp: multiset of per-API quantiles must match
+    tr = rt.query({"subsys": "tracereq", "window": "1h",
+                   "maxrecs": 200, "filter": "{ tracereq.nreq > 0 }"})
+    expt = caps[4]["api_resp"] - caps[0]["api_resp"]
+    want = sorted(round(quant(h, CFG.apiresp_spec, 0.99, 1e3), 3)
+                  for h in expt if h.sum() > 0)
+    got = sorted(r["p99resp"] for r in tr["recs"])
+    assert got == pytest.approx(want, abs=5e-4)
+
+    # --- taskstate cpup95 from the task_cpu delta panel
+    tk = rt.query({"subsys": "taskstate", "window": "1h",
+                   "maxrecs": 200})
+    expc = caps[4]["task_cpu"] - caps[0]["task_cpu"]
+    wantc = sorted(round(quant(h, CFG.taskcpu_spec, 0.95, 1.0), 3)
+                   for h in expc if h.sum() > 0)
+    gotc = sorted(r["cpup95"] for r in tk["recs"]
+                  if r["cpup95"] > 0)
+    assert gotc == pytest.approx(
+        [w for w in wantc if w > 0], abs=5e-4)
+
+    # windowed QUANTILE alertdef: p99 criteria over the window fire
+    rt.alerts.add_def({"alertname": "win-p99", "subsys": "svcstate",
+                       "filter": "{ svcstate.p99resp5s > 0 }",
+                       "window": "1h"})
+    fired = rt.alerts.check(rt.state, columns_fn=rt._alert_columns)
+    assert any(a.alertname == "win-p99" for a in fired)
+    c.close()
+    rt.close()
+
+
+def test_windowed_quantile_unsupported_rejected_counted(tmp_path):
+    """Satellite: shards WITHOUT delta panels (pre-ISSUE-14 stores)
+    must REJECT windowed quantile references at validation time —
+    counted — and omit the fields from implicit projections; never
+    serve the old silent mean-of-snapshots."""
+    opts = _opts(tmp_path)
+    store = ShardStore(opts.hist_shard_dir)
+    cols = {"svcid": np.array(["aa", "bb"], object),
+            "svcname": np.array(["s1", "s2"], object),
+            "qps5s": np.array([1.0, 2.0]),
+            "p99resp5s": np.array([10.0, 20.0]),
+            "hostid": np.array([0.0, 1.0])}
+    for k, (t0, t1) in enumerate(((10.0, 20.0), (20.0, 30.0))):
+        store.add_shard(level="raw", tick0=k * 2, tick1=k * 2 + 2,
+                        t0=t0, t1=t1, state_leaves=[], dep_leaves=[],
+                        columns={"svcstate":
+                                 (cols, np.ones(2, bool))},
+                        wal_pos=(0, 100 * (k + 1)))
+    rt = Runtime(CFG, opts)
+    # explicit reference (projection / sort / filter / aggr) → reject
+    for req in (
+            {"columns": ["svcid", "p99resp5s"]},
+            {"sortcol": "p99resp5s"},
+            {"filter": "{ svcstate.p99resp5s > 5 }"},
+            {"aggr": ["max(p99resp5s)"]}):
+        with pytest.raises(ValueError, match="windowed quantile"):
+            rt.query({"subsys": "svcstate", "window": "1h", **req})
+    assert rt.stats.counters["windowed_quant_rejected"] == 4
+    # implicit full projection: field OMITTED (counted), row served
+    out = rt.query({"subsys": "svcstate", "window": "1h",
+                    "maxrecs": 10})
+    assert out["nrecs"] == 2
+    assert all("p99resp5s" not in r for r in out["recs"])
+    assert all(r["qps5s"] > 0 for r in out["recs"])
+    assert rt.stats.counters["windowed_quant_fields_omitted"] > 0
+    # non-quantile references still work
+    f = rt.query({"subsys": "svcstate", "window": "1h",
+                  "sortcol": "qps5s", "maxrecs": 10})
+    assert f["nrecs"] == 2
+    # a windowed QUANTILE alertdef over the delta-less store skips
+    # COUNTED instead of breaking the whole alert pass
+    rt.alerts.add_def({"alertname": "stale-p99", "subsys": "svcstate",
+                       "filter": "{ svcstate.p99resp5s > 1 }",
+                       "window": "1h"})
+    skipped0 = rt.alerts.stats["nwindow_skipped"]
+    fired = rt.alerts.check(rt.state, columns_fn=rt._alert_columns)
+    assert not any(a.alertname == "stale-p99" for a in fired)
+    assert rt.alerts.stats["nwindow_skipped"] == skipped0 + 1
+    rt.close()
+
+
+def test_delta_panel_roundtrip_and_downsample_merge(tmp_path):
+    """Delta panels survive the npz roundtrip (keys, histograms, the
+    derived t-digest) and the raw→mid downsample SUMS them (additive
+    partial aggregates — windowed quantiles keep full fidelity over
+    downsampled shards)."""
+    from gyeeta_tpu.history import winquant as WQ
+
+    opts = _opts(tmp_path, hist_window_ticks=1, hist_mid_every=2,
+                 hist_retain_raw=2, hist_retain_mid=50,
+                 hist_retain_hour=10)
+    rt = Runtime(CFG, opts)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=43)
+    rt.feed(sim.name_frames())
+    _drive(rt, sim, 6)
+    final = _capture_leaf(rt, "svc_resp")
+    c = Compactor(CFG, opts, journal=rt.journal, stats=rt.stats)
+    c.compact_once(seal=True, upto_tick=rt._tick_no)
+    mids = c.store.shards("mid")
+    raws = c.store.shards("raw")
+    assert mids
+    d = c.store.load(mids[0])["deltas"]
+    assert "svc_resp" in d and "td" in d["svc_resp"]
+    assert len(d["svc_resp"]["key"]) == len(d["svc_resp"]["hist"])
+    # td panel: per-row weights equal the histogram mass
+    td = d["svc_resp"]["td"]
+    assert np.allclose(td["weights"].sum(axis=1),
+                       d["svc_resp"]["hist"].sum(axis=1), rtol=1e-5)
+    # sum of EVERY surviving delta panel == the final monotone state
+    # (nothing lost through downsampling)
+    parts = [(c.store.load(e)["deltas"]["svc_resp"]["key"],
+              c.store.load(e)["deltas"]["svc_resp"]["hist"])
+             for e in mids + raws]
+    keys, merged = WQ.merge_delta_rows(parts)
+    assert float(merged.sum()) == pytest.approx(float(final.sum()),
+                                                rel=1e-6)
+    c.close()
+    rt.close()
+
+
 def test_timeview_errors_without_shards(tmp_path):
     rt = Runtime(CFG, RuntimeOpts(dep_pair_capacity=1024,
                                   dep_edge_capacity=512))
@@ -425,12 +623,17 @@ def test_sharded_replay_parity_and_time_travel(tmp_path):
     from gyeeta_tpu.parallel.mesh import make_mesh
     from gyeeta_tpu.parallel.shardedrt import ShardedRuntime
 
+    from gyeeta_tpu.history import winquant as WQ
+    from gyeeta_tpu.query.api import _hex_id
+
     opts = _opts(tmp_path)
     srt = ShardedRuntime(CFG, make_mesh(8), opts)
     sim = ParthaSim(n_hosts=8, n_svcs=4, seed=31)
     srt.feed(sim.name_frames())
+    base_resp = WQ.leaf_of(srt.state, "svc_resp").copy()
     _drive(srt, sim, 4)
     live_state = _leaves(srt.state)
+    live_resp = WQ.leaf_of(srt.state, "svc_resp").copy()
     live_rows = srt.query({"subsys": "svcstate", "maxrecs": 100,
                            "sortcol": "qps5s"})["recs"]
 
@@ -448,6 +651,26 @@ def test_sharded_replay_parity_and_time_travel(tmp_path):
     tk = srt.query({"subsys": "topk", "window": "1h", "maxrecs": 20})
     assert tk["nrecs"] > 0
     assert all("errbound" in r for r in tk["recs"])
+
+    # windowed quantiles on the MESH tier equal the offline exact
+    # merge (the stacked monotone leaf captured live, shard-major)
+    win = srt.query({"subsys": "svcstate", "window": "1h",
+                     "maxrecs": 100})
+    exp = (live_resp - base_resp).astype(np.float32)
+    key_hi = np.asarray(srt.state.tbl.key_hi).reshape(-1)
+    key_lo = np.asarray(srt.state.tbl.key_lo).reshape(-1)
+    by_id = {s: i for i, s in enumerate(_hex_id(key_hi, key_lo))}
+    checked = 0
+    for r in win["recs"]:
+        i = by_id.get(r["svcid"])
+        if i is None or exp[i].sum() == 0:
+            continue
+        want = float(WQ.np_hist_quantiles(
+            exp[i][None, :], CFG.resp_spec, [0.99])[0, 0]) / 1e3
+        assert r["p99resp5s"] == pytest.approx(want, abs=5e-4)
+        assert r["p99resp5s"] >= r["p95resp5s"] >= r["p50resp5d"]
+        checked += 1
+    assert checked >= 8
     c.close()
     srt.close()
 
