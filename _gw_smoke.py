@@ -78,8 +78,12 @@ async def scenario() -> None:
         replicas.append(rt)
         servers.append(srv)
 
+    # hedge_ms=0: this smoke asserts the STRICT fleet-single-render
+    # contract of the edge cache; a hedged read (PR 15) deliberately
+    # spends a second render when the primary is slow — on a loaded
+    # CI box that would trip the exact-miss-count assertion
     gw = FabricGateway([(s.host, s.port) for s in servers],
-                       poll_s=0.05)
+                       poll_s=0.05, hedge_ms=0)
     gh, gp = await gw.start()
     snap_tick = replicas[0].snapshot.tick
     await _until(lambda: gw.fabric_tick >= snap_tick,

@@ -133,6 +133,22 @@ if ! JAX_PLATFORMS=cpu python _chaos_smoke.py; then
     exit 1
 fi
 
+# Fabric fault-domain smoke (ISSUE 15): phase A — 2 replicas + 2 REAL
+# gateway subprocesses with a wedge-capable chaos proxy (gateway
+# SIGKILL mid-subscription → counted resync + byte-equal continuation
+# on the peer, restart resumes from the persisted ring with a DELTA,
+# wedged replica bounded by hedged reads, killed replica opens the
+# circuit breaker — zero surfaced upstream errors throughout); phase
+# B — `serve --shards 2 --ingest-procs 2` subprocess (fresh scoped
+# XLA cache): ingest worker SIGKILL under subscription load with the
+# ring ledger closing EXACTLY, and a compaction-worker death at a
+# shard boundary failing loudly then converging on rerun.
+echo "ci: fabric fault-domain smoke" >&2
+if ! JAX_PLATFORMS=cpu python _fabric_chaos_smoke.py; then
+    echo "ci: FATAL — fabric fault-domain smoke failed" >&2
+    exit 1
+fi
+
 # Fused fold-path smoke: (a) the fused megakernel is the DEFAULT fold
 # path (a regression to the legacy per-subsystem dispatch sequence
 # would silently cost 2-6x fold throughput); (b) GYT_PALLAS=1 on a

@@ -149,6 +149,15 @@ def _cmd_chaos(argv) -> None:
                     "window (drop + refuse all conns)")
     ap.add_argument("--kill-for", type=float, default=0.0,
                     help="kill-window duration (0 = no window)")
+    ap.add_argument("--wedge-at", type=float, default=0.0,
+                    help="seconds after start to open a WEDGE window "
+                    "(stop forwarding both directions, conns stay "
+                    "open — the stalled-not-dead upstream)")
+    ap.add_argument("--wedge-for", type=float, default=0.0,
+                    help="wedge-window duration (0 = no window)")
+    ap.add_argument("--fault-both", action="store_true",
+                    help="also fault the server->client direction "
+                    "(responses / subscription pushes)")
     ap.add_argument("--report-interval", type=float, default=10.0)
     args = ap.parse_args(argv)
 
@@ -436,6 +445,27 @@ def _cmd_gateway(argv) -> None:
     ap.add_argument("--poll-s", type=float, default=None,
                     help="snaptick watch cadence per upstream "
                     "(default GYT_GW_POLL_S or 0.5)")
+    # fault-domain knobs (OPERATIONS.md "Failure domains &
+    # degradation"): circuit breaker, hedged reads, subscription
+    # continuation across restarts
+    ap.add_argument("--gw-down-after", type=int, default=None,
+                    help="consecutive failures before an upstream is "
+                    "marked down (circuit breaker; default "
+                    "GYT_GW_DOWN_AFTER or 3 — never one bad poll)")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="latency budget past which a render hedges "
+                    "to the next-healthiest replica (default "
+                    "GYT_GW_HEDGE_MS or 75; 0 disables)")
+    ap.add_argument("--sub-persist", default=None,
+                    help="append-only file persisting the "
+                    "subscription version ring: a restarted gateway "
+                    "resumes reconnecting subscribers with DELTAS "
+                    "instead of full resyncs (default "
+                    "GYT_GW_SUB_PERSIST or off)")
+    ap.add_argument("--advertise", default=None,
+                    help="the host:port PEERS dial this gateway on "
+                    "(rendezvous key ownership; default the listen "
+                    "address)")
     args = ap.parse_args(argv)
 
     def hp(s):
@@ -448,7 +478,11 @@ def _cmd_gateway(argv) -> None:
                            host=args.listen_host,
                            port=args.listen_port,
                            peers=[hp(p) for p in args.peer],
-                           poll_s=args.poll_s)
+                           poll_s=args.poll_s,
+                           down_after=args.gw_down_after,
+                           hedge_ms=args.hedge_ms,
+                           sub_persist=args.sub_persist,
+                           advertise=args.advertise)
         h, p = await gw.start()
         print(f"fabric gateway on {h}:{p} (REST + GYT + NM) -> "
               f"{len(gw.upstreams)} upstream(s), "

@@ -42,6 +42,23 @@ the back, with the render shared fleet-wide:
   (``query/delta.py``), and pushes the delta to every subscriber —
   REST SSE and GYT binary both.
 
+- **Fault domains** (ISSUE 15): every upstream carries a circuit
+  breaker — EWMA latency + a consecutive-failure count with a
+  K-failure threshold (``--gw-down-after``; ONE bad poll never marks
+  a replica down), half-open probing on a jittered exponential
+  backoff, and per-upstream state on the labeled
+  ``gyt_gw_upstream_state{upstream,state}`` gauge family (flaps
+  counted in ``gyt_gw_upstream_flaps_total{upstream}``). Renders
+  fail over health-ordered — live replicas first, marked-down ones
+  tried LAST rather than never, so a fabric with >=1 live replica
+  never surfaces an upstream error — and a render that exceeds the
+  hedge latency budget (``GYT_GW_HEDGE_MS``) fires the same request
+  at the next-healthiest replica, first response wins (the wedged-
+  not-dead replica case: the breaker only opens on failures, the
+  hedge bounds the latency meanwhile). Subscription state survives
+  gateway restarts via the hub's persisted version ring
+  (``--sub-persist``, ``net/subs.py``).
+
 The gateway is deliberately **jax-free** (it imports the thin-client
 half of the tree only): it can run on any box between the dashboards
 and the replicas, and N gateways scale the query edge without touching
@@ -90,18 +107,94 @@ def _envi(name: str, default: int) -> int:
 
 
 class _Upstream:
-    """One serve replica: a small checkout pool of query conns plus
-    the watcher's last-seen snaptick."""
+    """One serve replica: a small checkout pool of query conns, the
+    watcher's last-seen snaptick, and the circuit-breaker health
+    state — EWMA latency, a consecutive-failure count (K failures
+    before mark-down, never one bad poll), and half-open probing on
+    a jittered exponential backoff."""
 
-    def __init__(self, host: str, port: int, nconns: int):
+    def __init__(self, host: str, port: int, nconns: int,
+                 stats: Optional[Stats] = None, down_after: int = 3,
+                 probe_base_s: float = 1.0, probe_max_s: float = 15.0):
         self.host, self.port = host, int(port)
+        self.label = f"{host}:{int(port)}"
         self.tick = -1
         self.tick_at = 0.0
-        self.up = False
+        self.stats = stats
+        self.state = "up"           # up | down | half_open
+        self.fails = 0              # CONSECUTIVE failures
+        self.ewma_ms: Optional[float] = None
+        self.down_after = max(1, int(down_after))
+        self.probe_base_s = float(probe_base_s)
+        self.probe_max_s = float(probe_max_s)
+        self.backoff_s = self.probe_base_s
+        self.probe_at = 0.0
         self._pool: asyncio.Queue = asyncio.Queue()
         for _ in range(max(1, nconns)):
             self._pool.put_nowait(None)
+        self._gauge_state()
 
+    # ------------------------------------------------------- circuit
+    @property
+    def up(self) -> bool:
+        return self.state == "up"
+
+    def _gauge_state(self) -> None:
+        if self.stats is None:
+            return
+        for st in ("up", "down", "half_open"):
+            self.stats.gauge(
+                f"gw_upstream_state|upstream={self.label},state={st}",
+                1.0 if st == self.state else 0.0)
+        if self.ewma_ms is not None:
+            self.stats.gauge(
+                f"gw_upstream_ewma_ms|upstream={self.label}",
+                round(self.ewma_ms, 3))
+
+    def _set_state(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self._gauge_state()
+
+    def record_ok(self, lat_ms: float) -> None:
+        self.ewma_ms = lat_ms if self.ewma_ms is None \
+            else 0.7 * self.ewma_ms + 0.3 * lat_ms
+        self.fails = 0
+        self.backoff_s = self.probe_base_s
+        if self.state != "up":
+            if self.stats is not None:
+                self.stats.bump("gw_upstream_recoveries"
+                                f"|upstream={self.label}")
+            self._set_state("up")
+        else:
+            self._gauge_state()         # refresh the EWMA gauge
+
+    def record_fail(self) -> None:
+        self.fails += 1
+        if self.state == "up":
+            if self.fails < self.down_after:
+                return                  # the one-bad-poll fix: wait K
+            if self.stats is not None:
+                self.stats.bump("gw_upstream_flaps"
+                                f"|upstream={self.label}")
+            self._set_state("down")
+            self._arm_probe()
+            return
+        # a failed half-open probe (or a failed last-resort attempt):
+        # stay down, back off further
+        self._set_state("down")
+        self.backoff_s = min(self.backoff_s * 2.0, self.probe_max_s)
+        self._arm_probe()
+
+    def _arm_probe(self) -> None:
+        import random as _r
+        self.probe_at = time.monotonic() \
+            + self.backoff_s * (0.5 + _r.random())
+
+    def probe_due(self) -> bool:
+        return self.state != "down" or time.monotonic() >= self.probe_at
+
+    # ---------------------------------------------------------- pool
     async def checkout(self, timeout: float) -> QueryClient:
         qc = await self._pool.get()
         if qc is None:
@@ -133,9 +226,27 @@ class FabricGateway:
                  peer_timeout_s: Optional[float] = None,
                  upstream_conns: Optional[int] = None,
                  upstream_timeout_s: float = 30.0,
-                 write_timeout: float = 10.0):
+                 write_timeout: float = 10.0,
+                 down_after: Optional[int] = None,
+                 hedge_ms: Optional[float] = None,
+                 sub_persist: Optional[str] = None,
+                 advertise: Optional[str] = None):
         self.host, self.port = host, int(port)
         self.stats = stats if stats is not None else Stats()
+        # circuit-breaker + hedge knobs (OPERATIONS.md "Failure
+        # domains & degradation"): K consecutive failures before an
+        # upstream is marked down; latency budget past which a render
+        # hedges to the next-healthiest replica (0 disables hedging)
+        self.down_after = _envi("GYT_GW_DOWN_AFTER", 3) \
+            if down_after is None else int(down_after)
+        self.hedge_ms = _envf("GYT_GW_HEDGE_MS", 75.0) \
+            if hedge_ms is None else float(hedge_ms)
+        self.probe_base_s = _envf("GYT_GW_PROBE_BASE_S", 1.0)
+        self.probe_max_s = _envf("GYT_GW_PROBE_MAX_S", 15.0)
+        # the identity PEERS route to this gateway under (rendezvous
+        # owner hashing needs every fleet member to rank the same
+        # ident for this process its peers dial)
+        self.advertise = advertise or os.environ.get("GYT_GW_ADVERTISE")
         self.poll_s = _envf("GYT_GW_POLL_S", 0.5) \
             if poll_s is None else float(poll_s)
         self.cache_max = _envi("GYT_GW_CACHE_MAX", 4096) \
@@ -148,7 +259,12 @@ class FabricGateway:
             if upstream_conns is None else int(upstream_conns)
         self.upstream_timeout_s = float(upstream_timeout_s)
         self.write_timeout = float(write_timeout)
-        self.upstreams = [_Upstream(h, p, nconns) for h, p in upstreams]
+        self.upstreams = [
+            _Upstream(h, p, nconns, stats=self.stats,
+                      down_after=self.down_after,
+                      probe_base_s=self.probe_base_s,
+                      probe_max_s=self.probe_max_s)
+            for h, p in upstreams]
         if not self.upstreams:
             raise ValueError("gateway needs at least one upstream")
         self.peers = [(h, int(p)) for h, p in peers]
@@ -173,7 +289,10 @@ class FabricGateway:
         from gyeeta_tpu.net.qexec import JsonRenderPool
         self._render = JsonRenderPool(stats=self.stats)
         from gyeeta_tpu.net.subs import SubscriptionHub
-        self.subs = SubscriptionHub(self.query, self.stats)
+        self.subs = SubscriptionHub(
+            self.query, self.stats,
+            persist_path=sub_persist
+            or os.environ.get("GYT_GW_SUB_PERSIST") or None)
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> tuple:
@@ -205,6 +324,7 @@ class FabricGateway:
             if ent[1] is not None:
                 ent[1].close()
         self._peer_conns.clear()
+        self.subs.close()
         self._render.close()
 
     # ------------------------------------------------------------- upstream
@@ -215,32 +335,134 @@ class FabricGateway:
     async def _query_one(self, u: _Upstream, req: dict,
                          timeout: Optional[float] = None) -> dict:
         from gyeeta_tpu.ingest import wire
-        qc = await u.checkout(self.upstream_timeout_s)
+        if u.state == "down" and time.monotonic() >= u.probe_at:
+            # this attempt IS the half-open probe: one request tests
+            # the circuit, success closes it, failure re-arms backoff
+            u._set_state("half_open")       # noqa: SLF001
+        try:
+            qc = await u.checkout(self.upstream_timeout_s)
+        except (ConnectionError, OSError, TimeoutError,
+                asyncio.IncompleteReadError, wire.FrameError):
+            # connect/handshake failure — the COMMON way a replica is
+            # down; it must feed the breaker like a request failure
+            u.record_fail()
+            raise
+        t0 = time.perf_counter()
         try:
             out = await qc.query(req, timeout=timeout)
         except RuntimeError:
-            # server error ENVELOPE: the conn is healthy — reuse it
+            # server error ENVELOPE: the conn (and replica) is healthy
+            # — reuse it, and the circuit records a SUCCESS
             u.checkin(qc)
+            u.record_ok((time.perf_counter() - t0) * 1e3)
             raise
         except (ConnectionError, OSError, TimeoutError,
                 asyncio.IncompleteReadError, wire.FrameError):
             await u.discard(qc)
+            u.record_fail()
+            raise
+        except BaseException:
+            # cancellation (a hedge loser) or unexpected: the conn is
+            # mid-request and can never be reused; NOT a health
+            # signal — a cancelled request says nothing about the
+            # replica
+            await u.discard(qc)
             raise
         u.checkin(qc)
+        u.record_ok((time.perf_counter() - t0) * 1e3)
         return out
 
+    def _ranked(self) -> list:
+        """Failover order: live replicas first (rotated so load
+        spreads; the rotation's successor is the hedge target),
+        half-open probes next, and marked-DOWN replicas LAST rather
+        than never — a fabric with >=1 live replica never surfaces an
+        upstream error, and a fully-down fabric still tries everyone
+        instead of failing by label alone."""
+        ups = sorted((u for u in self.upstreams if u.state == "up"),
+                     key=lambda u: u.ewma_ms or 0.0)
+        half = [u for u in self.upstreams if u.state == "half_open"]
+        down = sorted((u for u in self.upstreams
+                       if u.state == "down"),
+                      key=lambda u: u.probe_at)
+        if len(ups) > 1:
+            self._rr = (self._rr + 1) % len(ups)
+            ups = ups[self._rr:] + ups[:self._rr]
+        return ups + half + down
+
+    def _hedge_budget_s(self, u: _Upstream) -> float:
+        """Latency budget before the hedge fires: the knob floor, or
+        4x the primary's EWMA when traffic has taught us its normal —
+        a loaded-but-healthy replica must not double every render."""
+        return max(self.hedge_ms, 4.0 * (u.ewma_ms or 0.0)) / 1e3
+
+    async def _query_hedged(self, u1: _Upstream, u2: _Upstream,
+                            req: dict) -> dict:
+        """First-response-wins over (primary, next-healthiest): the
+        hedge fires when the primary exceeds the latency budget
+        (counted — the wedged-not-dead replica case, where the
+        breaker sees no failure to open on), or immediately on a fast
+        primary conn failure (plain failover). RuntimeError envelopes
+        win outright — every replica answers them identically."""
+        t1 = asyncio.ensure_future(self._query_one(u1, dict(req)))
+        done, _ = await asyncio.wait({t1},
+                                     timeout=self._hedge_budget_s(u1))
+        if done:
+            exc = t1.exception()
+            if exc is None:
+                return t1.result()
+            if isinstance(exc, RuntimeError):
+                raise exc
+            # primary died fast: just fail over, no hedge needed
+            return await self._query_one(u2, dict(req))
+        self.stats.bump("gw_hedged_requests")
+        t2 = asyncio.ensure_future(self._query_one(u2, dict(req)))
+        pending: set = {t1, t2}
+        winner = None
+        err: Optional[BaseException] = None
+        try:
+            while pending and winner is None:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    exc = t.exception()
+                    if exc is None:
+                        winner = t
+                        break
+                    if isinstance(exc, RuntimeError):
+                        raise exc
+                    err = exc
+            if winner is None:
+                raise err if err is not None else \
+                    ConnectionError("hedged render failed")
+            if winner is t2:
+                self.stats.bump("gw_hedged_wins")
+            return winner.result()
+        finally:
+            for t in (t1, t2):
+                if not t.done():
+                    t.cancel()
+                elif not t.cancelled():
+                    t.exception()       # mark retrieved
+
     async def _upstream_query(self, req: dict) -> dict:
-        """One render upstream: round-robin across live replicas with
-        failover. RuntimeError (the server's own error envelope)
+        """One render upstream: health-ordered failover with hedged
+        reads. RuntimeError (the server's own error envelope)
         propagates without failover — it is the QUERY's error and
         every replica would answer it identically."""
-        last = None
-        n = len(self.upstreams)
-        self._rr = (self._rr + 1) % n
-        for i in range(n):
-            u = self.upstreams[(self._rr + i) % n]
+        order = self._ranked()
+        last: Optional[BaseException] = None
+        idx, n = 0, len(order)
+        while idx < n:
+            u = order[idx]
+            hedge = (self.hedge_ms > 0 and u.state == "up"
+                     and idx + 1 < n and order[idx + 1].state == "up")
             try:
-                out = await self._query_one(u, req)
+                if hedge:
+                    out = await self._query_hedged(u, order[idx + 1],
+                                                   req)
+                else:
+                    out = await self._query_one(u, req)
                 self.stats.bump("gw_renders_upstream")
                 return out
             except RuntimeError:
@@ -248,13 +470,25 @@ class FabricGateway:
             except Exception as e:      # noqa: BLE001 — conn trouble
                 self.stats.bump("gw_upstream_errors")
                 last = e
+            # a hedged attempt that raised already consumed BOTH
+            idx += 2 if hedge else 1
         raise ConnectionError(f"no upstream reachable: {last}")
 
     async def _watch_upstream(self, u: _Upstream) -> None:
         """One cheap poll per tick per upstream: watch ``snaptick``
         advance and trigger the subscription push when the FABRIC tick
-        (max across upstreams) moves."""
+        (max across upstreams) moves. Health transitions live in the
+        circuit breaker (``record_ok``/``record_fail`` inside
+        ``_query_one``): a single failed poll only increments the
+        consecutive-failure count — mark-down takes ``down_after`` of
+        them — and a down upstream is polled on its jittered probe
+        backoff instead of every tick."""
         while True:
+            if not u.probe_due():
+                await asyncio.sleep(
+                    min(self.poll_s,
+                        max(0.05, u.probe_at - time.monotonic())))
+                continue
             try:
                 out = await self._query_one(u, dict(_POLL_REQ),
                                             timeout=10.0)
@@ -262,7 +496,6 @@ class FabricGateway:
                 if tick > u.tick:
                     u.tick = tick
                 u.tick_at = time.monotonic()
-                u.up = True
                 self.stats.gauge("gw_fabric_tick",
                                  float(self.fabric_tick))
                 self.stats.gauge(
@@ -288,9 +521,13 @@ class FabricGateway:
                         self._pushing = False
             except asyncio.CancelledError:
                 raise
-            except Exception:       # noqa: BLE001 — down upstream
-                u.up = False
+            except Exception:       # noqa: BLE001 — counted; the
+                # circuit breaker (not this handler) decides when the
+                # upstream is DOWN: K consecutive failures, not one
                 self.stats.bump("gw_poll_errors")
+                self.stats.gauge(
+                    "gw_upstreams_up",
+                    float(sum(1 for x in self.upstreams if x.up)))
             await asyncio.sleep(self.poll_s)
 
     # ------------------------------------------------------ cache + query
@@ -402,12 +639,16 @@ class FabricGateway:
                 self._hist_put(alias, resp)
         return resp
 
-    async def query(self, req: dict) -> dict:
+    async def query(self, req: dict, _from_peer: bool = False) -> dict:
         """THE query entry every front shares. Cache-eligible requests
         collapse onto the (fabric-tick, normalized-key) edge cache with
-        single-flight + peer exchange; everything else passes through
-        to a replica. Raises RuntimeError with the server's error
-        envelope, ConnectionError when no upstream answers."""
+        single-flight + owner-routed peer exchange; everything else
+        passes through to a replica. ``_from_peer`` marks a render
+        forwarded BY a peer (``_serve_peer``): it must not hop again —
+        rendezvous ownership is consistent fleet-wide, but an
+        asymmetric peer config would otherwise ping-pong forever.
+        Raises RuntimeError with the server's error envelope,
+        ConnectionError when no upstream answers."""
         if not self._cacheable(req):
             anchor = self._hist_anchor(req)
             if anchor is not None \
@@ -437,8 +678,18 @@ class FabricGateway:
         try:
             self.stats.bump("gw_cache_misses")
             resp = None
-            if self.peers:
-                resp = await self._peer_get(tick, key)
+            if self.peers and not _from_peer:
+                got = await self._peer_get(tick, key, req)
+                if got is not None and got[0] == "neg":
+                    # the owner's render errored: share the negative
+                    # verdict so the fleet, not just the owner,
+                    # collapses the broken-panel stampede
+                    self._cache_put(
+                        ck, ["neg", got[1],
+                             time.monotonic() + self.neg_ttl_s])
+                    raise RuntimeError(got[1])
+                if got is not None:
+                    resp = got[1]
             if resp is not None:
                 self.stats.bump("gw_cache_hits|tier=peer")
             else:
@@ -523,20 +774,69 @@ class FabricGateway:
                     ent[0] = ent[1] = None
                 raise
 
-    async def _peer_get(self, tick: int, key: str) -> Optional[dict]:
-        """Ask each peer for (tick, key); first hit wins. Bounded by
-        ``peer_timeout_s`` per peer — a slow peer must cost less than
-        the render it saves."""
-        body = json.dumps({"tick": tick, "key": key}).encode()
-        for peer in self.peers:
+    def _ident(self) -> str:
+        return self.advertise or f"{self.host}:{self.port}"
+
+    @staticmethod
+    def _rdv_score(ident: str, key: str) -> int:
+        import hashlib
+        return int.from_bytes(
+            hashlib.blake2b(f"{ident}\x00{key}".encode(),
+                            digest_size=8).digest(), "big")
+
+    def _owner_peer(self, key: str) -> Optional[tuple]:
+        """Rendezvous-hash owner of ``key`` across the fleet (self +
+        peers): every gateway ranks the same idents, so the whole
+        fleet agrees on ONE owner per key with no coordination —
+        N-gateway fleets do one peer hop instead of an in-order scan,
+        and membership changes only reshuffle 1/N of the keys.
+        Returns None when THIS gateway owns the key."""
+        best_peer = None
+        best = self._rdv_score(self._ident(), key)
+        for h, p in self.peers:
+            s = self._rdv_score(f"{h}:{p}", key)
+            if s > best:
+                best, best_peer = s, (h, p)
+        return best_peer
+
+    async def _peer_get(self, tick: int, key: str,
+                        req: dict) -> Optional[tuple]:
+        """On a local miss route to the rendezvous OWNER of the key
+        (ROADMAP query-fabric item c): the owner answers from its
+        cache, waits on its own in-flight render, or renders upstream
+        itself — one peer hop, one render per fleet. A clean miss is
+        impossible from the owner (it renders), so the in-order scan
+        of the remaining peers runs only when the owner is DOWN.
+        Returns ("hit", resp) | ("neg", errmsg) | None (render
+        locally). Bounded by ``peer_timeout_s`` per peer — a slow
+        peer must cost less than the render it saves."""
+        owner = self._owner_peer(key)
+        if owner is None:
+            # this gateway owns the key: peers route here; render
+            self.stats.bump("gw_peer_owner_self")
+            return None
+        body = json.dumps({"tick": tick, "key": key,
+                           "req": req}).encode()
+        probe = json.dumps({"tick": tick, "key": key}).encode()
+        peers = [owner] + [p for p in self.peers if p != owner]
+        for i, peer in enumerate(peers):
             self.stats.bump("gw_peer_requests")
             try:
                 status, payload = await asyncio.wait_for(
-                    self._peer_post_one(peer, body),
+                    self._peer_post_one(peer,
+                                        body if i == 0 else probe),
                     self.peer_timeout_s)
                 if status == 200:
+                    obj = json.loads(payload)
+                    if obj.get("neg") is not None:
+                        return ("neg", obj["neg"])
                     self.stats.bump("gw_peer_hits")
-                    return json.loads(payload)["resp"]
+                    return ("hit", obj["resp"])
+                if i == 0:
+                    # the owner answered but could not render (its
+                    # upstreams unreachable): render locally — our
+                    # replica view may differ from the owner's
+                    return None
             except asyncio.CancelledError:
                 raise
             except Exception:       # noqa: BLE001 — peer down/slow
@@ -544,13 +844,20 @@ class FabricGateway:
                 # the per-peer lock; closing here could kill a fresh
                 # conn another coroutine just opened
                 self.stats.bump("gw_peer_errors")
+                if i == 0:
+                    # owner down: degrade to the PR-13 in-order scan
+                    # of the remaining peers' caches
+                    self.stats.bump("gw_peer_owner_down")
         return None
 
     async def _serve_peer(self, obj: dict):
         """The answering half: local cache lookup, waiting on an
-        in-flight render for the SAME (tick, key) — that wait is what
-        makes a fresh-tick stampede render once per FLEET, not once
-        per gateway."""
+        in-flight render for the SAME (tick, key), and — when the
+        caller forwarded the full request because WE own the key —
+        rendering upstream ourselves. Ownership is what makes a
+        fresh-tick stampede render once per FLEET, not once per
+        gateway. A render error ships as ``neg`` so the whole fleet
+        shares the negative verdict."""
         self.stats.bump("gw_peer_served_requests")
         ck = (int(obj.get("tick", -1)), str(obj.get("key", "")))
         ent = self._cache.get(ck)
@@ -565,6 +872,19 @@ class FabricGateway:
                 return {"resp": resp}
             except Exception:       # noqa: BLE001
                 pass
+        req = obj.get("req")
+        if isinstance(req, dict) and req:
+            # owner-routed render: _from_peer pins the hop count at 1
+            try:
+                resp = await self.query(dict(req), _from_peer=True)
+                self.stats.bump("gw_peer_served_renders")
+                return {"resp": resp}
+            except RuntimeError as e:
+                return {"neg": str(e)}
+            except asyncio.CancelledError:
+                raise
+            except Exception:       # noqa: BLE001 — upstreams down
+                self.stats.bump("gw_peer_served_errors")
         return None
 
     # ---------------------------------------------------------- the fronts

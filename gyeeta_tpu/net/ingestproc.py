@@ -888,6 +888,12 @@ class IngestSupervisor:
                              1.0 if h.up else 0.0)
             self.stats.gauge(f"ingest_proc_epoch|proc={h.w}",
                              float(h.shm.epoch()))
+            # the worker's pid as a gauge: lets an operator (or the
+            # fault-injection harness) target one worker from OUTSIDE
+            # the serve process — kill a wedged one, strace a slow one
+            if h.proc is not None:
+                self.stats.gauge(f"ingest_proc_pid|proc={h.w}",
+                                 float(h.proc.pid))
             self.stats.gauge(f"ingest_proc_conns|proc={h.w}",
                              float(max(0, ctrs["conns_open"]
                                        - ctrs["conns_closed"])))

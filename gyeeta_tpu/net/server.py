@@ -71,7 +71,8 @@ class GytServer:
                  query_snapshot: Optional[bool] = None,
                  shard_ingest: bool = False,
                  shard_queue_mb: float = 8.0,
-                 ingest_procs: int = 1):
+                 ingest_procs: int = 1,
+                 sub_persist: Optional[str] = None):
         self.rt = rt
         self.host = host
         self.port = port
@@ -235,7 +236,8 @@ class GytServer:
         # pushes per-tick row deltas — render once, diff once, push to
         # every subscriber of that normalized query
         from gyeeta_tpu.net.subs import SubscriptionHub
-        self.subs = SubscriptionHub(self._sub_fetch, rt.stats)
+        self.subs = SubscriptionHub(self._sub_fetch, rt.stats,
+                                    persist_path=sub_persist)
 
     async def _sub_fetch(self, req: dict) -> dict:
         """Subscription render: the same admission-controlled off-loop
@@ -485,6 +487,7 @@ class GytServer:
         if self._recorder is not None:
             rec, self._recorder = self._recorder, None
             rec.close()      # live conns see None, never a closed file
+        self.subs.close()    # flush + close the continuation ring file
         if self._ingest is not None:
             # graceful worker drain BEFORE the runtime closes: workers
             # stop their conns, fsync + close their WALs and report

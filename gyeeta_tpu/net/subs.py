@@ -23,12 +23,29 @@ group.
 Reconnect: a subscriber that held version T re-subscribes with
 ``last_snaptick=T``; if the hub still holds T in its short version
 history it answers with a delta (or an ``ack`` when T is current),
-otherwise a full resync — the client never has to special-case it
+otherwise a full resync — COUNTED (``gw_sub_resyncs``) and marked
+in-band (``resync: true`` on the full event), never silent — and the
+client never has to special-case it
 (``query/delta.py:apply_event`` handles all three).
 
+Fault-domain continuation (ISSUE 15): version rings are RETAINED
+(bounded) when the last subscriber of a key disconnects, so a brief
+client outage resumes with a delta instead of a resync; and with
+``persist_path`` set the hub appends every pushed version to a small
+append-only file and restores the rings on construction — a
+RESTARTED gateway replays the missing deltas to clients that
+reconnect with ``last_snaptick``, when its ring still covers them.
+
 Client halves: :class:`SubscribeClient` speaks the GYT binary
-``COMM_SUBSCRIBE_CMD`` stream; :func:`read_sse_events` parses the REST
+``COMM_SUBSCRIBE_CMD`` stream (``events(stall_timeout=...)`` raises a
+typed :class:`SubscriptionStalled` instead of hanging forever on a
+frozen hub); :func:`read_sse_events` parses the REST
 ``/v1/subscribe`` SSE stream. Both yield the same event dicts.
+:class:`SubscribeStream` supervises a subscription across gateway
+failures: reconnect with ``last_snaptick`` on conn loss or stall,
+rotating endpoints — at-least-once, no-gap: its reassembled
+responses are byte-identical to an uninterrupted subscription's at
+every common snaptick (property-tested).
 
 Metrics (all through the hub's ``Stats`` registry — rendered as
 ``gyt_gw_*`` by ``obs/prom.py``): ``gw_subscribers`` / ``gw_sub_keys``
@@ -76,9 +93,38 @@ def delta_max_ratio(env=None) -> float:
         return 1.0
 
 
+def retain_keys(env=None) -> int:
+    """Version rings RETAINED after the last subscriber of a key
+    disconnects (the reconnect-continuation window), bounding hub
+    memory when many distinct queries come and go."""
+    env = os.environ if env is None else env
+    try:
+        return max(0, int(env.get("GYT_GW_SUB_RETAIN_KEYS", "1024")))
+    except ValueError:
+        return 1024
+
+
+def persist_max_bytes(env=None) -> int:
+    env = os.environ if env is None else env
+    try:
+        mb = float(env.get("GYT_GW_SUB_PERSIST_MAX_MB", "16"))
+    except ValueError:
+        mb = 16.0
+    return max(1 << 20, int(mb * (1 << 20)))
+
+
 class SubscribeError(ValueError):
     """Subscription rejected at registration (bad envelope / at
     capacity) — the edge answers its error frame and keeps the conn."""
+
+
+class SubscriptionStalled(RuntimeError):
+    """No event or keepalive arrived within the stall deadline — the
+    hub (or the path to it) is wedged, not merely quiet: every tick
+    delivers at least one event per subscription, so silence past
+    ~3x the tick interval means the stream is dead even though the
+    TCP conn looks alive. Typed so supervisors reconnect instead of
+    treating it as a server rejection."""
 
 
 class _Sub:
@@ -99,20 +145,110 @@ class SubscriptionHub:
 
     def __init__(self, fetch, stats, history: Optional[int] = None,
                  max_ratio: Optional[float] = None,
-                 max_subs: int = 4096):
+                 max_subs: int = 4096,
+                 persist_path: Optional[str] = None,
+                 retain: Optional[int] = None):
         self._fetch = fetch
         self.stats = stats
         self.history = sub_history() if history is None else int(history)
         self.max_ratio = delta_max_ratio() if max_ratio is None \
             else float(max_ratio)
         self.max_subs = int(max_subs)
+        self.retain = retain_keys() if retain is None else int(retain)
         self._seq = 0
         self._subs: dict[int, _Sub] = {}
         self._by_key: dict[str, dict] = {}
         self._req_of_key: dict[str, dict] = {}
         # key -> deque[(snaptick, resp)] newest-last: the reconnect
-        # window (how far back a delta can base) AND the diff source
+        # window (how far back a delta can base) AND the diff source.
+        # Rings OUTLIVE their subscribers (bounded by ``retain``) so a
+        # reconnect resumes with a delta, and optionally persist to an
+        # append-only file so a RESTART does too.
         self._versions: dict[str, collections.deque] = {}
+        self._persist_path = persist_path
+        self._persist_f = None
+        self._persist_max = persist_max_bytes()
+        if persist_path:
+            self._load_persist()
+
+    # ------------------------------------------------- persistence
+    def _load_persist(self) -> None:
+        """Restore the version rings from the append-only file. A
+        torn tail (SIGKILL mid-append) is truncated and counted —
+        every complete line before it is usable."""
+        import os as _os
+        path = self._persist_path
+        if _os.path.exists(path):
+            with open(path, "rb") as f:
+                for raw in f:
+                    if not raw.endswith(b"\n"):
+                        self.stats.bump("gw_sub_persist_torn")
+                        break
+                    try:
+                        obj = json.loads(raw)
+                        key = obj["k"]
+                        st = obj["st"]
+                        resp = obj["resp"]
+                    except (ValueError, KeyError):
+                        self.stats.bump("gw_sub_persist_torn")
+                        continue
+                    dq = self._versions.setdefault(
+                        key, collections.deque(maxlen=self.history))
+                    dq.append((st, resp))
+                    if isinstance(obj.get("req"), dict):
+                        self._req_of_key[key] = obj["req"]
+        self._persist_f = open(path, "ab")
+        keys = len(self._versions)
+        if keys:
+            self.stats.gauge("gw_sub_persist_restored_keys",
+                             float(keys))
+            log.info("subscription continuation: restored %d key "
+                     "ring(s) from %s", keys, path)
+
+    def _persist_append(self, key: str, resp: dict) -> None:
+        f = self._persist_f
+        if f is None:
+            return
+        line = json.dumps(
+            {"k": key, "req": self._req_of_key.get(key),
+             "st": resp.get("snaptick"), "resp": resp},
+            default=str).encode() + b"\n"
+        try:
+            f.write(line)
+            f.flush()
+            self.stats.bump("gw_sub_persist_appends")
+            if f.tell() > self._persist_max:
+                self._persist_compact()
+        except OSError:
+            self.stats.bump("gw_sub_persist_errors")
+
+    def _persist_compact(self) -> None:
+        """Rewrite the file with only the LIVE rings (tmp + rename:
+        a crash mid-compaction leaves the old complete file)."""
+        import os as _os
+        path = self._persist_path
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for key, dq in self._versions.items():
+                req = self._req_of_key.get(key)
+                for st, resp in dq:
+                    f.write(json.dumps(
+                        {"k": key, "req": req, "st": st,
+                         "resp": resp}, default=str).encode() + b"\n")
+            f.flush()
+            _os.fsync(f.fileno())
+        _os.replace(tmp, path)
+        self._persist_f.close()
+        self._persist_f = open(path, "ab")
+        self.stats.bump("gw_sub_persist_compactions")
+
+    def close(self) -> None:
+        if self._persist_f is not None:
+            try:
+                self._persist_f.close()
+            except OSError:         # pragma: no cover
+                pass
+            self._persist_f = None
 
     # ------------------------------------------------------------ gauges
     def _gauge(self) -> None:
@@ -149,16 +285,24 @@ class SubscriptionHub:
         key = request_key(norm)
         self._seq += 1
         sid = self._seq
-        cur = self._latest(key)
+        self._req_of_key[key] = dict(norm)
+        # a ring with no LIVE subscribers (retained across
+        # disconnects, or restored from the persist file after a
+        # restart) is a delta BASE, not a current view: fetch fresh,
+        # append it to the ring, and the reconnect below replays the
+        # missing delta from the client's last-seen version
+        cur = self._latest(key) if self._by_key.get(key) else None
         if cur is None:
             resp = await self._fetch(dict(norm))
             # another subscriber may have raced the fetch; keep the
             # newest version only once
-            if self._latest(key) is None:
+            latest = self._latest(key)
+            if latest is None or latest[0] != resp.get("snaptick"):
                 self._push_version(key, resp)
             cur = self._latest(key)
         tick, resp = cur
         ev = None
+        resync = False
         if last_snaptick is not None and last_snaptick == tick:
             ev = D.ack_event(tick)
         elif last_snaptick is not None:
@@ -168,15 +312,23 @@ class SubscriptionHub:
                                              self.max_ratio)
                 self.stats.bump("gw_delta_bytes", db)
                 self.stats.bump("gw_full_bytes", fb)
+                self.stats.bump("gw_sub_resumes")
             else:
+                # continuation gap: the ring no longer covers the
+                # client's version — a full resync, COUNTED and
+                # marked in-band, never silent
                 self.stats.bump("gw_resyncs")
+                self.stats.bump("gw_sub_resyncs")
+                resync = True
         if ev is None and not (last_snaptick is not None
                                and last_snaptick == tick):
             ev = D.full_event(resp)
+            if resync:
+                ev = dict(ev)
+                ev["resync"] = True
         sub = _Sub(sid, key, send, tick, conn_tag)
         self._subs[sid] = sub
         self._by_key.setdefault(key, {})[sid] = sub
-        self._req_of_key[key] = dict(norm)
         self._gauge()
         self.stats.bump("gw_subs_registered")
         try:
@@ -196,11 +348,26 @@ class SubscriptionHub:
             grp.pop(sid, None)
             if not grp:
                 # last subscriber gone: the key stops costing a render
-                # per tick and its version history is released
+                # per tick, but its version ring is RETAINED (bounded
+                # by ``retain``) so a reconnect with last_snaptick
+                # resumes with a delta instead of a resync
                 self._by_key.pop(sub.key, None)
-                self._req_of_key.pop(sub.key, None)
-                self._versions.pop(sub.key, None)
+                self._evict_retained()
         self._gauge()
+
+    def _evict_retained(self) -> None:
+        over = len(self._versions) - len(self._by_key) - self.retain
+        if over <= 0:
+            return
+        for key in list(self._versions):
+            if over <= 0:
+                break
+            if key in self._by_key:
+                continue
+            self._versions.pop(key, None)
+            self._req_of_key.pop(key, None)
+            self.stats.bump("gw_sub_retained_evicted")
+            over -= 1
 
     def conn_subscribed(self, conn_tag) -> bool:
         return any(s.conn_tag == conn_tag
@@ -229,6 +396,7 @@ class SubscriptionHub:
         dq = self._versions.setdefault(
             key, collections.deque(maxlen=self.history))
         dq.append((resp.get("snaptick"), resp))
+        self._persist_append(key, resp)
 
     # -------------------------------------------------------------- push
     async def push_tick(self) -> int:
@@ -354,15 +522,31 @@ class SubscribeClient:
             wire.MAGIC_NQ))
         await self._writer.drain()
 
-    async def events(self):
+    async def events(self, stall_timeout: Optional[float] = None):
         """Async-iterate pushed event dicts until the conn closes.
-        A QS_ERROR frame raises RuntimeError with the server's error."""
+        A QS_ERROR frame raises RuntimeError with the server's error.
+        ``stall_timeout`` (seconds; callers use ~3x the tick
+        interval) raises a typed :class:`SubscriptionStalled` when no
+        event arrives in time — every tick delivers at least one
+        event per subscription, so prolonged silence means the hub or
+        the path to it is WEDGED even though the conn looks alive;
+        without it a frozen hub would park the consumer forever."""
         from gyeeta_tpu.ingest import wire
         while True:
             try:
-                dtype, payload = await wire.read_frame(self._reader)
+                if stall_timeout is not None and stall_timeout > 0:
+                    dtype, payload = await asyncio.wait_for(
+                        wire.read_frame(self._reader), stall_timeout)
+                else:
+                    dtype, payload = await wire.read_frame(
+                        self._reader)
             except (asyncio.IncompleteReadError, ConnectionError):
                 return
+            except (asyncio.TimeoutError, TimeoutError):
+                raise SubscriptionStalled(
+                    f"no subscription event within "
+                    f"{stall_timeout:.1f}s (hub frozen or path "
+                    f"wedged)") from None
             if dtype != wire.COMM_QUERY_RESP:
                 raise wire.FrameError(
                     f"expected QUERY_RESP on subscription, got {dtype}")
@@ -381,6 +565,90 @@ class SubscribeClient:
             except (ConnectionError, OSError):
                 pass
             self._writer = None
+
+
+class SubscribeStream:
+    """Supervised subscription with the agent spool's at-least-once /
+    no-gap contract across GATEWAY failures: connect to the first
+    reachable endpoint, subscribe, apply events; on conn loss, stall
+    (:class:`SubscriptionStalled`) or rejection, reconnect with
+    ``last_snaptick`` — rotating endpoints with jittered backoff, so
+    a killed gateway hands its subscribers to a peer (or its own
+    restart). A continuation gap arrives as a counted full resync
+    (``counters["resyncs"]``), never silently: the yielded responses
+    are byte-identical to an uninterrupted subscription's at every
+    common snaptick (property-tested in ``tests/test_failover.py``).
+    """
+
+    def __init__(self, endpoints, req: dict,
+                 stall_timeout: Optional[float] = None,
+                 backoff_base: float = 0.25, backoff_cap: float = 5.0,
+                 machine_id: Optional[int] = None):
+        if not endpoints:
+            raise ValueError("SubscribeStream needs >=1 endpoint")
+        self.endpoints = [tuple(e) for e in endpoints]
+        self.req = dict(req)
+        self.stall_timeout = stall_timeout
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.machine_id = machine_id
+        self.held: Optional[dict] = None
+        self.last_snaptick = None
+        self.counters: collections.Counter = collections.Counter()
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    async def responses(self):
+        """Async-iterate reassembled FULL responses, one per applied
+        event, forever (until :meth:`stop`). Reconnects internally;
+        consumers only ever see complete views."""
+        import random as _r
+        ep = 0
+        backoff = self.backoff_base
+        while not self._stop:
+            sc = SubscribeClient(machine_id=self.machine_id)
+            host, port = self.endpoints[ep % len(self.endpoints)]
+            try:
+                await sc.connect(host, port)
+                await sc.subscribe(self.req,
+                                   last_snaptick=self.last_snaptick)
+                backoff = self.backoff_base
+                async for ev in sc.events(
+                        stall_timeout=self.stall_timeout):
+                    self.counters["events"] += 1
+                    if ev.get("t") == "full" and ev.get("resync"):
+                        self.counters["resyncs"] += 1
+                    try:
+                        held = D.apply_event(self.held, ev)
+                    except D.ResyncRequired:
+                        # base gap mid-stream (hub ring aged out):
+                        # drop the held version and force a full on
+                        # the re-subscribe — counted, never silent
+                        self.counters["forced_resyncs"] += 1
+                        self.held = None
+                        self.last_snaptick = None
+                        break
+                    if ev.get("t") == "ack":
+                        continue            # nothing new to yield
+                    self.held = held
+                    self.last_snaptick = held.get("snaptick")
+                    yield held
+                self.counters["conn_lost"] += 1
+            except SubscriptionStalled:
+                self.counters["stalls"] += 1
+            except (ConnectionError, OSError, RuntimeError,
+                    asyncio.IncompleteReadError):
+                self.counters["conn_errors"] += 1
+            finally:
+                await sc.close()
+            if self._stop:
+                return
+            ep += 1                         # rotate endpoints
+            self.counters["reconnects"] += 1
+            await asyncio.sleep(backoff * (0.5 + _r.random()))
+            backoff = min(backoff * 2.0, self.backoff_cap)
 
 
 async def read_sse_events(reader):

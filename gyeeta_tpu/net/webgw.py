@@ -240,6 +240,17 @@ class WebGateway:
                 last = int(q["last_snaptick"][0])
             except ValueError:
                 pass
+        # stall_s= opts the relay into upstream heartbeat-loss
+        # detection: a wedged hub surfaces as a typed
+        # SubscriptionStalled, relayed below as an `event: error`
+        # block instead of an indefinitely-silent stream (clients
+        # pick ~3x the server tick interval)
+        stall_s = None
+        if "stall_s" in q:
+            try:
+                stall_s = float(q["stall_s"][0])
+            except ValueError:
+                pass
         sc = SubscribeClient()
         try:
             await sc.connect(*self.upstream)
@@ -255,16 +266,17 @@ class WebGateway:
                      b"Connection: close\r\n\r\n")
         try:
             await writer.drain()
-            async for ev in sc.events():
+            async for ev in sc.events(stall_timeout=stall_s):
                 writer.write(
                     f"event: {ev.get('t', 'message')}\n"
                     f"data: {_json.dumps(ev)}\n\n".encode())
                 await writer.drain()
         except RuntimeError as e:
             # upstream rejected the subscription (bad filter,
-            # capacity): relay it as an SSE error event — mirroring
-            # FabricGateway._sse_subscribe — so the client can tell a
-            # rejection from an empty stream
+            # capacity) or the stream STALLED past stall_s
+            # (SubscriptionStalled is a RuntimeError): relay it as an
+            # SSE error event — mirroring FabricGateway._sse_subscribe
+            # — so the client can tell either from an empty stream
             try:
                 writer.write(
                     f"event: error\n"

@@ -81,6 +81,19 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
         db, fb = c.get("gw_delta_bytes", 0), c.get("gw_full_bytes", 0)
         if fb:
             gwm["delta_vs_full_byte_ratio"] = round(db / fb, 4)
+        # fault-domain derived rows (OPERATIONS.md "Failure domains &
+        # degradation"): a hedge WIN rate near 1 means one replica is
+        # consistently slow; resumes-vs-resyncs is the continuation
+        # hit rate of the retained/persisted version rings
+        hreq = c.get("gw_hedged_requests", 0)
+        if hreq:
+            gwm["hedge_win_rate"] = round(
+                c.get("gw_hedged_wins", 0) / hreq, 4)
+        resumes = c.get("gw_sub_resumes", 0)
+        resyncs = c.get("gw_sub_resyncs", 0)
+        if resumes or resyncs:
+            gwm["sub_continuation_rate"] = round(
+                resumes / (resumes + resyncs), 4)
         for k, v in gwm.items():
             lines.append(f"  {k:<36} {v}")
 

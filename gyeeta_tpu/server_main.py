@@ -107,7 +107,9 @@ class Daemon:
                              shard_queue_mb=getattr(
                                  args, "shard_queue_mb", 8.0),
                              ingest_procs=getattr(
-                                 args, "ingest_procs", 1) or 1)
+                                 args, "ingest_procs", 1) or 1,
+                             sub_persist=getattr(
+                                 args, "sub_persist", None))
         self._hot = C.HotReload(args.config, opts) if args.config else None
         # history compaction daemon: sealed WAL segments → columnar
         # snapshot shards (the time-travel tier's writer). Runs only
@@ -436,6 +438,13 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
                     help="max in-flight queries before shedding with "
                     "a counted overload error (default "
                     "GYT_QUERY_QUEUE_MAX or 128)")
+    ap.add_argument("--sub-persist",
+                    help="append-only file persisting the streaming-"
+                    "subscription version ring (net/subs.py): a "
+                    "restarted server resumes reconnecting "
+                    "subscribers with deltas instead of full resyncs "
+                    "(single-replica deployments; gateways have "
+                    "their own --sub-persist)")
     ap.add_argument("--query-strong", action="store_true",
                     help="serve every query inline with strong "
                     "consistency (the pre-snapshot behavior; also "

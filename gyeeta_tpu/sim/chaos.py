@@ -15,7 +15,19 @@ failure vocabulary of real networks —
 - added **latency/jitter** per forwarded chunk,
 - coordinated **server-kill windows** (refuse + drop every conn —
   the proxy-side view of a dead server; test harnesses pair it with
-  an actual server restart).
+  an actual server restart),
+- **wedge windows** (ISSUE 15): stop forwarding in BOTH directions
+  while keeping every conn open — the stalled-NOT-dead upstream, the
+  hard fault-domain case: requests are accepted, responses never
+  come, and no conn error ever fires (circuit breakers see nothing
+  until a timeout; hedged reads are what bound the latency). Also a
+  manual ``proxy.wedged`` toggle for harness-driven schedules.
+
+The PR-15 fault-domain campaign points these at the INTER-TIER hops
+(gateway→replica, subscription client→gateway) as well as the
+original agent↔server edge — ``fault_both=True`` faults the
+server→client direction too (responses, pushes), which the PR-4
+plans never exercised.
 
 Determinism: every fault decision derives from a seeded
 :class:`FaultPlan` keyed by (seed, conn index) and **byte offsets**,
@@ -63,7 +75,9 @@ class FaultPlan:
                  latency_s: float = 0.0,
                  jitter_s: float = 0.0,
                  resplit: int = 0,
-                 kill_windows: Iterable[tuple] = ()):
+                 kill_windows: Iterable[tuple] = (),
+                 wedge_windows: Iterable[tuple] = (),
+                 fault_both: bool = False):
         self.seed = seed
         self.fault_kinds = tuple(fault_kinds)
         for k in self.fault_kinds:
@@ -78,6 +92,15 @@ class FaultPlan:
         self.resplit = int(resplit)
         self.kill_windows = tuple((float(a), float(b))
                                   for a, b in kill_windows)
+        # (start_s, end_s) intervals during which the proxy forwards
+        # NOTHING in either direction but keeps every conn open — a
+        # stalled (wedged) upstream, not a dead one
+        self.wedge_windows = tuple((float(a), float(b))
+                                   for a, b in wedge_windows)
+        # fault the server→client direction too (responses/pushes):
+        # the inter-tier hops fail on the answer path as often as the
+        # ask path
+        self.fault_both = bool(fault_both)
 
     def _rng(self, conn_idx: int, salt: int = 0) -> random.Random:
         # int-mixed seed (tuple seeding is deprecated and hash-based)
@@ -99,6 +122,9 @@ class FaultPlan:
     def in_kill_window(self, t_rel: float) -> bool:
         return any(a <= t_rel < b for a, b in self.kill_windows)
 
+    def in_wedge_window(self, t_rel: float) -> bool:
+        return any(a <= t_rel < b for a, b in self.wedge_windows)
+
 
 class ChaosProxy:
     """Seeded fault-injecting TCP proxy (agent side → ``listen``,
@@ -113,6 +139,7 @@ class ChaosProxy:
         self.plan = plan or FaultPlan()
         self.host, self.port = host, port
         self.refusing = False         # manual server-kill coordination
+        self.wedged = False           # manual stalled-upstream toggle
         self.stats: collections.Counter = collections.Counter()
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()      # live (cwriter, uwriter) pairs
@@ -128,7 +155,7 @@ class ChaosProxy:
             self._handle, self.host, self.port)
         sock = self._server.sockets[0].getsockname()
         self.host, self.port = sock[0], sock[1]
-        if self.plan.kill_windows:
+        if self.plan.kill_windows or self.plan.wedge_windows:
             self._kill_task = asyncio.create_task(self._kill_monitor())
         log.info("chaos proxy on %s:%d -> %s:%d (faults=%s seed=%d)",
                  self.host, self.port, *self.upstream,
@@ -159,6 +186,7 @@ class ChaosProxy:
     async def _kill_monitor(self) -> None:
         loop = asyncio.get_running_loop()
         was = False
+        was_wedged = False
         while True:
             await asyncio.sleep(0.05)
             now = loop.time() - self._t0
@@ -171,6 +199,15 @@ class ChaosProxy:
                 log.info("chaos: kill window closes at t=%.2fs", now)
                 self.refusing = False
             was = inwin
+            inwedge = self.plan.in_wedge_window(now)
+            if inwedge and not was_wedged:
+                log.info("chaos: wedge window opens at t=%.2fs", now)
+                self.wedged = True
+                self.stats["wedge_spans"] += 1
+            elif was_wedged and not inwedge:
+                log.info("chaos: wedge window closes at t=%.2fs", now)
+                self.wedged = False
+            was_wedged = inwedge
 
     # ------------------------------------------------------------- conn path
     async def _handle(self, creader, cwriter) -> None:
@@ -193,7 +230,8 @@ class ChaosProxy:
             c2s = asyncio.create_task(self._pump(
                 creader, uwriter, idx, faulted=True))
             s2c = asyncio.create_task(self._pump(
-                ureader, cwriter, idx, faulted=False))
+                ureader, cwriter, idx,
+                faulted=self.plan.fault_both))
             done, pending = await asyncio.wait(
                 {c2s, s2c}, return_when=asyncio.FIRST_COMPLETED)
             for t in pending:
@@ -260,6 +298,15 @@ class ChaosProxy:
     async def _fwd(self, writer, data: bytes, rng: random.Random
                    ) -> None:
         plan = self.plan
+        # wedged: park (conn open, bytes held) until the toggle/window
+        # clears — the stalled-not-dead upstream both directions see
+        if self.wedged:
+            self.stats["wedged_chunks"] += 1
+            t0 = asyncio.get_running_loop().time()
+            while self.wedged:
+                await asyncio.sleep(0.02)
+            self.stats["wedged_s"] += round(
+                asyncio.get_running_loop().time() - t0, 3)
         step = len(data)
         if plan.resplit:
             step = rng.randint(max(1, plan.resplit // 4), plan.resplit)
@@ -284,7 +331,11 @@ async def run_proxy(args) -> None:
         jitter_s=args.jitter_ms / 1e3,
         resplit=args.resplit,
         kill_windows=[(args.kill_at, args.kill_at + args.kill_for)]
-        if args.kill_for > 0 else ())
+        if args.kill_for > 0 else (),
+        wedge_windows=[(args.wedge_at,
+                        args.wedge_at + args.wedge_for)]
+        if getattr(args, "wedge_for", 0) > 0 else (),
+        fault_both=getattr(args, "fault_both", False))
     proxy = ChaosProxy(args.upstream_host, args.upstream_port, plan,
                        host=args.listen_host, port=args.listen_port)
     host, port = await proxy.start()

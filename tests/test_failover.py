@@ -1,0 +1,648 @@
+"""Fault-domain hardening of the distributed fabric (ISSUE 15):
+upstream circuit breakers (K-consecutive-failure mark-down — never one
+bad poll — with half-open probing and labeled state gauges), hedged
+reads (first-response-wins past a latency budget), rendezvous-hash
+peer ownership with owner-down fallback, peer-conn recovery after a
+mid-exchange kill, subscription continuation across hub restarts
+(persisted version ring → delta replay, else a COUNTED resync),
+typed heartbeat-loss detection (``SubscriptionStalled``), the
+supervised :class:`SubscribeStream` byte-equal failover property, and
+the chaos proxy's wedge (stalled-not-dead) windows.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from gyeeta_tpu.engine.aggstate import EngineCfg
+from gyeeta_tpu.ingest import wire
+from gyeeta_tpu.query import delta as D
+from gyeeta_tpu.runtime import Runtime
+from gyeeta_tpu.sim.partha import ParthaSim
+from gyeeta_tpu.utils.selfstats import Stats
+
+CFG = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                conn_batch=256, resp_batch=512, listener_batch=64,
+                fold_k=2)
+
+DEAD = ("127.0.0.1", 9)                 # nothing listens on discard
+
+
+async def _until(cond, timeout=20.0, interval=0.02, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        got = cond()
+        if got:
+            return got
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _feed(rt, sim, n=256):
+    rt.feed(sim.conn_frames(n) + sim.resp_frames(2 * n)
+            + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                sim.host_state_records()))
+
+
+def _mk_rt(seed=21):
+    rt = Runtime(CFG)
+    sim = ParthaSim(n_hosts=8, n_svcs=4, seed=seed)
+    rt.feed(sim.name_frames())
+    rt.feed(sim.listener_frames())
+    _feed(rt, sim)
+    rt.run_tick()
+    return rt, sim
+
+
+# ===================================================== circuit breaker
+
+
+def test_circuit_k_failures_not_one(  # the _watch_upstream regression
+):
+    """One failed poll must NOT mark an upstream down (the PR-13
+    behavior this PR fixes): mark-down takes ``down_after``
+    CONSECUTIVE failures, the flap is counted per upstream, a success
+    resets the count, and the labeled state gauges track it."""
+    from gyeeta_tpu.net.gateway import _Upstream
+
+    st = Stats()
+    u = _Upstream("127.0.0.1", 9999, 1, stats=st, down_after=3)
+    assert u.state == "up"
+    u.record_fail()
+    assert u.state == "up" and u.fails == 1     # ONE failure: still up
+    u.record_ok(5.0)
+    assert u.fails == 0                          # success resets
+    u.record_fail()
+    u.record_fail()
+    assert u.state == "up"                       # 2 consecutive: up
+    u.record_fail()
+    assert u.state == "down"                     # K=3: breaker opens
+    assert st.counters.get(
+        "gw_upstream_flaps|upstream=127.0.0.1:9999") == 1
+    assert u.probe_at > time.monotonic()         # jittered backoff armed
+    assert not u.probe_due()
+    assert st.gauges.get(
+        "gw_upstream_state|upstream=127.0.0.1:9999,state=down") == 1.0
+    assert st.gauges.get(
+        "gw_upstream_state|upstream=127.0.0.1:9999,state=up") == 0.0
+    # failed half-open probe: backoff doubles (jitter-bounded)
+    b0 = u.backoff_s
+    u._set_state("half_open")
+    u.record_fail()
+    assert u.state == "down" and u.backoff_s == 2 * b0
+    # successful probe closes the circuit, counted as a recovery
+    u.record_ok(3.0)
+    assert u.state == "up" and u.backoff_s == u.probe_base_s
+    assert st.counters.get(
+        "gw_upstream_recoveries|upstream=127.0.0.1:9999") == 1
+
+
+def test_failover_last_resort_and_halfopen_recovery():
+    """Queries against a fabric with >=1 live replica NEVER surface
+    an upstream error: a dead upstream fails over transparently, its
+    breaker opens after K real failures, and a marked-down (but
+    recovered) upstream closes the circuit on the half-open probe —
+    even when it is the ONLY replica."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+
+    rt, _sim = _mk_rt()
+
+    async def scenario():
+        from gyeeta_tpu.net.server import GytServer
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        gw = FabricGateway([DEAD, (host, port)], poll_s=3600.0,
+                           down_after=3, hedge_ms=0)
+        # no start(): drive queries directly (no watcher races);
+        # consistency=strong bypasses the edge cache so EVERY query
+        # exercises the failover path
+        for _ in range(8):
+            out = await gw.query({"subsys": "serverstatus",
+                                  "maxrecs": 1,
+                                  "consistency": "strong"})
+            assert out.get("nrecs", 0) >= 0      # never raises
+        dead = gw.upstreams[0]
+        assert dead.state == "down"              # real failures opened it
+        assert gw.stats.counters.get(
+            f"gw_upstream_flaps|upstream={dead.label}") == 1
+        assert gw.stats.counters.get("gw_upstream_errors", 0) >= 3
+        # ranked order now serves the live replica FIRST
+        assert gw._ranked()[0].label == f"{host}:{port}"
+
+        # half-open probe on the ONLY upstream: force the live one
+        # down (simulated failures), then a query probes + recovers
+        gw2 = FabricGateway([(host, port)], poll_s=3600.0,
+                            down_after=3, hedge_ms=0)
+        u = gw2.upstreams[0]
+        for _ in range(3):
+            u.record_fail()
+        assert u.state == "down"
+        u.probe_at = 0.0                         # probe due NOW
+        out = await gw2.query({"subsys": "serverstatus", "maxrecs": 1,
+                               "consistency": "strong"})
+        assert out.get("nrecs") == 1
+        assert u.state == "up"
+        assert gw2.stats.counters.get(
+            f"gw_upstream_recoveries|upstream={u.label}") == 1
+        await gw.stop()
+        await gw2.stop()
+        await srv.stop()
+
+    asyncio.run(scenario())
+
+
+def test_hedged_read_first_response_wins():
+    """A render exceeding the hedge latency budget fires the same
+    request at the next-healthiest replica; the first response wins
+    (counted) and the slow primary's result is discarded — the
+    wedged-not-dead replica case the breaker cannot see."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+
+    async def scenario():
+        gw = FabricGateway([("a", 1), ("b", 2)], hedge_ms=30.0)
+        slow, fast = gw.upstreams
+        slow.ewma_ms, fast.ewma_ms = 1.0, 2.0   # rank slow first
+
+        async def fake(u, req, timeout=None):
+            if u is slow:
+                await asyncio.sleep(0.5)
+                return {"snaptick": 1, "who": "slow"}
+            return {"snaptick": 1, "who": "fast"}
+
+        gw._query_one = fake
+        gw._rr = 1                               # rotation lands at 0
+        t0 = time.monotonic()
+        out = await gw._upstream_query({"subsys": "svcstate"})
+        assert out["who"] == "fast"
+        assert time.monotonic() - t0 < 0.4       # did not wait out slow
+        assert gw.stats.counters.get("gw_hedged_requests") == 1
+        assert gw.stats.counters.get("gw_hedged_wins") == 1
+
+        # primary answering INSIDE the budget never hedges
+        gw.stats.counters.pop("gw_hedged_requests", None)
+        slow.ewma_ms, fast.ewma_ms = 5.0, 1.0   # rank fast first
+        gw._rr = 1                               # rotation lands at 0
+        out = await gw._upstream_query({"subsys": "svcstate"})
+        assert out["who"] == "fast"
+        assert gw.stats.counters.get("gw_hedged_requests", 0) == 0
+
+    asyncio.run(scenario())
+
+
+# ================================================== rendezvous routing
+
+
+def test_rendezvous_owner_consistent_and_balanced():
+    """Every fleet member computes the SAME owner for a key (one peer
+    hop, no coordination), and ownership spreads across the fleet."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+
+    a = FabricGateway([DEAD], advertise="127.0.0.1:1111",
+                      peers=[("127.0.0.1", 2222)])
+    b = FabricGateway([DEAD], advertise="127.0.0.1:2222",
+                      peers=[("127.0.0.1", 1111)])
+    owned_a = owned_b = 0
+    for i in range(200):
+        key = f"key-{i}"
+        oa = a._owner_peer(key)      # None = a owns
+        ob = b._owner_peer(key)      # None = b owns
+        if oa is None:
+            assert ob == ("127.0.0.1", 1111), key
+            owned_a += 1
+        else:
+            assert oa == ("127.0.0.1", 2222) and ob is None, key
+            owned_b += 1
+    # rendezvous balance: both sides own a healthy share
+    assert owned_a > 50 and owned_b > 50, (owned_a, owned_b)
+
+
+def test_owner_down_falls_back_to_scan():
+    """When the key's owner is DOWN the exchange degrades to the
+    PR-13 in-order scan of the remaining peers' caches — counted,
+    and a cached copy anywhere in the fleet still saves the render."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+
+    async def scenario():
+        holder = FabricGateway([DEAD], poll_s=3600.0)
+        hh, hp = await holder.start()
+        holder._cache_put((7, "k0"),
+                          ["ok", {"snaptick": 7, "v": 42}, None])
+        gw = FabricGateway([DEAD], poll_s=3600.0,
+                           peers=[DEAD, (hh, hp)],
+                           peer_timeout_s=2.0)
+        gw._owner_peer = lambda key: DEAD        # owner is down
+        got = await gw._peer_get(7, "k0", {"subsys": "svcstate"})
+        assert got == ("hit", {"snaptick": 7, "v": 42})
+        assert gw.stats.counters.get("gw_peer_owner_down") == 1
+        assert gw.stats.counters.get("gw_peer_errors") == 1
+        await holder.stop()
+
+    asyncio.run(scenario())
+
+
+def test_peer_conn_recovery_after_mid_exchange_kill():
+    """Kill a peer gateway MID-EXCHANGE: the surviving gateway tears
+    the conn down (counted), the stale ``_peer_conns`` entry never
+    poisons a later response, and the next exchange reconnects and
+    returns the RIGHT body (regression for the PR-13 race class)."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+
+    async def scenario():
+        # a trap peer: accepts, reads the request, dies mid-response
+        async def trap(reader, writer):
+            await reader.readuntil(b"\r\n\r\n")
+            try:
+                await reader.readexactly(10)
+            except asyncio.IncompleteReadError:
+                pass
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Le")  # torn
+            await writer.drain()
+            writer.close()
+
+        trap_srv = await asyncio.start_server(trap, "127.0.0.1", 0)
+        th, tp = trap_srv.sockets[0].getsockname()[:2]
+
+        gw = FabricGateway([DEAD], poll_s=3600.0, peers=[(th, tp)],
+                           peer_timeout_s=1.0)
+        gw._owner_peer = lambda key: (th, tp)
+        got = await gw._peer_get(3, "k", {"subsys": "svcstate"})
+        assert got is None
+        assert gw.stats.counters.get("gw_peer_errors", 0) >= 1
+        ent = gw._peer_conns.get((th, tp))
+        assert ent is None or ent[1] is None     # conn torn down
+        trap_srv.close()
+        await trap_srv.wait_closed()
+
+        # a REAL gateway takes over the same address: the next
+        # exchange reconnects and the response routes correctly
+        peer = FabricGateway([DEAD], poll_s=3600.0, host=th, port=tp)
+        await peer.start()
+        peer._cache_put((3, "k"), ["ok", {"snaptick": 3, "v": 7},
+                                   None])
+        got = await gw._peer_get(3, "k", {"subsys": "svcstate"})
+        assert got == ("hit", {"snaptick": 3, "v": 7})
+        await peer.stop()
+
+    asyncio.run(scenario())
+
+
+# ====================================== subscription continuation
+
+
+def _mk_fetch(state):
+    # wide stable rows + ONE changing row per tick: a delta genuinely
+    # beats the full body (the max_ratio escape never fires), so
+    # continuation replay is observable as a real delta event
+    pad = "x" * 64
+
+    async def fetch(req):
+        t = state["t"]
+        recs = [{"hostid": f"h{i}", "v": i * 1000, "pad": pad}
+                for i in range(40)]
+        recs[0] = {"hostid": "h0", "v": t, "pad": pad}
+        return {"subsys": req.get("subsys", "svcstate"), "nrecs": 40,
+                "snaptick": t, "recs": recs}
+    return fetch
+
+
+def test_hub_persisted_ring_replays_deltas(tmp_path):
+    """A RESTARTED hub (new process, same persist file) answers a
+    reconnect inside its restored ring with a DELTA — byte-equal
+    reassembly, zero resyncs; a reconnect OUTSIDE the ring gets one
+    full with a counted in-band ``resync`` marker, never silence."""
+    from gyeeta_tpu.net.subs import SubscriptionHub
+
+    path = str(tmp_path / "subs.jsonl")
+
+    async def scenario():
+        state = {"t": 0}
+        fetch = _mk_fetch(state)
+        hub = SubscriptionHub(fetch, Stats(), persist_path=path)
+        got: list = []
+
+        async def send(ev):
+            got.append(ev)
+
+        sid = await hub.subscribe({"subsys": "svcstate"}, send)
+        held = D.apply_event(None, got[0])
+        for t in (1, 2, 3):
+            state["t"] = t
+            await hub.push_tick()
+            held = D.apply_event(held, got[-1])
+        assert held["snaptick"] == 3
+        hub.unsubscribe(sid)
+        # the version ring is RETAINED after the last unsubscribe
+        assert len(hub._versions) == 1
+        hub.close()
+
+        # ---- a FRESH hub over the same file: the restart
+        state["t"] = 5
+        hub2 = SubscriptionHub(fetch, Stats(), persist_path=path)
+        assert hub2.stats.gauges.get(
+            "gw_sub_persist_restored_keys") == 1.0
+        got2: list = []
+
+        async def send2(ev):
+            got2.append(ev)
+
+        # reconnect INSIDE the restored ring: delta replay
+        await hub2.subscribe({"subsys": "svcstate"}, send2,
+                             last_snaptick=2)
+        assert got2[0]["t"] == "delta" and got2[0]["base"] == 2
+        assert hub2.stats.counters.get("gw_sub_resumes") == 1
+        assert hub2.stats.counters.get("gw_sub_resyncs", 0) == 0
+        # the client that held version 2 reassembles byte-equal to a
+        # fresh full render
+        state_at_2 = {"t": 2}
+        held_v2 = await _mk_fetch(state_at_2)({"subsys": "svcstate"})
+        applied = D.apply_event(held_v2, got2[0])
+        fresh = await fetch({"subsys": "svcstate"})
+        assert json.dumps(applied) == json.dumps(fresh)
+
+        # reconnect OUTSIDE the ring: counted full resync, marked
+        got3: list = []
+
+        async def send3(ev):
+            got3.append(ev)
+
+        await hub2.subscribe({"subsys": "svcstate"}, send3,
+                             last_snaptick=-99)
+        assert got3[0]["t"] == "full" and got3[0].get("resync") is True
+        assert hub2.stats.counters.get("gw_sub_resyncs") == 1
+        hub2.close()
+
+    asyncio.run(scenario())
+
+
+def test_hub_persist_torn_tail_and_compaction(tmp_path):
+    """A SIGKILL mid-append leaves a torn last line: restore counts
+    it and keeps every complete line; compaction rewrites the file
+    bounded while preserving the rings."""
+    from gyeeta_tpu.net.subs import SubscriptionHub
+
+    path = str(tmp_path / "subs.jsonl")
+
+    async def scenario():
+        state = {"t": 0}
+        fetch = _mk_fetch(state)
+        hub = SubscriptionHub(fetch, Stats(), persist_path=path)
+        got: list = []
+
+        async def send(ev):
+            got.append(ev)
+
+        await hub.subscribe({"subsys": "svcstate"}, send)
+        state["t"] = 1
+        await hub.push_tick()
+        hub.close()
+        with open(path, "ab") as f:          # the torn tail
+            f.write(b'{"k": "torn')
+
+        hub2 = SubscriptionHub(fetch, Stats(), persist_path=path)
+        assert hub2.stats.counters.get("gw_sub_persist_torn") == 1
+        assert len(hub2._versions) == 1      # complete lines restored
+        # force a compaction: the rewritten file drops the torn tail
+        # and every superseded append
+        hub2._persist_max = 1
+        state["t"] = 2
+        got2: list = []
+
+        async def send2(ev):
+            got2.append(ev)
+
+        await hub2.subscribe({"subsys": "svcstate"}, send2)
+        assert hub2.stats.counters.get("gw_sub_persist_compactions",
+                                       0) >= 1
+        hub2.close()
+        with open(path, "rb") as f:
+            lines = f.read().splitlines()
+        assert all(json.loads(ln) for ln in lines)   # all complete
+
+    asyncio.run(scenario())
+
+
+def test_retained_ring_bounded():
+    """Rings retained after the last unsubscribe are LRU-bounded so
+    churning distinct queries cannot grow the hub forever."""
+    from gyeeta_tpu.net.subs import SubscriptionHub
+
+    async def scenario():
+        state = {"t": 0}
+        hub = SubscriptionHub(_mk_fetch(state), Stats(), retain=3)
+
+        async def send(ev):
+            pass
+
+        for i in range(8):
+            sid = await hub.subscribe(
+                {"subsys": "svcstate", "maxrecs": 10 + i}, send)
+            hub.unsubscribe(sid)
+        assert len(hub._versions) == 3
+        assert hub.stats.counters.get("gw_sub_retained_evicted") == 5
+
+    asyncio.run(scenario())
+
+
+# ============================================= stall + stream failover
+
+
+def test_subscribe_client_stall_typed(  # frozen hub → typed error
+):
+    """``events(stall_timeout=...)`` raises a typed
+    :class:`SubscriptionStalled` when the hub freezes (no event
+    within the deadline) instead of hanging forever."""
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.subs import SubscribeClient, \
+        SubscriptionStalled
+
+    rt, _sim = _mk_rt(seed=31)
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        sc = SubscribeClient()
+        await sc.connect(host, port)
+        await sc.subscribe({"subsys": "hoststate", "maxrecs": 16})
+        agen = sc.events(stall_timeout=0.4)
+        ev = await agen.__anext__()
+        assert ev["t"] == "full"
+        # the hub is FROZEN now (no ticks, no pushes): typed stall
+        t0 = time.monotonic()
+        with pytest.raises(SubscriptionStalled):
+            await agen.__anext__()
+        assert 0.3 < time.monotonic() - t0 < 5.0
+        await sc.close()
+        await srv.stop()
+
+    asyncio.run(scenario())
+
+
+def test_subscribe_stream_failover_byte_equal():
+    """The supervised stream property (the fault-domain contract):
+    kill the gateway a subscriber is attached to mid-stream — the
+    stream reconnects to the NEXT endpoint with ``last_snaptick`` and
+    its reassembled responses stay byte-identical to a fresh full
+    render at every tick it observes. Continuation across gateways:
+    zero silent gaps (any gap is a counted resync; here the peer
+    covers the tick, so zero resyncs too)."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.subs import SubscribeStream
+
+    rt, sim = _mk_rt(seed=41)
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        gw1 = FabricGateway([(host, port)], poll_s=0.05)
+        h1, p1 = await gw1.start()
+        gw2 = FabricGateway([(host, port)], poll_s=0.05)
+        h2, p2 = await gw2.start()
+        snap = rt.snapshot.tick
+        await _until(lambda: gw1.fabric_tick >= snap
+                     and gw2.fabric_tick >= snap, msg="tick discovery")
+
+        q = {"subsys": "svcstate", "sortcol": "qps5s",
+             "sortdesc": True, "maxrecs": 50}
+        stream = SubscribeStream([(h1, p1), (h2, p2)], q,
+                                 stall_timeout=2.0,
+                                 backoff_base=0.05)
+        seen: list = []
+
+        async def consume():
+            async for held in stream.responses():
+                seen.append(held)
+
+        task = asyncio.create_task(consume())
+        await _until(lambda: seen, msg="initial full")
+
+        _feed(rt, sim)
+        rt.run_tick()
+        n = len(seen)
+        await _until(lambda: len(seen) > n, msg="delta via gw1")
+
+        # ---- kill the attached gateway mid-subscription: the conn
+        # goes SILENT (not closed) — exactly the stall case — and the
+        # stream hops to gw2 with last_snaptick; the tick has not
+        # advanced, so gw2 acks and continuation is gapless
+        await gw1.stop()
+        e0 = stream.counters["events"]
+        # the next event can only come from gw2: the ack answering
+        # the re-subscribe at the unchanged tick
+        await _until(lambda: stream.counters["events"] > e0,
+                     timeout=30.0, msg="re-subscribe ack from gw2")
+        assert stream.counters["reconnects"] >= 1
+        _feed(rt, sim)
+        rt.run_tick()
+        n = len(seen)
+        await _until(lambda: len(seen) > n, timeout=30.0,
+                     msg="continuation via gw2")
+
+        # byte-equal to a fresh full render at the converged tick
+        fresh = await gw2.query(dict(q))
+        await _until(lambda: seen[-1]["snaptick"]
+                     == fresh["snaptick"], msg="converged tick")
+        assert json.dumps(seen[-1]) == json.dumps(
+            json.loads(json.dumps(fresh)))
+        # gw2's hub had the tick in reach → no resync was needed; a
+        # gap would have been COUNTED, never silent
+        assert stream.counters.get("resyncs", 0) == 0
+        assert stream.counters.get("forced_resyncs", 0) == 0
+
+        stream.stop()
+        task.cancel()
+        await gw2.stop()
+        await srv.stop()
+
+    asyncio.run(scenario())
+
+
+# ======================================================== chaos wedge
+
+
+def test_chaos_wedge_stalled_not_dead():
+    """The wedge fault: the proxy stops forwarding BOTH directions
+    while every conn stays open — bytes park, no conn error fires,
+    and forwarding resumes byte-exact when the wedge clears."""
+    from gyeeta_tpu.sim.chaos import ChaosProxy, FaultPlan
+
+    async def scenario():
+        async def echo(reader, writer):
+            while True:
+                data = await reader.read(4096)
+                if not data:
+                    break
+                writer.write(data)
+                await writer.drain()
+            writer.close()
+
+        up = await asyncio.start_server(echo, "127.0.0.1", 0)
+        uh, upp = up.sockets[0].getsockname()[:2]
+        proxy = ChaosProxy(uh, upp, FaultPlan())
+        ph, pp = await proxy.start()
+
+        reader, writer = await asyncio.open_connection(ph, pp)
+        writer.write(b"alpha")
+        await writer.drain()
+        assert await asyncio.wait_for(reader.readexactly(5), 5.0) \
+            == b"alpha"
+
+        proxy.wedged = True
+        writer.write(b"beta")
+        await writer.drain()
+        with pytest.raises((asyncio.TimeoutError, TimeoutError)):
+            await asyncio.wait_for(reader.readexactly(4), 0.4)
+        assert not writer.transport.is_closing()    # open, just stalled
+
+        proxy.wedged = False
+        assert await asyncio.wait_for(reader.readexactly(4), 5.0) \
+            == b"beta"                              # byte-exact resume
+        assert proxy.stats["wedged_chunks"] >= 1
+
+        writer.close()
+        await proxy.stop()
+        up.close()
+        await up.wait_closed()
+
+    asyncio.run(scenario())
+
+
+def test_chaos_wedge_window_scheduled():
+    """Deterministic wedge WINDOWS on the plan: the monitor opens and
+    closes the wedge on schedule (the smoke's replica-wedge phase)."""
+    from gyeeta_tpu.sim.chaos import ChaosProxy, FaultPlan
+
+    async def scenario():
+        async def echo(reader, writer):
+            data = await reader.read(4096)
+            writer.write(data)
+            await writer.drain()
+            writer.close()
+
+        up = await asyncio.start_server(echo, "127.0.0.1", 0)
+        uh, upp = up.sockets[0].getsockname()[:2]
+        plan = FaultPlan(wedge_windows=[(0.0, 0.5)])
+        proxy = ChaosProxy(uh, upp, plan)
+        ph, pp = await proxy.start()
+        await asyncio.sleep(0.1)                # monitor opens wedge
+        assert proxy.wedged
+        reader, writer = await asyncio.open_connection(ph, pp)
+        writer.write(b"hello")
+        await writer.drain()
+        # parked during the window, delivered after it closes
+        t0 = time.monotonic()
+        out = await asyncio.wait_for(reader.readexactly(5), 10.0)
+        assert out == b"hello"
+        assert time.monotonic() - t0 > 0.2
+        assert not proxy.wedged
+        assert proxy.stats["wedge_spans"] == 1
+        writer.close()
+        await proxy.stop()
+        up.close()
+        await up.wait_closed()
+
+    asyncio.run(scenario())
