@@ -1,0 +1,404 @@
+"""CI smoke: the continuous-query tier (ISSUE 18).
+
+Boots TWO serve replicas + ONE fabric gateway, stands up 100+
+continuous queries (standing filters over churning svcstate, spelled
+in equivalent variants that must collapse into FEW criteria groups)
+through the hub and the REST/SSE edge, then asserts the tier's
+contract at smoke scale:
+
+- AMORTIZATION: per churn tick, the panel renders at most ONCE and
+  each criteria GROUP evaluates exactly once no matter how many
+  subscribers stand behind it (``gyt_cq_group_evals_total`` /
+  ``gyt_cq_panel_renders_total`` off the gateway's /metrics);
+- BYTE-EXACT membership: an SSE ``cq=1`` subscriber applying its
+  enter/leave/change chain holds exactly the rows a brute-force
+  predicate pass over a fresh full REST panel selects;
+- ``/v1/topology`` renders the fabric health model on REST and on a
+  STOCK node-webserver conn (zero GYT frames) via the shared entry;
+- alertdef-as-CQ parity: grouped evaluation fires byte-identical to
+  degenerate per-def evaluation over live replica columns, and the
+  def-less replicas SKIP the realtime pass (counted);
+- CONTINUITY: a gateway restart over its ``sub_persist`` ring resumes
+  the reconnecting CQ subscriber without a resync, and the stream
+  stays byte-exact across the restart.
+
+Run by ci.sh; standalone: ``JAX_PLATFORMS=cpu python _cq_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import tempfile
+import time
+import urllib.parse
+
+SUBSYS = "svcstate"
+# 4 canonical criteria groups, each spelled two ways: 104 subscribers
+# below cycle over these 8 spellings and MUST land in 4 groups
+SPELLINGS = [
+    "{ svcstate.qps5s > 0.5 }", "{  svcstate.qps5s  >  0.5  }",
+    "{ svcstate.qps5s > 2 }", "{ svcstate.qps5s > 2.0 }",
+    "{ svcstate.qps5s > 5 }", "{ svcstate.qps5s > 5.0 }",
+    "{ svcstate.p95resp5s > 1 }", "{ svcstate.p95resp5s > 1.0 }",
+]
+N_GROUPS = 4
+N_INPROC = 96
+N_SSE = 8
+
+
+async def _http_get(h, p, path: str) -> tuple[int, bytes]:
+    reader, writer = await asyncio.open_connection(h, p)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: s\r\n"
+                 "Connection: close\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read(-1)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), body
+
+
+async def _until(cond, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"cq smoke: timed out waiting for {msg}")
+
+
+def _metric(text: str, name: str) -> float:
+    for ln in text.splitlines():
+        if ln.startswith(name + " "):
+            return float(ln.split()[1])
+    return 0.0
+
+
+async def _sse_cq(gh, gp, filt, extra=""):
+    """Open one SSE continuous-query stream → (events, task, writer)."""
+    from gyeeta_tpu.net.subs import read_sse_events
+    reader, writer = await asyncio.open_connection(gh, gp)
+    q = urllib.parse.quote(filt)
+    writer.write(f"GET /v1/subscribe?subsys={SUBSYS}&filter={q}&cq=1"
+                 f"{extra} HTTP/1.1\r\nHost: s\r\n\r\n".encode())
+    await writer.drain()
+    head = await reader.readuntil(b"\r\n\r\n")
+    assert b"200" in head.split(b"\r\n", 1)[0], head
+    events: list = []
+
+    async def loop():
+        async for ev in read_sse_events(reader):
+            events.append(ev)
+
+    return events, asyncio.create_task(loop()), writer
+
+
+def _expected_members(filt: str, panel: dict) -> dict:
+    """Brute force: full predicate pass over a fresh full panel."""
+    from gyeeta_tpu.query import cq as CQ
+    _, tree = CQ.parse_standing(SUBSYS, filt)
+    rows = panel.get("recs") or []
+    kf = CQ.panel_kf(SUBSYS)
+    mask = CQ.match_mask(tree, SUBSYS, rows)
+    return {CQ.row_key(r, kf): r for r, hit in zip(rows, mask) if hit}
+
+
+async def scenario(tmp: str) -> None:
+    from gyeeta_tpu.alerts import AlertManager
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.ingest import wire
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.query import cq as CQ, delta as D
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.sim.nodeweb import NodeWebSim
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                    conn_batch=256, resp_batch=512, listener_batch=64,
+                    fold_k=2)
+    sim = ParthaSim(n_hosts=8, n_svcs=6, seed=17)
+
+    def tick_frames(phase: int) -> bytes:
+        # ONE deterministic churn sweep, fed to BOTH replicas — the
+        # rotating duty cycle swings services across the qps/resp
+        # thresholds every tick (sim/partha.py churn_records)
+        conn, resp = sim.churn_records(phase, n_conn=256, n_resp=512)
+        return (wire.encode_frames_chunked(wire.NOTIFY_TCP_CONN, conn)
+                + wire.encode_frames_chunked(wire.NOTIFY_RESP_SAMPLE,
+                                             resp)
+                + wire.encode_frame(wire.NOTIFY_HOST_STATE,
+                                    sim.host_state_records()))
+
+    replicas, servers = [], []
+    boot = tick_frames(0)
+    for _ in range(2):
+        rt = Runtime(cfg)
+        rt.feed(sim.name_frames())
+        rt.feed(sim.listener_frames())
+        rt.feed(boot)
+        rt.run_tick()
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        await srv.start()
+        replicas.append(rt)
+        servers.append(srv)
+
+    def drive_tick(phase: int) -> None:
+        fr = tick_frames(phase)
+        for rt in replicas:
+            rt.feed(fr)
+            rt.run_tick()
+
+    persist = tmp + "/gw_subs.jsonl"
+    ups = [(s.host, s.port) for s in servers]
+    gw = FabricGateway(ups, poll_s=0.05, hedge_ms=0,
+                       sub_persist=persist)
+    gh, gp = await gw.start()
+    await _until(lambda: gw.fabric_tick >= replicas[0].snapshot.tick,
+                 msg="tick discovery")
+
+    # ---- 104 standing filters: 96 on the hub + 8 real SSE streams
+    sinks: list[list] = []
+    for i in range(N_INPROC):
+        sink: list = []
+
+        async def send(ev, _s=sink):
+            _s.append(ev)
+
+        await gw.subs.subscribe(
+            {"subsys": SUBSYS, "filter": SPELLINGS[i % len(SPELLINGS)],
+             "cq": True}, send)
+        sinks.append(sink)
+    sse = [await _sse_cq(gh, gp, SPELLINGS[j % len(SPELLINGS)])
+           for j in range(N_SSE)]
+    for events, _t, _w in sse:
+        await _until(lambda _e=events: _e, msg="SSE initial full")
+        assert events[0]["t"] == "full"
+    ngroups = len(gw.subs._cq_groups)       # noqa: SLF001
+    assert ngroups == N_GROUPS, (
+        f"{len(SPELLINGS)} spellings over {N_INPROC + N_SSE} "
+        f"subscribers made {ngroups} groups, expected {N_GROUPS} "
+        "(criteria normalization is not collapsing equivalents)")
+    print(f"cq smoke: {N_INPROC + N_SSE} subscribers collapsed into "
+          f"{ngroups} criteria groups")
+
+    # ---- amortization: N churn ticks, ONE render + one pass/group
+    status, body = await _http_get(gh, gp, "/metrics")
+    assert status == 200
+    m0 = body.decode()
+    evals0 = _metric(m0, "gyt_cq_group_evals_total")
+    renders0 = (_metric(m0, "gyt_cq_panel_renders_total")
+                + _metric(m0, "gyt_cq_panel_render_shared_total"))
+
+    held0 = [D.apply_event(None, ev[0][0]) for ev in sse]
+    nticks = 6
+    for phase in range(1, nticks + 1):
+        lens = [len(s) for s in sinks] + [len(e) for e, _t, _w in sse]
+        drive_tick(phase)
+        tick = replicas[0].snapshot.tick
+        await _until(lambda: gw.fabric_tick >= tick, msg="fabric tick")
+        # EVERY subscription advances every tick (event or heartbeat)
+        await _until(
+            lambda: all(len(s) > n for s, n in
+                        zip(sinks + [e for e, _t, _w in sse], lens)),
+            msg=f"tick {phase} fan-out to every subscriber")
+
+    status, body = await _http_get(gh, gp, "/metrics")
+    m1 = body.decode()
+    evals = _metric(m1, "gyt_cq_group_evals_total") - evals0
+    renders = (_metric(m1, "gyt_cq_panel_renders_total")
+               + _metric(m1, "gyt_cq_panel_render_shared_total")
+               - renders0)
+    assert renders == nticks, (
+        f"{renders} panel renders for {nticks} ticks — the CQ tier "
+        "must render the panel at most ONCE per tick")
+    assert evals == ngroups * nticks, (
+        f"{evals} group evals for {ngroups} groups x {nticks} ticks "
+        f"({N_INPROC + N_SSE} subscribers) — predicate passes must "
+        "amortize per GROUP, not per subscriber")
+    assert _metric(m1, "gyt_cq_groups") == ngroups
+    assert _metric(m1, "gyt_cq_subscribers") >= N_INPROC + N_SSE
+    nevents = sum(
+        _metric(m1, f'gyt_cq_events_total{{kind="{k}"}}')
+        for k in ("enter", "leave", "change"))
+    assert nevents > 0, "churn produced zero membership events"
+    print(f"cq smoke: amortization OK ({int(evals)} group evals, "
+          f"{int(renders)} panel renders over {nticks} ticks, "
+          f"{int(nevents)} membership events)")
+
+    # ---- byte-exact: SSE chains vs brute force over a full panel
+    status, body = await _http_get(
+        gh, gp, f"/v1/{SUBSYS}?maxrecs={CQ.PANEL_MAXRECS}")
+    assert status == 200
+    panel = json.loads(body)
+    assert panel["snaptick"] == replicas[0].snapshot.tick, \
+        "verification panel raced a tick"
+    for j, (events, _t, _w) in enumerate(sse):
+        held = held0[j]
+        for ev in events[1:]:
+            held = D.apply_event(held, ev)
+        exp = _expected_members(SPELLINGS[j % len(SPELLINGS)], panel)
+        got = {CQ.row_key(r, held["kf"]): r for r in held["recs"]}
+        assert json.dumps(got, sort_keys=True) \
+            == json.dumps(exp, sort_keys=True), (
+            f"SSE membership diverged from the brute-force pass "
+            f"(filter {SPELLINGS[j % len(SPELLINGS)]!r}: "
+            f"{len(got)} vs {len(exp)} rows)")
+    assert any(len(_expected_members(s, panel)) > 0
+               for s in SPELLINGS), "every group empty — dead churn"
+    print(f"cq smoke: SSE membership byte-exact vs brute force "
+          f"({len(panel.get('recs') or [])} panel rows)")
+
+    # ---- /v1/topology on REST and on a STOCK node-webserver conn
+    status, body = await _http_get(gh, gp, "/v1/topology")
+    assert status == 200
+    topo = json.loads(body)
+    assert topo.get("t") == "topology"
+    assert len(topo["upstreams"]) == 2
+    assert all(u["state"] == "up" for u in topo["upstreams"])
+    assert topo["cq_groups"] == ngroups
+    assert topo["cq_subscribers"] >= N_INPROC + N_SSE
+    nw = NodeWebSim(hostname="cq-nodeweb")
+    await nw.connect(gh, gp)
+    nm_topo = await nw.query_web("topology")
+    await nw.close()
+    assert nm_topo.get("t") == "topology"
+    assert [u["upstream"] for u in nm_topo["upstreams"]] \
+        == [u["upstream"] for u in topo["upstreams"]]
+    print(f"cq smoke: topology OK on REST + stock NM "
+          f"({len(topo['upstreams'])} upstreams, "
+          f"{len(topo['owners'])} owned keys)")
+
+    # ---- alertdefs ARE continuous queries: grouped evaluation fires
+    # byte-identical to degenerate per-def evaluation on LIVE columns,
+    # and the def-less replica runtimes skip the realtime pass
+    assert all(r.stats.counters.get("alert_eval_skipped", 0) > 0
+               for r in replicas), (
+        "def-less runtimes must skip (and count) the alert pass")
+
+    class Clock:
+        t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    defs = [
+        {"alertname": "hot_svc", "subsys": SUBSYS,
+         "filter": "{ svcstate.qps5s > 0.5 }", "severity": "warning",
+         "numcheckfor": 1, "repeataftersec": 0},
+        {"alertname": "hot_svc2", "subsys": SUBSYS,
+         "filter": "{  svcstate.qps5s  >  0.5 }",       # same group
+         "severity": "info", "numcheckfor": 2, "repeataftersec": 0},
+        {"alertname": "slow_svc", "subsys": SUBSYS,
+         "filter": "{ svcstate.p95resp5s > 1 }", "severity": "critical",
+         "numcheckfor": 1, "repeataftersec": 0},
+    ]
+    grouped = AlertManager(None, clock=clock)
+    legacy = AlertManager(None, clock=clock)
+    for d in defs:
+        grouped.add_def(dict(d))
+        legacy.add_def(dict(d))
+    legacy._canon = {n: f"__uniq:{n}" for n in legacy.defs}
+    for phase in range(nticks + 1, nticks + 5):
+        drive_tick(phase)
+        cols_fn = replicas[0].snapshot.columns
+        # the snapshot's column mapping materializes DERIVED columns
+        # (rate/quantile fields) on first criteria access and alert
+        # rows carry every materialized column — touch them up front
+        # so both managers see the identical row shape
+        cols, _base = cols_fn(SUBSYS)
+        _ = (cols["qps5s"], cols["p95resp5s"])
+        a = grouped.check(replicas[0].state, columns_fn=cols_fn)
+        b = legacy.check(replicas[0].state, columns_fn=cols_fn)
+        assert a == b, "grouped evaluation diverged from per-def"
+        assert grouped._state == legacy._state      # noqa: SLF001
+        clock.t += 5.0
+    assert grouped.stats["nfired"] == legacy.stats["nfired"]
+    assert grouped.stats["nfired"] > 0, "no alerts fired under churn"
+    assert grouped.stats["ncq_group_evals"] \
+        < legacy.stats["ncq_group_evals"], (
+        "defs sharing canonical criteria must share predicate passes")
+    tick = replicas[0].snapshot.tick
+    await _until(lambda: gw.fabric_tick >= tick, msg="alert ticks")
+    print(f"cq smoke: alertdef CQ parity OK ({grouped.stats['nfired']}"
+          f" fired, {grouped.stats['ncq_group_evals']} grouped vs "
+          f"{legacy.stats['ncq_group_evals']} per-def passes)")
+
+    # ---- continuity across a gateway RESTART (persisted ring)
+    watch_filt = SPELLINGS[0]
+    events, task, writer = sse[0]
+    held = held0[0]
+    for ev in events[1:]:
+        held = D.apply_event(held, ev)
+    task.cancel()
+    writer.close()
+    for _e, t, w in sse[1:]:
+        t.cancel()
+        w.close()
+    await gw.stop()
+
+    # the fabric keeps moving while the gateway is down — the restarted
+    # gateway restores the persisted ring, primes against the CURRENT
+    # panel, and the reconnect below must receive the missed
+    # enter/leave deltas (not an ack, not a resync)
+    drive_tick(50)
+    drive_tick(51)
+
+    gw2 = FabricGateway(ups, poll_s=0.05, hedge_ms=0,
+                        sub_persist=persist)
+    gh2, gp2 = await gw2.start()
+    tick = replicas[0].snapshot.tick
+    await _until(lambda: gw2.fabric_tick >= tick, msg="gw2 tick")
+    ev2, task2, w2 = await _sse_cq(
+        gh2, gp2, watch_filt,
+        extra=f"&last_snaptick={held['snaptick']}")
+    await _until(lambda: ev2, msg="resumed stream")
+    assert ev2[0]["t"] != "full", (
+        f"reconnect across restart got {ev2[0]['t']!r} — the persisted "
+        "membership ring must resume with deltas, not a resync")
+    assert gw2.stats.counters.get("gw_sub_resumes", 0) >= 1
+    assert gw2.stats.counters.get("cq_resyncs", 0) == 0
+    for ev in ev2:
+        held = D.apply_event(held, ev)
+    n2 = len(ev2)
+    drive_tick(99)          # movement after the restart
+    tick = replicas[0].snapshot.tick
+    await _until(lambda: gw2.fabric_tick >= tick, msg="gw2 push")
+    await _until(lambda: len(ev2) > n2, msg="post-restart event")
+    for ev in ev2[n2:]:
+        held = D.apply_event(held, ev)
+    status, body = await _http_get(
+        gh2, gp2, f"/v1/{SUBSYS}?maxrecs={CQ.PANEL_MAXRECS}")
+    panel = json.loads(body)
+    assert panel["snaptick"] == tick
+    exp = _expected_members(watch_filt, panel)
+    got = {CQ.row_key(r, held["kf"]): r for r in held["recs"]}
+    assert json.dumps(got, sort_keys=True) \
+        == json.dumps(exp, sort_keys=True), (
+        "post-restart membership diverged from the brute-force pass")
+    print(f"cq smoke: restart continuity OK (resumed at snaptick "
+          f"{held['snaptick']}, {len(got)} members byte-exact)")
+
+    task2.cancel()
+    w2.close()
+    await gw2.stop()
+    for srv in servers:
+        await srv.stop()
+    for rt in replicas:
+        rt.close()
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory(prefix="gyt_cq_smoke_") as tmp:
+        asyncio.run(scenario(tmp))
+    print("cq smoke: OK")
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except AssertionError as e:
+        print(f"cq smoke: FAIL — {e}", file=sys.stderr)
+        sys.exit(1)

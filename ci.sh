@@ -149,6 +149,25 @@ if ! JAX_PLATFORMS=cpu python _fabric_chaos_smoke.py; then
     exit 1
 fi
 
+# Continuous-query smoke (ISSUE 18): 2 replicas + gateway, 104
+# standing filters (96 hub + 8 real SSE) spelled 8 ways over 4
+# canonical criteria groups on churning svcstate. Asserts the
+# amortization contract off /metrics (gyt_cq_group_evals_total ==
+# groups*ticks, gyt_cq_panel_renders_total == ticks — ≤1 render and
+# one predicate pass per group per tick no matter how many
+# subscribers), SSE-held membership byte-exact vs a brute-force
+# predicate pass over the full panel, /v1/topology on REST + a stock
+# NM conn, alertdef CQ evaluation byte-identical to degenerate per-def
+# groups (fewer predicate passes, same fires/astate), the zero-def
+# alert short-circuit counter, and enter/leave continuity across a
+# gateway restart (persisted ring resumes with the missed deltas —
+# counted as a resume, zero resyncs).
+echo "ci: continuous-query smoke" >&2
+if ! JAX_PLATFORMS=cpu python _cq_smoke.py; then
+    echo "ci: FATAL — continuous-query smoke failed" >&2
+    exit 1
+fi
+
 # Fused fold-path smoke: (a) the fused megakernel is the DEFAULT fold
 # path (a regression to the legacy per-subsystem dispatch sequence
 # would silently cost 2-6x fold throughput); (b) GYT_PALLAS=1 on a

@@ -7,6 +7,20 @@ walk, ``server/gy_malerts.cc:1869``), then advances per-entity lifecycle:
 
     pending (consecutive hits < numcheckfor) → firing → resolved
 
+Alertdefs ARE continuous queries (ISSUE 18): each def is a standing
+filter whose canonical form (``query/normalize.py:canonical_filter``)
+lands it in a ``(column-source, criteria)`` group shared with every
+other def asking the same question — the predicate evaluates ONCE per
+group per check (``ncq_group_evals`` counts group passes; compare
+against the def count), the same normalization+grouping the
+subscription hub's CQ tier uses (``query/cq.py``). A def FIRES on
+membership *enter* (gated by ``numcheckfor`` consecutive membership
+checks) and RESOLVES on *leave* (``cq.advance_entities`` is the
+lifecycle step). Column sources are rendered lazily per targeted
+subsystem only — a subsystem no def targets costs nothing, and the
+runtimes skip the whole pass (counted ``alert_eval_skipped``) when no
+realtime def is enabled.
+
 Silences and inhibits gate *notification*, not detection (matching the
 reference: a silenced alert still tracks state, ``gy_alertmgr.cc:5117``).
 
@@ -35,7 +49,8 @@ from typing import Callable, NamedTuple, Optional
 import numpy as np
 
 from gyeeta_tpu.alerts.defs import AlertDef, Inhibit, Silence
-from gyeeta_tpu.query import api, criteria
+from gyeeta_tpu.query import api, cq, criteria
+from gyeeta_tpu.query.normalize import canonical_filter
 
 
 class Alert(NamedTuple):
@@ -105,6 +120,9 @@ class AlertManager:
         self._dispatcher = None
         self._state: dict[tuple, _EntityState] = {}
         self._trees: dict[str, object] = {}     # parsed filter cache
+        # def name → canonical filter: the criteria-group identity
+        # (defs sharing it share one predicate pass per check)
+        self._canon: dict[str, str] = {}
         self._groups: dict[str, list] = {}      # name → [deadline, alerts]
         self._next_db: dict[str, float] = {}    # db-def → next eval time
         self._last_db: dict[str, float] = {}    # db-def → last eval time
@@ -115,7 +133,11 @@ class AlertManager:
                       # windowed defs checked before the first history
                       # window exists skip COUNTED (check() bumps this;
                       # it must pre-exist or the += KeyErrors)
-                      "nwindow_skipped": 0}
+                      "nwindow_skipped": 0,
+                      # criteria-group predicate passes per check():
+                      # defs sharing a canonical filter share one pass,
+                      # so this stays ≤ the enabled realtime def count
+                      "ncq_group_evals": 0}
 
     # ------------------------------------------------------------- CRUD
     def add_def(self, d: dict | AlertDef) -> AlertDef:
@@ -127,13 +149,29 @@ class AlertManager:
               else AlertDef.from_json(d))
         self.defs[ad.name] = ad
         self._trees[f"def:{ad.name}"] = criteria.parse(ad.filter)
+        self._canon[ad.name] = canonical_filter(ad.filter)
         return ad
 
     def delete_def(self, name: str) -> bool:
         self._state = {k: v for k, v in self._state.items()
                        if k[0] != name}
         self._trees.pop(f"def:{name}", None)
+        self._canon.pop(name, None)
         return self.defs.pop(name, None) is not None
+
+    # defs the runtimes must evaluate this pass — the zero-def (or
+    # zero-REALTIME-def) short-circuit happens at the CALLER, before
+    # any column/render work, counted ``alert_eval_skipped``
+    def wants_realtime(self) -> bool:
+        return any(ad.enabled and ad.mode == "realtime"
+                   for ad in self.defs.values())
+
+    def wants_db(self) -> bool:
+        return any(ad.enabled and ad.mode == "db"
+                   for ad in self.defs.values())
+
+    def pending_groups(self) -> bool:
+        return bool(self._groups)
 
     def add_silence(self, d: dict | Silence) -> Silence:
         s = d if isinstance(d, Silence) else Silence.from_json(d)
@@ -212,12 +250,22 @@ class AlertManager:
         """Evaluate all defs against live engine state → newly-notified
         alerts (grouped per def, routed to actions).
 
+        Each def is a CONTINUOUS QUERY: its predicate evaluates once
+        per ``(column-source, canonical-filter)`` group — N defs asking
+        an equivalent question share one vectorized pass (the mask
+        cache below; ``ncq_group_evals`` counts passes) — and the
+        entity lifecycle advances on membership enter/stay/leave
+        (``cq.advance_entities``): fire on enter after ``numcheckfor``
+        consecutive membership checks, resolve on leave. Column
+        sources render lazily per TARGETED subsystem only.
+
         ``columns_fn(subsys) -> (cols, mask)`` overrides the column source
         (the sharded runtime evaluates alerts on gathered readbacks)."""
         now = self._clock()
         self.stats["nchecks"] += 1
         notified: list[Alert] = []
         cols_cache: dict[str, tuple] = {}
+        mask_cache: dict[tuple, object] = {}
 
         for ad in self.defs.values():
             if not ad.enabled or ad.mode != "realtime":
@@ -241,24 +289,38 @@ class AlertManager:
             if cols_cache[ckey] is None:
                 continue
             cols, base = cols_cache[ckey]
-            tree = self._trees.get(f"def:{ad.name}") \
-                or criteria.parse(ad.filter)
-            try:
-                mask = base & criteria.evaluate(tree, cols, ad.subsys)
-            except KeyError:
-                if not ad.window:
-                    raise
-                # a windowed QUANTILE criterion over shards without
-                # delta panels: the field was omitted from the window
-                # columns (never approximated) — skip COUNTED, exactly
-                # like a not-yet-existing window, instead of one stale
-                # store breaking the whole alert pass
+            # shared-predicate index: one mask per (column source,
+            # canonical criteria) group per check — the group key
+            # embeds ckey so live and windowed defs never share
+            gkey = (ckey, self._canon.get(ad.name, ad.filter))
+            if gkey not in mask_cache:
+                tree = self._trees.get(f"def:{ad.name}") \
+                    or criteria.parse(ad.filter)
+                try:
+                    mask_cache[gkey] = \
+                        base & criteria.evaluate(tree, cols, ad.subsys)
+                    self.stats["ncq_group_evals"] += 1
+                except KeyError:
+                    if not ad.window:
+                        raise
+                    # a windowed QUANTILE criterion over shards without
+                    # delta panels: the field was omitted from the
+                    # window columns (never approximated) — the GROUP
+                    # skips; each def standing on it counts below,
+                    # exactly like a not-yet-existing window, instead
+                    # of one stale store breaking the whole alert pass
+                    mask_cache[gkey] = None
+            mask = mask_cache[gkey]
+            if mask is None:
                 self.stats["nwindow_skipped"] += 1
                 continue
             hits = set(np.nonzero(mask)[0].tolist())
 
             inhibited = self._inhibited(ad)
             group: list[Alert] = []
+            # the def's held membership (entity keys with state):
+            # enter/stay advance nhits below, leave resolves after
+            held = {k for k in self._state if k[0] == ad.name}
             seen_keys = set()
             for i in sorted(hits):
                 ent = _entity_key_of(ad.subsys, cols, i)
@@ -287,10 +349,10 @@ class AlertManager:
                     es = es._replace(tlast_notify=now)
                 self._state[key] = es._replace(nhits=nhits, firing=firing)
 
-            # entities that stopped matching resolve (and are dropped —
-            # the state dict must not grow with entity churn)
-            for key in [k for k in self._state
-                        if k[0] == ad.name and k not in seen_keys]:
+            # LEAVE resolves (and drops state — the dict must not grow
+            # with entity churn); enter/stay already advanced above
+            _enter, _stay, leave = cq.advance_entities(held, seen_keys)
+            for key in leave:
                 if self._state[key].firing:
                     self.stats["nresolved"] += 1
                 del self._state[key]

@@ -638,7 +638,7 @@ class _WindowColumns:
         the merged histogram. Fields whose delta panel is missing
         (pre-delta shards) are REMOVED from the output — and counted —
         never served as the old silent mean-of-snapshots."""
-        qf = WQ.QUANTILE_FIELDS.get(subsys)
+        qf = WQ.quantile_fields(subsys)
         if not qf or not isinstance(cols, dict) or not len(mask):
             if qf and isinstance(cols, dict):
                 # empty window: fields stay, values are vacuous
@@ -829,7 +829,7 @@ class TimeView:
         panel. Silently serving the old mean-of-snapshots would be a
         wrong number wearing a quantile's name; an implicit full
         projection instead omits the field (also counted)."""
-        qf = WQ.QUANTILE_FIELDS.get(opts.subsys)
+        qf = WQ.quantile_fields(opts.subsys)
         if not qf:
             return
         refs = WQ.referenced_fields(opts) & set(qf)

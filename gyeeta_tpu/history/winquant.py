@@ -115,6 +115,60 @@ QUANTILE_FIELDS = {
 }
 
 
+# ------------------------------------------------------------ registry
+# New subsystems register their monotone leaves and quantile fields at
+# import time instead of editing the literals above (ROADMAP history
+# item: qps baselines / new panels get true windowed quantiles without
+# touching this module). The read side goes through
+# ``quantile_fields()`` (both timeview call sites), so a registered
+# field is picked up by window SERVING and by the windowed-alertdef
+# column check identically — a field can't silently skip the windowed
+# path (tests/test_cq.py pins coverage).
+
+def register_delta_spec(name: str, spec: DeltaSpec,
+                        replace: bool = False) -> DeltaSpec:
+    """Register a monotone loghist leaf as a delta panel. The
+    compactor extracts end−start per window for every registered
+    panel; ``spec_attr`` must name a LogHistSpec on EngineCfg."""
+    if not replace and name in DELTA_SPECS \
+            and DELTA_SPECS[name] != spec:
+        raise ValueError(f"delta panel {name!r} already registered "
+                         f"with a different spec")
+    DELTA_SPECS[name] = spec
+    return spec
+
+
+def register_quantile_field(subsys: str, field: str, qf: QuantField,
+                            replace: bool = False) -> QuantField:
+    """Register one JSON field of ``subsys`` as a quantile (or window
+    mean, ``q=None``) over a registered delta panel. Validates at
+    registration: the panel must exist in ``DELTA_SPECS`` and the
+    field in the subsystem's field map — a typo fails HERE, not as a
+    silently-unwindowed field at query time."""
+    if qf.panel not in DELTA_SPECS:
+        raise ValueError(
+            f"quantile field {subsys}.{field} references unknown "
+            f"delta panel {qf.panel!r} (register_delta_spec first)")
+    if field not in fieldmaps.field_map(subsys):
+        raise ValueError(
+            f"{field!r} is not a field of {subsys!r}")
+    cur = QUANTILE_FIELDS.setdefault(subsys, {})
+    if not replace and cur.get(field) not in (None, qf):
+        raise ValueError(f"{subsys}.{field} already registered "
+                         f"with a different source")
+    # subsystems sharing a dict literal (taskstate presets) see the
+    # registration together — that sharing is the point
+    cur[field] = qf
+    return qf
+
+
+def quantile_fields(subsys: str) -> dict:
+    """The subsystem's windowed-quantile fields ({} when none) — THE
+    read-side accessor (timeview's window serving and the windowed
+    criteria check both resolve through it)."""
+    return QUANTILE_FIELDS.get(subsys) or {}
+
+
 def spec_of(cfg, name: str):
     return getattr(cfg, DELTA_SPECS[name].spec_attr)
 

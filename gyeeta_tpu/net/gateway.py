@@ -332,6 +332,48 @@ class FabricGateway:
     def fabric_tick(self) -> int:
         return max((u.tick for u in self.upstreams), default=-1)
 
+    # ------------------------------------------------------------- topology
+    def topology(self) -> dict:
+        """The PR-15 health model as a queryable panel
+        (``/v1/topology`` on every front): per-upstream circuit state
+        (the breakers' live view — up / half_open / down, consecutive
+        fails, latency EWMA, probe deadline), the peer fleet, and the
+        rendezvous OWNER of every live subscription / continuous-query
+        key — so SubscribeStream supervisors and agents route off the
+        SAME view the breakers maintain instead of probing blind."""
+        now = time.monotonic()
+        ups = []
+        for u in self.upstreams:
+            ups.append({
+                "upstream": u.label, "host": u.host, "port": u.port,
+                "state": u.state, "tick": u.tick, "fails": u.fails,
+                "ewma_ms": round(u.ewma_ms, 3)
+                if u.ewma_ms is not None else None,
+                "probe_in_s": round(max(0.0, u.probe_at - now), 3)
+                if u.state == "down" else None,
+            })
+        me = self._ident()
+        owners = {}
+        sub_keys = list(self.subs._by_key) \
+            + list(self.subs._cq_groups)            # noqa: SLF001
+        for key in sub_keys[:256]:
+            own = self._owner_peer(key)
+            owners[key] = me if own is None else f"{own[0]}:{own[1]}"
+        return {
+            "t": "topology",
+            "fabric_tick": self.fabric_tick,
+            "self": me,
+            "peers": [f"{h}:{p}" for h, p in self.peers],
+            "upstreams": ups,
+            "owners": owners,
+            "subscribers": self.subs.nsubs,
+            "sub_keys": len(self.subs._by_key),     # noqa: SLF001
+            "cq_groups": len(self.subs._cq_groups),  # noqa: SLF001
+            "cq_subscribers": sum(
+                len(g.subs)
+                for g in self.subs._cq_groups.values()),  # noqa: SLF001
+        }
+
     async def _query_one(self, u: _Upstream, req: dict,
                          timeout: Optional[float] = None) -> dict:
         from gyeeta_tpu.ingest import wire
@@ -649,6 +691,12 @@ class FabricGateway:
         asymmetric peer config would otherwise ping-pong forever.
         Raises RuntimeError with the server's error envelope,
         ConnectionError when no upstream answers."""
+        if req.get("subsys") == "topology":
+            # breaker-aware topology hints (/v1/topology on every
+            # front): rendered from the gateway's OWN health model —
+            # never forwarded upstream, never cached
+            self.stats.bump("gw_queries|edge=topology")
+            return self.topology()
         if not self._cacheable(req):
             anchor = self._hist_anchor(req)
             if anchor is not None \
@@ -1124,6 +1172,10 @@ class FabricGateway:
                 req[k] = q[k][0]
         if "sortdesc" in q:
             req["sortdesc"] = q["sortdesc"][0].lower() in ("1", "true")
+        if "cq" in q:
+            # continuous query: the subscription is a STANDING FILTER
+            # (enter/leave/change membership events), not a panel view
+            req["cq"] = q["cq"][0].lower() in ("1", "true")
         return req
 
     # ---- SSE subscription edge

@@ -143,8 +143,11 @@ async def serve_nm_gateway(gw, reader, writer, body: bytes) -> None:
     same handshake gates, but queries route through the gateway's
     (snaptick, request-hash) edge cache instead of a local runtime —
     a stock node webserver pointed at a gateway shares the fleet's
-    renders without knowing the tier exists. CRUD verbs translate and
-    pass through to a replica (mutations are never cached)."""
+    renders without knowing the tier exists. Because this rides the
+    SAME ``gw.query`` entry as the HTTP/GYT fronts, a stock NM also
+    sees the gateway-local panels (``subsys=topology`` — the breaker /
+    owner-map health model). CRUD verbs translate and pass through to
+    a replica (mutations are never cached)."""
     req = RQ.parse_nm_connect_cmd(body)
     err, es = _gate_nm(req)
     now = int(time.time())

@@ -47,12 +47,33 @@ rotating endpoints — at-least-once, no-gap: its reassembled
 responses are byte-identical to an uninterrupted subscription's at
 every common snaptick (property-tested).
 
+Continuous queries (ISSUE 18): a subscription carrying ``cq: true``
+plus a ``filter`` is a STANDING PREDICATE, not a panel view. The hub
+canonicalizes the filter (``query/cq.py``), groups subscribers by
+``(subsys, canonical-criteria)``, and per tick runs ONE predicate pass
+per group over only the panel rows that CHANGED — computed from the
+same row-keyed diff the panel subscriptions already pay for, never
+from a second render. Subscribers receive first-class ``enter`` /
+``change`` / ``leave`` membership events (``query/delta.py`` applies
+them) and heartbeat acks on quiet ticks. Group membership is carried
+incrementally across ticks, versioned through the SAME retained /
+persisted rings as panel subscriptions — a reconnect (or a restarted
+gateway) resumes with enter/leave deltas when the ring covers the
+client's version, else a counted, ``resync``-marked full. N standing
+filters over F distinct criteria cost F predicate passes and ≤1 panel
+render per tick, shared with any plain full-panel subscriber.
+
 Metrics (all through the hub's ``Stats`` registry — rendered as
-``gyt_gw_*`` by ``obs/prom.py``): ``gw_subscribers`` / ``gw_sub_keys``
-gauges, ``gw_deltas_pushed`` / ``gw_resyncs`` / ``gw_sub_events`` /
-``gw_sub_dropped`` counters, ``gw_delta_bytes`` / ``gw_full_bytes``
-(the delta-vs-full wire ratio, QUERYLAT_r08), and the ``gw_push``
-stage hist (render+diff+deliver lag per key per tick).
+``gyt_gw_*`` / ``gyt_cq_*`` by ``obs/prom.py``): ``gw_subscribers`` /
+``gw_sub_keys`` gauges, ``gw_deltas_pushed`` / ``gw_resyncs`` /
+``gw_sub_events`` / ``gw_sub_dropped`` counters, ``gw_delta_bytes`` /
+``gw_full_bytes`` (the delta-vs-full wire ratio, QUERYLAT_r08), the
+``gw_push`` stage hist (render+diff+deliver lag per key per tick);
+and for the continuous-query tier ``cq_groups`` / ``cq_subscribers``
+gauges plus ``cq_group_evals`` (THE amortization proof: one bump per
+live group per tick, compare against ``cq_subscribers``),
+``cq_panel_renders`` / ``cq_panel_render_shared``, ``cq_events|kind=``,
+``cq_resyncs``, ``cq_fetch_errors`` / ``cq_eval_errors`` counters.
 """
 
 from __future__ import annotations
@@ -64,7 +85,7 @@ import logging
 import os
 from typing import Optional
 
-from gyeeta_tpu.query import delta as D
+from gyeeta_tpu.query import cq as CQ, delta as D
 from gyeeta_tpu.query.normalize import normalize_request, request_key
 
 log = logging.getLogger("gyeeta_tpu.net.subs")
@@ -138,6 +159,32 @@ class _Sub:
         self.conn_tag = conn_tag
 
 
+class _CQGroup:
+    """One normalized standing filter + every subscriber asking it.
+    Lives only while it has subscribers — its membership version ring
+    outlives it (retained/persisted), so a re-subscribe rebuilds the
+    group from the ring and resumes with deltas."""
+
+    __slots__ = ("key", "m", "subs")
+
+    def __init__(self, key, m):
+        self.key = key
+        self.m = m                      # CQ.Membership
+        self.subs: dict = {}
+
+
+class _CQPanel:
+    """Per-subsystem panel state shared by every criteria group
+    standing on it: the previous tick's row map, diffed ONCE per tick
+    (the changed-rows set every group's predicate pass runs over)."""
+
+    __slots__ = ("prev", "tick")
+
+    def __init__(self, prev=None, tick=None):
+        self.prev = prev
+        self.tick = tick
+
+
 class SubscriptionHub:
     """One per serving process. ``fetch`` is the tier's full-render
     function ``async (req) -> resp`` — the snapshot query path on a
@@ -165,6 +212,10 @@ class SubscriptionHub:
         # reconnect resumes with a delta, and optionally persist to an
         # append-only file so a RESTART does too.
         self._versions: dict[str, collections.deque] = {}
+        # continuous-query tier: criteria group per normalized
+        # standing filter, panel diff state per subsystem
+        self._cq_groups: dict[str, _CQGroup] = {}
+        self._cq_panels: dict[str, _CQPanel] = {}
         self._persist_path = persist_path
         self._persist_f = None
         self._persist_max = persist_max_bytes()
@@ -254,6 +305,9 @@ class SubscriptionHub:
     def _gauge(self) -> None:
         self.stats.gauge("gw_subscribers", float(len(self._subs)))
         self.stats.gauge("gw_sub_keys", float(len(self._by_key)))
+        self.stats.gauge("cq_groups", float(len(self._cq_groups)))
+        self.stats.gauge("cq_subscribers", float(
+            sum(len(g.subs) for g in self._cq_groups.values())))
 
     @property
     def nsubs(self) -> int:
@@ -281,6 +335,9 @@ class SubscriptionHub:
             raise SubscribeError(
                 "subscriptions serve the snapshot tier "
                 "(consistency=strong cannot stream)")
+        if req.get("cq"):
+            return await self._subscribe_cq(req, send, last_snaptick,
+                                            conn_tag)
         norm = normalize_request(req)
         key = request_key(norm)
         self._seq += 1
@@ -339,6 +396,123 @@ class SubscriptionHub:
             raise
         return sid
 
+    # ------------------------------------------------ continuous queries
+    async def _subscribe_cq(self, req: dict, send, last_snaptick,
+                            conn_tag) -> int:
+        """Register one STANDING FILTER (``cq: true``): validate +
+        canonicalize the criteria, land the subscriber in its
+        ``(subsys, canonical-filter)`` group, prime membership from
+        the shared panel state (one render at most — none when the
+        panel is already live), and deliver the initial event chain:
+        full / enter+change+leave-from-last-seen / ack."""
+        extra = set(req) - {"subsys", "filter", "cq"}
+        if extra:
+            self.stats.bump("gw_subs_rejected|reason=envelope")
+            raise SubscribeError(
+                f"a continuous query is subsys+filter only "
+                f"(membership is a set): unexpected {sorted(extra)}")
+        subsys = req.get("subsys")
+        filt = req.get("filter")
+        if not subsys or not filt:
+            self.stats.bump("gw_subs_rejected|reason=envelope")
+            raise SubscribeError(
+                "a continuous query needs subsys and filter")
+        try:
+            canon, tree = CQ.parse_standing(subsys, filt)
+        except ValueError as e:
+            self.stats.bump("gw_subs_rejected|reason=filter")
+            raise SubscribeError(str(e)) from e
+        key = CQ.group_key(subsys, canon)
+        self._req_of_key[key] = CQ.normalize_cq(subsys, canon)
+        group = self._cq_groups.get(key)
+        if group is None:
+            m = CQ.Membership(subsys, canon, tree)
+            # a retained / persist-restored membership version is the
+            # resume BASE: restore it, then prime against the current
+            # panel below so held clients get enter/leave deltas
+            latest = self._latest(key)
+            if latest is not None:
+                m.members = CQ.members_of_response(latest[1])
+                m.snaptick = latest[0]
+            group = _CQGroup(key, m)
+            await self._prime_cq(group)
+            self._cq_groups[key] = group
+        tick = group.m.snaptick
+        evs = None
+        if last_snaptick is not None and last_snaptick == tick:
+            evs = [D.ack_event(tick)]
+        elif last_snaptick is not None:
+            held = self._version_at(key, last_snaptick)
+            if held is not None:
+                tmp = CQ.Membership(
+                    subsys, canon, None, kf=group.m.kf,
+                    members=CQ.members_of_response(held),
+                    snaptick=last_snaptick)
+                e, c, lv = CQ.rebuild(tmp, group.m.members, tick)
+                evs = CQ.events_of(last_snaptick, tick, group.m.kf,
+                                   e, c, lv)
+                if not evs:
+                    # same membership at a newer tick (changed, then
+                    # changed back): an empty change advances the
+                    # client's version without a resync
+                    evs = [{"t": "change", "snaptick": tick,
+                            "base": last_snaptick, "kf": group.m.kf,
+                            "rows": {}}]
+                self.stats.bump("gw_sub_resumes")
+            else:
+                self.stats.bump("gw_resyncs")
+                self.stats.bump("gw_sub_resyncs")
+                self.stats.bump("cq_resyncs")
+                ev = dict(D.full_event(CQ.response_of(group.m)))
+                ev["resync"] = True
+                evs = [ev]
+        if evs is None:
+            evs = [D.full_event(CQ.response_of(group.m))]
+        self._seq += 1
+        sid = self._seq
+        sub = _Sub(sid, key, send, tick, conn_tag)
+        self._subs[sid] = sub
+        group.subs[sid] = sub
+        self._gauge()
+        self.stats.bump("gw_subs_registered")
+        try:
+            for ev in evs:
+                await send(ev)
+                self.stats.bump("gw_sub_events")
+        except Exception:
+            self.unsubscribe(sid)
+            raise
+        return sid
+
+    async def _prime_cq(self, group: _CQGroup) -> None:
+        """Bring a new/retained/restored group's membership to the
+        CURRENT panel tick. Reuses the live shared panel state when
+        another group already keeps it hot (no render); otherwise one
+        render, which then seeds the panel state every later group on
+        this subsystem shares."""
+        m = group.m
+        panel = self._cq_panels.get(m.subsys)
+        if panel is None or panel.prev is None:
+            resp = await self._fetch(CQ.panel_request(m.subsys))
+            rows = resp.get("recs") or []
+            prev = {}
+            for r in rows:
+                prev[CQ.row_key(r, m.kf)] = r
+            panel = _CQPanel(prev, resp.get("snaptick"))
+            self._cq_panels[m.subsys] = panel
+            self.stats.bump("cq_panel_renders")
+        rows = list(panel.prev.values())
+        mask = CQ.match_mask(m.tree, m.subsys, rows)
+        new_members = {k: r for (k, r), hit
+                       in zip(panel.prev.items(), mask) if hit}
+        changed = CQ.rebuild(m, new_members, panel.tick)
+        if m.snaptick is None:
+            m.snaptick = panel.tick
+        latest = self._latest(group.key)
+        if latest is None or latest[0] != m.snaptick \
+                or any(changed):
+            self._push_version(group.key, CQ.response_of(m))
+
     def unsubscribe(self, sid: int) -> None:
         sub = self._subs.pop(sid, None)
         if sub is None:
@@ -353,16 +527,31 @@ class SubscriptionHub:
                 # resumes with a delta instead of a resync
                 self._by_key.pop(sub.key, None)
                 self._evict_retained()
+        cg = self._cq_groups.get(sub.key)
+        if cg is not None:
+            cg.subs.pop(sid, None)
+            if not cg.subs:
+                # last standing subscriber gone: the group stops
+                # costing a predicate pass; its membership version
+                # ring is RETAINED like any subscription key, so a
+                # re-subscribe rebuilds the group and resumes with
+                # enter/leave deltas
+                self._cq_groups.pop(sub.key, None)
+                if not any(g.m.subsys == cg.m.subsys
+                           for g in self._cq_groups.values()):
+                    self._cq_panels.pop(cg.m.subsys, None)
+                self._evict_retained()
         self._gauge()
 
     def _evict_retained(self) -> None:
-        over = len(self._versions) - len(self._by_key) - self.retain
+        live = len(self._by_key) + len(self._cq_groups)
+        over = len(self._versions) - live - self.retain
         if over <= 0:
             return
         for key in list(self._versions):
             if over <= 0:
                 break
-            if key in self._by_key:
+            if key in self._by_key or key in self._cq_groups:
                 continue
             self._versions.pop(key, None)
             self._req_of_key.pop(key, None)
@@ -405,6 +594,7 @@ class SubscriptionHub:
         A failing subscriber (dead conn, send deadline) is dropped and
         counted — one wedged dashboard cannot stall the tier."""
         sent = 0
+        fetched: dict = {}
         for key in list(self._by_key):
             grp = self._by_key.get(key)
             req = self._req_of_key.get(key)
@@ -420,6 +610,7 @@ class SubscriptionHub:
                     log.debug("subscription fetch failed for %s: %s",
                               req.get("subsys"), e)
                     continue
+                fetched[key] = resp
                 try:
                     sent += await self._push_key(key, grp, resp)
                 except Exception as e:      # noqa: BLE001 — counted
@@ -430,6 +621,127 @@ class SubscriptionHub:
                     self.stats.bump("gw_sub_push_errors")
                     log.debug("subscription push failed for %s: %s",
                               req.get("subsys"), e)
+        if self._cq_groups:
+            sent += await self._push_cq(fetched)
+        return sent
+
+    async def _push_cq(self, fetched: dict) -> int:
+        """Advance every live criteria group: per subsystem, ONE panel
+        render (reused from this tick's regular pushes when a plain
+        subscriber already paid for it), ONE row-keyed diff, and per
+        group ONE predicate pass over only the CHANGED rows — then
+        enter/change/leave events (or heartbeat acks) to every
+        subscriber."""
+        sent = 0
+        by_subsys: dict[str, list] = {}
+        for g in self._cq_groups.values():
+            if g.subs:
+                by_subsys.setdefault(g.m.subsys, []).append(g)
+        for subsys, groups in by_subsys.items():
+            with self.stats.timeit("cq_push"):
+                preq = CQ.panel_request(subsys)
+                pkey = request_key(normalize_request(preq))
+                resp = fetched.get(pkey)
+                if resp is not None:
+                    self.stats.bump("cq_panel_render_shared")
+                else:
+                    try:
+                        resp = await self._fetch(preq)
+                    except Exception as e:  # noqa: BLE001 — counted
+                        # upstream shed/error: membership holds, next
+                        # tick retries (subscribers see a quiet tick)
+                        self.stats.bump("cq_fetch_errors")
+                        log.debug("cq panel fetch failed for %s: %s",
+                                  subsys, e)
+                        continue
+                    self.stats.bump("cq_panel_renders")
+                try:
+                    sent += await self._push_cq_panel(
+                        subsys, groups, resp)
+                except Exception as e:      # noqa: BLE001 — counted
+                    self.stats.bump("gw_sub_push_errors")
+                    log.debug("cq push failed for %s: %s", subsys, e)
+        return sent
+
+    async def _push_cq_panel(self, subsys, groups, resp) -> int:
+        sent = 0
+        tick = resp.get("snaptick")
+        panel = self._cq_panels.get(subsys)
+        if panel is not None and panel.tick == tick:
+            return 0                    # no advance for this panel
+        kf = groups[0].m.kf
+        curr = {}
+        for r in resp.get("recs") or []:
+            curr[CQ.row_key(r, kf)] = r
+        if panel is not None and panel.prev is not None:
+            changed_keys, changed_rows, removed = \
+                CQ.panel_diff(panel.prev, curr)
+            full_pass = False
+        else:                           # pragma: no cover — defensive
+            changed_keys = list(curr.keys())
+            changed_rows = list(curr.values())
+            removed = []
+            full_pass = True
+        cols = CQ.columns_of_rows(subsys, changed_rows) \
+            if changed_rows else {}
+        for g in groups:
+            # THE amortization contract: one bump per live group per
+            # tick — gyt_cq_group_evals_total / ticks == n_groups, no
+            # matter how many subscribers stand behind each group
+            self.stats.bump("cq_group_evals")
+            base = g.m.snaptick
+            try:
+                if changed_rows:
+                    match = CQ.match_mask(g.m.tree, subsys,
+                                          changed_rows, cols)
+                else:
+                    match = ()
+                if full_pass:
+                    new_members = {
+                        k: r for k, r, hit
+                        in zip(changed_keys, changed_rows, match)
+                        if hit}
+                    e, c, lv = CQ.rebuild(g.m, new_members, tick)
+                else:
+                    e, c, lv = CQ.advance(g.m, changed_keys,
+                                          changed_rows, match,
+                                          removed, tick)
+            except Exception as ex:     # noqa: BLE001 — counted
+                # a row the predicate cannot evaluate (projected
+                # response, bad field): contain to THIS group
+                self.stats.bump("cq_eval_errors")
+                log.debug("cq eval failed for %s: %s", g.m.filt, ex)
+                continue
+            evs = CQ.events_of(base, g.m.snaptick, g.m.kf, e, c, lv)
+            for ev in evs:
+                self.stats.bump(f"cq_events|kind={ev['t']}")
+            if evs:
+                self._push_version(g.key, CQ.response_of(g.m))
+            full_ev = None
+            for sub in list(g.subs.values()):
+                if evs and sub.last_tick == base:
+                    out = evs
+                elif not evs and sub.last_tick == g.m.snaptick:
+                    # quiet tick: heartbeat so stall detection holds
+                    # (every tick delivers ≥1 event per subscription)
+                    out = [D.ack_event(g.m.snaptick)]
+                else:
+                    # late joiner / missed a tick: full resync
+                    if full_ev is None:
+                        full_ev = D.full_event(CQ.response_of(g.m))
+                        self.stats.bump("gw_resyncs")
+                        self.stats.bump("cq_resyncs")
+                    out = [full_ev]
+                try:
+                    for ev in out:
+                        await sub.send(ev)
+                        self.stats.bump("gw_sub_events")
+                    sub.last_tick = g.m.snaptick
+                    sent += 1
+                except Exception:       # noqa: BLE001 — dead conn
+                    self.stats.bump("gw_sub_dropped")
+                    self.unsubscribe(sub.sid)
+        self._cq_panels[subsys] = _CQPanel(curr, tick)
         return sent
 
     async def _push_key(self, key, grp, resp) -> int:

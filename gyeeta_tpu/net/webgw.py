@@ -234,6 +234,11 @@ class WebGateway:
             req["maxrecs"] = int(q["maxrecs"][0])
         if "sortdesc" in q:
             req["sortdesc"] = q["sortdesc"][0].lower() in ("1", "true")
+        if "cq" in q:
+            # continuous query: relay a STANDING FILTER subscription
+            # (enter/leave/change membership events) instead of a
+            # panel-delta one — the upstream hub does the grouping
+            req["cq"] = q["cq"][0].lower() in ("1", "true")
         last = None
         if "last_snaptick" in q:
             try:

@@ -16,7 +16,17 @@ standard scraper. This renders the SAME registry as exposition format
   geometric buckets mapped to cumulative ``le`` buckets (seconds) with
   ``_sum``/``_count``; trailing all-zero buckets are elided (+Inf
   always emitted), a valid subset per the exposition spec
-- alert-manager   → ``gyt_alerts_<name>_total``
+- alert-manager   → ``gyt_alerts_<name>_total`` (including
+  ``gyt_alerts_ncq_group_evals_total`` — criteria-group predicate
+  passes: defs sharing a canonical filter share one pass)
+
+Continuous-query rows (``net/subs.py`` hub, OPERATIONS.md
+"Continuous queries"): ``gyt_cq_groups`` / ``gyt_cq_subscribers``
+gauges and the ``gyt_cq_group_evals_total`` /
+``gyt_cq_panel_renders_total`` / ``gyt_cq_events_total{kind=...}`` /
+``gyt_cq_resyncs_total`` counter families — the amortization contract
+(one predicate pass per criteria group, ≤1 render per panel per tick)
+is checked off these exact rows by ``_cq_smoke.py``.
 
 One rendering function serves every surface: ``GET /metrics`` on the
 HTTP gateway and the ``metrics`` query subsystem on the binary

@@ -1143,7 +1143,14 @@ class ShardedRuntime:
                 and (self._tick_no + 1) % ev == 0:
             report["topk_recovered"] = self._cols.get(
                 "__hh_recover", self.heavy_recover)["recovered_keys"]
-        fired = self.alerts.check(None, columns_fn=snap.columns)
+        # alert eval short-circuits BEFORE any column render when no
+        # realtime def is enabled (counted; pending group-wait batches
+        # still flush on schedule)
+        if self.alerts.wants_realtime():
+            fired = self.alerts.check(None, columns_fn=snap.columns)
+        else:
+            self.stats.bump("alert_eval_skipped")
+            fired = self.alerts.flush_groups()
         report["alerts_fired"] = len(fired)
         for a in fired:
             self.notifylog.add_alert(a)

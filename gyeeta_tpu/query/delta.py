@@ -43,6 +43,18 @@ SSE ``data:`` line and the GYT binary subscription frame):
   [...], "upsert": {...}, "env": {...}, "ekeys": [...]}``
 - ``{"t": "ack",   "snaptick": T}``  (reconnect at the current tick:
   nothing to send yet)
+
+Continuous queries (``query/cq.py``) add three MEMBERSHIP kinds over
+the same wire — a standing predicate's match set moving, not a panel
+re-ordering. Rows sort by membership key on reassembly (membership is
+a set; no ``order`` vector), and the same base-chain rule applies:
+
+- ``{"t": "enter",  "snaptick": T, "base": P, "kf": [...],
+  "rows": {key: row, ...}}``   (rows newly matching the predicate)
+- ``{"t": "change", "snaptick": T, "base": P, "kf": [...],
+  "rows": {key: row, ...}}``   (members whose row bytes changed)
+- ``{"t": "leave",  "snaptick": T, "base": P, "kf": [...],
+  "keys": [key, ...]}``        (rows that stopped matching / vanished)
 """
 
 from __future__ import annotations
@@ -152,6 +164,8 @@ def apply_event(prev: Optional[dict], event: dict) -> dict:
         if prev is None:
             raise ResyncRequired("ack with no held version")
         return prev
+    if t in ("enter", "change", "leave"):
+        return _apply_membership(prev, event)
     if t != "delta":
         raise ValueError(f"unknown subscription event {t!r}")
     if prev is None:
@@ -173,4 +187,46 @@ def apply_event(prev: Optional[dict], event: dict) -> dict:
     env = event["env"]
     for k in event["ekeys"]:
         out[k] = rows if k == "recs" else env[k]
+    return out
+
+
+def _apply_membership(prev: Optional[dict], event: dict) -> dict:
+    """Apply one continuous-query membership event (``enter`` /
+    ``change`` / ``leave``) to the held membership response. Same
+    base-version contract as ``delta``; reassembled ``recs`` sort by
+    membership key and the envelope keeps the held response's key
+    order, so chained application stays byte-exact against the hub's
+    canonical rendering (``cq.cq_response``)."""
+    t = event["t"]
+    if prev is None:
+        raise ResyncRequired(f"{t} with no held version")
+    if prev.get("snaptick") != event.get("base"):
+        raise ResyncRequired(
+            f"{t} base {event.get('base')} != held "
+            f"{prev.get('snaptick')}")
+    kf = event.get("kf", prev.get("kf", "*"))
+    members = _keyed(prev.get("recs") or [], kf)
+    if members is None:
+        raise ResyncRequired("held membership rows collide on key")
+    if t == "leave":
+        for k in event["keys"]:
+            if k not in members:
+                raise ResyncRequired(f"leave of unknown member {k!r}")
+            del members[k]
+    else:
+        for k, r in event["rows"].items():
+            if t == "change" and k not in members:
+                raise ResyncRequired(f"change of unknown member {k!r}")
+            members[k] = r
+    recs = [members[k] for k in sorted(members)]
+    out = {}
+    for k, v in prev.items():
+        if k == "recs":
+            out[k] = recs
+        elif k == "snaptick":
+            out[k] = event.get("snaptick")
+        elif k == "nrecs":
+            out[k] = len(recs)
+        else:
+            out[k] = v
     return out

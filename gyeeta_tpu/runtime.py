@@ -1066,7 +1066,15 @@ class Runtime:
                 and (self._tick_no + 1) % ev == 0:
             report["topk_recovered"] = self._cols.get(
                 "__hh_recover", self.heavy_recover)["recovered_keys"]
-        fired = self.alerts.check(self.state, columns_fn=snap.columns)
+        # alert eval short-circuits BEFORE any column render when no
+        # realtime def is enabled (counted; pending group-wait batches
+        # still flush on schedule)
+        if self.alerts.wants_realtime():
+            fired = self.alerts.check(self.state,
+                                      columns_fn=snap.columns)
+        else:
+            self.stats.bump("alert_eval_skipped")
+            fired = self.alerts.flush_groups()
         # history snapshots BEFORE the window tick: the closing 5s slab is
         # still readable (tick zeroes it)
         tick = int(np.asarray(self.state.resp_win.tick)) + 1
@@ -1128,9 +1136,7 @@ class Runtime:
         # the snapshot from this very tick (ref: MDB alerts query the DB
         # the madhava just wrote, server/gy_malerts.cc). Only defs that
         # actually read the store pay the writer-queue barrier.
-        if self.history and any(
-                ad.enabled and ad.mode == "db"
-                for ad in self.alerts.defs.values()):
+        if self.history and self.alerts.wants_db():
             self._histwriter.barrier()
             fired += self.alerts.check_db(self.history)
         report["alerts_fired"] = len(fired)

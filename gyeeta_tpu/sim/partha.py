@@ -124,6 +124,35 @@ class ParthaSim:
         self.tusec += np.uint64(5_000_000)
         return out
 
+    def churn_records(self, phase: int, n_conn: int = 256,
+                      n_resp: int = 512, duty: int = 3):
+        """One tick of DETERMINISTICALLY ROTATING traffic → (conn,
+        resp) record arrays: tick ``phase`` directs all traffic at
+        services where ``(svc + phase) % duty != 0``, so every
+        ``duty`` ticks each service swings between loaded and idle —
+        a rate/latency threshold predicate's match set visibly gains
+        and loses rows every tick. The churn source the continuous-
+        query tests, smoke, and bench share (natural rng drift alone
+        can leave thresholds unmoved for many ticks)."""
+        allowed = np.array([s for s in range(self.n_svcs)
+                            if (s + phase) % duty != 0], np.int64)
+        if not len(allowed):
+            allowed = np.arange(self.n_svcs, dtype=np.int64)
+        conn = self.conn_records(n_conn)
+        resp = self.resp_records(n_resp)
+        r = self.rng
+        for out, n in ((conn, n_conn), (resp, n_resp)):
+            host = r.integers(0, self.n_hosts, n)
+            svc = allowed[r.integers(0, len(allowed), n)]
+            out["host_id"] = (host + self.host_base).astype(np.uint32)
+            gid = self.glob_ids[host, svc]
+            if "ser_glob_id" in out.dtype.names:
+                out["ser_glob_id"] = gid
+                out["ser_related_listen_id"] = gid
+            else:
+                out["glob_id"] = gid
+        return conn, resp
+
     def svc_call_graph(self):
         """The fleet's deterministic service→service call topology.
 

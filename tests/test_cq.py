@@ -1,0 +1,547 @@
+"""Continuous-query engine tests (ISSUE 18).
+
+Property: the hub's enter/leave/change event stream, applied
+client-side (``delta.apply_event``), is BYTE-EXACT against an offline
+replay oracle — brute-force per-tick row-set diffing with a hand-coded
+Python predicate, sharing nothing with the hub's incremental
+panel-diff path — at every tick of a churning svcstate stream,
+including reconnect-with-resume, aged-ring resync, persistence
+restarts, and criteria-group sharing across equivalent subscribers.
+
+Alert side: defs grouped by canonical filter fire byte-identical to
+degenerate per-def evaluation (the legacy shape), and evaluation
+short-circuits with zero renders when no def targets a subsystem.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+
+import pytest
+
+from gyeeta_tpu.net.subs import SubscribeError, SubscriptionHub
+from gyeeta_tpu.query import cq as CQ, delta as D
+from gyeeta_tpu.utils.selfstats import Stats
+
+SUBSYS = "svcstate"
+FILT = "{ svcstate.qps5s > 50 }"
+KF = ["svcid", "hostid"]
+
+
+def _wire(obj):
+    """One JSON round trip: exactly what SSE / the GYT frame delivers."""
+    return json.loads(json.dumps(obj))
+
+
+class _World:
+    """A churning svcstate panel: rows enter/leave the fleet AND swing
+    across the qps threshold, deterministically per seed."""
+
+    def __init__(self, seed=7, n=12):
+        self.rng = random.Random(seed)
+        self.tick = 1
+        self.rows = {}
+        for i in range(n):
+            self._spawn(i)
+
+    def _spawn(self, i):
+        self.rows[f"{i:016x}"] = {
+            "svcid": f"{i:016x}", "hostid": i % 4,
+            "name": f"svc-{i}",
+            "qps5s": round(self.rng.uniform(0, 100), 3),
+            "state": self.rng.choice(["OK", "Bad"]),
+        }
+
+    def step(self, quiet=False):
+        self.tick += 1
+        if quiet:               # tick advances, no row moves → ack
+            return
+        rng = self.rng
+        for k in list(self.rows):
+            act = rng.random()
+            if act < 0.35:      # swing the threshold field
+                self.rows[k] = {**self.rows[k],
+                                "qps5s": round(rng.uniform(0, 100), 3)}
+            elif act < 0.45:    # row leaves the panel
+                del self.rows[k]
+        if rng.random() < 0.5:  # a new service appears
+            self._spawn(rng.randrange(1000, 9999))
+
+    def panel(self):
+        recs = [self.rows[k] for k in sorted(self.rows)]
+        return {"subsys": SUBSYS, "snaptick": self.tick,
+                "nrecs": len(recs), "recs": recs}
+
+
+def _fetch_of(world):
+    async def fetch(req):
+        assert req["subsys"] == SUBSYS
+        return _wire(world.panel())
+    return fetch
+
+
+class _Oracle:
+    """Brute-force replay: full predicate pass + full row-set diff per
+    tick, hand-coded predicate — independent of criteria/panel-diff."""
+
+    def __init__(self, filt):
+        self.filt = filt
+        self.members = {}
+        self.snaptick = None
+
+    def advance(self, world):
+        new = {r["svcid"]: _wire(r) for r in world.rows.values()
+               if r["qps5s"] > 50}
+        # key format must match the wire contract (kf json list)
+        new = {CQ.row_key(r, KF): r for r in new.values()}
+        if self.snaptick is None or new != self.members:
+            self.snaptick = world.tick
+        self.members = new
+
+    def response(self):
+        return CQ.cq_response(SUBSYS, self.filt, KF, self.snaptick,
+                              self.members)
+
+
+def _assert_byte_equal(applied, oracle_resp):
+    assert json.dumps(applied) == json.dumps(_wire(oracle_resp))
+
+
+# ------------------------------------------------------------ property
+
+
+def test_cq_stream_byte_exact_vs_oracle():
+    world = _World(seed=101)
+    canon, _tree = CQ.parse_standing(SUBSYS, FILT)
+    oracle = _Oracle(canon)
+
+    async def run():
+        hub = SubscriptionHub(_fetch_of(world), Stats())
+        got = []
+
+        async def send(ev):
+            got.append(_wire(ev))
+
+        await hub.subscribe({"subsys": SUBSYS, "filter": FILT,
+                             "cq": True}, send)
+        oracle.advance(world)
+        held = D.apply_event(None, got[0])
+        _assert_byte_equal(held, oracle.response())
+        kinds = set()
+        for i in range(40):
+            world.step(quiet=(i % 9 == 4))
+            n0 = len(got)
+            await hub.push_tick()
+            assert len(got) > n0, "every tick delivers >= 1 event"
+            oracle.advance(world)
+            for ev in got[n0:]:
+                kinds.add(ev["t"])
+                held = D.apply_event(held, ev)
+            _assert_byte_equal(held, oracle.response())
+        # churn must have exercised every membership kind
+        assert {"enter", "leave", "change", "ack"} <= kinds
+
+    asyncio.run(run())
+
+
+def test_cq_group_sharing_two_subscribers():
+    """Equivalent criteria spelled differently land in ONE group: one
+    predicate pass per tick (cq_group_evals), identical event bytes."""
+    world = _World(seed=33)
+
+    async def run():
+        stats = Stats()
+        hub = SubscriptionHub(_fetch_of(world), stats)
+        g1, g2 = [], []
+
+        async def s1(ev):
+            g1.append(_wire(ev))
+
+        async def s2(ev):
+            g2.append(_wire(ev))
+
+        await hub.subscribe({"subsys": SUBSYS, "cq": True,
+                             "filter": "{ svcstate.qps5s > 50 }"}, s1)
+        await hub.subscribe({"subsys": SUBSYS, "cq": True,
+                             "filter": "{  svcstate.qps5s  >  50  }"},
+                            s2)
+        assert len(hub._cq_groups) == 1         # noqa: SLF001
+        nticks = 10
+        for _ in range(nticks):
+            world.step()
+            await hub.push_tick()
+        assert json.dumps(g1) == json.dumps(g2)
+        evals = stats.export()[0].get("cq_group_evals", 0)
+        assert evals == nticks      # ONE pass per tick for BOTH subs
+        renders = stats.export()[0].get("cq_panel_renders", 0)
+        assert renders <= nticks + 1    # <= 1 render per tick
+
+    asyncio.run(run())
+
+
+def test_cq_reconnect_resume_and_resync():
+    world = _World(seed=55)
+    canon, _ = CQ.parse_standing(SUBSYS, FILT)
+    oracle = _Oracle(canon)
+
+    async def run():
+        stats = Stats()
+        hub = SubscriptionHub(_fetch_of(world), stats, history=4)
+        got = []
+
+        async def send(ev):
+            got.append(_wire(ev))
+
+        sid = await hub.subscribe({"subsys": SUBSYS, "filter": FILT,
+                                   "cq": True}, send)
+        oracle.advance(world)
+        held = D.apply_event(None, got[0])
+        for _ in range(3):
+            world.step()
+            await hub.push_tick()
+            oracle.advance(world)
+        for ev in got[1:]:
+            held = D.apply_event(held, ev)
+        _assert_byte_equal(held, oracle.response())
+        hub.unsubscribe(sid)
+        assert not hub._cq_groups               # noqa: SLF001
+
+        # SHORT outage: the retained ring still covers the held
+        # version → resume with membership deltas, not a resync
+        world.step()
+        got2 = []
+
+        async def send2(ev):
+            got2.append(_wire(ev))
+
+        sid2 = await hub.subscribe(
+            {"subsys": SUBSYS, "filter": FILT, "cq": True}, send2,
+            last_snaptick=held["snaptick"])
+        oracle.advance(world)
+        assert got2[0]["t"] != "full", "resume must not resync"
+        for ev in got2:
+            held = D.apply_event(held, ev)
+        _assert_byte_equal(held, oracle.response())
+        c = stats.export()[0]
+        assert c.get("gw_sub_resumes", 0) >= 1
+        assert c.get("cq_resyncs", 0) == 0
+        hub.unsubscribe(sid2)
+
+        # LONG outage: enough changing ticks to age the ring out →
+        # counted, resync-MARKED full — never silence
+        prev_tick = held["snaptick"]
+        got3 = []
+
+        async def send3(ev):
+            got3.append(_wire(ev))
+
+        sidk = await hub.subscribe(
+            {"subsys": SUBSYS, "filter": FILT, "cq": True}, send3)
+        for _ in range(12):
+            world.step()
+            await hub.push_tick()
+            oracle.advance(world)
+        hub.unsubscribe(sidk)
+        got4 = []
+
+        async def send4(ev):
+            got4.append(_wire(ev))
+
+        await hub.subscribe(
+            {"subsys": SUBSYS, "filter": FILT, "cq": True}, send4,
+            last_snaptick=prev_tick)
+        oracle.advance(world)
+        assert got4[0]["t"] == "full" and got4[0].get("resync") is True
+        held = D.apply_event(None, got4[0])
+        _assert_byte_equal(held, oracle.response())
+        assert stats.export()[0].get("cq_resyncs", 0) >= 1
+
+    asyncio.run(run())
+
+
+def test_cq_persist_restart_resumes(tmp_path):
+    """A restarted hub (fresh process, same persist file) resumes a
+    reconnecting CQ subscriber with membership deltas off the restored
+    ring — the PR-15 continuation contract extended to memberships."""
+    world = _World(seed=77)
+    path = str(tmp_path / "subs.jsonl")
+    canon, _ = CQ.parse_standing(SUBSYS, FILT)
+    oracle = _Oracle(canon)
+
+    async def run():
+        hub = SubscriptionHub(_fetch_of(world), Stats(),
+                              persist_path=path)
+        got = []
+
+        async def send(ev):
+            got.append(_wire(ev))
+
+        await hub.subscribe({"subsys": SUBSYS, "filter": FILT,
+                             "cq": True}, send)
+        oracle.advance(world)
+        held = D.apply_event(None, got[0])
+        for _ in range(2):
+            world.step()
+            await hub.push_tick()
+            oracle.advance(world)
+        for ev in got[1:]:
+            held = D.apply_event(held, ev)
+        hub.close()
+
+        world.step()        # movement while the gateway is down
+        stats2 = Stats()
+        hub2 = SubscriptionHub(_fetch_of(world), stats2,
+                               persist_path=path)
+        got2 = []
+
+        async def send2(ev):
+            got2.append(_wire(ev))
+
+        await hub2.subscribe(
+            {"subsys": SUBSYS, "filter": FILT, "cq": True}, send2,
+            last_snaptick=held["snaptick"])
+        oracle.advance(world)
+        assert got2[0]["t"] != "full", \
+            "restored ring must resume, not resync"
+        for ev in got2:
+            held = D.apply_event(held, ev)
+        _assert_byte_equal(held, oracle.response())
+        assert stats2.export()[0].get("gw_sub_resumes", 0) >= 1
+        hub2.close()
+
+    asyncio.run(run())
+
+
+def test_cq_envelope_rejects():
+    world = _World()
+
+    async def run():
+        hub = SubscriptionHub(_fetch_of(world), Stats())
+
+        async def send(ev):
+            pass
+
+        for req in (
+            {"subsys": SUBSYS, "cq": True},                 # no filter
+            {"subsys": SUBSYS, "cq": True, "filter": "{ x >> }"},
+            {"subsys": SUBSYS, "cq": True,                  # foreign
+             "filter": "{ hoststate.cpu_pct > 1 }"},
+            {"subsys": SUBSYS, "cq": True, "filter": FILT,
+             "maxrecs": 10},        # membership is a set: no envelope
+            {"subsys": "nope", "cq": True, "filter": FILT},
+        ):
+            with pytest.raises(SubscribeError):
+                await hub.subscribe(req, send)
+        assert hub.nsubs == 0
+
+    asyncio.run(run())
+
+
+# --------------------------------------------- membership delta kinds
+
+
+def test_membership_apply_error_paths():
+    base = {"subsys": SUBSYS, "cqfilter": "f", "kf": KF,
+            "snaptick": 5, "nrecs": 1,
+            "recs": [{"svcid": "a", "hostid": 0, "qps5s": 60.0}]}
+    key = CQ.row_key(base["recs"][0], KF)
+    with pytest.raises(D.ResyncRequired):       # no held version
+        D.apply_event(None, {"t": "enter", "snaptick": 6, "base": 5,
+                             "kf": KF, "rows": {}})
+    with pytest.raises(D.ResyncRequired):       # base mismatch
+        D.apply_event(base, {"t": "leave", "snaptick": 7, "base": 6,
+                             "kf": KF, "keys": [key]})
+    with pytest.raises(D.ResyncRequired):       # unknown member
+        D.apply_event(base, {"t": "leave", "snaptick": 6, "base": 5,
+                             "kf": KF, "keys": ['["zz",9]']})
+    with pytest.raises(D.ResyncRequired):       # change of non-member
+        D.apply_event(base, {"t": "change", "snaptick": 6, "base": 5,
+                             "kf": KF, "rows": {'["zz",9]': {}}})
+    out = D.apply_event(base, {"t": "leave", "snaptick": 6, "base": 5,
+                               "kf": KF, "keys": [key]})
+    assert out["nrecs"] == 0 and out["snaptick"] == 6
+    assert base["nrecs"] == 1, "held version must not mutate"
+
+
+# ------------------------------------------------------- alert parity
+
+
+def _alert_cols(rows):
+    """Rendered rows → the (cols, base) column source check() eats."""
+    import numpy as np
+    cols = CQ.columns_of_rows(SUBSYS, rows)
+    return cols, np.ones(len(rows), bool)
+
+
+def _mk_mgr(clock, filters):
+    from gyeeta_tpu.alerts import AlertManager
+    m = AlertManager(None, clock=clock)
+    for i, f in enumerate(filters):
+        m.add_def({"alertname": f"def{i}", "subsys": SUBSYS,
+                   "filter": f, "severity": "warning",
+                   "numcheckfor": 2 if i % 2 else 1,
+                   "repeataftersec": 0})
+    return m
+
+
+def test_alertdefs_grouped_eval_parity():
+    """Defs sharing canonical criteria share ONE predicate pass —
+    and fire/resolve byte-identical to per-def (legacy) evaluation."""
+
+    class Clock:
+        t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    filters = ["{ svcstate.qps5s > 50 }",
+               "{  svcstate.qps5s >  50 }",       # same group
+               "{ svcstate.qps5s > 80 }"]
+    clock = Clock()
+    grouped = _mk_mgr(clock, filters)
+    legacy = _mk_mgr(clock, filters)
+    # degenerate groups: a unique sentinel per def forces the exact
+    # legacy one-pass-per-def evaluation
+    legacy._canon = {n: f"__uniq:{n}" for n in legacy.defs}
+
+    world = _World(seed=11)
+    for _ in range(12):
+        rows = _wire(world.panel())["recs"]
+        cols_fn = lambda ck, _r=rows: _alert_cols(_r)   # noqa: E731
+        a = grouped.check(None, columns_fn=cols_fn)
+        b = legacy.check(None, columns_fn=cols_fn)
+        assert a == b
+        assert grouped._state == legacy._state          # noqa: SLF001
+        world.step()
+        clock.t += 5.0
+    sg = dict(grouped.stats)
+    sl = dict(legacy.stats)
+    ga, gl = sg.pop("ncq_group_evals"), sl.pop("ncq_group_evals")
+    assert sg == sl, "every legacy counter byte-identical"
+    assert ga == 12 * 2 and gl == 12 * 3    # sharing saved a pass/tick
+
+
+def test_alert_zero_dispatch_short_circuit():
+    """Zero defs targeting a subsystem → zero renders, both modes."""
+    from gyeeta_tpu.alerts import AlertManager
+    m = AlertManager(None)
+    assert not m.wants_realtime() and not m.wants_db()
+    calls = []
+
+    def counting(ck):
+        calls.append(ck)
+        return _alert_cols([{"svcid": "a", "hostid": 0,
+                             "qps5s": 1.0}])
+
+    m.check(None, columns_fn=counting)
+    assert calls == [], "no defs -> no column renders at all"
+
+    m.add_def({"alertname": "a", "subsys": SUBSYS,
+               "filter": FILT, "severity": "info"})
+    assert m.wants_realtime() and not m.wants_db()
+    m.check(None, columns_fn=counting)
+    assert calls == [SUBSYS], "only the TARGETED subsystem renders"
+
+    class CountingHistory:
+        n = 0
+
+        def query(self, *a, **k):
+            self.n += 1
+            return []
+
+    h = CountingHistory()
+    m.check_db(h)
+    assert h.n == 0, "no db defs -> the history store is never queried"
+    m.add_def({"alertname": "d", "subsys": SUBSYS, "filter": FILT,
+               "severity": "info", "mode": "db", "querysec": 1})
+    assert m.wants_db()
+    m.check_db(h)
+    assert h.n == 1
+
+
+def test_alert_eval_skipped_counter_runtime_contract():
+    """The runtimes bump ``alert_eval_skipped`` instead of calling
+    check() when no realtime def is enabled — pinned here at the
+    manager predicate level (the smoke drives the full runtime)."""
+    from gyeeta_tpu.alerts import AlertManager
+    m = AlertManager(None)
+    m.add_def({"alertname": "d", "subsys": SUBSYS, "filter": FILT,
+               "severity": "info", "mode": "db", "querysec": 60})
+    # db-only defs: the REALTIME pass is skippable, the DB one is not
+    assert not m.wants_realtime() and m.wants_db()
+    assert "ncq_group_evals" in m.stats
+
+
+# --------------------------------------- windowed-quantile registry
+
+
+def test_winquant_registry_coverage():
+    """Every QUANTILE_FIELDS entry resolves: its panel is a registered
+    delta spec and its field exists in the subsystem's field map — a
+    field can't silently skip the windowed path."""
+    from gyeeta_tpu.history import winquant as WQ
+    from gyeeta_tpu.query import fieldmaps
+
+    assert WQ.QUANTILE_FIELDS, "registry must not be empty"
+    for subsys, qfields in WQ.QUANTILE_FIELDS.items():
+        fmap = fieldmaps.field_map(subsys)
+        for field, qf in qfields.items():
+            assert qf.panel in WQ.DELTA_SPECS, \
+                f"{subsys}.{field} -> unknown panel {qf.panel!r}"
+            assert field in fmap, \
+                f"{subsys}.{field} not in the field map"
+            assert qf.q is None or 0.0 < qf.q < 1.0
+    for name, spec in WQ.DELTA_SPECS.items():
+        fieldmaps.check_subsys(spec.subsys)
+        assert isinstance(spec.scale, float)
+
+
+def test_winquant_register_validates_and_serves():
+    from gyeeta_tpu.history import winquant as WQ
+
+    with pytest.raises(ValueError):        # unknown delta panel
+        WQ.register_quantile_field(
+            "svcstate", "p99resp5s", WQ.QuantField("nope", 0.99))
+    with pytest.raises(ValueError):        # field not in the map
+        WQ.register_quantile_field(
+            "svcstate", "not_a_field", WQ.QuantField("svc_resp", 0.5))
+    with pytest.raises(ValueError):        # conflicting re-register
+        WQ.register_quantile_field(
+            "svcstate", "p95resp5s", WQ.QuantField("svc_resp", 0.50))
+    # idempotent same-value re-register is fine
+    WQ.register_quantile_field(
+        "svcstate", "p95resp5s", WQ.QuantField("svc_resp", 0.95))
+
+    with pytest.raises(ValueError):        # conflicting delta spec
+        WQ.register_delta_spec(
+            "svc_resp", WQ.DeltaSpec("svcstate", "resp_spec",
+                                     "elsewhere", 1.0))
+
+    # a NEW registration is picked up by the read-side accessor —
+    # the exact lookup both timeview call sites resolve through
+    assert "qps5s" not in WQ.quantile_fields("svcstate")
+    try:
+        qf = WQ.register_quantile_field(
+            "svcstate", "qps5s", WQ.QuantField("svc_resp", 0.5))
+        assert WQ.quantile_fields("svcstate")["qps5s"] is qf
+        # svcstate/extsvcstate share the preset dict: registrations
+        # surface on every subsystem standing on it
+        assert WQ.quantile_fields("extsvcstate")["qps5s"] is qf
+    finally:
+        WQ.QUANTILE_FIELDS["svcstate"].pop("qps5s", None)
+    assert "qps5s" not in WQ.quantile_fields("svcstate")
+
+
+def test_winquant_preset_sharing_consistent():
+    """Subsystems sharing a field map share quantile sources."""
+    from gyeeta_tpu.history import winquant as WQ
+
+    assert WQ.quantile_fields("svcstate") \
+        == WQ.quantile_fields("extsvcstate")
+    for preset in ("topcpu", "toppgcpu", "toprss", "topdelay",
+                   "topfork"):
+        assert WQ.quantile_fields(preset) \
+            == WQ.quantile_fields("taskstate")
+    assert WQ.quantile_fields("hoststate") == {}
