@@ -49,6 +49,16 @@ def validate(body: str) -> None:
     occ = series["gyt_engine_svc_occupancy_ratio"][0][1]
     assert 0.0 < occ <= 1.0, f"bad occupancy {occ}"
 
+    # remote-ingest relay ledger: the exact-accounting families a WAN
+    # dashboard scrapes (published == consumed + dropped off-host)
+    pub = series["gyt_relay_published_records_total"][0][1]
+    con = series["gyt_relay_consumed_records_total"][0][1]
+    drop = sum(v for lb, v in
+               series.get("gyt_relay_dropped_records_total", []))
+    assert pub > 0, "relay published nothing"
+    assert pub == con + drop, f"relay ledger open: {pub} != {con}+{drop}"
+    assert series["gyt_relay_up"][0][1] == 1.0, "relay not up"
+
     # histogram contract per stage: cumulative, +Inf == _count
     bucket = series.get("gyt_stage_duration_seconds_bucket", [])
     assert bucket, "no timing histogram"
@@ -70,19 +80,46 @@ def validate(body: str) -> None:
 
 
 async def scenario() -> str:
+    import threading
+    import time
+
     from gyeeta_tpu.engine.aggstate import EngineCfg
     from gyeeta_tpu.net import GytServer, NetAgent
+    from gyeeta_tpu.net.relay import RelayWorker
     from gyeeta_tpu.net.webgw import WebGateway
     from gyeeta_tpu.runtime import Runtime
 
     cfg = EngineCfg(n_hosts=4, svc_capacity=64, conn_batch=64,
                     resp_batch=64, fold_k=2)
     rt = Runtime(cfg)
-    srv = GytServer(rt, tick_interval=None)
+    srv = GytServer(rt, tick_interval=None, relay_port=0,
+                    relay_host="127.0.0.1")
     host, port = await srv.start()
     agent = NetAgent(seed=1)
     await agent.connect(host, port)
     await agent.send_sweep(n_conn=128, n_resp=128)
+
+    # a second agent rides the remote-ingest relay so the gyt_relay_*
+    # ledger families appear on the scrape (OPERATIONS.md "Regions &
+    # WAN deployment" — the relay hub piggybacks on the server loop)
+    worker = RelayWorker({"supervisor": ("127.0.0.1", srv._relay.port),
+                          "relay_id": "ci", "listen_host": "127.0.0.1"})
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    t0 = time.monotonic()
+    while not worker._up_ready and time.monotonic() - t0 < 60.0:
+        await asyncio.sleep(0.05)
+    assert worker._up_ready, "relay worker never came up"
+    ragent = NetAgent(seed=2)
+    await ragent.connect(*worker.listen_addr)
+    await ragent.send_sweep(n_conn=64, n_resp=64)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 60.0:
+        c = rt.stats.snapshot()
+        pub = c.get("relay_published_records|relay=ci", 0)
+        if pub > 0 and pub == c.get("relay_consumed_records|relay=ci", 0):
+            break
+        await asyncio.sleep(0.05)
     await asyncio.sleep(0.05)
     rt.run_tick()
 
@@ -95,8 +132,11 @@ async def scenario() -> str:
     raw = await reader.read(-1)
     writer.close()
     await agent.close()
+    await ragent.close()
+    worker.running = False
     await gw.stop()
     await srv.stop()
+    wt.join(timeout=10.0)
 
     head, _, body = raw.partition(b"\r\n\r\n")
     status = head.splitlines()[0].decode()

@@ -591,8 +591,8 @@ def gateway_fabric_phase() -> dict:
                     fold_k=2)
     sim = ParthaSim(n_hosts=64, n_svcs=6, seed=17)
 
-    def feed(rt):
-        rt.feed(sim.conn_frames(512) + sim.resp_frames(1024)
+    def frames():
+        return (sim.conn_frames(512) + sim.resp_frames(1024)
                 + wire.encode_frame(wire.NOTIFY_HOST_STATE,
                                     sim.host_state_records()))
 
@@ -605,12 +605,17 @@ def gateway_fabric_phase() -> dict:
         raise AssertionError(f"gateway fabric: timeout on {msg}")
 
     async def scenario() -> dict:
+        # capture each tick's frames ONCE and feed the SAME bytes to
+        # both replicas: the sim's RNG advances per call, so per-
+        # replica feed() calls silently diverged the replicas and the
+        # byte-equality checks below compared different fleets
+        nf, lf, f0 = sim.name_frames(), sim.listener_frames(), frames()
         replicas, servers = [], []
         for _ in range(2):
             rt = Runtime(cfg)
-            rt.feed(sim.name_frames())
-            rt.feed(sim.listener_frames())
-            feed(rt)
+            rt.feed(nf)
+            rt.feed(lf)
+            rt.feed(f0)
             rt.run_tick()
             srv = GytServer(rt, tick_interval=None, idle_timeout=600.0)
             await srv.start()
@@ -619,11 +624,15 @@ def gateway_fabric_phase() -> dict:
         ups = [(s.host, s.port) for s in servers]
         # hedge_ms=0: this phase proves the strict fleet-single-render
         # collapse; hedged reads (PR 15) intentionally spend a second
-        # render when the primary is slow
-        gw1 = FabricGateway(ups, poll_s=0.05, hedge_ms=0)
+        # render when the primary is slow. peer_timeout_s rides well
+        # above the default 0.5s: first renders sit behind jit
+        # compiles on a cold process, and an owner ask that times out
+        # degrades to a local render (peer_hits=0 flake).
+        gw1 = FabricGateway(ups, poll_s=0.05, hedge_ms=0,
+                            peer_timeout_s=10.0)
         h1, p1 = await gw1.start()
         gw2 = FabricGateway(ups, peers=[(h1, p1)], poll_s=0.05,
-                            hedge_ms=0)
+                            hedge_ms=0, peer_timeout_s=10.0)
         h2, p2 = await gw2.start()
         gw1.peers = [(h2, p2)]
         snap_tick = replicas[0].snapshot.tick
@@ -678,8 +687,9 @@ def gateway_fabric_phase() -> dict:
         kinds: set = set()
         for _ in range(4):
             ng, ns = len(gyt_events), len(sse_events)
+            fr = frames()               # identical frames, both sides
             for rt in replicas:
-                feed(rt)
+                rt.feed(fr)
                 rt.run_tick()
             await until(lambda: len(gyt_events) > ng
                         and len(sse_events) > ns, msg="push")

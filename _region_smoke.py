@@ -1,0 +1,457 @@
+"""CI smoke: two-region fabric under a WAN chaos campaign (ISSUE 19).
+
+Region A (home) lives in-process: a Runtime behind a GytServer that
+accepts remote ingest relay uplinks (``--relay-port`` /
+``net/relay.py``), fronted by a REAL fabric-gateway subprocess.
+Region B (remote) is the off-host half: a REAL relay subprocess (its
+agents register and stream there; decoded batches ship over ONE
+exact-ledger TCP uplink) and a REAL hub-mode gateway subprocess
+(``gateway --hub-from``) whose dashboards ride one inter-region delta
+stream per key. Both inter-region hops — the relay uplink and the
+gateway subscription stream — cross a partition-capable chaos proxy.
+
+Campaign legs (the ISSUE 19 acceptance gates):
+
+1. **Remote ingest host loss** — SIGKILL the relay subprocess
+   mid-feed, respawn it: the supervisor finalizes the dead epoch and
+   the cross-machine ledger closes EXACTLY
+   (``published == consumed + counted drops``) across the kill.
+2. **Inter-region partition → heal** — both WAN hops drop bytes while
+   conns are held (the nasty half-open shape): ticks keep flowing in
+   region A; on heal the subscription relay resumes with deltas or
+   ONE counted, in-band-marked resync per key (never silent
+   divergence), the relay ledger re-closes with the partition's loss
+   counted, and steady-state inter-region bytes follow delta churn,
+   not panel size (zero steady-window resyncs).
+3. **Region-wide SIGKILL** — region B's every process dies; region A
+   keeps serving queries; the restarted region B converges BYTE-EQUAL
+   to the fault-free control subscription.
+
+Run by ci.sh; standalone: ``JAX_PLATFORMS=cpu python _region_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# every subprocess ever spawned — reaped in main()'s finally so a
+# failed assertion can't orphan gateways/relays (an orphan also holds
+# the ci pipe open, wedging the harness, not just leaking a process)
+_PROCS: list = []
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+async def _until(cond, timeout=90.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        got = cond()
+        if got:
+            return got
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"region smoke: timed out waiting for {msg}")
+
+
+async def _http(port, method, path, body=b"", timeout=20.0):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        req = (f"{method} {path} HTTP/1.1\r\nHost: s\r\n"
+               f"Connection: close\r\n"
+               f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+        writer.write(req)
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(-1), timeout)
+    finally:
+        writer.close()
+    head, _, rbody = raw.partition(b"\r\n\r\n")
+    parts = head.split()
+    if len(parts) < 2:      # conn closed before a status line arrived
+        raise ConnectionError(f"short http response: {raw[:80]!r}")
+    return int(parts[1]), rbody
+
+
+def _metric(text: str, prefix: str) -> float:
+    total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(prefix) and not ln.startswith("# "):
+            total += float(ln.split()[-1])
+    return total
+
+
+async def _gw_metrics(port) -> str:
+    st, body = await _http(port, "GET", "/metrics")
+    assert st == 200
+    return body.decode()
+
+
+def _ledger(stats, relay_id="rb"):
+    c = stats.snapshot()
+    pub = c.get(f"relay_published_records|relay={relay_id}", 0)
+    con = c.get(f"relay_consumed_records|relay={relay_id}", 0)
+    drop = sum(v for k, v in c.items()
+               if k.startswith(f"relay_dropped_records|relay="
+                               f"{relay_id},"))
+    return pub, con, drop
+
+
+def _spawn_relay(sup_port, listen_port):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "gyeeta_tpu", "relay",
+         "--supervisor", f"127.0.0.1:{sup_port}",
+         "--listen-host", "127.0.0.1",
+         "--listen-port", str(listen_port), "--relay-id", "rb"],
+        cwd=HERE, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    _PROCS.append(p)
+    return p
+
+
+def _spawn_gw_a(listen_port, serve_port):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "gyeeta_tpu", "gateway",
+         "--listen-port", str(listen_port),
+         "--upstream", f"127.0.0.1:{serve_port}", "--poll-s", "0.1"],
+        cwd=HERE, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    _PROCS.append(p)
+    return p
+
+
+def _spawn_gw_hub(listen_port, wan_port):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               GYT_GW_HUB_STALL_S="3", GYT_GW_HUB_FIRST_S="30",
+               GYT_GW_HUB_SETTLE_S="0.5")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "gyeeta_tpu", "gateway",
+         "--listen-port", str(listen_port),
+         "--hub-from", f"127.0.0.1:{wan_port}"],
+        cwd=HERE, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    _PROCS.append(p)
+    return p
+
+
+async def _wait_healthy(port, proc, msg, timeout=60.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if proc.poll() is not None:
+            raise AssertionError(f"region smoke: {msg} exited rc="
+                                 f"{proc.returncode}")
+        try:
+            st, _ = await _http(port, "GET", "/healthz", timeout=5.0)
+            if st == 200:
+                return
+        except (OSError, asyncio.TimeoutError):
+            pass
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"region smoke: {msg} never healthy")
+
+
+async def scenario() -> None:
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.net import GytServer, NetAgent
+    from gyeeta_tpu.net.subs import SubscribeClient, SubscribeStream
+    from gyeeta_tpu.query import delta as D
+    from gyeeta_tpu.runtime import Runtime
+    from gyeeta_tpu.sim.chaos import ChaosProxy, FaultPlan
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=256, task_capacity=256,
+                    conn_batch=256, resp_batch=512, listener_batch=64,
+                    fold_k=2)
+
+    # ---------------- region A: serve + relay hub + fabric gateway
+    rt = Runtime(cfg)
+    srv = GytServer(rt, tick_interval=None, idle_timeout=600.0,
+                    relay_port=0, relay_host="127.0.0.1")
+    host, port = await srv.start()
+    hub_port = srv._relay.port
+    gpa = _free_port()
+    gwa = _spawn_gw_a(gpa, port)
+
+    # ---------------- the WAN: both hops cross partitionable proxies
+    proxy_r = ChaosProxy("127.0.0.1", hub_port, FaultPlan())
+    _, ppr = await proxy_r.start()
+    proxy_w = ChaosProxy("127.0.0.1", gpa, FaultPlan())
+    _, ppw = await proxy_w.start()
+
+    # ---------------- region B: relay + agents + hub gateway
+    relay_port = _free_port()
+    relay = _spawn_relay(ppr, relay_port)
+    gpb = _free_port()
+    gwb = _spawn_gw_hub(gpb, ppw)
+
+    agents = [NetAgent(machine_id=0x9000 + i, seed=40 + i, n_svcs=2,
+                       n_groups=3, spool_max_bytes=1 << 20)
+              for i in range(3)]
+    astop = asyncio.Event()
+    atasks = [asyncio.create_task(a.run_forever(
+        "127.0.0.1", relay_port, interval=0.4, n_conn=32, n_resp=64,
+        backoff_base=0.2, backoff_cap=1.0, stop=astop))
+        for a in agents]
+
+    tstop = asyncio.Event()
+
+    async def ticker():
+        # region A's tick driver: fold whatever the relay staged,
+        # advance the snapshot, push the serve tier's subscriptions
+        # (the gateway tier watches snaptick and pushes its own)
+        while not tstop.is_set():
+            try:
+                rt.flush()
+                rt.run_tick()
+                await srv.push_subscriptions()
+            except Exception as e:      # noqa: BLE001 — visible
+                print(f"region smoke: tick error {e}", file=sys.stderr)
+            await asyncio.sleep(0.7)
+
+    # wait for relay-fed records BEFORE the first (compile-heavy) tick
+    await _until(lambda: _ledger(rt.stats)[0] > 0, timeout=120.0,
+                 msg="first relay-published records")
+    ttask = asyncio.create_task(ticker())
+    await _until(lambda: rt.snapshot is not None
+                 and rt.snapshot.tick >= 0, timeout=600.0,
+                 msg="first tick (jax compile)")
+    print("region smoke: region A ticking, relay uplink live",
+          file=sys.stderr)
+
+    await _wait_healthy(gpa, gwa, "gateway A")
+    await _wait_healthy(gpb, gwb, "hub gateway B")
+
+    # ---------------- subscriptions: fault-free control direct on
+    # serve A; the faulted view rides region B's hub gateway
+    q = {"subsys": "svcstate", "sortcol": "qps5s", "sortdesc": True,
+         "maxrecs": 50}
+    ctl = SubscribeClient()
+    await ctl.connect(host, port)
+    await ctl.subscribe(dict(q))
+    control = {"held": None}
+
+    async def ctl_loop():
+        async for ev in ctl.events():
+            control["held"] = D.apply_event(control["held"], ev)
+
+    ctl_task = asyncio.create_task(ctl_loop())
+
+    stream = SubscribeStream([("127.0.0.1", gpb)], q,
+                             stall_timeout=5.0, backoff_base=0.2)
+    latest = {"held": None}
+
+    async def stream_loop():
+        async for held in stream.responses():
+            latest["held"] = held
+
+    stask = asyncio.create_task(stream_loop())
+
+    def converged():
+        return (latest["held"] is not None
+                and control["held"] is not None
+                and latest["held"]["snaptick"]
+                == control["held"]["snaptick"])
+
+    await _until(converged, timeout=120.0, msg="initial convergence")
+    assert json.dumps(latest["held"]) == json.dumps(control["held"]), \
+        "hub subscriber diverged from control at the same tick"
+    print(f"region smoke: converged at tick "
+          f"{latest['held']['snaptick']} through the hub relay",
+          file=sys.stderr)
+
+    # ---------------- steady window: inter-region bytes follow delta
+    # churn, not panel size — events flow, ZERO resyncs, and the WAN
+    # bytes for N ticks cost less than N panel retransmits
+    m0 = await _gw_metrics(gpb)
+    e0 = _metric(m0, "gyt_gw_region_events_total")
+    b0 = _metric(m0, "gyt_gw_region_event_bytes_total")
+    r0 = (_metric(m0, "gyt_gw_region_resyncs_total")
+          + _metric(m0, "gyt_gw_region_forced_resyncs_total"))
+    t_steady0 = control["held"]["snaptick"] if control["held"] else 0
+    await _until(lambda: control["held"]["snaptick"] >= t_steady0 + 4
+                 and converged(), timeout=90.0, msg="steady window")
+    m1 = await _gw_metrics(gpb)
+    nticks = control["held"]["snaptick"] - t_steady0
+    ev_d = _metric(m1, "gyt_gw_region_events_total") - e0
+    by_d = _metric(m1, "gyt_gw_region_event_bytes_total") - b0
+    rs_d = (_metric(m1, "gyt_gw_region_resyncs_total")
+            + _metric(m1, "gyt_gw_region_forced_resyncs_total")) - r0
+    panel = len(json.dumps(latest["held"]))
+    assert ev_d >= 2, f"no delta events flowed ({ev_d})"
+    assert rs_d == 0, f"steady window paid {rs_d} resyncs"
+    assert by_d < nticks * panel, (
+        f"WAN bytes {by_d:.0f} over {nticks} ticks >= panel-size "
+        f"retransmission ({nticks}x{panel})")
+    assert _metric(m1, "gyt_gw_region_keys") >= 2, \
+        "hub gateway holds no region relays"
+    print(f"region smoke: steady WAN window OK — {ev_d:.0f} delta "
+          f"events, {by_d:.0f} bytes over {nticks} ticks "
+          f"(panel {panel}B), 0 resyncs", file=sys.stderr)
+
+    # ============ leg 1: remote ingest host loss (relay SIGKILL)
+    pub0 = _ledger(rt.stats)[0]
+    relay.kill()
+    relay.wait(timeout=30)
+    relay = _spawn_relay(ppr, relay_port)
+    await _until(lambda: rt.stats.snapshot().get(
+        "relay_epochs|relay=rb", 0) >= 1, timeout=60.0,
+        msg="relay epoch finalize after SIGKILL")
+    # agents reconnect on their own; fresh records flow; the
+    # cross-machine ledger closes EXACTLY across the kill
+    await _until(lambda: _ledger(rt.stats)[0] > pub0
+                 and _ledger(rt.stats)[0]
+                 == sum(_ledger(rt.stats)[1:]), timeout=90.0,
+                 msg="exact ledger across relay restart")
+    pub, con, drop = _ledger(rt.stats)
+    print(f"region smoke: relay SIGKILL OK — epoch finalized, ledger "
+          f"exact (published={pub:.0f} == consumed={con:.0f} + "
+          f"dropped={drop:.0f})", file=sys.stderr)
+
+    # ============ leg 2: inter-region partition → heal
+    _REC = ("gyt_gw_region_resyncs_total",
+            "gyt_gw_region_forced_resyncs_total",
+            "gyt_gw_region_reconnects_total",
+            "gyt_gw_region_stalls_total",
+            "gyt_gw_region_conn_errors_total",
+            "gyt_gw_region_conn_lost_total")
+    m0 = await _gw_metrics(gpb)
+    r0 = sum(_metric(m0, n) for n in _REC)
+    proxy_r.partitioned = True
+    proxy_w.partitioned = True
+    t_part = control["held"]["snaptick"]
+    t_wall = time.monotonic()
+    # region A keeps ticking through the partition
+    await _until(lambda: control["held"]["snaptick"] >= t_part + 3,
+                 timeout=60.0, msg="ticks during partition")
+    # outlast the hub stream's stall window (GYT_GW_HUB_STALL_S=3):
+    # a partition shorter than it — with ingest ALSO partitioned, so
+    # the panel never changed — can legitimately heal gap-free with
+    # nothing to count; the leg must force the WAN gap to be DETECTED
+    remain = 8.0 - (time.monotonic() - t_wall)
+    if remain > 0:
+        await asyncio.sleep(remain)
+    dropped_w = proxy_w.stats.get("partition_dropped_chunks", 0)
+    dropped_r = proxy_r.stats.get("partition_dropped_chunks", 0)
+    assert dropped_w > 0 or dropped_r > 0, \
+        "partition dropped nothing — the WAN hops bypass the proxies"
+    proxy_r.partitioned = False
+    proxy_w.partitioned = False
+    await _until(converged, timeout=120.0,
+                 msg="post-partition convergence")
+    assert json.dumps(latest["held"]) == json.dumps(control["held"]), \
+        "silent divergence after partition heal"
+    m1 = await _gw_metrics(gpb)
+    r1 = sum(_metric(m1, n) for n in _REC)
+    assert r1 - r0 >= 1, (
+        "partition healed with no counted recovery event — the gap "
+        "would have been silent")
+    # the relay uplink also crossed the partition: its loss (if any)
+    # is COUNTED and the ledger re-closes exactly
+    await _until(lambda: _ledger(rt.stats)[0]
+                 == sum(_ledger(rt.stats)[1:]), timeout=90.0,
+                 msg="exact ledger after partition")
+    pub, con, drop = _ledger(rt.stats)
+    print(f"region smoke: partition/heal OK — counted recovery "
+          f"events ({r1 - r0:.0f}), byte-equal at tick "
+          f"{latest['held']['snaptick']}, relay ledger exact "
+          f"(dropped={drop:.0f})", file=sys.stderr)
+
+    # ============ leg 3: region-wide SIGKILL — region B dies whole
+    gwb.kill()
+    relay.kill()
+    gwb.wait(timeout=30)
+    relay.wait(timeout=30)
+    # the surviving region keeps serving its own dashboards
+    body = json.dumps({"subsys": "svcstate", "maxrecs": 16}).encode()
+    t_kill = control["held"]["snaptick"]
+    for _ in range(5):
+        st, rb = await _http(gpa, "POST", "/query", body, timeout=20.0)
+        assert st == 200 and b'"error"' not in rb[:64], rb[:200]
+        await asyncio.sleep(0.3)
+    await _until(lambda: control["held"]["snaptick"] >= t_kill + 2,
+                 timeout=60.0, msg="survivor region ticking")
+    print("region smoke: region B killed — region A survivor kept "
+          "serving", file=sys.stderr)
+
+    # restart the region: relay re-registers (NEW epoch, books closed
+    # exactly), the hub gateway re-subscribes, and the subscriber
+    # converges byte-equal with the fault-free control
+    relay = _spawn_relay(ppr, relay_port)
+    gwb = _spawn_gw_hub(gpb, ppw)
+    await _wait_healthy(gpb, gwb, "hub gateway B restart")
+    await _until(lambda: rt.stats.snapshot().get(
+        "relay_epochs|relay=rb", 0) >= 2, timeout=60.0,
+        msg="relay epoch after region restart")
+    await _until(converged, timeout=120.0,
+                 msg="restarted region convergence")
+    assert json.dumps(latest["held"]) == json.dumps(control["held"]), \
+        "restarted region diverged from the fault-free control"
+    assert stream.counters.get("resyncs", 0) \
+        + stream.counters.get("forced_resyncs", 0) >= 1, \
+        dict(stream.counters)
+    await _until(lambda: _ledger(rt.stats)[0]
+                 == sum(_ledger(rt.stats)[1:]), timeout=90.0,
+                 msg="exact ledger after region restart")
+    pub, con, drop = _ledger(rt.stats)
+    print(f"region smoke: region restart OK — byte-equal at tick "
+          f"{latest['held']['snaptick']}, stream resyncs counted "
+          f"({stream.counters.get('resyncs', 0)}), ledger exact "
+          f"(published={pub:.0f} == consumed={con:.0f} + "
+          f"dropped={drop:.0f})", file=sys.stderr)
+
+    # ---------------- teardown
+    astop.set()
+    tstop.set()
+    stream.stop()
+    for t in (stask, ctl_task):
+        t.cancel()
+    await asyncio.gather(*atasks, return_exceptions=True)
+    ttask.cancel()
+    await ctl.close()
+    for p in (gwa, gwb, relay):
+        if p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    await proxy_r.stop()
+    await proxy_w.stop()
+    await srv.stop()
+
+
+def main() -> int:
+    try:
+        asyncio.run(scenario())
+    finally:
+        for p in _PROCS:
+            if p.poll() is None:
+                p.kill()
+        for p in _PROCS:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+    print("region smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"region smoke: FAIL — {e}", file=sys.stderr)
+        sys.exit(1)

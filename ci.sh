@@ -168,6 +168,24 @@ if ! JAX_PLATFORMS=cpu python _cq_smoke.py; then
     exit 1
 fi
 
+# Two-region WAN smoke (ISSUE 19): region A = hub Runtime + REAL
+# gateway subprocess; region B = REAL `relay` + hub-mode `gateway`
+# subprocesses with 3 agents, BOTH WAN hops through chaos proxies
+# carrying asymmetric latency. Asserts: steady-state inter-region
+# bytes ∝ delta churn (not panel size) with one WAN stream per key;
+# relay-worker SIGKILL → respawn = a NEW counted epoch with the
+# published == consumed + dropped ledger closing EXACTLY across TCP;
+# full inter-region partition → bytes LOST (not parked) → heal
+# resumes with a counted in-band resync/reconnect and byte-equal
+# convergence; region-B wipeout (gateway + relay SIGKILL) → region A
+# keeps serving, restarted region B converges byte-equal to the
+# fault-free control. Never silent divergence.
+echo "ci: two-region WAN smoke" >&2
+if ! JAX_PLATFORMS=cpu python _region_smoke.py; then
+    echo "ci: FATAL — two-region WAN smoke failed" >&2
+    exit 1
+fi
+
 # Fused fold-path smoke: (a) the fused megakernel is the DEFAULT fold
 # path (a regression to the legacy per-subsystem dispatch sequence
 # would silently cost 2-6x fold throughput); (b) GYT_PALLAS=1 on a

@@ -158,6 +158,17 @@ def _cmd_chaos(argv) -> None:
     ap.add_argument("--fault-both", action="store_true",
                     help="also fault the server->client direction "
                     "(responses / subscription pushes)")
+    ap.add_argument("--latency-c2s-ms", type=float, default=None,
+                    help="asymmetric latency, client->server "
+                    "direction (overrides --latency-ms)")
+    ap.add_argument("--latency-s2c-ms", type=float, default=None,
+                    help="asymmetric latency, server->client "
+                    "direction (overrides --latency-ms)")
+    ap.add_argument("--partition-at", type=float, default=0.0,
+                    help="seconds after start to open a PARTITION "
+                    "window (both directions dropped, conns held)")
+    ap.add_argument("--partition-for", type=float, default=0.0,
+                    help="partition-window duration (0 = no window)")
     ap.add_argument("--report-interval", type=float, default=10.0)
     args = ap.parse_args(argv)
 
@@ -428,12 +439,29 @@ def _cmd_web(argv) -> None:
     asyncio.run(run())
 
 
+def _cmd_relay(argv) -> None:
+    """Remote ingest relay (net/relay.py): runs the full ingest edge
+    on THIS host — agents register and stream here — and ships decoded
+    batches to the serve process's --relay-port over one exact-ledger
+    TCP uplink (published == consumed + counted drops, across
+    machines, across relay restarts)."""
+    from gyeeta_tpu.net.relay import relay_main
+    relay_main(argv)
+
+
 def _cmd_gateway(argv) -> None:
     ap = argparse.ArgumentParser(prog="gyeeta_tpu gateway")
-    ap.add_argument("--upstream", action="append", required=True,
+    ap.add_argument("--upstream", action="append", default=[],
                     metavar="HOST:PORT",
                     help="serve replica to fan out to (repeatable; "
                     ">=2 makes the cache worth the hop)")
+    ap.add_argument("--hub-from", action="append", default=[],
+                    metavar="HOST:PORT", dest="hub_from",
+                    help="run as a cross-region HUB: subscribe to a "
+                    "PEER GATEWAY's delta stream instead of polling "
+                    "serve replicas — the whole remote region rides "
+                    "one delta stream per distinct query (repeatable "
+                    "for failover across the home region's gateways)")
     ap.add_argument("--peer", action="append", default=[],
                     metavar="HOST:PORT",
                     help="another gateway instance to exchange cached "
@@ -472,9 +500,14 @@ def _cmd_gateway(argv) -> None:
         h, _, p = s.rpartition(":")
         return (h or "127.0.0.1", int(p))
 
+    if not args.upstream and not args.hub_from:
+        ap.error("need --upstream (region-local) or --hub-from "
+                 "(cross-region hub)")
+
     async def run():
         from gyeeta_tpu.net.gateway import FabricGateway
-        gw = FabricGateway([hp(u) for u in args.upstream],
+        gw = FabricGateway([hp(u) for u in args.upstream]
+                           or [hp(u) for u in args.hub_from],
                            host=args.listen_host,
                            port=args.listen_port,
                            peers=[hp(p) for p in args.peer],
@@ -482,9 +515,11 @@ def _cmd_gateway(argv) -> None:
                            down_after=args.gw_down_after,
                            hedge_ms=args.hedge_ms,
                            sub_persist=args.sub_persist,
-                           advertise=args.advertise)
+                           advertise=args.advertise,
+                           hub=bool(args.hub_from))
         h, p = await gw.start()
-        print(f"fabric gateway on {h}:{p} (REST + GYT + NM) -> "
+        mode = "HUB <-" if args.hub_from else "->"
+        print(f"fabric gateway on {h}:{p} (REST + GYT + NM) {mode} "
               f"{len(gw.upstreams)} upstream(s), "
               f"{len(gw.peers)} peer(s)", file=sys.stderr)
         await asyncio.Event().wait()
@@ -495,11 +530,13 @@ def _cmd_gateway(argv) -> None:
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] in ("query", "agent", "replay", "web", "obs",
-                            "nm", "chaos", "compact", "gateway"):
+                            "nm", "chaos", "compact", "gateway",
+                            "relay"):
         return {"query": _cmd_query, "agent": _cmd_agent,
                 "replay": _cmd_replay, "web": _cmd_web,
                 "obs": _cmd_obs, "nm": _cmd_nm,
                 "chaos": _cmd_chaos, "gateway": _cmd_gateway,
+                "relay": _cmd_relay,
                 "compact": _cmd_compact}[argv[0]](argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]

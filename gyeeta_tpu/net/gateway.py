@@ -70,6 +70,7 @@ renders at ``GET /metrics`` as the ``gyt_gw_*`` families
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import os
@@ -217,6 +218,101 @@ class _Upstream:
             pass
 
 
+#: SubscribeStream counter -> gateway stat, folded as deltas per relay
+_HUB_FOLD = (("events", "gw_region_events"),
+             ("event_bytes", "gw_region_event_bytes"),
+             ("resyncs", "gw_region_resyncs"),
+             ("forced_resyncs", "gw_region_forced_resyncs"),
+             ("reconnects", "gw_region_reconnects"),
+             ("stalls", "gw_region_stalls"),
+             ("conn_errors", "gw_region_conn_errors"),
+             ("conn_lost", "gw_region_conn_lost"))
+
+
+class _HubRelay:
+    """One inter-region subscription: a supervised
+    :class:`~gyeeta_tpu.net.subs.SubscribeStream` to the peer region's
+    gateway front, holding the latest FULL response for its key. The
+    local ``SubscriptionHub`` fetches from the held version, so every
+    local dashboard subscriber and CQ group on this key rides ONE WAN
+    delta stream; a WAN gap surfaces as the stream's counted, in-band
+    ``resync`` full (``gyt_gw_region_resyncs_total``), never as silent
+    divergence, and inter-region bytes follow delta churn
+    (``gyt_gw_region_event_bytes_total``), not panel size."""
+
+    __slots__ = ("gw", "key", "req", "held", "tick", "last_used",
+                 "stream", "task", "_folded", "_advanced")
+
+    def __init__(self, gw: "FabricGateway", req: dict, key: str):
+        from gyeeta_tpu.net.subs import SubscribeStream
+        self.gw, self.key = gw, key
+        self.req = {k: v for k, v in req.items()
+                    if k not in ("last_snaptick", "subscribe")}
+        self.held: Optional[dict] = None
+        self.tick = -1
+        self.last_used = time.monotonic()
+        self._folded: collections.Counter = collections.Counter()
+        self._advanced = asyncio.Event()
+        self.stream = SubscribeStream(
+            [(u.host, u.port) for u in gw.upstreams], self.req,
+            stall_timeout=gw.hub_stall_s)
+        self.task = asyncio.create_task(self._run())
+
+    def done(self) -> bool:
+        return self.task.done()
+
+    def stop(self) -> None:
+        self.stream.stop()
+        self.task.cancel()
+
+    def fold(self) -> None:
+        """Publish the stream's counter DELTAS since the last fold
+        onto the gateway's gyt_gw_region_* families."""
+        c = self.stream.counters
+        for src, dst in _HUB_FOLD:
+            d = c[src] - self._folded[src]
+            if d:
+                self.gw.stats.bump(dst, d)
+                self._folded[src] = c[src]
+
+    async def _run(self) -> None:
+        try:
+            async for resp in self.stream.responses():
+                self.held = resp
+                st = resp.get("snaptick")
+                if st is not None and int(st) > self.tick:
+                    self.tick = int(st)
+                ev, self._advanced = self._advanced, asyncio.Event()
+                ev.set()
+                self.fold()
+                self.gw._hub_advance(self.tick)     # noqa: SLF001
+        except asyncio.CancelledError:
+            raise
+        except Exception:       # noqa: BLE001 — relay dies visibly
+            self.gw.stats.bump("gw_region_relay_errors")
+            log.exception("hub relay %s failed", self.key)
+
+    async def current(self, target: int, settle_s: float,
+                      first_s: float) -> Optional[dict]:
+        """The latest held full, waiting (bounded) for the relay to
+        reach ``target``: ``first_s`` budget before the FIRST full
+        (a fresh WAN subscribe), ``settle_s`` for a tick to land.
+        Returns whatever is held when the budget runs out — a lagging
+        view, or None when the WAN is down before the first full."""
+        t0 = time.monotonic()
+        while self.held is None or self.tick < target:
+            budget = (first_s if self.held is None else settle_s) \
+                - (time.monotonic() - t0)
+            if budget <= 0 or self.done():
+                break
+            ev = self._advanced
+            try:
+                await asyncio.wait_for(ev.wait(), budget)
+            except (asyncio.TimeoutError, TimeoutError):
+                break
+        return self.held
+
+
 class FabricGateway:
     def __init__(self, upstreams, host: str = "127.0.0.1",
                  port: int = 0, peers=(), stats: Optional[Stats] = None,
@@ -230,9 +326,32 @@ class FabricGateway:
                  down_after: Optional[int] = None,
                  hedge_ms: Optional[float] = None,
                  sub_persist: Optional[str] = None,
-                 advertise: Optional[str] = None):
+                 advertise: Optional[str] = None,
+                 hub: bool = False):
         self.host, self.port = host, int(port)
         self.stats = stats if stats is not None else Stats()
+        # hub mode (ISSUE 19): ``upstreams`` are a PEER REGION's
+        # gateways and this gateway FETCHES from their subscription
+        # stream instead of polling per tick — every local panel and
+        # CQ group rides ONE inter-region delta stream per key
+        # (gyt_gw_region_* families). One-shot / historical queries
+        # still pass through the same pooled query conns.
+        self.hub = bool(hub)
+        self.hub_stall_s = _envf("GYT_GW_HUB_STALL_S", 10.0)
+        self.hub_settle_s = _envf("GYT_GW_HUB_SETTLE_S", 0.5)
+        self.hub_first_s = _envf("GYT_GW_HUB_FIRST_S", 15.0)
+        self.hub_idle_s = _envf("GYT_GW_HUB_IDLE_S", 60.0)
+        self._hub_relays: dict = {}             # key -> _HubRelay
+        self._hub_tick = -1
+        self._hub_kick = asyncio.Event()
+        self._hub_hb_key = request_key(dict(_POLL_REQ))
+        # peer-exchange tick floor (owner-tick poll-skew fix): when a
+        # peer asks us — the rendezvous owner — for a tick our own
+        # poller has not seen yet, ADOPT it. The fabric already
+        # reached that tick (the asker saw it on its replica), so
+        # rendering under our stale tick would alias the result where
+        # the asker never looks (peer_hits=0 flake, CHANGES PR 16).
+        self._tick_floor = -1
         # circuit-breaker + hedge knobs (OPERATIONS.md "Failure
         # domains & degradation"): K consecutive failures before an
         # upstream is marked down; latency budget past which a render
@@ -290,7 +409,7 @@ class FabricGateway:
         self._render = JsonRenderPool(stats=self.stats)
         from gyeeta_tpu.net.subs import SubscriptionHub
         self.subs = SubscriptionHub(
-            self.query, self.stats,
+            self._hub_fetch if self.hub else self.query, self.stats,
             persist_path=sub_persist
             or os.environ.get("GYT_GW_SUB_PERSIST") or None)
 
@@ -300,17 +419,26 @@ class FabricGateway:
             self._handle, self.host, self.port)
         addr = self._server.sockets[0].getsockname()
         self.host, self.port = addr[0], addr[1]
-        self._tasks = [asyncio.create_task(self._watch_upstream(u))
-                       for u in self.upstreams]
+        if self.hub:
+            # no per-tick WAN polls: the remote tick arrives on the
+            # heartbeat relay inside _hub_drive
+            self._tasks = [asyncio.create_task(self._hub_drive())]
+        else:
+            self._tasks = [asyncio.create_task(self._watch_upstream(u))
+                           for u in self.upstreams]
         log.info("fabric gateway on %s:%d -> %d upstream(s), "
-                 "%d peer(s)", self.host, self.port,
-                 len(self.upstreams), len(self.peers))
+                 "%d peer(s)%s", self.host, self.port,
+                 len(self.upstreams), len(self.peers),
+                 " [hub]" if self.hub else "")
         return self.host, self.port
 
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
         self._tasks = []
+        for rel in self._hub_relays.values():
+            rel.stop()
+        self._hub_relays.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -330,7 +458,12 @@ class FabricGateway:
     # ------------------------------------------------------------- upstream
     @property
     def fabric_tick(self) -> int:
-        return max((u.tick for u in self.upstreams), default=-1)
+        t = max((u.tick for u in self.upstreams), default=-1)
+        if self._hub_tick > t:          # hub mode: the relay's view
+            t = self._hub_tick
+        if self._tick_floor > t:        # peer-adopted (poll skew)
+            t = self._tick_floor
+        return t
 
     # ------------------------------------------------------------- topology
     def topology(self) -> dict:
@@ -572,6 +705,91 @@ class FabricGateway:
                     float(sum(1 for x in self.upstreams if x.up)))
             await asyncio.sleep(self.poll_s)
 
+    # ------------------------------------------------------ hub mode
+    def _hub_advance(self, tick: int) -> None:
+        """A relay saw a newer remote tick: adopt it as the hub's
+        fabric tick and kick the push driver."""
+        if tick > self._hub_tick:
+            self._hub_tick = tick
+            self.stats.gauge("gw_region_tick", float(tick))
+            self._hub_kick.set()
+
+    def _hub_relay_for(self, req: dict) -> _HubRelay:
+        key = request_key(req)
+        rel = self._hub_relays.get(key)
+        if rel is None or rel.done():
+            if rel is not None:
+                rel.stop()
+            rel = self._hub_relays[key] = _HubRelay(self, req, key)
+            self.stats.bump("gw_region_relays_opened")
+            self.stats.gauge("gw_region_keys",
+                             float(len(self._hub_relays)))
+        rel.last_used = time.monotonic()
+        return rel
+
+    async def _hub_fetch(self, req: dict) -> dict:
+        """The SubscriptionHub's fetch in hub mode: serve the key's
+        relay-held full instead of rendering upstream — N local
+        subscribers on one key cost ONE inter-region stream. Falls
+        back to a one-shot passthrough (counted) only before the
+        first full lands, so the first subscriber still gets a base
+        while the WAN subscribe is in flight."""
+        rel = self._hub_relay_for(req)
+        resp = await rel.current(self._hub_tick, self.hub_settle_s,
+                                 self.hub_first_s)
+        if resp is None:
+            self.stats.bump("gw_region_fetch_fallbacks")
+            return await self.query(dict(req))
+        return resp
+
+    async def _hub_drive(self) -> None:
+        """Hub-mode push driver: the remote region's tick arrives on
+        the heartbeat relay (the same ``serverstatus`` request poll
+        mode uses — but ONE standing subscription instead of a poll
+        per upstream per tick). When it advances, give the active
+        relays a short settle window to land the same tick, then run
+        the local subscription push once — the exact analogue of
+        ``_watch_upstream``'s guarded push, driven by events instead
+        of polls."""
+        self._hub_relay_for(dict(_POLL_REQ))
+        while True:
+            try:
+                await asyncio.wait_for(self._hub_kick.wait(), 1.0)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+            self._hub_kick.clear()
+            now = time.monotonic()
+            for key, rel in list(self._hub_relays.items()):
+                rel.fold()
+                if key == self._hub_hb_key:
+                    rel.last_used = now     # the heartbeat never idles
+                elif now - rel.last_used > self.hub_idle_s:
+                    # no local fetch touched this key for a while: the
+                    # last subscriber left — stop paying WAN for it
+                    rel.stop()
+                    del self._hub_relays[key]
+                    self.stats.bump("gw_region_relays_closed")
+            self.stats.gauge("gw_region_keys",
+                             float(len(self._hub_relays)))
+            new = self.fabric_tick
+            if new > self._pushed_tick and not self._pushing:
+                deadline = time.monotonic() + self.hub_settle_s
+                while time.monotonic() < deadline and any(
+                        r.held is not None and r.tick < new
+                        for r in self._hub_relays.values()):
+                    await asyncio.sleep(0.02)
+                self._pushing = True
+                try:
+                    await self.subs.push_tick()
+                    self._pushed_tick = new
+                except asyncio.CancelledError:
+                    raise
+                except Exception:   # noqa: BLE001 — counted, retried
+                    self.stats.bump("gw_push_errors")
+                    log.exception("hub push failed at tick %d", new)
+                finally:
+                    self._pushing = False
+
     # ------------------------------------------------------ cache + query
     @staticmethod
     def _cacheable(req: dict) -> bool:
@@ -740,7 +958,15 @@ class FabricGateway:
                     resp = got[1]
             if resp is not None:
                 self.stats.bump("gw_cache_hits|tier=peer")
-            else:
+            if resp is None and self.hub:
+                # hub mode: an active inter-region relay already holds
+                # this key's current full — a one-shot dashboard query
+                # must not cost a WAN render
+                rel = self._hub_relays.get(key)
+                if rel is not None and rel.held is not None:
+                    resp = rel.held
+                    self.stats.bump("gw_cache_hits|tier=region")
+            if resp is None:
                 try:
                     resp = await self._upstream_query(dict(req))
                 except RuntimeError as e:
@@ -908,6 +1134,16 @@ class FabricGateway:
         shares the negative verdict."""
         self.stats.bump("gw_peer_served_requests")
         ck = (int(obj.get("tick", -1)), str(obj.get("key", "")))
+        if ck[0] > self.fabric_tick:
+            # owner-tick poll skew (CHANGES PR 16 flake): the asker's
+            # replica already published this tick, our poller just
+            # has not seen it yet. Adopt it as a floor so the render
+            # below caches under the tick the asker (and everyone
+            # else at that tick) will look up — NOT under our stale
+            # one, which made owner-routed renders invisible
+            # (peer_hits=0) until the next poll.
+            self._tick_floor = ck[0]
+            self.stats.bump("gw_peer_tick_adopted")
         ent = self._cache.get(ck)
         if ent is not None and ent[0] == "ok":
             self.stats.bump("gw_peer_served_hits")

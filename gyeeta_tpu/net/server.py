@@ -72,7 +72,9 @@ class GytServer:
                  shard_ingest: bool = False,
                  shard_queue_mb: float = 8.0,
                  ingest_procs: int = 1,
-                 sub_persist: Optional[str] = None):
+                 sub_persist: Optional[str] = None,
+                 relay_port: Optional[int] = None,
+                 relay_host: str = "0.0.0.0"):
         self.rt = rt
         self.host = host
         self.port = port
@@ -204,6 +206,17 @@ class GytServer:
                     subdir_fmt=getattr(
                         getattr(rt, "layout", None), "WAL_SUBDIR_FMT",
                         "shard_{:02d}"))
+        # ---- remote ingest relay hub (net/relay.py): accepts REMOTE
+        # relay uplinks carrying the shm-ring contract over TCP —
+        # decoded batches with cumulative per-shard record chains, so
+        # published == consumed + counted drops holds across machines.
+        # Registration RPCs land on the SAME sticky hostmap; the relay
+        # owns its WAL on its own host. relay_port=None binds nothing.
+        self._relay = None
+        if relay_port is not None:
+            from gyeeta_tpu.net.relay import RelayHub
+            self._relay = RelayHub(rt, self._relay_register,
+                                   host=relay_host, port=relay_port)
         # stock-partha registration state: machine-id → the ident key
         # issued at PS_REGISTER (the SM_PARTHA_IDENT_NOTIFY flow,
         # gy_comm_proto.h:946 — shyama hands the key to madhava; the
@@ -313,6 +326,23 @@ class GytServer:
                 f"agent registered: machine {mid:032x} -> host {hid}",
                 source="agent")
         return wire.REG_OK, hid
+
+    def _relay_register(self, mid: int, conn_type: int,
+                        ver: int) -> tuple[int, int, int]:
+        """Registration RPC from a remote ingest relay → (status,
+        host_id, last_seq). Same gates + sticky hostmap as the local
+        handshake, so an agent's identity survives moving between a
+        direct conn and any relay."""
+        if ver < version.MIN_WIRE_VERSION:
+            return wire.REG_ERR_VERSION, 0, 0
+        if conn_type != wire.CONN_EVENT:
+            return wire.REG_OK, 0xFFFFFFFF, 0
+        status, hid = self._host_for_machine(mid)
+        last_seq = 0
+        if status == wire.REG_OK:
+            last_seq = int(getattr(self.rt, "_sweep_last_seq",
+                                   {}).get(hid, 0))
+        return status, hid, last_seq
 
     _DOMAIN_MAX_PENDING = 8192
     _DOMAIN_MAX_AGE_TICKS = 12
@@ -439,6 +469,8 @@ class GytServer:
             self._ingest_tasks = [
                 asyncio.create_task(self._ingest_drain_loop()),
                 asyncio.create_task(self._ingest_monitor_loop())]
+        if self._relay is not None:
+            await self._relay.start()
         if self.tick_interval:
             self._tick_task = asyncio.create_task(self._tick_loop())
         log.info("gyt server on %s:%d", self.host, self.port)
@@ -474,6 +506,12 @@ class GytServer:
         if self._tick_task:
             self._tick_task.cancel()
             self._tick_task = None
+        if self._relay is not None:
+            # stop accepting relay batches before the runtime winds
+            # down (a batch landing mid-close would stage into a
+            # closing runtime); shutdown is not relay loss — no epoch
+            # finalize, the relays reconnect to the restarted hub
+            await self._relay.stop()
         if self._server:
             self._server.close()
             # force-close live conns BEFORE wait_closed: since 3.12.1
@@ -517,6 +555,9 @@ class GytServer:
                     # workers stamp WAL chunks with the window tick
                     # (replay merge order + compactor window evidence)
                     self._ingest.broadcast_tick(self.rt._tick_no)
+                if self._relay is not None:
+                    # remote relays stamp THEIR WALs with the same tick
+                    self._relay.broadcast_tick(self.rt._tick_no)
                 self._resolve_pending_domains()
                 await self.push_trace_control()
                 await self.push_throttle()

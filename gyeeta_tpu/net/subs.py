@@ -930,6 +930,12 @@ class SubscribeStream:
                 async for ev in sc.events(
                         stall_timeout=self.stall_timeout):
                     self.counters["events"] += 1
+                    # what actually crossed the wire: the EVENT (delta
+                    # or full), not the reassembled view — consumers
+                    # (the hub-mode gateway's inter-region relay) use
+                    # this to prove bytes ∝ delta churn, not panel size
+                    self.counters["event_bytes"] += len(
+                        json.dumps(ev, separators=(",", ":")))
                     if ev.get("t") == "full" and ev.get("resync"):
                         self.counters["resyncs"] += 1
                     try:

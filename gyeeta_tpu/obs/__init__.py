@@ -97,6 +97,30 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
         for k, v in gwm.items():
             lines.append(f"  {k:<36} {v}")
 
+    # remote ingest relay surface (net/relay.py): per-relay published /
+    # consumed / counted-dropped ledgers plus epoch and reconnect churn
+    # (OPERATIONS.md "Regions & WAN deployment"). ledger_open is the
+    # global invariant published − consumed − dropped summed over all
+    # relays: a persistently nonzero value means records vanished
+    # UNCOUNTED between the remote host and the hub — page on it.
+    rly = {k: v for k, v in sorted(c.items())
+           if str(k).startswith("relay_")}
+    if rly:
+        lines.append("")
+        lines.append("remote ingest relay:")
+
+        def _rsum(pfx: str) -> float:
+            return sum(v for k, v in rly.items()
+                       if str(k).startswith(pfx)
+                       and isinstance(v, (int, float)))
+
+        rly["ledger_open"] = round(
+            _rsum("relay_published_records")
+            - _rsum("relay_consumed_records")
+            - _rsum("relay_dropped_records"), 4)
+        for k, v in rly.items():
+            lines.append(f"  {k:<36} {v}")
+
     # history tier (compactor + windowed quantiles, OPERATIONS.md
     # "Distributed compaction & windowed quantiles")
     hist = {k: v for k, v in sorted(c.items())
@@ -111,8 +135,9 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
     plain = {k: v for k, v in sorted(c.items())
              if not str(k).startswith(("engine_", "journal_", "wal_",
                                        "throttle", "query_", "queries",
-                                       "snapshot", "gw_", "compact_",
-                                       "wd_", "windowed_quant"))
+                                       "snapshot", "gw_", "relay_",
+                                       "compact_", "wd_",
+                                       "windowed_quant"))
              and isinstance(v, (int, float))}
     lines.append("")
     hdr = f"  {'counter':<36} {'total':>12}"

@@ -109,7 +109,9 @@ class Daemon:
                              ingest_procs=getattr(
                                  args, "ingest_procs", 1) or 1,
                              sub_persist=getattr(
-                                 args, "sub_persist", None))
+                                 args, "sub_persist", None),
+                             relay_port=getattr(
+                                 args, "relay_port", None))
         self._hot = C.HotReload(args.config, opts) if args.config else None
         # history compaction daemon: sealed WAL segments → columnar
         # snapshot shards (the time-travel tier's writer). Runs only
@@ -412,6 +414,11 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
                     help="deframe/decode on a worker thread (the "
                     "reference's L1/L2 split; useful on multi-core "
                     "hosts — the native decoders release the GIL)")
+    ap.add_argument("--relay-port", type=int, default=None,
+                    help="accept REMOTE ingest relay uplinks on this "
+                    "port (net/relay.py: the shm-ring ledger over "
+                    "TCP — published == consumed + counted drops "
+                    "across machines; 0 = ephemeral)")
     ap.add_argument("--stats-interval", type=float, default=60.0)
     # conn-hardening deadlines (net/server.py; every reap lands on a
     # labeled gyt_conn_timeouts_total counter in /metrics)

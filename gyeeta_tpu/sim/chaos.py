@@ -21,7 +21,19 @@ failure vocabulary of real networks —
   hard fault-domain case: requests are accepted, responses never
   come, and no conn error ever fires (circuit breakers see nothing
   until a timeout; hedged reads are what bound the latency). Also a
-  manual ``proxy.wedged`` toggle for harness-driven schedules.
+  manual ``proxy.wedged`` toggle for harness-driven schedules,
+- **asymmetric latency/jitter** (ISSUE 19): per-direction delays
+  (``latency_c2s_s``/``latency_s2c_s``) — the WAN shape where the ask
+  path and the answer path cost differently,
+- **partition windows** (ISSUE 19): bytes in BOTH directions are
+  silently DROPPED (counted) while every conn stays open — unlike a
+  wedge the bytes never arrive, so a healed stream is torn mid-frame
+  and the endpoints' resync machinery (frame-error close, counted
+  relay gaps, subscription resyncs) must recover; also a manual
+  ``proxy.partitioned`` toggle,
+- **region-kill scheduling** (ISSUE 19): :class:`RegionKill` drives
+  kill/restart callbacks on deterministic windows — the harness-side
+  clock for region-wide SIGKILL campaigns.
 
 The PR-15 fault-domain campaign points these at the INTER-TIER hops
 (gateway→replica, subscription client→gateway) as well as the
@@ -77,7 +89,12 @@ class FaultPlan:
                  resplit: int = 0,
                  kill_windows: Iterable[tuple] = (),
                  wedge_windows: Iterable[tuple] = (),
-                 fault_both: bool = False):
+                 fault_both: bool = False,
+                 latency_c2s_s: Optional[float] = None,
+                 latency_s2c_s: Optional[float] = None,
+                 jitter_c2s_s: Optional[float] = None,
+                 jitter_s2c_s: Optional[float] = None,
+                 partition_windows: Iterable[tuple] = ()):
         self.seed = seed
         self.fault_kinds = tuple(fault_kinds)
         for k in self.fault_kinds:
@@ -101,6 +118,17 @@ class FaultPlan:
         # the inter-tier hops fail on the answer path as often as the
         # ask path
         self.fault_both = bool(fault_both)
+        # asymmetric WAN shape: per-direction latency/jitter override
+        # the symmetric knobs when set (None = inherit)
+        self.latency_c2s_s = latency_c2s_s
+        self.latency_s2c_s = latency_s2c_s
+        self.jitter_c2s_s = jitter_c2s_s
+        self.jitter_s2c_s = jitter_s2c_s
+        # (start_s, end_s) intervals during which BOTH directions are
+        # silently dropped (counted) while every conn stays open — a
+        # network partition, not a stall: the bytes never arrive
+        self.partition_windows = tuple((float(a), float(b))
+                                       for a, b in partition_windows)
 
     def _rng(self, conn_idx: int, salt: int = 0) -> random.Random:
         # int-mixed seed (tuple seeding is deprecated and hash-based)
@@ -125,6 +153,19 @@ class FaultPlan:
     def in_wedge_window(self, t_rel: float) -> bool:
         return any(a <= t_rel < b for a, b in self.wedge_windows)
 
+    def in_partition_window(self, t_rel: float) -> bool:
+        return any(a <= t_rel < b for a, b in self.partition_windows)
+
+    def latency_for(self, direction: str) -> float:
+        v = self.latency_c2s_s if direction == "c2s" \
+            else self.latency_s2c_s
+        return self.latency_s if v is None else float(v)
+
+    def jitter_for(self, direction: str) -> float:
+        v = self.jitter_c2s_s if direction == "c2s" \
+            else self.jitter_s2c_s
+        return self.jitter_s if v is None else float(v)
+
 
 class ChaosProxy:
     """Seeded fault-injecting TCP proxy (agent side → ``listen``,
@@ -140,12 +181,14 @@ class ChaosProxy:
         self.host, self.port = host, port
         self.refusing = False         # manual server-kill coordination
         self.wedged = False           # manual stalled-upstream toggle
+        self.partitioned = False      # manual partition toggle
         self.stats: collections.Counter = collections.Counter()
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()      # live (cwriter, uwriter) pairs
         self._n_accepted = 0
         self._t0 = 0.0
         self._kill_task: Optional[asyncio.Task] = None
+        self._was_partitioned = False
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> tuple[str, int]:
@@ -155,7 +198,8 @@ class ChaosProxy:
             self._handle, self.host, self.port)
         sock = self._server.sockets[0].getsockname()
         self.host, self.port = sock[0], sock[1]
-        if self.plan.kill_windows or self.plan.wedge_windows:
+        if self.plan.kill_windows or self.plan.wedge_windows \
+                or self.plan.partition_windows:
             self._kill_task = asyncio.create_task(self._kill_monitor())
         log.info("chaos proxy on %s:%d -> %s:%d (faults=%s seed=%d)",
                  self.host, self.port, *self.upstream,
@@ -208,6 +252,15 @@ class ChaosProxy:
                 log.info("chaos: wedge window closes at t=%.2fs", now)
                 self.wedged = False
             was_wedged = inwedge
+            inpart = self.plan.in_partition_window(now)
+            if inpart and not self._was_partitioned:
+                log.info("chaos: partition opens at t=%.2fs", now)
+                self.partitioned = True
+                self.stats["partition_spans"] += 1
+            elif self._was_partitioned and not inpart:
+                log.info("chaos: partition heals at t=%.2fs", now)
+                self.partitioned = False
+            self._was_partitioned = inpart
 
     # ------------------------------------------------------------- conn path
     async def _handle(self, creader, cwriter) -> None:
@@ -228,10 +281,11 @@ class ChaosProxy:
         self._conns.add(pair)
         try:
             c2s = asyncio.create_task(self._pump(
-                creader, uwriter, idx, faulted=True))
+                creader, uwriter, idx, faulted=True,
+                direction="c2s"))
             s2c = asyncio.create_task(self._pump(
                 ureader, cwriter, idx,
-                faulted=self.plan.fault_both))
+                faulted=self.plan.fault_both, direction="s2c"))
             done, pending = await asyncio.wait(
                 {c2s, s2c}, return_when=asyncio.FIRST_COMPLETED)
             for t in pending:
@@ -250,7 +304,7 @@ class ChaosProxy:
                     pass
 
     async def _pump(self, reader, writer, conn_idx: int,
-                    faulted: bool) -> None:
+                    faulted: bool, direction: str = "c2s") -> None:
         """Forward bytes one direction, applying the plan's faults
         (agent→server only) plus latency/jitter/re-splitting."""
         plan = self.plan
@@ -269,13 +323,13 @@ class ChaosProxy:
                         cut = max(0, next_off - offset)
                         pre, at = data[:cut], data[cut:]
                         if pre:
-                            await self._fwd(writer, pre, rng)
+                            await self._fwd(writer, pre, rng, direction)
                             offset += len(pre)
                         self.stats[kind] += 1
                         if kind == "corrupt":
                             # flip every bit of ONE byte in flight
                             bad = bytes([at[0] ^ 0xFF]) + at[1:]
-                            await self._fwd(writer, bad, rng)
+                            await self._fwd(writer, bad, rng, direction)
                             offset += len(bad)
                             data = b""
                         elif kind == "stall":
@@ -289,15 +343,22 @@ class ChaosProxy:
                             return
                         next_off, kind = next(faults, (None, None))
                     else:
-                        await self._fwd(writer, data, rng)
+                        await self._fwd(writer, data, rng, direction)
                         offset += len(data)
                         data = b""
         except (ConnectionError, OSError):
             return
 
-    async def _fwd(self, writer, data: bytes, rng: random.Random
-                   ) -> None:
+    async def _fwd(self, writer, data: bytes, rng: random.Random,
+                   direction: str = "c2s") -> None:
         plan = self.plan
+        # partitioned: the bytes are GONE (counted), the conn is not —
+        # a healed stream resumes torn mid-frame and the endpoints'
+        # resync machinery must recover, counted, never silently
+        if self.partitioned:
+            self.stats["partition_dropped_chunks"] += 1
+            self.stats["partition_dropped_bytes"] += len(data)
+            return
         # wedged: park (conn open, bytes held) until the toggle/window
         # clears — the stalled-not-dead upstream both directions see
         if self.wedged:
@@ -310,12 +371,73 @@ class ChaosProxy:
         step = len(data)
         if plan.resplit:
             step = rng.randint(max(1, plan.resplit // 4), plan.resplit)
+        lat = plan.latency_for(direction)
+        jit = plan.jitter_for(direction)
         for i in range(0, len(data), step):
-            if plan.latency_s or plan.jitter_s:
-                await asyncio.sleep(plan.latency_s
-                                    + plan.jitter_s * rng.random())
+            if lat or jit:
+                self.stats[f"delayed_chunks_{direction}"] += 1
+                await asyncio.sleep(lat + jit * rng.random())
             writer.write(data[i: i + step])
             await writer.drain()
+
+
+class RegionKill:
+    """Deterministic region-wide kill scheduler (ISSUE 19): at each
+    window's OPEN edge call ``kill_cb`` (the harness SIGKILLs the
+    region's processes), at its CLOSE edge call ``restart_cb`` (the
+    harness respawns them). Pure ``in_window(t_rel)`` carries the
+    schedule so unit tests cover edge semantics without a clock;
+    :meth:`run` polls a real clock and fires the callbacks exactly
+    once per edge (``stats['region_kills']``/``['region_restarts']``
+    are the ground truth for the campaign's accounting). Callbacks
+    may be sync or async; the task finishes once every window has
+    closed and fired."""
+
+    def __init__(self, windows: Iterable[tuple], kill_cb=None,
+                 restart_cb=None, poll_s: float = 0.05):
+        self.windows = tuple(sorted((float(a), float(b))
+                                    for a, b in windows))
+        for a, b in self.windows:
+            if b <= a:
+                raise ValueError(f"empty region-kill window {a}..{b}")
+        self.kill_cb = kill_cb
+        self.restart_cb = restart_cb
+        self.poll_s = float(poll_s)
+        self.stats: collections.Counter = collections.Counter()
+
+    def in_window(self, t_rel: float) -> bool:
+        return any(a <= t_rel < b for a, b in self.windows)
+
+    @property
+    def end(self) -> float:
+        return max((b for _a, b in self.windows), default=0.0)
+
+    async def _fire(self, cb) -> None:
+        if cb is None:
+            return
+        out = cb()
+        if asyncio.iscoroutine(out):
+            await out
+
+    async def run(self, t0: Optional[float] = None) -> None:
+        loop = asyncio.get_running_loop()
+        t0 = loop.time() if t0 is None else t0
+        was = False
+        while True:
+            now = loop.time() - t0
+            inwin = self.in_window(now)
+            if inwin and not was:
+                log.info("chaos: region kill at t=%.2fs", now)
+                self.stats["region_kills"] += 1
+                await self._fire(self.kill_cb)
+            elif was and not inwin:
+                log.info("chaos: region restart at t=%.2fs", now)
+                self.stats["region_restarts"] += 1
+                await self._fire(self.restart_cb)
+            was = inwin
+            if not inwin and now >= self.end:
+                return
+            await asyncio.sleep(self.poll_s)
 
 
 async def run_proxy(args) -> None:
@@ -335,7 +457,16 @@ async def run_proxy(args) -> None:
         wedge_windows=[(args.wedge_at,
                         args.wedge_at + args.wedge_for)]
         if getattr(args, "wedge_for", 0) > 0 else (),
-        fault_both=getattr(args, "fault_both", False))
+        fault_both=getattr(args, "fault_both", False),
+        latency_c2s_s=(args.latency_c2s_ms / 1e3
+                       if getattr(args, "latency_c2s_ms", None)
+                       is not None else None),
+        latency_s2c_s=(args.latency_s2c_ms / 1e3
+                       if getattr(args, "latency_s2c_ms", None)
+                       is not None else None),
+        partition_windows=[(args.partition_at,
+                            args.partition_at + args.partition_for)]
+        if getattr(args, "partition_for", 0) > 0 else ())
     proxy = ChaosProxy(args.upstream_host, args.upstream_port, plan,
                        host=args.listen_host, port=args.listen_port)
     host, port = await proxy.start()

@@ -400,6 +400,155 @@ def test_fault_plan_deterministic():
     assert plan.in_kill_window(1.5) and not plan.in_kill_window(2.5)
 
 
+# ------------------------------------------- WAN fault shapes (ISSUE 19)
+async def _echo_server():
+    async def echo(reader, writer):
+        try:
+            while True:
+                d = await reader.read(1024)
+                if not d:
+                    return
+                writer.write(d)
+                await writer.drain()
+        finally:
+            writer.close()
+
+    srv = await asyncio.start_server(echo, "127.0.0.1", 0)
+    host, port = srv.sockets[0].getsockname()[:2]
+    return srv, host, port
+
+
+def test_asymmetric_latency_counted_per_direction():
+    """latency_s2c_s delays ONLY the answer path: the ask path stays
+    undelayed (counted per direction), and the round trip pays the
+    s2c budget."""
+    async def scenario():
+        srv, host, port = await _echo_server()
+        proxy = ChaosProxy(host, port,
+                           FaultPlan(latency_s2c_s=0.15))
+        ph, pp = await proxy.start()
+        reader, writer = await asyncio.open_connection(ph, pp)
+        t0 = time.monotonic()
+        writer.write(b"ping")
+        await writer.drain()
+        got = await asyncio.wait_for(reader.readexactly(4), 5.0)
+        rtt = time.monotonic() - t0
+        writer.close()
+        stats = dict(proxy.stats)
+        await proxy.stop()
+        srv.close()
+        await srv.wait_closed()
+        return got, rtt, stats
+
+    got, rtt, stats = asyncio.run(scenario())
+    assert got == b"ping"
+    assert rtt >= 0.15                      # the answer path paid
+    # exact per-direction accounting: one delayed s2c chunk, zero c2s
+    assert stats["delayed_chunks_s2c"] == 1
+    assert stats.get("delayed_chunks_c2s", 0) == 0
+    # the plan resolves per-direction overrides against the symmetric
+    # default
+    plan = FaultPlan(latency_s=0.2, latency_c2s_s=0.05)
+    assert plan.latency_for("c2s") == 0.05
+    assert plan.latency_for("s2c") == 0.2
+
+
+def test_partition_drops_bytes_conns_held():
+    """A partition LOSES the bytes (counted exactly) while every conn
+    stays open; after heal the same conn carries traffic again."""
+    async def scenario():
+        srv, host, port = await _echo_server()
+        proxy = ChaosProxy(host, port)
+        ph, pp = await proxy.start()
+        reader, writer = await asyncio.open_connection(ph, pp)
+        # prove the path first
+        writer.write(b"pre")
+        await writer.drain()
+        assert await asyncio.wait_for(reader.readexactly(3), 5.0) \
+            == b"pre"
+        proxy.partitioned = True
+        lost = b"x" * 1000
+        writer.write(lost)
+        await writer.drain()
+        assert await _until(
+            lambda: proxy.stats.get("partition_dropped_bytes", 0)
+            >= len(lost))
+        # the conn is HELD: no EOF arrived while partitioned
+        with pytest.raises((asyncio.TimeoutError, TimeoutError)):
+            await asyncio.wait_for(reader.read(1), 0.3)
+        proxy.partitioned = False
+        writer.write(b"post")
+        await writer.drain()
+        got = await asyncio.wait_for(reader.readexactly(4), 5.0)
+        writer.close()
+        stats = dict(proxy.stats)
+        await proxy.stop()
+        srv.close()
+        await srv.wait_closed()
+        return got, stats, len(lost)
+
+    got, stats, nlost = asyncio.run(scenario())
+    assert got == b"post"                   # healed, same conn
+    # exact loss accounting: the lost blob, whole, nothing else
+    assert stats["partition_dropped_bytes"] == nlost
+    assert stats["partition_dropped_chunks"] == 1
+
+
+def test_partition_window_schedule():
+    plan = FaultPlan(partition_windows=[(0.5, 1.0), (2.0, 2.5)])
+    assert not plan.in_partition_window(0.49)
+    assert plan.in_partition_window(0.5)    # closed start edge
+    assert not plan.in_partition_window(1.0)  # open end edge
+    assert plan.in_partition_window(2.25)
+
+    async def scenario():
+        srv, host, port = await _echo_server()
+        proxy = ChaosProxy(host, port,
+                           FaultPlan(partition_windows=[(0.0, 0.3)]))
+        await proxy.start()
+        assert await _until(lambda: proxy.partitioned, timeout=2.0)
+        assert await _until(lambda: not proxy.partitioned, timeout=2.0)
+        spans = proxy.stats["partition_spans"]
+        await proxy.stop()
+        srv.close()
+        await srv.wait_closed()
+        return spans
+
+    assert asyncio.run(scenario()) == 1     # one span, counted once
+
+
+def test_region_kill_scheduling():
+    from gyeeta_tpu.sim.chaos import RegionKill
+    with pytest.raises(ValueError):
+        RegionKill([(1.0, 1.0)])
+    rk = RegionKill([(1.0, 2.0), (3.0, 4.0)])
+    assert not rk.in_window(0.99) and rk.in_window(1.0)
+    assert not rk.in_window(2.0) and rk.in_window(3.5)
+    assert rk.end == 4.0
+
+    async def scenario():
+        events = []
+
+        def kill():
+            events.append("kill")
+
+        async def restart():
+            events.append("restart")
+
+        rk = RegionKill([(0.05, 0.15), (0.25, 0.35)],
+                        kill_cb=kill, restart_cb=restart,
+                        poll_s=0.01)
+        await asyncio.wait_for(rk.run(), 5.0)
+        return events, dict(rk.stats)
+
+    events, stats = asyncio.run(scenario())
+    # each window fires kill exactly once at open, restart once at
+    # close, in order — the campaign's exact accounting
+    assert events == ["kill", "restart", "kill", "restart"]
+    assert stats["region_kills"] == 2
+    assert stats["region_restarts"] == 2
+
+
 # ------------------------------------------------- checkpoint walk-back
 def test_torn_newest_checkpoint_walks_back(rt, tmp_path):
     """A truncated newest .npz (crash mid-write without the fsync

@@ -94,6 +94,9 @@ def _run(monkeypatch, fused: bool, streams, chunk_seed: int) -> tuple:
     return d, counters
 
 
+@pytest.mark.slow   # ~3 min on 1 vCPU; the byte-chunked parity test
+                    # below keeps a fused==legacy digest check in the
+                    # fast tier, and ci.sh smokes the fused path too
 def test_fused_vs_legacy_parity_fuzz(monkeypatch):
     """500-stream mixed-subsystem fuzz: fused == legacy, bit for bit."""
     streams = [_mixed_stream(seed) for seed in range(500)]

@@ -628,6 +628,126 @@ def test_ring_backlog_frac_per_ring_capacity():
     assert pool.ring_backlog_frac() == 0.0
 
 
+# -------------------------------------------- two-region fabric (ISSUE 19)
+
+
+def test_peer_request_adopts_newer_tick():
+    """Owner-tick poll skew (the PR-16 gateway_fabric flake): a peer
+    asking the rendezvous owner for a tick the owner's poller has not
+    seen yet must ADOPT that tick — the fabric already reached it —
+    so the owner's render caches under the tick the asker looks up,
+    not under the owner's stale one (peer_hits=0 otherwise)."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.query.normalize import request_key
+
+    async def scenario():
+        gw = FabricGateway([("127.0.0.1", 9)])
+        gw.upstreams[0].tick = 5        # our poller is behind
+        k = request_key({"subsys": "svcstate"})
+
+        async def fake(req):
+            # the replica HAS tick 7 (the asker saw it there)
+            return {"snaptick": 7, "nrecs": 1, "recs": [{"a": 1}]}
+
+        gw._upstream_query = fake
+        out = await gw._serve_peer(
+            {"tick": 7, "key": k, "req": {"subsys": "svcstate"}})
+        assert out is not None and "resp" in out
+        assert gw.fabric_tick == 7
+        assert gw.stats.counters.get("gw_peer_tick_adopted") == 1
+        # the render parked under tick 7 — where the fleet looks
+        assert (7, k) in gw._cache
+        # a follow-up probe at the same tick HITS the cache
+        out2 = await gw._serve_peer({"tick": 7, "key": k})
+        assert out2["resp"] is out["resp"]
+        assert gw.stats.counters.get("gw_peer_served_hits") == 1
+        gw._render.close()
+
+    asyncio.run(scenario())
+
+
+def test_gateway_hub_mode_region_relay():
+    """Cross-region relay (ISSUE 19): a hub-mode gateway FETCHES from
+    the peer region's subscription stream instead of polling — N
+    local subscribers on one key ride ONE inter-region delta stream,
+    the remote tick arrives on the heartbeat relay, reassembly is
+    byte-equal, and one-shot queries serve from the relay-held full
+    (tier=region) instead of costing a WAN render."""
+    from gyeeta_tpu.net.gateway import FabricGateway
+    from gyeeta_tpu.net.server import GytServer
+    from gyeeta_tpu.net.subs import SubscribeClient
+
+    rt, sim = _mk_rt()
+
+    async def scenario():
+        srv = GytServer(rt, tick_interval=None, idle_timeout=300.0)
+        host, port = await srv.start()
+        gwa = FabricGateway([(host, port)], poll_s=0.05)
+        ha, pa = await gwa.start()
+        gwb = FabricGateway([(ha, pa)], hub=True)
+        hb, pb = await gwb.start()
+        snap = rt.snapshot.tick
+        # the remote tick rides the heartbeat relay, not a poll loop
+        await _until(lambda: gwb.fabric_tick >= snap,
+                     msg="hub tick via heartbeat relay")
+
+        q = {"subsys": "svcstate", "sortcol": "qps5s",
+             "sortdesc": True, "maxrecs": 50}
+        scs, readers, tasks = [], [], []
+        for _ in range(2):      # TWO local subscribers, ONE WAN stream
+            sc = SubscribeClient()
+            await sc.connect(hb, pb)
+            await sc.subscribe(dict(q))
+            evs: list = []
+
+            async def rd(_sc=sc, _evs=evs):
+                async for ev in _sc.events():
+                    _evs.append(ev)
+
+            scs.append(sc)
+            readers.append(evs)
+            tasks.append(asyncio.create_task(rd()))
+        await _until(lambda: readers[0] and readers[1],
+                     msg="initial fulls through the relay")
+        assert readers[0][0]["t"] == "full"
+        held = D.apply_event(None, readers[0][0])
+        # exactly TWO relays: the heartbeat + the shared svcstate key
+        assert gwb.stats.counters.get("gw_region_relays_opened") == 2
+        assert gwb.stats.gauges.get("gw_region_keys") == 2.0
+
+        n0, n1 = len(readers[0]), len(readers[1])
+        _feed(rt, sim)
+        rt.run_tick()
+        await _until(lambda: len(readers[0]) > n0
+                     and len(readers[1]) > n1, msg="hub delta push")
+        held = D.apply_event(held, readers[0][-1])
+        full = await gwa.query(dict(q))
+        assert held["snaptick"] == full["snaptick"]
+        assert json.dumps(held) == json.dumps(
+            json.loads(json.dumps(full)))
+        # inter-region accounting: events + their wire bytes counted
+        assert gwb.stats.counters.get("gw_region_events", 0) >= 2
+        assert gwb.stats.counters.get("gw_region_event_bytes", 0) > 0
+        # a one-shot query on the hub serves the relay-held full —
+        # no WAN render for an actively-relayed key
+        r0 = gwb.stats.counters.get("gw_renders_upstream", 0)
+        out = await gwb.query(dict(q))
+        assert out["snaptick"] == full["snaptick"]
+        assert gwb.stats.counters.get(
+            "gw_cache_hits|tier=region", 0) >= 1
+        assert gwb.stats.counters.get("gw_renders_upstream", 0) == r0
+
+        for t in tasks:
+            t.cancel()
+        for sc in scs:
+            await sc.close()
+        await gwb.stop()
+        await gwa.stop()
+        await srv.stop()
+
+    asyncio.run(scenario())
+
+
 def test_webgw_sse_relay_surfaces_rejection():
     """A subscription the upstream rejects (QS_ERROR) must reach the
     SSE client as an ``event: error`` block — not a silent close that

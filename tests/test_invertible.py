@@ -188,6 +188,8 @@ def _measured_error(rows, truth: exact.StreamTopK, k: int) -> float:
     return err / max(mass, 1e-9)
 
 
+@pytest.mark.slow   # 500-stream feed; the fast tier keeps the decode /
+                    # merge / query / alert tests above for coverage
 def test_recovered_topk_error_bound_fuzz():
     """500-stream mixed-subsystem fuzz: the merged heavy-flow view
     (exact lanes ∪ invertible recovery) stays within 2% weighted error

@@ -238,13 +238,44 @@ def test_runtime_spans_ride_selfstats():
     rt.close()
 
 
-def test_fold_profiler_knob_gated(tmp_path):
-    """GYT_JAX_PROFILE brackets exactly N folds; unset = inert."""
+def test_format_top_relay_ledger_section():
+    """relay_* counters render in their own section with the derived
+    ledger_open invariant (published − consumed − dropped, all
+    relays), and never duplicate into the plain-counters tail."""
+    ss = {"counters": {
+        "uptime_sec": 3,
+        "relay_published_records|relay=rb": 100,
+        "relay_consumed_records|relay=rb": 90,
+        "relay_dropped_records|relay=rb,shard=0": 6,
+        "relay_dropped_records|relay=rb,shard=1": 4,
+        "relay_epochs|relay=rb": 1,
+        "gw_region_events": 5,
+        "conn_events": 7}}
+    frame = format_top(ss)
+    assert "remote ingest relay:" in frame
+    m = re.search(r"ledger_open\s+(\S+)", frame)
+    assert m and float(m.group(1)) == 0.0       # books closed
+    assert "relay_" not in frame.split("counters:")[1]
+    # an open ledger surfaces as a nonzero derived row
+    ss["counters"]["relay_published_records|relay=rb"] = 110
+    m = re.search(r"ledger_open\s+(\S+)", format_top(ss))
+    assert m and float(m.group(1)) == 10.0
+
+
+def test_fold_profiler_unset_inert():
+    """Unset GYT_JAX_PROFILE = profiler disarmed, on_fold is a no-op."""
     from gyeeta_tpu.obs.spans import FoldProfiler
 
     off = FoldProfiler(env={})
     off.on_fold()
     assert not off.armed and off._seen == 0
+
+
+@pytest.mark.slow   # starts a real jax trace bracket (~80s on 1 vCPU);
+                    # the inert-path knob gating stays in the fast tier
+def test_fold_profiler_knob_gated(tmp_path):
+    """GYT_JAX_PROFILE brackets exactly N folds."""
+    from gyeeta_tpu.obs.spans import FoldProfiler
 
     prof = FoldProfiler(env={"GYT_JAX_PROFILE": str(tmp_path),
                              "GYT_JAX_PROFILE_FOLDS": "2"})
