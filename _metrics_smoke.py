@@ -59,6 +59,22 @@ def validate(body: str) -> None:
     assert pub == con + drop, f"relay ledger open: {pub} != {con}+{drop}"
     assert series["gyt_relay_up"][0][1] == 1.0, "relay not up"
 
+    # segment-shipping ledger: sealed == shipped + counted drops, the
+    # remote-compaction-region invariant (sealed is a per-shipper
+    # gauge folded from heartbeats; shipped/dropped are receiver-side
+    # ledger counters)
+    sealed = sum(v for lb, v in series["gyt_ship_sealed_segments"])
+    shp = sum(v for lb, v in
+              series["gyt_ship_shipped_segments_total"])
+    sdrop = sum(v for lb, v in
+                series.get("gyt_ship_dropped_segments_total", []))
+    assert sealed > 0, "shipper sealed nothing"
+    assert sealed == shp + sdrop, \
+        f"ship ledger open: {sealed} != {shp}+{sdrop}"
+    assert series["gyt_ship_shipped_records_total"][0][1] > 0, \
+        "ship landed no records"
+    assert "gyt_ship_staging_bytes" in series, "no staging gauge"
+
     # histogram contract per stage: cumulative, +Inf == _count
     bucket = series.get("gyt_stage_duration_seconds_bucket", [])
     assert bucket, "no timing histogram"
@@ -122,6 +138,49 @@ async def scenario() -> str:
         await asyncio.sleep(0.05)
     await asyncio.sleep(0.05)
     rt.run_tick()
+
+    # segment-shipping leg: a small sealed journal shipped into a
+    # receiver that shares rt.stats, so the gyt_ship_* ledger families
+    # (OPERATIONS.md "Remote compaction region") ride the same scrape
+    import shutil
+    import tempfile
+
+    from gyeeta_tpu.history.shipper import SegmentShipper
+    from gyeeta_tpu.net.segship import SegmentReceiver
+    from gyeeta_tpu.utils.journal import Journal
+    from gyeeta_tpu.utils.selfstats import Stats
+    sdir = tempfile.mkdtemp(prefix="gyt_ship_src_")
+    ddir = tempfile.mkdtemp(prefix="gyt_ship_dst_")
+    try:
+        j = Journal(sdir, segment_max_bytes=1 << 14)
+        for i in range(200):
+            j.append(b"m" * 64, hid=i % 4, conn_id=i, tick=i // 20)
+        j.seal_active()
+        j.fsync()
+        want = j.sealed_upto()
+        rcv = SegmentReceiver(ddir, stats=rt.stats, host="127.0.0.1")
+        rh, rp = await rcv.start()
+        shipper = SegmentShipper({"target": (rh, rp),
+                                  "shipper_id": "ci",
+                                  "journal": j, "stats": Stats(),
+                                  "scan_s": 0.05, "hb_s": 0.05})
+        st = threading.Thread(target=shipper.run, daemon=True)
+        st.start()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60.0:
+            c = rt.stats.snapshot()
+            if (c.get("ship_shipped_segments", 0) >= want
+                    and c.get("ship_sealed_segments|shipper=ci", 0)
+                    >= want):
+                break
+            await asyncio.sleep(0.05)
+        shipper.stop()
+        st.join(timeout=10.0)
+        await rcv.stop()
+        j.close()
+    finally:
+        shutil.rmtree(sdir, ignore_errors=True)
+        shutil.rmtree(ddir, ignore_errors=True)
 
     gw = WebGateway(host, port)
     gh, gp = await gw.start()

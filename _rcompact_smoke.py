@@ -1,0 +1,344 @@
+"""CI smoke: remote compaction region — chaos campaign end to end.
+
+A sharded source WAL ships its sealed segments to a compaction-region
+staging dir through the segment-ship protocol while BOTH endpoints are
+killed at every ship boundary, then a WAN partition cuts a transfer
+mid-segment:
+
+- phase 1: a REAL ``gyeeta_tpu ship`` subprocess per segment, each
+  dying via ``os._exit(9)`` immediately after its FIRST terminal
+  verdict (``GYT_SHIP_DIE_AFTER_ACKS=1``) — a shipper SIGKILL at
+  EVERY ship boundary,
+- phase 2: a REAL ``gyeeta_tpu shiprecv`` subprocess per landing,
+  each dying at its first landing (``GYT_SHIP_RECV_DIE_AFTER=1``) —
+  once right after the atomic rename (mode ``rename``: landed file,
+  no ledger entry) and once right after the ledger append (mode
+  ``ledger``: landed + ledgered, never acked) — while a supervised
+  in-process shipper rides through the deaths,
+- phase 3: the remaining segments ship through a ChaosProxy that
+  PARTITIONS the WAN mid-segment; the reconnect resumes from the
+  receiver's partial offset.
+
+Afterward the campaign must leave NO trace: every staged segment is
+BYTE-IDENTICAL to its source, the content-hash ledger closes EXACTLY
+(``sealed == landed + drops``, drops == 0), and a ``--compact-procs
+2``-equivalent replay over the staging dir (the serve daemon's
+``_StagingCompactLoop``) produces a parted store ARRAY-FOR-ARRAY
+IDENTICAL to a local parallel replay of the original WAL. Exit code
+0 = the remote-compaction contract holds. Run by ci.sh; standalone:
+``JAX_PLATFORMS=cpu python _rcompact_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+SHIPPER_ID = "src-a"
+
+
+def _log(msg: str) -> None:
+    print(f"rcompact smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def build_source_wal(wal: str) -> tuple[int, int]:
+    """Sharded source WAL (the serve --shards layout), several sealed
+    segments per shard; returns (total_segments, ticks)."""
+    from gyeeta_tpu.sim.partha import ParthaSim
+    from gyeeta_tpu.utils import journal as J
+
+    ticks = 4
+    for s in range(2):
+        j = J.Journal(os.path.join(wal, f"shard_{s:02d}"),
+                      segment_max_bytes=1 << 16, fsync_bytes=1 << 30)
+        sim = ParthaSim(n_hosts=4, n_svcs=2, seed=80 + s,
+                        host_base=s * 4)
+        j.append(sim.name_frames(), hid=s * 4, tick=0)
+        for t in range(ticks):
+            for _ in range(3):
+                j.append(sim.conn_frames(128) + sim.resp_frames(256)
+                         + sim.listener_frames() + sim.task_frames(),
+                         hid=s * 4, tick=t)
+        j.close()
+    total = sum(len(J.dir_segments(os.path.join(wal, f"shard_{s:02d}")))
+                for s in range(2))
+    assert total >= 6, f"need >=6 segments for the campaign, got {total}"
+    return total, ticks
+
+
+def count_landed(staging: str) -> int:
+    from gyeeta_tpu.net.segship import LEDGER_NAME
+    lp = pathlib.Path(staging) / LEDGER_NAME
+    if not lp.exists():
+        return 0
+    n = 0
+    for raw in lp.read_bytes().splitlines(keepends=True):
+        if not raw.endswith(b"\n"):
+            break
+        try:
+            e = json.loads(raw)
+        except ValueError:
+            break
+        if e.get("status") == "landed":
+            n += 1
+    return n
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+async def phase1_shipper_kills(wal: str, staging: str,
+                               target: int) -> int:
+    """A shipper subprocess per boundary, each SIGKILLed (os._exit)
+    right after its first terminal verdict."""
+    from gyeeta_tpu.net.segship import SegmentReceiver
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    rcv = SegmentReceiver(staging, stats=Stats(), host="127.0.0.1")
+    h, p = await rcv.start()
+    kills = 0
+    while count_landed(staging) < target:
+        # die right after the FIRST NEW landing's ack: the first
+        # count_landed() verdicts are instant ledger "done" replies
+        # for the re-announced already-landed keys
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   GYT_SHIP_DIE_AFTER_ACKS=str(
+                       count_landed(staging) + 1))
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "gyeeta_tpu", "ship",
+            "--dir", wal, "--to", f"{h}:{p}", "--id", SHIPPER_ID,
+            "--once", "--scan-s", "0.1", env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        rc = await asyncio.wait_for(proc.wait(), 120.0)
+        assert rc == 9, f"shipper should die at the boundary, rc={rc}"
+        kills += 1
+        assert kills <= target + 4, "no progress under shipper kills"
+    await rcv.stop()
+    _log(f"phase 1: {count_landed(staging)} segment(s) landed across "
+         f"{kills} shipper SIGKILL(s) — one death per ship boundary")
+    return kills
+
+
+async def phase2_receiver_kills(wal: str, staging: str,
+                                target: int) -> int:
+    """A receiver subprocess per landing, dying at the rename/ledger
+    crash points in alternation, with a supervised in-process shipper
+    riding through the deaths on a FIXED port."""
+    from gyeeta_tpu.history.shipper import SegmentShipper
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    port = free_port()
+    sstats = Stats()
+    sh = SegmentShipper({"target": ("127.0.0.1", port),
+                         "shipper_id": SHIPPER_ID, "dir": wal,
+                         "stats": sstats, "scan_s": 0.1,
+                         "hb_s": 0.1})
+    st = threading.Thread(target=sh.run, daemon=True)
+    st.start()
+    deaths = 0
+    modes = ("rename", "ledger")
+    try:
+        while count_landed(staging) < target:
+            mode = modes[deaths % 2]
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       GYT_SHIP_RECV_DIE_AFTER="1",
+                       GYT_SHIP_RECV_DIE_MODE=mode)
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "gyeeta_tpu", "shiprecv",
+                "--staging", staging, "--listen-host", "127.0.0.1",
+                "--listen-port", str(port), env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            # the receiver dies BY ITSELF at its first landing —
+            # before the ledger append in mode "rename", before the
+            # ack in mode "ledger"; both must reconcile next spawn
+            rc = await asyncio.wait_for(proc.wait(), 120.0)
+            assert rc == 9, f"receiver should die at landing, rc={rc}"
+            deaths += 1
+            assert deaths <= 2 * target + 6, \
+                "no progress under receiver kills"
+    finally:
+        sh.stop()
+        st.join(timeout=10.0)
+    assert deaths >= 2, "both crash modes must have fired"
+    _log(f"phase 2: {count_landed(staging)} segment(s) landed through "
+         f"{deaths} receiver death(s) at rename/ledger boundaries")
+    return deaths
+
+
+async def phase3_wan_partition(wal: str, staging: str,
+                               total: int) -> dict:
+    """Ship the remainder through a chaos proxy partitioned
+    MID-SEGMENT; the same-token reconnect resumes the partial."""
+    from gyeeta_tpu.history.shipper import SegmentShipper
+    from gyeeta_tpu.net.segship import SegmentReceiver
+    from gyeeta_tpu.sim.chaos import ChaosProxy, FaultPlan
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    rstats = Stats()
+    rcv = SegmentReceiver(staging, stats=rstats, host="127.0.0.1")
+    h, p = await rcv.start()
+    proxy = ChaosProxy(h, p, plan=FaultPlan(seed=7,
+                                            latency_c2s_s=0.002,
+                                            latency_s2c_s=0.002))
+    ph, pp = await proxy.start()
+    sstats = Stats()
+    sh = SegmentShipper({"target": (ph, pp), "shipper_id": SHIPPER_ID,
+                         "dir": wal, "stats": sstats, "scan_s": 0.1,
+                         "hb_s": 0.1, "chunk_bytes": 4096,
+                         "once": True})
+    st = threading.Thread(target=sh.run, daemon=True)
+    st.start()
+    # cut the WAN the moment a partial is mid-flight on the receiver
+    cut = False
+    t0 = time.monotonic()
+    stage = pathlib.Path(staging)
+    while time.monotonic() - t0 < 60.0 and not cut:
+        parts = list(stage.glob("shard_*/.ship_*.part"))
+        if any(q.stat().st_size > 0 for q in parts):
+            proxy.partitioned = True
+            cut = True
+        await asyncio.sleep(0.001)
+    assert cut, "never caught a transfer mid-segment"
+    await asyncio.sleep(0.5)
+    proxy.partitioned = False
+    t0 = time.monotonic()
+    while st.is_alive() and time.monotonic() - t0 < 120.0:
+        await asyncio.sleep(0.05)
+    sh.stop()
+    st.join(timeout=10.0)
+    assert not st.is_alive(), "shipper stuck after the partition"
+    await proxy.stop()
+    await rcv.stop()
+    c = rstats.snapshot()
+    assert count_landed(staging) == total, \
+        f"campaign did not converge: {count_landed(staging)}/{total}"
+    assert c.get(f"ship_reconnects|shipper={SHIPPER_ID}", 0) >= 1, \
+        "partition must force a counted same-token reconnect"
+    _log("phase 3: WAN partition mid-segment healed — "
+         f"resumes={c.get('ship_resumes', 0)} "
+         f"reconnects={c.get(f'ship_reconnects|shipper={SHIPPER_ID}', 0)}")
+    return c
+
+
+def assert_staging_identical(wal: str, staging: str) -> None:
+    from gyeeta_tpu.utils import journal as J
+    for s in range(2):
+        sd = pathlib.Path(wal) / f"shard_{s:02d}"
+        dd = pathlib.Path(staging) / f"shard_{s:02d}"
+        src_segs = J.dir_segments(sd)
+        assert J.dir_segments(dd) == src_segs, (s, src_segs)
+        for q in src_segs:
+            a = (sd / J._SEG_FMT.format(q)).read_bytes()
+            b = (dd / J._SEG_FMT.format(q)).read_bytes()
+            assert a == b, f"shard {s} seg {q} not byte-identical"
+
+
+def assert_ledger_closed(staging: str, total: int) -> None:
+    from gyeeta_tpu.net.segship import LEDGER_NAME
+    entries = []
+    for raw in (pathlib.Path(staging) / LEDGER_NAME).read_bytes() \
+            .splitlines(keepends=True):
+        if not raw.endswith(b"\n"):
+            break
+        entries.append(json.loads(raw))
+    keyed = {e["k"]: e for e in entries if "k" in e}
+    landed = [e for e in keyed.values() if e["status"] == "landed"]
+    dropped = [e for e in keyed.values() if e["status"] != "landed"]
+    assert len(landed) == total and not dropped, \
+        f"ledger open: {len(landed)} landed + {len(dropped)} dropped " \
+        f"!= {total} sealed"
+    for e in landed:
+        assert len(e["hash"]) == 64 and e["src"]["shipper"] == SHIPPER_ID
+    _log(f"ledger closed exactly: sealed == landed == {total}, "
+         "0 counted drops")
+
+
+def compact_and_compare(wal: str, staging: str, tmp: str) -> None:
+    """The acceptance bar: a parallel replay of the SHIPPED staging dir
+    (through the serve daemon's staging loop) is array-for-array
+    identical to a local parallel replay of the original WAL."""
+    import numpy as np
+
+    from gyeeta_tpu.engine.aggstate import EngineCfg
+    from gyeeta_tpu.history.compactproc import ParallelCompactor
+    from gyeeta_tpu.server_main import _StagingCompactLoop
+    from gyeeta_tpu.utils.config import RuntimeOpts
+    from gyeeta_tpu.utils.selfstats import Stats
+
+    cfg = EngineCfg(n_hosts=8, svc_capacity=64, task_capacity=64,
+                    conn_batch=128, resp_batch=256, fold_k=2)
+
+    local_parts = os.path.join(tmp, "parts_local")
+    opts_l = RuntimeOpts(hist_shard_dir=local_parts,
+                         hist_window_ticks=2,
+                         dep_pair_capacity=1024, dep_edge_capacity=512)
+    pc = ParallelCompactor(cfg, opts_l, 2, journal_dir=wal,
+                           shard_dir=local_parts, stats=Stats())
+    rep = pc.compact_once()
+    pc.close()
+    assert rep["windows"] > 0, rep
+
+    staged_parts = os.path.join(tmp, "parts_staged")
+    opts_s = RuntimeOpts(hist_shard_dir=staged_parts,
+                         hist_window_ticks=2,
+                         dep_pair_capacity=1024, dep_edge_capacity=512)
+    loop = _StagingCompactLoop(cfg, opts_s, staging, staged_parts,
+                               procs=2, stats=Stats())
+    loop.final_pass()                      # one deferred-construct pass
+    assert loop.compactor is not None, "staging loop never compacted"
+
+    lroot, sroot = pathlib.Path(local_parts), pathlib.Path(staged_parts)
+    lfiles = sorted(q.relative_to(lroot) for q in lroot.rglob("*.npz"))
+    sfiles = sorted(q.relative_to(sroot) for q in sroot.rglob("*.npz"))
+    assert lfiles and lfiles == sfiles, \
+        f"part layout differs: {len(lfiles)} vs {len(sfiles)} shards"
+    narr = 0
+    for rel in lfiles:
+        a = np.load(lroot / rel, allow_pickle=False)
+        b = np.load(sroot / rel, allow_pickle=False)
+        assert sorted(a.files) == sorted(b.files), rel
+        for name in a.files:
+            assert np.array_equal(a[name], b[name]), \
+                f"{rel}:{name} diverged between local and shipped replay"
+            narr += 1
+    _log(f"remote-shipped replay BIT-IDENTICAL to local: "
+         f"{len(lfiles)} part shard(s), {narr} array(s) equal")
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="gyt_rcompact_") as tmp:
+        wal = os.path.join(tmp, "wal")
+        staging = os.path.join(tmp, "staging")
+        total, _ticks = build_source_wal(wal)
+        _log(f"source WAL: 2 shards, {total} sealed segment(s)")
+
+        # thirds: shipper kills, receiver kills, WAN partition — every
+        # ship boundary in each phase carries that phase's fault
+        t1 = max(2, total // 3)
+        t2 = max(t1 + 2, (2 * total) // 3)
+        asyncio.run(phase1_shipper_kills(wal, staging, t1))
+        asyncio.run(phase2_receiver_kills(wal, staging, t2))
+        asyncio.run(phase3_wan_partition(wal, staging, total))
+
+        assert_staging_identical(wal, staging)
+        assert_ledger_closed(staging, total)
+        compact_and_compare(wal, staging, tmp)
+    print("rcompact smoke: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -186,6 +186,25 @@ if ! JAX_PLATFORMS=cpu python _region_smoke.py; then
     exit 1
 fi
 
+# Remote compaction region smoke (ISSUE 20): sealed WAL segments ship
+# from a 2-shard source region over the supervised segship protocol to
+# a compaction region's staging dir, under the full crash campaign —
+# shipper SIGKILL at EVERY ship boundary (one death per landed
+# segment, exit code enforced), receiver self-kill alternating between
+# the post-rename and post-ledger crash points, and a WAN partition
+# dropped mid-segment (stream hole → counted reconnect → per-segment
+# offset resume). Asserts: the staging dir converges BYTE-IDENTICAL to
+# the source WAL, the content-hash ledger closes EXACTLY
+# (sealed == landed + counted drops, zero drops here), and a parallel
+# replay of the SHIPPED staging dir through the serve daemon's staging
+# loop is array-for-array identical to a local parallel replay of the
+# original WAL. Never silent divergence.
+echo "ci: remote compaction region smoke" >&2
+if ! JAX_PLATFORMS=cpu python _rcompact_smoke.py; then
+    echo "ci: FATAL — remote compaction smoke failed" >&2
+    exit 1
+fi
+
 # Fused fold-path smoke: (a) the fused megakernel is the DEFAULT fold
 # path (a regression to the legacy per-subsystem dispatch sequence
 # would silently cost 2-6x fold throughput); (b) GYT_PALLAS=1 on a

@@ -387,6 +387,37 @@ def _cmd_compact(argv) -> None:
         store = open_shard_store(args.shard_dir)
         out = {"pos": store.position(), "tick": store.tick(),
                "shards": store.shards()}
+        # shipped-store provenance: when the WAL source is a ship
+        # staging dir, its content-hash ledger says which region
+        # produced every segment (shipper id, instance token, epoch,
+        # blake2b) — the operator's "who made this window" answer
+        if args.journal_dir:
+            import pathlib
+
+            from gyeeta_tpu.net.segship import LEDGER_NAME
+            lp = pathlib.Path(args.journal_dir) / LEDGER_NAME
+            if lp.exists():
+                segs = []
+                for raw in lp.read_bytes().splitlines(keepends=True):
+                    if not raw.endswith(b"\n"):
+                        break              # torn tail: incomplete fact
+                    try:
+                        e = json.loads(raw)
+                    except ValueError:
+                        break
+                    if e.get("meta") or "k" not in e:
+                        continue
+                    src = e.get("src") or {}
+                    segs.append({
+                        "segment": e["k"], "status": e.get("status"),
+                        "hash": e.get("hash"),
+                        "records": e.get("nrec"),
+                        "bytes": e.get("size"),
+                        "src_shipper": src.get("shipper"),
+                        "src_epoch": src.get("epoch"),
+                        "src_token": src.get("token"),
+                        "src_host": src.get("host")})
+                out["shipped_segments"] = segs
         json.dump(out, sys.stdout, indent=2)
         sys.stdout.write("\n")
         return
@@ -447,6 +478,24 @@ def _cmd_relay(argv) -> None:
     machines, across relay restarts)."""
     from gyeeta_tpu.net.relay import relay_main
     relay_main(argv)
+
+
+def _cmd_ship(argv) -> None:
+    """Source-region segment shipper (history/shipper.py): sealed WAL
+    segments stream to a remote compaction region's staging receiver,
+    content-hashed and resumable, with the ship truncate floor
+    pinning unshipped segments against checkpoint truncation."""
+    from gyeeta_tpu.history.shipper import ship_main
+    ship_main(argv)
+
+
+def _cmd_shiprecv(argv) -> None:
+    """Compaction-region staging receiver (net/segship.py): sealed
+    segments land here hash-verified + crash-consistent; point
+    `compact --procs N` (or serve --compact-procs with --ship-staging)
+    at the staging dir to replay them exactly as if local."""
+    from gyeeta_tpu.net.segship import recv_main
+    recv_main(argv)
 
 
 def _cmd_gateway(argv) -> None:
@@ -531,12 +580,13 @@ def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] in ("query", "agent", "replay", "web", "obs",
                             "nm", "chaos", "compact", "gateway",
-                            "relay"):
+                            "relay", "ship", "shiprecv"):
         return {"query": _cmd_query, "agent": _cmd_agent,
                 "replay": _cmd_replay, "web": _cmd_web,
                 "obs": _cmd_obs, "nm": _cmd_nm,
                 "chaos": _cmd_chaos, "gateway": _cmd_gateway,
-                "relay": _cmd_relay,
+                "relay": _cmd_relay, "ship": _cmd_ship,
+                "shiprecv": _cmd_shiprecv,
                 "compact": _cmd_compact}[argv[0]](argv[1:])
     if argv and argv[0] == "serve":
         argv = argv[1:]

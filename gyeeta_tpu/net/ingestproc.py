@@ -1031,13 +1031,14 @@ class ProcWalView:
             return self._sealed.get(0, 0)
         return [self._sealed.get(s, 0) for s in range(self.n)]
 
-    def set_truncate_floor(self, seq) -> None:
+    def set_truncate_floor(self, seq, name: str = "compact") -> None:
+        fl = self._floors.setdefault(name, {})
         if isinstance(seq, (list, tuple)):
             for s, v in enumerate(seq):
-                self._floors[s] = max(self._floors.get(s, 0), int(v))
+                fl[s] = max(fl.get(s, 0), int(v))
         else:
             for s in range(self.n):
-                self._floors[s] = max(self._floors.get(s, 0), int(seq))
+                fl[s] = max(fl.get(s, 0), int(seq))
 
     # ---------------------------------------------------------- truncate
     def truncate_upto(self, bounds) -> int:
@@ -1056,9 +1057,9 @@ class ProcWalView:
             per = {s: b for s in range(self.n)}
         for s in range(self.n):
             bound = per.get(s, 0)
-            floor = self._floors.get(s)
-            if floor is not None:
-                bound = min(bound, floor)
+            floors = [fl[s] for fl in self._floors.values() if s in fl]
+            if floors:
+                bound = min(bound, min(floors))
             d = self._subdir(s)
             if not d.is_dir():
                 continue

@@ -121,6 +121,32 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
         for k, v in rly.items():
             lines.append(f"  {k:<36} {v}")
 
+    # segment-shipping surface (history/shipper.py + net/segship.py):
+    # sealed / shipped / counted-dropped SEGMENT ledgers per shipper
+    # plus hash mismatches, staging sheds and heartbeat age
+    # (OPERATIONS.md "Remote compaction region"). ship_open is the
+    # global invariant sealed − shipped − dropped: persistently
+    # nonzero and growing means sealed segments are NOT reaching the
+    # compaction region — check the uplink before the source's disk
+    # fills against the pinned ship floor.
+    shp = {k: v for k, v in sorted(c.items())
+           if str(k).startswith("ship_")}
+    if shp:
+        lines.append("")
+        lines.append("segment shipping:")
+
+        def _ssum(pfx: str) -> float:
+            return sum(v for k, v in shp.items()
+                       if str(k).startswith(pfx)
+                       and isinstance(v, (int, float)))
+
+        shp["ship_open"] = round(
+            _ssum("ship_sealed_segments")
+            - _ssum("ship_shipped_segments")
+            - _ssum("ship_dropped_segments"), 4)
+        for k, v in shp.items():
+            lines.append(f"  {k:<36} {v}")
+
     # history tier (compactor + windowed quantiles, OPERATIONS.md
     # "Distributed compaction & windowed quantiles")
     hist = {k: v for k, v in sorted(c.items())
@@ -136,7 +162,7 @@ def format_top(selfstats: dict, prev_counters: dict | None = None,
              if not str(k).startswith(("engine_", "journal_", "wal_",
                                        "throttle", "query_", "queries",
                                        "snapshot", "gw_", "relay_",
-                                       "compact_", "wd_",
+                                       "ship_", "compact_", "wd_",
                                        "windowed_quant"))
              and isinstance(v, (int, float))}
     lines.append("")

@@ -29,6 +29,109 @@ from gyeeta_tpu.utils import config as C
 log = logging.getLogger("gyeeta_tpu.daemon")
 
 
+class _StagingCompactLoop:
+    """Compaction-region replay loop over a ship staging directory.
+
+    Segments land in ``staging`` via net/segship.py and are replayed by
+    the STOCK compactors (journal_dir mode) exactly as if local.  The
+    layout (flat vs shard_NN/) is discovered from what actually lands,
+    so the daemon can boot on an empty staging dir before the first
+    segment arrives — construction of the compactor is deferred to the
+    first pass that finds segments (ParallelCompactor refuses an empty
+    or flat dir at construction, and its proc count must be clamped to
+    the shard count the shipper reveals)."""
+
+    def __init__(self, cfg, opts, staging: str, shard_dir: str,
+                 procs: int = 0, stats=None):
+        self.cfg = cfg
+        self.opts = opts
+        self.staging = staging
+        self.shard_dir = shard_dir
+        self.procs = int(procs or 0)
+        self.stats = stats
+        self.compactor = None
+        self._stop = None           # threading.Event, set in start()
+        self._thread = None
+
+    def _ensure(self):
+        if self.compactor is not None:
+            return self.compactor
+        from gyeeta_tpu.utils import journal as J
+        subs = J.sharded_subdirs(self.staging)
+        if subs and self.procs >= 1:
+            from gyeeta_tpu.history.compactproc import ParallelCompactor
+            self.compactor = ParallelCompactor(
+                self.cfg, self.opts, min(self.procs, len(subs)),
+                journal_dir=self.staging, shard_dir=self.shard_dir,
+                stats=self.stats)
+        elif subs or J.dir_segments(self.staging):
+            from gyeeta_tpu.history.compactor import Compactor
+            self.compactor = Compactor(self.cfg, self.opts,
+                                       journal_dir=self.staging,
+                                       shard_dir=self.shard_dir,
+                                       stats=self.stats)
+        return self.compactor
+
+    def pass_once(self) -> None:
+        c = self._ensure()
+        if c is None:
+            return                  # nothing landed yet
+        c.compact_once()
+
+    def floors(self):
+        """Per-shard compacted floors for SegmentReceiver.sweep_below:
+        a staged segment below its floor is fully represented in the
+        parted store and safe to delete locally (the ship ledger keeps
+        answering "done" for it)."""
+        c = self.compactor
+        if c is None:
+            return None
+        try:
+            pos = c.store.position()
+        except Exception:           # noqa: BLE001 — sweep is best-effort
+            return None
+        if not pos:
+            return None
+        from gyeeta_tpu.utils import journal as J
+        return J.floors_of(pos)
+
+    def start(self) -> None:
+        import threading
+        self._stop = threading.Event()
+        interval = max(float(self.opts.hist_compact_interval_s), 0.2)
+
+        def _loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.pass_once()
+                except Exception:   # noqa: BLE001 — keep the loop alive
+                    if self.stats is not None:
+                        self.stats.bump("compact_errors")
+                    log.exception("staging compaction pass failed")
+
+        self._thread = threading.Thread(target=_loop, daemon=True,
+                                        name="gyt-staging-compact")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    def final_pass(self) -> None:
+        """Stop the loop and run one last replay so a clean stop leaves
+        the parted store current with everything already landed."""
+        self.stop()
+        try:
+            self.pass_once()
+        except Exception:           # noqa: BLE001 — never block shutdown
+            log.exception("final staging compaction pass failed")
+        if self.compactor is not None:
+            self.compactor.close()
+
+
 class Daemon:
     def __init__(self, args: argparse.Namespace):
         self.args = args
@@ -117,7 +220,24 @@ class Daemon:
         # snapshot shards (the time-travel tier's writer). Runs only
         # with BOTH a journal (the source) and a shard dir (the sink).
         self.compactor = None
-        if opts.hist_shard_dir and self.rt.journal is not None:
+        # remote compaction region pieces (OPERATIONS.md "Remote
+        # compaction region"): receiver + staging replay loop on the
+        # compaction side, shipper thread on the source side
+        self._ship_loop = None
+        self.ship_recv = None
+        self.shipper = None
+        self._ship_thread = None
+        if opts.hist_shard_dir and getattr(args, "ship_staging", None):
+            # compaction-region mode: the WAL source is the SHIP
+            # STAGING dir (segments landed by net/segship.py), not
+            # this process's own journal — replayed by the stock
+            # compactors exactly as if local
+            self._ship_loop = _StagingCompactLoop(
+                self.rt.cfg, opts, args.ship_staging,
+                opts.hist_shard_dir,
+                procs=getattr(args, "compact_procs", 0),
+                stats=self.rt.stats)
+        elif opts.hist_shard_dir and self.rt.journal is not None:
             if getattr(args, "compact_procs", 0) >= 1:
                 # distributed compaction: N replay worker processes
                 # over disjoint WAL shard groups (parted store layout)
@@ -170,6 +290,45 @@ class Daemon:
                      "-> %s", self.rt.opts.hist_window_ticks,
                      self.rt.opts.hist_compact_interval_s,
                      self.rt.opts.hist_shard_dir)
+        if getattr(self.args, "ship_staging", None) \
+                and getattr(self.args, "ship_port", None) is not None:
+            from gyeeta_tpu.net.segship import SegmentReceiver
+            self.ship_recv = SegmentReceiver(
+                self.args.ship_staging, stats=self.rt.stats,
+                host=self.args.ship_listen_host,
+                port=self.args.ship_port,
+                floors_fn=(self._ship_loop.floors
+                           if self._ship_loop is not None else None),
+                notifylog=self.rt.notifylog)
+            sh, sp = await self.ship_recv.start()
+            # machine-parsable bind line for harnesses scripting
+            # ephemeral ports (the relay's RELAY_LISTEN idiom)
+            print(f"SHIP_LISTEN {sh} {sp}", flush=True)
+        if self._ship_loop is not None:
+            self._ship_loop.start()
+            log.info("staging compactor over %s every %.0fs -> %s",
+                     self.args.ship_staging,
+                     self.rt.opts.hist_compact_interval_s,
+                     self.rt.opts.hist_shard_dir)
+        if getattr(self.args, "ship_to", None) \
+                and self.rt.journal is not None:
+            import threading
+
+            from gyeeta_tpu.history.shipper import SegmentShipper
+            th, _, tp = self.args.ship_to.rpartition(":")
+            self.shipper = SegmentShipper({
+                "target": (th or "127.0.0.1", int(tp)),
+                "shipper_id": getattr(self.args, "ship_id", None),
+                "journal": self.rt.journal, "stats": self.rt.stats})
+            self._ship_thread = threading.Thread(
+                target=self.shipper.run, daemon=True,
+                name="gyt-shipper")
+            self._ship_thread.start()
+            log.info("segment shipper -> %s (id=%s)",
+                     self.args.ship_to, self.shipper.shipper_id)
+        elif getattr(self.args, "ship_to", None):
+            log.warning("--ship-to without --journal-dir: nothing to "
+                        "ship (the WAL is the shipped source)")
         stats_task = asyncio.create_task(self._stats_loop())
         try:
             await self.stop_event.wait()
@@ -235,6 +394,19 @@ class Daemon:
         of the reference's init proc). A clean shutdown therefore
         leaves an EMPTY WAL window: the respawn replays zero chunks."""
         log.info("shutting down: draining staged slabs")
+        if self.shipper is not None:
+            # stop BEFORE the journal closes; the ship floor it
+            # registered stays in force for the final truncation, so
+            # a not-yet-landed segment survives this shutdown
+            self.shipper.stop()
+            if self._ship_thread is not None:
+                self._ship_thread.join(timeout=10.0)
+        if self._ship_loop is not None:
+            # final staging pass so a clean stop leaves the parted
+            # store current with everything already landed
+            self._ship_loop.final_pass()
+        if self.ship_recv is not None:
+            await self.ship_recv.stop()
         if self.compactor is not None:
             # final pass BEFORE the journal closes: seal + compact the
             # shutdown window so a clean stop leaves history current
@@ -507,6 +679,29 @@ def parse_args(argv: Optional[list] = None) -> argparse.Namespace:
                     "into a parted shard store (needs --shards; N <= "
                     "shard count). 0 (default) = the in-process "
                     "single-runtime compactor")
+    # remote compaction region (history/shipper.py + net/segship.py;
+    # OPERATIONS.md "Remote compaction region"): sealed WAL segments
+    # ship content-hashed to a peer region's staging dir, where the
+    # stock compactors replay them exactly as if local
+    ap.add_argument("--ship-to", metavar="HOST:PORT",
+                    help="ship this server's sealed WAL segments to a "
+                    "remote compaction region's segment receiver "
+                    "(needs --journal-dir; the ship truncate floor "
+                    "pins unshipped segments against checkpoint "
+                    "truncation)")
+    ap.add_argument("--ship-id", default=None,
+                    help="stable shipper identity for --ship-to "
+                    "(provenance key; default ship-<hostname>)")
+    ap.add_argument("--ship-staging",
+                    help="run the COMPACTION-REGION side: accept "
+                    "shipped segments into this staging dir (with "
+                    "--ship-port) and/or compact it into --shard-dir "
+                    "(with --compact-procs)")
+    ap.add_argument("--ship-port", type=int, default=None,
+                    help="listen port for shipper uplinks into "
+                    "--ship-staging (0 = ephemeral; prints "
+                    "SHIP_LISTEN host port)")
+    ap.add_argument("--ship-listen-host", default="0.0.0.0")
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
 

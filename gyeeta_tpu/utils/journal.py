@@ -114,11 +114,14 @@ class Journal:
         self._closing = False
         self._sync_req = False            # a blocking fsync() waits on it
         self._seal_req = False            # a blocking seal_active() waits
-        # compaction handoff: segments >= this floor are NEVER truncated
-        # even when a checkpoint supersedes them — the history compactor
-        # still has to consume them into snapshot shards. None = no
-        # compactor registered (the pre-history behavior).
-        self._truncate_floor: Optional[int] = None
+        # consumer handoff: segments >= a registered floor are NEVER
+        # truncated even when a checkpoint supersedes them. NAMED floors
+        # (one per consumer: the history compactor, the remote-ship
+        # tier, ...) each advance monotonically; the effective bound is
+        # the MIN over every registered floor, so no consumer can lose
+        # a segment another consumer has already released. Empty = no
+        # consumer registered (the pre-history behavior).
+        self._floors: dict = {}
         self._worker = threading.Thread(
             target=self._writer_loop, name="gyt-wal-writer", daemon=True)
         self._worker.start()
@@ -348,13 +351,23 @@ class Journal:
         the compactor never reads at/after it while the writer lives."""
         return self._seq
 
-    def set_truncate_floor(self, seq: int) -> None:
-        """Register the compactor's position: segments >= ``seq`` are
-        held back from checkpoint truncation until the compactor has
-        rolled them into snapshot shards (the seal/handoff half of the
-        history tier). Monotone — a floor never moves backwards."""
-        cur = self._truncate_floor
-        self._truncate_floor = int(seq) if cur is None \
+    @property
+    def _truncate_floor(self) -> Optional[int]:
+        """Effective truncation floor: the MIN over every named
+        consumer floor (None when no consumer has registered)."""
+        return min(self._floors.values()) if self._floors else None
+
+    def set_truncate_floor(self, seq: int, name: str = "compact") -> None:
+        """Register a consumer's position under ``name``: segments >=
+        ``seq`` are held back from checkpoint truncation until that
+        consumer has processed them (the compactor rolling them into
+        snapshot shards; the segment shipper landing them in the remote
+        compaction region). Each named floor is monotone — it never
+        moves backwards — and truncation bounds at the MIN across all
+        names, so e.g. a sealed-but-unshipped segment stays on disk no
+        matter how far ahead checkpoints and local compaction run."""
+        cur = self._floors.get(name)
+        self._floors[name] = int(seq) if cur is None \
             else max(cur, int(seq))
 
     # ----------------------------------------------------------- position
@@ -537,14 +550,16 @@ class ShardedJournal:
     def sealed_upto(self) -> list:
         return [j.sealed_upto() for j in self.shards]
 
-    def set_truncate_floor(self, seq) -> None:
-        """Per-shard floors (a list), or one floor broadcast."""
+    def set_truncate_floor(self, seq, name: str = "compact") -> None:
+        """Per-shard floors (a list), or one floor broadcast; ``name``
+        scopes the floor to one consumer (see :meth:`Journal
+        .set_truncate_floor`)."""
         if isinstance(seq, (list, tuple)):
             for j, s in zip(self.shards, seq):
-                j.set_truncate_floor(int(s))
+                j.set_truncate_floor(int(s), name=name)
         else:
             for j in self.shards:
-                j.set_truncate_floor(int(seq))
+                j.set_truncate_floor(int(seq), name=name)
 
     # ----------------------------------------------------------- position
     def position(self) -> list:

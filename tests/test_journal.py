@@ -149,6 +149,57 @@ def test_journal_seal_floor_and_sealed_reads(tmp_path):
     j.close()
 
 
+def test_named_truncate_floors_pin_unshipped_segments(tmp_path):
+    """A sealed-but-unshipped segment pins the truncate floor: the
+    effective bound is the MIN over all named floors (compactor AND
+    shipper), so checkpoint truncation can never delete a segment the
+    remote compaction region has not durably landed."""
+    j = Journal(tmp_path / "wal", segment_max_bytes=1 << 14,
+                fsync_bytes=1 << 30)
+    blob = b"x" * 4096
+    for i in range(24):
+        j.append(blob, hid=i)
+    j.seal_active()
+    segs = j.segments()
+    assert len(segs) >= 3
+    newest = j.position()[0]
+    # compactor consumed everything, but the shipper has only landed
+    # segment 0 remotely → ship floor 1 bounds the deletion
+    j.set_truncate_floor(newest, name="compact")
+    j.set_truncate_floor(1, name="ship")
+    assert j._truncate_floor == 1
+    assert j.truncate_upto(newest) == 1
+    assert 0 not in j.segments()
+    assert 1 in j.segments()
+    # each named floor is individually monotone: a late/stale ship
+    # floor below the current one never re-opens deleted ground
+    j.set_truncate_floor(0, name="ship")
+    assert j._truncate_floor == 1
+    # ship catches up past compact → compact floor now binds
+    j.set_truncate_floor(newest + 5, name="ship")
+    assert j._truncate_floor == newest
+    j.close()
+
+    # same contract on the sharded WAL (per-shard floor lists)
+    sj = J.ShardedJournal(tmp_path / "swal", 2,
+                          segment_max_bytes=1 << 14)
+    for i in range(64):
+        sj.append(blob, hid=i % 4, conn_id=i)
+    sj.seal_active()
+    upto = sj.sealed_upto()
+    assert all(u >= 1 for u in upto)
+    sj.set_truncate_floor(list(upto), name="compact")
+    sj.set_truncate_floor([0] * len(upto), name="ship")
+    pos = sj.position()
+    deleted = sj.truncate_upto(pos)
+    assert deleted == 0                    # ship floor 0 pins everything
+    for s, sh in enumerate(sj.shards):
+        assert 0 in sh.segments(), s
+    sj.set_truncate_floor(list(upto), name="ship")
+    assert sj.truncate_upto(pos) > 0       # released once shipped
+    sj.close()
+
+
 # ---------------------------------------------- Runtime feed → WAL → replay
 def test_runtime_wal_replay_equals_direct_fold(tmp_path):
     sim = ParthaSim(n_hosts=2, n_svcs=2, seed=3)

@@ -1,0 +1,382 @@
+"""Segment-ship protocol (history/shipper.py + net/segship.py): the
+remote-compaction-region WAN hop.
+
+Covers the crash-consistency contract at the unit/protocol level (the
+full SIGKILL-at-every-boundary campaign is _rcompact_smoke.py): bit-
+identical landing with content-hash verification, per-segment resume
+after a mid-segment disconnect, wire-corruption rejection + re-ship,
+receiver-restart partial sweeping and ledger-derived counter recovery,
+bounded staging sheds, shipper-announced permanent drops, epoch
+accounting, staging-dir owner binding, compaction-floor staging
+sweeps, and the ``compact list`` provenance rendering — plus the
+global ledger invariant ``sealed == shipped + counted drops`` at every
+turn.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+from gyeeta_tpu.history.shipper import SegmentShipper, seg_info
+from gyeeta_tpu.net import segship as SP
+from gyeeta_tpu.net.segship import LEDGER_NAME, SegmentReceiver
+from gyeeta_tpu.utils import journal as J
+from gyeeta_tpu.utils.selfstats import Stats
+
+
+def _mk_sharded(path, n=2, nrec=2000, blob=100):
+    j = J.ShardedJournal(path, n, segment_max_bytes=1 << 16,
+                         fsync_bytes=1 << 30)
+    for i in range(nrec):
+        j.append(b"x" * blob, hid=i % 5, conn_id=i, tick=i // 20)
+    j.seal_active()
+    j.fsync()
+    return j
+
+
+def _mk_flat(path, nrec=200, blob=512, seg_bytes=1 << 16):
+    j = J.Journal(path, segment_max_bytes=seg_bytes,
+                  fsync_bytes=1 << 30)
+    for i in range(nrec):
+        j.append(b"y" * blob, hid=i % 3, conn_id=i, tick=i // 10)
+    j.seal_active()
+    j.fsync()
+    return j
+
+
+def _run_pair(staging, *, journal=None, wal_dir=None, rstats=None,
+              sstats=None, renv=None, prep=None, cfg_extra=None,
+              shipper_id="s1", timeout=30.0):
+    """One receiver + one once-mode shipper to completion; returns
+    (receiver, shipper) with both stopped."""
+    rstats = rstats if rstats is not None else Stats()
+    sstats = sstats if sstats is not None else Stats()
+
+    async def go():
+        rcv = SegmentReceiver(staging, stats=rstats, host="127.0.0.1",
+                              env=renv)
+        h, p = await rcv.start()
+        cfg = {"target": (h, p), "shipper_id": shipper_id,
+               "stats": sstats, "scan_s": 0.05, "hb_s": 0.05,
+               "once": True}
+        if journal is not None:
+            cfg["journal"] = journal
+        else:
+            cfg["dir"] = wal_dir
+        if cfg_extra:
+            cfg.update(cfg_extra)
+        sh = SegmentShipper(cfg)
+        if prep:
+            prep(sh)
+        t = threading.Thread(target=sh.run, daemon=True)
+        t.start()
+        t0 = time.monotonic()
+        while t.is_alive() and time.monotonic() - t0 < timeout:
+            await asyncio.sleep(0.02)
+        sh.stop()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "shipper did not finish"
+        await rcv.stop()
+        return rcv, sh
+
+    return asyncio.run(go())
+
+
+def _landed_identical(src_dir, staging, shards, upto):
+    for s in range(shards):
+        sd = src_dir / f"shard_{s:02d}" if shards > 1 else src_dir
+        dd = staging / f"shard_{s:02d}" if shards > 1 else staging
+        for q in J.dir_segments(sd):
+            if q >= upto[s]:
+                continue
+            a = (sd / J._SEG_FMT.format(q)).read_bytes()
+            b = (dd / J._SEG_FMT.format(q)).read_bytes()
+            assert a == b, (s, q)
+
+
+def test_ship_bit_identical_ledger_and_floor(tmp_path):
+    j = _mk_sharded(tmp_path / "wal")
+    upto = j.sealed_upto()
+    want = sum(upto)
+    assert want >= 3
+    rstats, sstats = Stats(), Stats()
+    _run_pair(tmp_path / "stage", journal=j, rstats=rstats,
+              sstats=sstats)
+    _landed_identical(tmp_path / "wal", tmp_path / "stage", 2, upto)
+    # global ledger closes exactly: sealed == shipped + dropped
+    c = rstats.snapshot()
+    assert c["ship_shipped_segments"] == want
+    assert c.get("ship_dropped_segments", 0) == 0
+    assert c["ship_sealed_segments|shipper=s1"] == want
+    assert c["ship_shipped_records"] == 2000
+    # shipper side agrees, and the ship floor advanced to the sealed
+    # bound (nothing pending → truncation is fully released)
+    sc = sstats.snapshot()
+    assert sc["ship_shipped_segments"] == want
+    assert sc["ship_sealed_records"] == 2000
+    for s, u in enumerate(upto):
+        assert j.shards[s]._floors["ship"] == u
+    # ledger provenance: every landed key carries hash + source
+    ledger = (tmp_path / "stage" / LEDGER_NAME).read_bytes()
+    entries = [json.loads(ln) for ln in ledger.splitlines()]
+    owner = [e for e in entries if e.get("meta") == "owner"]
+    assert owner and owner[0]["layout"] == "sharded"
+    landed = [e for e in entries if e.get("status") == "landed"]
+    assert len(landed) == want
+    for e in landed:
+        assert len(e["hash"]) == 64
+        assert e["src"]["shipper"] == "s1"
+        assert e["src"]["token"]
+    j.close()
+
+
+def test_ship_resume_after_mid_segment_disconnect(tmp_path):
+    j = _mk_flat(tmp_path / "wal")
+    upto = j.sealed_upto()
+    assert upto >= 2
+    rstats, sstats = Stats(), Stats()
+
+    def prep(sh):
+        orig = sh._send
+        state = {"n": 0, "tripped": False}
+
+        def tripping(buf):
+            ftype = SP._FH.unpack_from(buf, 0)[1]
+            if ftype == SP.T_SDATA and not state["tripped"]:
+                state["n"] += 1
+                if state["n"] >= 3:
+                    # cut the uplink mid-segment: the partial stays on
+                    # the receiver; the reconnect resumes at its offset
+                    state["tripped"] = True
+                    sh._sock.close()
+                    raise ConnectionError("injected mid-segment cut")
+            orig(buf)
+
+        sh._send = tripping
+
+    _run_pair(tmp_path / "stage", journal=j, rstats=rstats,
+              sstats=sstats, cfg_extra={"chunk_bytes": 4096},
+              prep=prep)
+    _landed_identical(tmp_path / "wal", tmp_path / "stage", 1, [upto])
+    c = rstats.snapshot()
+    assert c["ship_shipped_segments"] == upto
+    assert c["ship_resumes"] >= 1
+    assert c["ship_reconnects|shipper=s1"] >= 1     # same-token resume
+    assert c.get("ship_epochs|shipper=s1", 0) == 0  # NOT an epoch
+    assert sstats.snapshot()["ship_resumed_bytes"] > 0
+    j.close()
+
+
+def test_wire_corruption_rejected_then_reshipped(tmp_path):
+    j = _mk_flat(tmp_path / "wal")
+    upto = j.sealed_upto()
+    rstats, sstats = Stats(), Stats()
+
+    def prep(sh):
+        orig = sh._send
+        state = {"done": False}
+
+        def corrupting(buf):
+            ftype = SP._FH.unpack_from(buf, 0)[1]
+            if ftype == SP.T_SDATA and not state["done"]:
+                state["done"] = True
+                i = SP._FH.size
+                buf = buf[:i] + bytes([buf[i] ^ 0xFF]) + buf[i + 1:]
+            orig(buf)
+
+        sh._send = corrupting
+
+    _run_pair(tmp_path / "stage", journal=j, rstats=rstats,
+              sstats=sstats, prep=prep)
+    # the corrupted transfer was discarded (never visible to the
+    # compactor), counted, and the re-ship landed the true bytes
+    _landed_identical(tmp_path / "wal", tmp_path / "stage", 1, [upto])
+    c = rstats.snapshot()
+    assert c["ship_hash_mismatches"] >= 1
+    assert c["ship_shipped_segments"] == upto
+    assert sstats.snapshot()["ship_hash_retries"] >= 1
+    j.close()
+
+
+def test_receiver_restart_sweeps_partials_rederives_ledger(tmp_path):
+    j = _mk_sharded(tmp_path / "wal")
+    upto = j.sealed_upto()
+    want = sum(upto)
+    r1 = Stats()
+    _run_pair(tmp_path / "stage", journal=j, rstats=r1)
+    assert r1.snapshot()["ship_shipped_segments"] == want
+    # a torn receiver-side partial left by a crash...
+    stray = (tmp_path / "stage" / "shard_00"
+             / SP._PART_FMT.format(999))
+    stray.write_bytes(b"torn")
+    # ...restart: partial swept (counted), global counters re-derived
+    # from the ledger alone, and a fresh shipper run (new token — a
+    # true restart) answers "done" for every key without re-landing
+    r2 = Stats()
+    _run_pair(tmp_path / "stage", journal=j, rstats=r2)
+    assert not stray.exists()
+    c = r2.snapshot()
+    assert c["ship_partials_swept"] == 1
+    assert c["ship_shipped_segments"] == want       # ledger-derived
+    assert c["ship_shipped_records"] == 2000
+    assert c.get("ship_hash_mismatches", 0) == 0    # nothing re-sent
+    j.close()
+
+
+def test_staging_bound_sheds_are_counted(tmp_path):
+    # two ~700KB sealed segments against a 1MB staging bound: the
+    # first lands, the second is SHED — terminal, counted, in the
+    # ledger — and the global invariant still closes
+    j = _mk_flat(tmp_path / "wal", nrec=44, blob=1 << 15,
+                 seg_bytes=700 * 1024)
+    upto = j.sealed_upto()
+    assert upto >= 2
+    rstats, sstats = Stats(), Stats()
+    _run_pair(tmp_path / "stage", journal=j, rstats=rstats,
+              sstats=sstats, renv={"GYT_SHIP_STAGE_MB": "1"})
+    c = rstats.snapshot()
+    assert c["ship_stage_sheds"] >= 1
+    assert c["ship_dropped_segments"] == c["ship_stage_sheds"]
+    assert (c["ship_shipped_segments"] + c["ship_dropped_segments"]
+            == upto == c["ship_sealed_segments|shipper=s1"])
+    entries = [json.loads(ln) for ln in
+               (tmp_path / "stage" / LEDGER_NAME).read_bytes()
+               .splitlines() if b'"k"' in ln]
+    assert any(e["status"] == "shed" for e in entries)
+    j.close()
+
+
+def test_source_shed_announces_counted_drops(tmp_path):
+    # a receiver outage longer than the pin bound: the shipper sheds
+    # its oldest unshipped segments as announced permanent T_SDROPs —
+    # counted at both ends, never silence
+    j = _mk_flat(tmp_path / "wal")
+    j.close()
+    nsegs = len(J.dir_segments(tmp_path / "wal"))
+    rstats, sstats = Stats(), Stats()
+    _run_pair(tmp_path / "stage", wal_dir=tmp_path / "wal",
+              rstats=rstats, sstats=sstats,
+              cfg_extra={"pin_bytes": 1},
+              prep=lambda sh: setattr(sh, "_ship_one",
+                                      lambda s, q, p: False))
+    c = rstats.snapshot()
+    assert c["ship_dropped_segments"] == nsegs
+    assert c.get("ship_shipped_segments", 0) == 0
+    assert sstats.snapshot()["ship_dropped_segments"] == nsegs
+    entries = [json.loads(ln) for ln in
+               (tmp_path / "stage" / LEDGER_NAME).read_bytes()
+               .splitlines() if b'"k"' in ln]
+    assert all(e["reason"] == "source_shed" for e in entries)
+
+
+def test_epoch_bump_owner_binding_and_staging_sweep(tmp_path):
+    j = _mk_sharded(tmp_path / "wal")
+    upto = j.sealed_upto()
+    want = sum(upto)
+    rstats = Stats()
+
+    async def go():
+        rcv = SegmentReceiver(tmp_path / "stage", stats=rstats,
+                              host="127.0.0.1")
+        h, p = await rcv.start()
+
+        def ship(sid):
+            sh = SegmentShipper({"target": (h, p), "shipper_id": sid,
+                                 "journal": j, "stats": Stats(),
+                                 "scan_s": 0.05, "once": True})
+            t = threading.Thread(target=sh.ship_once, daemon=True)
+            t.start()
+            return sh, t
+
+        sh1, t1 = ship("s1")
+        while t1.is_alive():
+            await asyncio.sleep(0.02)
+        t1.join(5.0)
+        # run 2, SAME id, NEW token = a restarted shipper process:
+        # epoch boundary, every key answers "done" from the ledger
+        sh2, t2 = ship("s1")
+        while t2.is_alive():
+            await asyncio.sleep(0.02)
+        t2.join(5.0)
+        c = rstats.snapshot()
+        assert c["ship_epochs|shipper=s1"] == 1
+        assert c["ship_shipped_segments"] == want   # no double-land
+        # a DIFFERENT shipper id is refused: one source region owns a
+        # staging dir (shard/seq must stay collision-free)
+        sh3 = SegmentShipper({"target": (h, p), "shipper_id": "other",
+                              "journal": j, "stats": Stats()})
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, sh3._connect)
+        assert not ok
+        assert rstats.snapshot()["ship_hello_refused"] >= 1
+        # compaction-floor sweep reclaims landed staging, the ledger
+        # keeps answering "done" for swept keys
+        n = rcv.sweep_below(list(upto))
+        assert n == want
+        assert rstats.snapshot()["ship_staged_swept"] == want
+        sh4, t4 = ship("s1")
+        while t4.is_alive():
+            await asyncio.sleep(0.02)
+        t4.join(5.0)
+        assert rstats.snapshot()["ship_shipped_segments"] == want
+        await rcv.stop()
+
+    asyncio.run(go())
+    j.close()
+
+
+def test_compact_list_renders_ship_provenance(tmp_path, capsys):
+    j = _mk_sharded(tmp_path / "wal")
+    want = sum(j.sealed_upto())
+    _run_pair(tmp_path / "stage", journal=j)
+    j.close()
+    from gyeeta_tpu.cli import _cmd_compact
+    (tmp_path / "parts").mkdir()
+    _cmd_compact(["list", "--shard-dir", str(tmp_path / "parts"),
+                  "--journal-dir", str(tmp_path / "stage")])
+    out = json.loads(capsys.readouterr().out)
+    segs = out["shipped_segments"]
+    assert len(segs) == want
+    for e in segs:
+        assert e["status"] == "landed"
+        assert len(e["hash"]) == 64
+        assert e["src_shipper"] == "s1"
+        assert e["src_epoch"] == 0
+        assert e["segment"].count("/") == 1
+
+
+def test_floor_pins_source_truncation_until_landed(tmp_path):
+    # end-to-end floor contract: before shipping, the ship floor pins
+    # checkpoint truncation at 0; after landing, truncation releases
+    j = _mk_flat(tmp_path / "wal")
+    upto = j.sealed_upto()
+    newest = j.position()[0]
+    rstats = Stats()
+
+    # a shipper that CANNOT reach its receiver still registers the
+    # floor from its scan loop (no uplink required to pin)
+    sh = SegmentShipper({"target": ("127.0.0.1", 1), "journal": j,
+                         "shipper_id": "s1", "stats": Stats()})
+    sh._advance_floor()
+    j.set_truncate_floor(newest, name="compact")
+    assert j.truncate_upto(newest) == 0             # all pinned
+    assert set(J.dir_segments(tmp_path / "wal")) >= set(range(upto))
+
+    _run_pair(tmp_path / "stage", journal=j, rstats=rstats)
+    assert rstats.snapshot()["ship_shipped_segments"] == upto
+    assert j.truncate_upto(newest) == upto          # released
+    j.close()
+
+
+def test_seg_info_matches_receiver_hash(tmp_path):
+    j = _mk_flat(tmp_path / "wal", nrec=10)
+    j.close()
+    segs = J.dir_segments(tmp_path / "wal")
+    p = tmp_path / "wal" / J._SEG_FMT.format(segs[0])
+    size, digest, nrec = seg_info(p)
+    assert size == p.stat().st_size
+    assert digest == SP.seg_hash(p)
+    assert nrec > 0
